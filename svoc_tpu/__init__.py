@@ -1,0 +1,32 @@
+"""svoc_tpu — TPU-native Stochastic-Vector-Oracle-Consensus framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of the reference project
+Ophiase/Stochastic-Vector-Oracle-Consensus (mounted read-only at
+/root/reference): on-chain-style robust consensus over N stochastic oracle
+prediction vectors, a sentiment-transformer oracle model, failing-oracle
+injection/detection/masking, admin replacement voting, Monte-Carlo
+statistical benchmarking — re-designed TPU-first:
+
+- the consensus math is a single fused, jittable XLA graph over fixed
+  shapes (masks instead of dynamic filtering) — ``svoc_tpu.consensus``;
+- the oracle fleet is ``vmap``-ed and shardable over a device mesh via
+  ``shard_map`` with ICI collectives — ``svoc_tpu.parallel``;
+- sentiment inference is a batched bf16 Flax transformer on the MXU —
+  ``svoc_tpu.models``;
+- a bit-faithful fixed-point ("wsad") engine mirrors the reference Cairo
+  contract for parity testing and on-chain encoding — ``svoc_tpu.ops.
+  fixedpoint`` / ``svoc_tpu.consensus.wsad_engine``.
+
+Layer map (mirrors SURVEY.md §7 build plan):
+
+    ops/        fixed-point codec, vectorized stats kernels, indexed sort
+    consensus/  two-pass consensus kernel + stateful contract simulator
+    sim/        oracle fleet generators, bootstrap model, Monte-Carlo bench
+    models/     Flax RoBERTa-style go_emotions classifier + pipeline
+    parallel/   mesh / sharding / collective layer (new TPU capability)
+    train/      fine-tuning trainer (optax) + checkpointing (orbax)
+    io/         sqlite comment ingest, HN scraper, Starknet chain adapter
+    apps/       command API + CLI reproducing the reference client
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+"""Two-pass robust consensus: fused XLA kernel + faithful contract simulator."""
+
+from svoc_tpu.consensus.kernel import (  # noqa: F401
+    ConsensusConfig,
+    ConsensusOutput,
+    consensus_step,
+    consensus_step_batched,
+)
+from svoc_tpu.consensus.state import OracleConsensusContract  # noqa: F401

@@ -1,0 +1,131 @@
+"""The two-pass consensus as one fused, jittable XLA graph.
+
+Reference semantics: ``update_constrained_consensus``
+(``contract/src/contract.cairo:442-503``) and
+``update_unconstrained_consensus`` (``contract.cairo:370-434``):
+
+1. FIRST PASS over all N oracles — essence₁ = component-wise smooth
+   median; per-oracle quadratic risk vs essence₁; first-pass
+   reliability; rank by risk and mark the worst ``n_failing`` oracles
+   unreliable (``contract.cairo:345-363``).
+2. SECOND PASS over the reliable subset — essence = smooth median
+   (constrained) or mean (unconstrained); second-pass reliability with
+   risk still centered on **essence₁** (a reference quirk:
+   ``contract.cairo:414`` and ``:484``); component-wise skewness and
+   kurtosis of the reliable subset (``contract.cairo:491-500``).
+
+Reliability estimators (``documentation/README.md:116-150``):
+
+- constrained: ``1 − 2·sqrt(mean(qr)/M)`` (``contract.cairo:436-439``)
+- unconstrained: ``1 − min(ms, sqrt(mean(qr)))/ms`` with max-spread
+  ``ms`` (``contract.cairo:365-368``)
+
+The whole computation is fixed-shape: the second pass uses a boolean
+reliability mask rather than dynamic filtering, so the graph vmaps over
+Monte-Carlo batches and shard_maps over an oracle-sharded device mesh
+(:mod:`svoc_tpu.parallel`) unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from svoc_tpu.ops import sort as sort_ops
+from svoc_tpu.ops import stats
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsensusConfig:
+    """Static consensus parameters (the contract's constructor calldata,
+    ``contract.cairo:236-265``, minus admin/oracle identities)."""
+
+    n_failing: int = 2
+    constrained: bool = True
+    #: Unconstrained max spread ``ms`` in real units (wsad/1e6).
+    max_spread: float = 10.0
+    #: "cairo" replicates the reference's degenerate smooth median
+    #: (mean of sorted[m/2-1], sorted[m/2]); "true" is the proper median.
+    smooth_mode: str = "cairo"
+
+
+class ConsensusOutput(NamedTuple):
+    essence: jnp.ndarray  # [M] second-pass consensus value
+    essence_first_pass: jnp.ndarray  # [M]
+    reliability_first_pass: jnp.ndarray  # scalar
+    reliability_second_pass: jnp.ndarray  # scalar
+    reliable: jnp.ndarray  # [N] bool — passes the consensus
+    quadratic_risk: jnp.ndarray  # [N] first-pass risk vs essence₁
+    skewness: jnp.ndarray  # [M]
+    kurtosis: jnp.ndarray  # [M]
+    interval_valid: jnp.ndarray  # scalar bool — reliabilities ∈ [0,1]
+
+
+def _reliability(cfg: ConsensusConfig, mean_qr: jnp.ndarray, dim: int) -> jnp.ndarray:
+    if cfg.constrained:
+        return 1.0 - 2.0 * jnp.sqrt(mean_qr / dim)
+    u = jnp.sqrt(mean_qr)
+    ms = cfg.max_spread
+    return 1.0 - jnp.minimum(ms, u) / ms
+
+
+def consensus_step(values: jnp.ndarray, cfg: ConsensusConfig) -> ConsensusOutput:
+    """Run the full two-pass consensus on an oracle block ``values [N, M]``.
+
+    Assumes every oracle has committed (the contract's activation gate,
+    ``contract.cairo:447-449``, lives in the stateful wrapper).
+    """
+    n, dim = values.shape
+    all_mask = jnp.ones(n, dtype=bool)
+
+    # ---- FIRST PASS (contract.cairo:450-470) ----
+    essence1 = stats.masked_smooth_median(values, all_mask, cfg.smooth_mode)
+    qr = stats.quadratic_risk(values, essence1)
+    rel1 = _reliability(cfg, jnp.mean(qr), dim)
+    reliable = sort_ops.reliability_mask(qr, cfg.n_failing)
+
+    # ---- SECOND PASS (contract.cairo:476-489) ----
+    if cfg.constrained:
+        essence2 = stats.masked_smooth_median(values, reliable, cfg.smooth_mode)
+    else:
+        essence2 = stats.masked_mean(values, reliable)
+    # Second-pass risk is centered on essence₁, not essence₂ — reference
+    # quirk at contract.cairo:414/:484, reproduced deliberately — so the
+    # first-pass risks are reused verbatim, only the mean is re-masked.
+    rel2 = _reliability(cfg, stats.masked_scalar_mean(qr, reliable), dim)
+
+    # ---- MOMENTS of the reliable subset (contract.cairo:491-500) ----
+    means = stats.masked_mean(values, reliable)
+    variances = stats.masked_component_variance(values, reliable, means)
+    skew = stats.masked_skewness(values, reliable, means, variances)
+    kurt = stats.masked_kurtosis(values, reliable, means, variances)
+
+    valid = jnp.logical_and(stats.interval_ok(rel1), stats.interval_ok(rel2))
+
+    return ConsensusOutput(
+        essence=essence2,
+        essence_first_pass=essence1,
+        reliability_first_pass=rel1,
+        reliability_second_pass=rel2,
+        reliable=reliable,
+        quadratic_risk=qr,
+        skewness=skew,
+        kurtosis=kurt,
+        interval_valid=valid,
+    )
+
+
+def consensus_step_batched(
+    values: jnp.ndarray, cfg: ConsensusConfig
+) -> ConsensusOutput:
+    """vmap of :func:`consensus_step` over a leading batch axis ``[B, N, M]``
+    — the Monte-Carlo / multi-window form."""
+    return jax.vmap(lambda v: consensus_step(v, cfg))(values)
+
+
+def jit_consensus(cfg: ConsensusConfig):
+    """Return a jitted single-block consensus closure for ``cfg``."""
+    return jax.jit(lambda v: consensus_step(v, cfg))

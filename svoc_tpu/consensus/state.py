@@ -1,0 +1,302 @@
+"""Stateful oracle-consensus contract simulator.
+
+Replaces the reference's Starknet test-VM harness (deploy +
+``set_contract_address`` impersonation, ``contract/tests/
+test_contract.cairo:52-113``) with a pure-Python state machine whose
+every transition matches ``contract/src/contract.cairo``:
+
+- constructor calldata layout (``contract.cairo:236-265``),
+- per-oracle prediction updates with the activation gate — the
+  consensus is recomputed only once **all** oracles have committed at
+  least once, then on every subsequent commit
+  (``contract.cairo:331-343`` + ``:447-449``),
+- constrained input interval check (``contract.cairo:589-593``),
+- caller access control ('not an oracle' / 'not an admin' / 'not
+  admin' asserts at ``contract.cairo:596``, ``:667``, ``:727``,
+  ``:775``),
+- the admin replacement-vote machinery: A×A vote matrix, proposition
+  reset rules, majority check and in-place oracle address swap
+  (``contract.cairo:547-580``, ``:661-738``; spec at
+  ``documentation/README.md:152-175``).
+
+Every caller is an opaque address (any hashable value — ints or
+strings play the role of the test's short-string felts).  Numeric state
+is exact wsad integers via :mod:`svoc_tpu.consensus.wsad_engine`; use
+``as_floats=True`` getters for real-valued views.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Hashable, List, Optional, Sequence, Tuple
+
+from svoc_tpu.consensus import wsad_engine as eng
+from svoc_tpu.ops.fixedpoint import WSAD, felt_to_wsad, from_wsad, to_wsad
+
+Address = Hashable
+Proposition = Optional[Tuple[int, Address]]
+
+
+class ContractError(AssertionError):
+    """A failed contract assert (the Cairo short-string panic message)."""
+
+
+@dataclass
+class OracleInfo:
+    """``OracleInfo`` storage struct (``contract.cairo:73-78``)."""
+
+    address: Address
+    enabled: bool = False  # has a value?
+    reliable: bool = True  # passes the consensus?
+    value: List[int] = field(default_factory=list)  # wsad vector
+
+
+class OracleConsensusContract:
+    """In-memory ``OracleConsensusNDS`` (``contract.cairo:38-832``)."""
+
+    def __init__(
+        self,
+        admins: Sequence[Address],
+        oracles: Sequence[Address],
+        *,
+        enable_oracle_replacement: bool = True,
+        required_majority: int = 2,
+        n_failing_oracles: int = 2,
+        constrained: bool = True,
+        unconstrained_max_spread: float = 0.0,
+        dimension: int = 2,
+        strict_interval: bool = True,
+    ):
+        self.admins = list(admins)
+        self.oracles = [
+            OracleInfo(address=a, value=[0] * dimension) for a in oracles
+        ]
+        self.enable_oracle_replacement = enable_oracle_replacement
+        self.required_majority = required_majority
+        self.n_failing_oracles = n_failing_oracles
+        self.constrained = constrained
+        self.unconstrained_max_spread = to_wsad(unconstrained_max_spread)
+        self.dimension = dimension
+        self.strict_interval = strict_interval
+
+        self.n_active_oracles = 0
+        self.consensus_active = False
+        self.consensus_value: List[int] = [0] * dimension
+        self.reliability_first_pass = 0
+        self.reliability_second_pass = 0
+        self.skewness: List[int] = [0] * dimension
+        self.kurtosis: List[int] = [0] * dimension
+
+        n_admins = len(self.admins)
+        self.vote_matrix: Dict[Tuple[int, int], bool] = {
+            (i, j): False for i in range(n_admins) for j in range(n_admins)
+        }
+        self.replacement_propositions: List[Proposition] = [None] * n_admins
+
+    # -- lookup helpers (contract.cairo:505-540) ---------------------------
+
+    def _find_oracle_index(self, address: Address) -> Optional[int]:
+        for i, o in enumerate(self.oracles):
+            if o.address == address:
+                return i
+        return None
+
+    def _find_admin_index(self, address: Address) -> Optional[int]:
+        for i, a in enumerate(self.admins):
+            if a == address:
+                return i
+        return None
+
+    def _require_admin(self, caller: Address) -> int:
+        idx = self._find_admin_index(caller)
+        if idx is None:
+            raise ContractError("not an admin")
+        return idx
+
+    # -- prediction path (contract.cairo:588-603) --------------------------
+
+    def update_prediction(
+        self, caller: Address, prediction: Sequence, *, encoding: str = "float"
+    ) -> None:
+        """Commit one oracle's prediction vector.
+
+        ``encoding``: "float" (real units), "wsad" (scaled ints), or
+        "felt" (felt252 two's-complement calldata as sent on chain).
+        """
+        if encoding == "float":
+            wsad_pred = [to_wsad(float(x)) for x in prediction]
+        elif encoding == "wsad":
+            wsad_pred = [int(x) for x in prediction]
+        elif encoding == "felt":
+            wsad_pred = [felt_to_wsad(int(x)) for x in prediction]
+        else:
+            raise ValueError(f"unknown encoding {encoding!r}")
+
+        if len(wsad_pred) != self.dimension:
+            raise ContractError("wrong dimension")
+        if self.constrained:
+            eng.nd_interval_check(wsad_pred)
+
+        idx = self._find_oracle_index(caller)
+        if idx is None:
+            raise ContractError("not an oracle")
+        self._update_consensus(idx, wsad_pred)
+
+    def _update_consensus(self, oracle_index: int, prediction: List[int]) -> None:
+        # update_a_single_oracle (contract.cairo:331-343)
+        info = self.oracles[oracle_index]
+        prev = (info.enabled, info.value, self.n_active_oracles)
+        if not info.enabled:
+            self.n_active_oracles += 1
+        info.enabled = True
+        info.value = list(prediction)
+
+        # activation gate (contract.cairo:447-449 / :375-377)
+        if self.n_active_oracles != len(self.oracles):
+            return
+
+        values = [o.value for o in self.oracles]
+        try:
+            result = eng.two_pass_consensus(
+                values,
+                constrained=self.constrained,
+                n_failing=self.n_failing_oracles,
+                max_spread=self.unconstrained_max_spread,
+                strict_interval=self.strict_interval,
+            )
+        except eng.IntervalError:
+            # A Cairo panic reverts the whole transaction, including the
+            # single-oracle update above — restore it before re-raising.
+            info.enabled, info.value, self.n_active_oracles = prev
+            raise
+        for o, ok in zip(self.oracles, result["reliable"]):
+            o.reliable = ok
+        self.consensus_value = result["essence"]
+        self.reliability_first_pass = result["reliability_first_pass"]
+        self.reliability_second_pass = result["reliability_second_pass"]
+        self.skewness = result["skewness"]
+        self.kurtosis = result["kurtosis"]
+        self.consensus_active = True
+
+    # -- replacement votes (contract.cairo:547-580, :661-738) --------------
+
+    def update_proposition(self, caller: Address, proposition: Proposition) -> None:
+        if not self.enable_oracle_replacement:
+            raise ContractError("replacement disabled")
+        admin_index = self._require_admin(caller)
+
+        if proposition is None:
+            self.replacement_propositions[admin_index] = None
+            return
+
+        old_oracle_index, new_oracle_address = proposition
+        if not (0 <= old_oracle_index < len(self.oracles)):
+            raise ContractError("wrong old oracle index")
+        if self._find_oracle_index(new_oracle_address) is not None:
+            raise ContractError("the oracle is already in the team")
+
+        # Changing a proposition forfeits collected votes, then self-vote
+        # (contract.cairo:687-712).
+        for i in range(len(self.admins)):
+            self.vote_matrix[(i, admin_index)] = False
+        self.vote_matrix[(admin_index, admin_index)] = True
+        self.replacement_propositions[admin_index] = (
+            old_oracle_index,
+            new_oracle_address,
+        )
+
+    def vote_for_a_proposition(
+        self, caller: Address, which_admin: int, support: bool
+    ) -> None:
+        if not self.enable_oracle_replacement:
+            raise ContractError("replacement disabled")
+        voter_index = self._require_admin(caller)
+        self.vote_matrix[(voter_index, which_admin)] = support
+        self._check_for_replacement(which_admin)
+
+    def _check_for_replacement(self, which_proposition: int) -> None:
+        # Cairo's vote matrix is a LegacyMap with default-false reads, so
+        # an out-of-range target column just counts the single vote that
+        # was written (contract.cairo:549-564) — .get mirrors that.
+        n_admins = len(self.admins)
+        n_votes = sum(
+            1
+            for i in range(n_admins)
+            if self.vote_matrix.get((i, which_proposition), False)
+        )
+        if self.required_majority > n_votes:
+            return
+        # LegacyMap<usize, Option> reads default to None out of range;
+        # guard against Python negative-index wrap-around too.
+        proposition = (
+            self.replacement_propositions[which_proposition]
+            if 0 <= which_proposition < n_admins
+            else None
+        )
+        # Cairo unwraps unconditionally (contract.cairo:572) — voting a
+        # majority onto an empty proposition panics there too.
+        if proposition is None:
+            raise ContractError("Option::unwrap failed")
+        which_oracle, new_address = proposition
+        # Only the address is swapped; enabled/reliable/value persist
+        # (contract.cairo:573-576).
+        self.oracles[which_oracle].address = new_address
+        self.replacement_propositions = [None] * n_admins
+        self.vote_matrix = {
+            (i, j): False for i in range(n_admins) for j in range(n_admins)
+        }
+
+    # -- getters (contract.cairo:605-830) ----------------------------------
+
+    def get_consensus_value(self, as_floats: bool = False):
+        v = list(self.consensus_value)
+        return [from_wsad(x) for x in v] if as_floats else v
+
+    def get_first_pass_consensus_reliability(self, as_floats: bool = False):
+        r = self.reliability_first_pass
+        return from_wsad(r) if as_floats else r
+
+    def get_second_pass_consensus_reliability(self, as_floats: bool = False):
+        r = self.reliability_second_pass
+        return from_wsad(r) if as_floats else r
+
+    def get_skewness(self, as_floats: bool = False):
+        return [from_wsad(x) for x in self.skewness] if as_floats else list(
+            self.skewness
+        )
+
+    def get_kurtosis(self, as_floats: bool = False):
+        return [from_wsad(x) for x in self.kurtosis] if as_floats else list(
+            self.kurtosis
+        )
+
+    def get_admin_list(self) -> List[Address]:
+        return list(self.admins)
+
+    def get_oracle_list(self) -> List[Address]:
+        return [o.address for o in self.oracles]
+
+    def get_oracle_value_list(self, caller: Address):
+        """Admin-only raw dump (``contract.cairo:772-798``)."""
+        if self._find_admin_index(caller) is None:
+            raise ContractError("not admin")
+        return [
+            (o.address, list(o.value), o.enabled, o.reliable) for o in self.oracles
+        ]
+
+    def get_replacement_propositions(self) -> List[Proposition]:
+        if not self.enable_oracle_replacement:
+            raise ContractError("replacement disabled")
+        return list(self.replacement_propositions)
+
+    def get_a_specific_proposition(self, which_admin: int) -> Proposition:
+        if not self.enable_oracle_replacement:
+            raise ContractError("replacement disabled")
+        return self.replacement_propositions[which_admin]
+
+    def get_predictions_dimension(self) -> int:
+        return self.dimension
+
+    @property
+    def wsad(self) -> int:
+        return WSAD

@@ -1,0 +1,3 @@
+"""Numeric primitives: fixed-point codec, vectorized statistics, indexed sort."""
+
+from svoc_tpu.ops import fixedpoint, sort, stats  # noqa: F401

@@ -1,0 +1,146 @@
+"""Signed fixed-point ("wsad") numerics and felt252 codec.
+
+The reference stores every statistical quantity on chain as an ``i128``
+scaled by 1e6 — the "wsad" convention (reference:
+``contract/src/signed_decimal.cairo:82-116``; the scale is 1e6 rather
+than the EVM-style 1e18 because of the i128 range, see
+``contract/README.md:89-93``).  Chain I/O additionally wraps negative
+values around the felt252 prime in two's-complement style
+(``client/contract.py:35-53``).
+
+This module provides the host-side, arbitrary-precision (Python int)
+implementation.  It is the *golden* arithmetic used by the faithful
+contract simulator (:mod:`svoc_tpu.consensus.wsad_engine`) for
+bit-parity with the Cairo contract, and the codec used when committing
+predictions on chain.  The TPU fast path works in float32/bfloat16 and
+only quantizes at the boundary (:func:`quantize` / :func:`to_wsad`).
+
+Cairo semantics that matter for parity:
+
+- ``i128`` division truncates toward zero (sign-magnitude division,
+  ``signed_decimal.cairo:52-63``) — unlike Python's floor division.
+- ``wsad_mul(a, b) = (a*b + HALF_WSAD) / WSAD`` (``:110-112``) — the
+  rounding bias is *always* +0.5 wsad, even for negative products, then
+  truncated toward zero.
+- ``wsad_div(a, b) = (a*WSAD + b/2) / b`` (``:114-116``).
+- ``sqrt`` is Newton iteration with initial guess ``value/2``, stopping
+  on a fixed point or after 50 iterations (``math.cairo:271-292``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+WSAD: int = 1_000_000
+HALF_WSAD: int = 500_000
+
+#: Starknet field prime (felt252 modulus), used for two's-complement
+#: encoding of negative wsad values (client/contract.py:35).
+FELT_PRIME: int = (
+    3618502788666131213697322783095070105623107215331596699973092056135872020481
+)
+#: Largest value decoded as positive (client/contract.py:36).
+I128_MAX: int = 2**127 - 1
+
+MAX_SQRT_ITERATIONS: int = 50
+
+
+def div_trunc(a: int, b: int) -> int:
+    """Cairo ``I128Div``: sign-magnitude division, truncating toward zero.
+
+    Mirrors ``signed_decimal.cairo:52-63`` (unsigned divide of absolute
+    values, sign re-applied).
+    """
+    if b == 0:
+        raise ZeroDivisionError("i128 division by zero")
+    q = abs(a) // abs(b)
+    return q if (a >= 0) == (b >= 0) else -q
+
+
+def wsad_mul(a: int, b: int) -> int:
+    """Rounded fixed-point multiply (``signed_decimal.cairo:110-112``)."""
+    return div_trunc(a * b + HALF_WSAD, WSAD)
+
+
+def wsad_div(a: int, b: int) -> int:
+    """Rounded fixed-point divide (``signed_decimal.cairo:114-116``)."""
+    return div_trunc(a * WSAD + div_trunc(b, 2), b)
+
+
+def wsad_sqrt(value: int) -> int:
+    """Newton square root in wsad, 50-iteration cap (``math.cairo:271-292``)."""
+    if value == 0:
+        return 0
+    g = div_trunc(value, 2)
+    g2 = g + WSAD
+    i = 0
+    while g != g2 and i < MAX_SQRT_ITERATIONS:
+        n = wsad_div(value, g)
+        g2 = g
+        g = div_trunc(g + n, 2)
+        i += 1
+    return g
+
+
+def to_wsad(x: float) -> int:
+    """Float → wsad, truncating like the reference's ``int(x*1e6)``.
+
+    Matches both the client encoder (``client/contract.py:48-49``) and
+    the notebook fixture generator ``to_wsad`` that produced the Cairo
+    test vectors.
+    """
+    return int(x * 1e6)
+
+
+def from_wsad(x: int) -> float:
+    """wsad → float (``client/contract.py:41-45`` scale factor)."""
+    return float(x) * 1e-6
+
+
+def float_to_fwsad(x: float) -> int:
+    """Float → felt252-encoded wsad (``client/contract.py:48-53``)."""
+    as_wsad = to_wsad(x)
+    return as_wsad + FELT_PRIME if as_wsad < 0 else as_wsad
+
+
+def fwsad_to_float(x: int) -> float:
+    """felt252-encoded wsad → float (``client/contract.py:41-45``)."""
+    return float(x - FELT_PRIME if x > I128_MAX else x) * 1e-6
+
+
+def wsad_to_felt(x: int) -> int:
+    """Signed wsad int → felt252 (``signed_decimal.cairo:26-28`` via felt cast)."""
+    return x % FELT_PRIME
+
+
+def felt_to_wsad(x: int) -> int:
+    """felt252 → signed wsad int (two's complement around the prime)."""
+    return x - FELT_PRIME if x > I128_MAX else x
+
+
+# ---------------------------------------------------------------------------
+# Array helpers (host-side, vectorized over numpy object/int64 arrays).
+# ---------------------------------------------------------------------------
+
+
+def encode_vector(xs) -> list[int]:
+    """Float vector → list of felt252-encoded wsad ints (chain calldata)."""
+    return [float_to_fwsad(float(x)) for x in np.asarray(xs).ravel()]
+
+
+def decode_vector(felts) -> np.ndarray:
+    """felt252 calldata → float vector."""
+    return np.array([fwsad_to_float(int(f)) for f in felts], dtype=np.float64)
+
+
+def quantize(x, scale: float = 1e6):
+    """Quantize a float array onto the wsad grid, truncating toward zero.
+
+    Device-friendly analogue of :func:`to_wsad` for the fast float path:
+    ``trunc(x * 1e6) / 1e6``.  Works on numpy and jax arrays alike.
+    """
+    import jax.numpy as jnp
+
+    if isinstance(x, np.ndarray) or np.isscalar(x):
+        return np.trunc(np.asarray(x) * scale) / scale
+    return jnp.trunc(x * scale) / scale

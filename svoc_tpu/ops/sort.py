@@ -1,0 +1,87 @@
+"""Indexed sort with the reference contract's exact tie semantics.
+
+The Cairo contract ranks oracles by quadratic risk with an indexed merge
+sort (``contract/src/sort.cairo:13-103``) whose merge step takes the
+*right* element on ties (``sort.cairo:96-101``: ``if left < right`` take
+left, else take right).  Applied recursively, equal values therefore come
+out ordered by **descending original index**.  The top
+``n_oracles - n_failing`` entries of this ordering are marked reliable
+(``contract/src/contract.cairo:345-363``), so tie order can decide which
+oracle gets masked — it must be reproduced exactly.
+
+Two implementations:
+
+- :func:`indexed_sort_host` — literal recursive merge sort on Python
+  ints (golden path, used by the faithful wsad engine).
+- :func:`argsort_cairo` — jit-friendly equivalent: a lexsort on
+  ``(value asc, index desc)``, proven equal to the merge sort by the
+  property above (exhaustively tested against the host version in
+  ``tests/test_sort.py``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+def indexed_sort_host(values: Sequence[int]) -> List[Tuple[int, int]]:
+    """Exact replica of ``IndexedMergeSort::sort`` (``sort.cairo:13-17``).
+
+    Returns ``(original_index, value)`` pairs sorted ascending by value,
+    ties broken like the Cairo merge (right half first).
+    """
+    arr = list(enumerate(values))
+
+    def sort_aux(a: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+        if len(a) <= 1:
+            return list(a)
+        middle = len(a) // 2
+        left = sort_aux(a[:middle])
+        right = sort_aux(a[middle:])
+        out: List[Tuple[int, int]] = []
+        li = ri = 0
+        while len(out) < len(left) + len(right):
+            if li == len(left):
+                out.append(right[ri])
+                ri += 1
+            elif ri == len(right):
+                out.append(left[li])
+                li += 1
+            elif left[li][1] < right[ri][1]:
+                out.append(left[li])
+                li += 1
+            else:
+                out.append(right[ri])
+                ri += 1
+        return out
+
+    return sort_aux(arr)
+
+
+def argsort_cairo(values: jnp.ndarray) -> jnp.ndarray:
+    """Jittable argsort matching the contract's tie order.
+
+    ``values``: 1-D array.  Returns the permutation such that
+    ``values[perm]`` is ascending with ties in descending-index order —
+    identical to the index column of :func:`indexed_sort_host`.
+    """
+    n = values.shape[0]
+    neg_idx = -jnp.arange(n)
+    # lexsort: last key is primary.
+    return jnp.lexsort((neg_idx, values))
+
+
+def reliability_mask(risk: jnp.ndarray, n_failing) -> jnp.ndarray:
+    """Boolean mask of oracles that *pass* the consensus.
+
+    Mirrors ``update_oracles_reliability`` (``contract.cairo:345-363``):
+    after ranking by risk ascending (Cairo tie order), the first
+    ``n - n_failing`` oracles are reliable, the worst ``n_failing`` are
+    masked out.  ``n_failing`` may be a traced scalar.
+    """
+    n = risk.shape[0]
+    order = argsort_cairo(risk)
+    rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    return rank < (n - n_failing)

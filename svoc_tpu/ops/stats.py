@@ -1,0 +1,177 @@
+"""Vectorized statistical kernels — the TPU fast path of ``math.cairo``.
+
+Every function here is pure, fixed-shape, and jit/vmap/shard_map
+friendly.  The reference computes these quantities with dynamic arrays
+and per-element Cairo loops (``contract/src/math.cairo``); XLA cannot
+(and should not) express dynamic filtering, so the second consensus pass
+works on the *full* ``[N, M]`` oracle block with a boolean reliability
+mask, exactly matching the semantics of
+``compute_oracle_values(only_reliable=true)``
+(``contract/src/contract.cairo:310-329``).
+
+Masked reductions use +inf sentinels for sorts and count-aware indices,
+so masked entries can never poison a median.
+
+Parity notes (all reproduced here, flag-gated):
+
+- Cairo's ``smooth_median`` (``math.cairo:113-126``) contains a bug:
+  ``(len & 2) == 1`` is always false, so it *always* averages
+  ``sorted[mid-1]`` and ``sorted[mid]`` with ``mid = len/2`` — for odd N
+  this is the mean of the two values *below* the center, a slightly
+  low-biased estimator.  ``mode="cairo"`` replicates this;
+  ``mode="true"`` is the proper smooth median.
+- Cairo's ``median`` (``math.cairo:102-110``) is the upper median
+  ``sorted[len/2]``.
+- ``skewness``/``kurtosis`` are the bias-corrected sample (Fisher)
+  versions (``math.cairo:320-363``).
+- Variance is the biased mean of squared deviations
+  (``math.cairo:208-222`` divides by n via ``average``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_BIG = jnp.inf
+
+
+def _masked_sorted(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Sort each column of ``values [N, M]`` with masked rows pushed to +inf."""
+    x = jnp.where(mask[:, None], values, _BIG)
+    return jnp.sort(x, axis=0)
+
+
+def _take_row(sorted_vals: jnp.ndarray, idx) -> jnp.ndarray:
+    """Row ``idx`` (traced scalar) of a ``[N, M]`` array."""
+    n = sorted_vals.shape[0]
+    idx = jnp.clip(idx, 0, n - 1)
+    return jnp.take(sorted_vals, idx, axis=0)
+
+
+def masked_median(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Component-wise upper median over unmasked rows (``math.cairo:102-110``)."""
+    s = _masked_sorted(values, mask)
+    m = jnp.sum(mask.astype(jnp.int32))
+    return _take_row(s, m // 2)
+
+
+def masked_smooth_median(
+    values: jnp.ndarray, mask: jnp.ndarray, mode: str = "cairo"
+) -> jnp.ndarray:
+    """Component-wise smooth median over unmasked rows of ``values [N, M]``.
+
+    ``mode="cairo"`` replicates ``math.cairo:113-126`` (always the mean
+    of ``sorted[m/2 - 1]`` and ``sorted[m/2]``); ``mode="true"`` returns
+    the standard median (middle element for odd counts).
+    """
+    s = _masked_sorted(values, mask)
+    m = jnp.sum(mask.astype(jnp.int32))
+    mid = m // 2
+    a = _take_row(s, mid - 1)
+    b = _take_row(s, mid)
+    pair_mean = (a + b) / 2.0
+    if mode == "cairo":
+        return pair_mean
+    if mode == "true":
+        odd = (m % 2) == 1
+        return jnp.where(odd, b, pair_mean)
+    raise ValueError(f"unknown smooth median mode: {mode!r}")
+
+
+def quadratic_risk(values: jnp.ndarray, center: jnp.ndarray) -> jnp.ndarray:
+    """Per-oracle squared distance to ``center`` (``math.cairo:225-238``).
+
+    ``values [N, M]``, ``center [M]`` → ``[N]``.
+    """
+    d = values - center[None, :]
+    return jnp.sum(d * d, axis=-1)
+
+
+def masked_mean(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Component-wise mean over unmasked rows (``math.cairo:240-269``)."""
+    m = jnp.sum(mask.astype(values.dtype))
+    return jnp.sum(values * mask[:, None], axis=0) / jnp.maximum(m, 1.0)
+
+
+def masked_scalar_mean(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Mean of a masked 1-D array (``average``, ``math.cairo:240-254``)."""
+    m = jnp.sum(mask.astype(values.dtype))
+    return jnp.sum(values * mask) / jnp.maximum(m, 1.0)
+
+
+def masked_component_variance(
+    values: jnp.ndarray, mask: jnp.ndarray, center: jnp.ndarray
+) -> jnp.ndarray:
+    """Biased per-component variance about ``center`` (``math.cairo:208-222``)."""
+    d = (values - center[None, :]) * mask[:, None]
+    m = jnp.sum(mask.astype(values.dtype))
+    return jnp.sum(d * d, axis=0) / jnp.maximum(m, 1.0)
+
+
+def masked_skewness(
+    values: jnp.ndarray,
+    mask: jnp.ndarray,
+    mean: jnp.ndarray,
+    variance: jnp.ndarray,
+) -> jnp.ndarray:
+    """Bias-corrected component-wise skewness (``math.cairo:320-338``).
+
+    ``skew = (Σ ((x-μ)/σ)³) · n / ((n-1)(n-2))`` over unmasked rows.
+    """
+    n = jnp.sum(mask.astype(values.dtype))
+    std = jnp.sqrt(variance)
+    diff = jnp.where(
+        mask[:, None], (values - mean[None, :]) / jnp.maximum(std[None, :], 1e-30), 0.0
+    )
+    s3 = jnp.sum(diff**3, axis=0)
+    denom = jnp.maximum((n - 1.0) * (n - 2.0), 1.0)
+    return s3 * n / denom
+
+
+def masked_kurtosis(
+    values: jnp.ndarray,
+    mask: jnp.ndarray,
+    mean: jnp.ndarray,
+    variance: jnp.ndarray,
+) -> jnp.ndarray:
+    """Bias-corrected excess component-wise kurtosis (``math.cairo:340-363``).
+
+    ``kurt = (Σ d⁴ · n(n+1)/(n-1) − 3(n-1)²) / ((n-2)(n-3))``.
+    """
+    n = jnp.sum(mask.astype(values.dtype))
+    std = jnp.sqrt(variance)
+    diff = jnp.where(
+        mask[:, None], (values - mean[None, :]) / jnp.maximum(std[None, :], 1e-30), 0.0
+    )
+    s4 = jnp.sum(diff**4, axis=0)
+    term1 = s4 * n * (n + 1.0) / jnp.maximum(n - 1.0, 1.0)
+    term2 = 3.0 * (n - 1.0) ** 2
+    denom = jnp.maximum((n - 2.0) * (n - 3.0), 1.0)
+    return (term1 - term2) / denom
+
+
+def rank_array(scores: jnp.ndarray):
+    """Deviation ranking used by the client UI (``oracle_scheduler.py:94-104``).
+
+    Returns ``(normalized_ranks, ranks)`` where the *smallest* deviation
+    gets the highest rank ``n-1`` and the largest deviation rank 0 —
+    ``rank >= n_failing`` means "looks healthy"
+    (``oracle_scheduler.py:146``, ``documentation/README.md:204-209``).
+    """
+    n = scores.shape[0]
+    order = jnp.argsort(scores)  # ascending deviation
+    ranks = jnp.zeros(n, dtype=jnp.int32).at[order].set(
+        jnp.arange(n - 1, -1, -1, dtype=jnp.int32)
+    )
+    return ranks.astype(jnp.float32) / (n - 1), ranks
+
+
+def interval_ok(x) -> jnp.ndarray:
+    """Whether ``x`` lies in [0, 1] — the contract *panics* otherwise
+    (``math.cairo:294-296``, called at ``contract.cairo:396,419,467,488``).
+
+    The jittable kernel cannot raise, so it returns this as a validity
+    flag; the stateful simulator raises on it by default (faithful) or
+    clamps under ``strict_interval=False``.
+    """
+    return jnp.logical_and(jnp.all(x >= 0.0), jnp.all(x <= 1.0))

@@ -1,0 +1,15 @@
+"""Test harness config: force CPU with 8 virtual devices.
+
+Must run before the first ``import jax`` anywhere in the test session so
+the sharding tests (:mod:`tests.test_parallel`) see a multi-device mesh
+without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,74 @@
+"""Fixed-point codec parity with the reference arithmetic.
+
+Golden values follow ``contract/src/signed_decimal.cairo`` and
+``client/contract.py:35-53``.
+"""
+
+import numpy as np
+import pytest
+
+from svoc_tpu.ops import fixedpoint as fp
+
+
+def test_wsad_constants():
+    assert fp.WSAD == 1_000_000
+    assert fp.HALF_WSAD == 500_000
+
+
+def test_div_trunc_toward_zero():
+    # Cairo I128Div is sign-magnitude: -7 / 2 == -3, not Python's -4.
+    assert fp.div_trunc(7, 2) == 3
+    assert fp.div_trunc(-7, 2) == -3
+    assert fp.div_trunc(7, -2) == -3
+    assert fp.div_trunc(-7, -2) == 3
+
+
+def test_wsad_mul_rounding():
+    # (a*b + 0.5e6) / 1e6, truncating.
+    assert fp.wsad_mul(fp.WSAD, fp.WSAD) == fp.WSAD
+    assert fp.wsad_mul(500_000, 500_000) == 250_000  # 0.5*0.5
+    assert fp.wsad_mul(1, 1) == 0  # 1e-12 rounds to 0... (1+5e5)//1e6 = 0
+    assert fp.wsad_mul(1_500_000, 1_000_001) == 1_500_002  # rounded up
+    # negative product keeps the +half bias then truncates toward zero
+    assert fp.wsad_mul(-500_000, 500_000) == -249_999
+
+
+def test_wsad_div():
+    assert fp.wsad_div(fp.WSAD, fp.WSAD) == fp.WSAD
+    assert fp.wsad_div(1, 3) == 333_333  # (1*1e6 + 1) / 3 truncated
+    assert fp.wsad_div(fp.WSAD, 3 * fp.WSAD) == 333_333
+    assert fp.wsad_div(2 * fp.WSAD, 3 * fp.WSAD) == 666_667  # rounds
+
+
+def test_sqrt_newton():
+    # test_math.cairo:21-37: sqrt(9) == 3 in wsad.
+    assert fp.wsad_sqrt(9 * fp.WSAD) == 3 * fp.WSAD
+    assert fp.wsad_sqrt(0) == 0
+    assert abs(fp.wsad_sqrt(2 * fp.WSAD) - 1_414_213) <= 1
+    # converges for large values within the 50-iteration cap
+    v = fp.wsad_sqrt(fp.to_wsad(400.0))
+    assert abs(v - fp.to_wsad(20.0)) <= 2
+
+
+def test_felt_roundtrip():
+    for x in [0.0, 0.5, -0.5, 123.456789, -123.456789, 1e-6, -1e-6]:
+        felt = fp.float_to_fwsad(x)
+        assert 0 <= felt < fp.FELT_PRIME
+        back = fp.fwsad_to_float(felt)
+        assert back == pytest.approx(x, abs=1e-6)
+    # negatives wrap above I128_MAX
+    assert fp.float_to_fwsad(-1.0) > fp.I128_MAX
+
+
+def test_encode_decode_vector():
+    v = np.array([0.25, -0.75, 3.5])
+    felts = fp.encode_vector(v)
+    out = fp.decode_vector(felts)
+    np.testing.assert_allclose(out, v, atol=1e-6)
+
+
+def test_quantize_matches_to_wsad():
+    xs = np.array([0.1234567, -0.1234567, 2.0000005])
+    q = fp.quantize(xs)
+    for x, qx in zip(xs, q):
+        assert qx == pytest.approx(fp.from_wsad(fp.to_wsad(float(x))), abs=1e-12)

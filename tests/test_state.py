@@ -1,0 +1,210 @@
+"""Contract simulator state machine — mirrors the scenarios of
+``contract/tests/test_contract.cairo`` (deployment state, activation
+gate, prediction flow, replacement votes, access control)."""
+
+import pytest
+
+from svoc_tpu.consensus.state import ContractError, OracleConsensusContract
+
+ADMINS = ["Akashi", "Ozu", "Higuchi"]
+ORACLES = [f"oracle_0{i}" for i in range(7)]
+
+# test_contract.cairo:150-158
+PREDICTIONS_2D = [
+    [0.492954, 0.334814],
+    [0.437692, 0.410445],
+    [0.967794, 0.564219],
+    [0.431029, 0.387225],
+    [0.487609, 0.337990],
+    [0.284178, 0.485072],
+    [0.990059, 0.558600],
+]
+
+
+def make_constrained(dimension=2):
+    # deploy_constrained_contract calldata (test_contract.cairo:28-59)
+    return OracleConsensusContract(
+        ADMINS,
+        ORACLES,
+        enable_oracle_replacement=True,
+        required_majority=2,
+        n_failing_oracles=2,
+        constrained=True,
+        unconstrained_max_spread=0.0,
+        dimension=dimension,
+    )
+
+
+def test_initial_state():
+    c = make_constrained()
+    # test_contract.cairo:140-143
+    assert c.consensus_active is False
+    assert c.get_consensus_value() == [0, 0]
+    assert c.get_first_pass_consensus_reliability() == 0
+    assert c.get_second_pass_consensus_reliability() == 0
+    assert c.get_admin_list() == ADMINS
+    assert c.get_oracle_list() == ORACLES
+    assert c.get_replacement_propositions() == [None] * 3
+
+
+def test_activation_gate():
+    """Consensus is only computed once every oracle committed
+    (contract.cairo:447-449)."""
+    c = make_constrained()
+    for i in range(6):
+        c.update_prediction(ORACLES[i], PREDICTIONS_2D[i])
+        assert c.consensus_active is False
+        assert c.get_consensus_value() == [0, 0]
+    c.update_prediction(ORACLES[6], PREDICTIONS_2D[6])
+    assert c.consensus_active is True
+    assert c.get_consensus_value() != [0, 0]
+    # afterwards every commit recomputes
+    before = c.get_consensus_value()
+    c.update_prediction(ORACLES[0], [0.111, 0.999])
+    assert c.get_consensus_value() != before
+
+
+def test_full_constrained_run_marks_two_unreliable():
+    c = make_constrained()
+    for o, p in zip(ORACLES, PREDICTIONS_2D):
+        c.update_prediction(o, p)
+    dump = c.get_oracle_value_list("Akashi")
+    reliable_flags = [r for (_, _, _, r) in dump]
+    assert sum(not r for r in reliable_flags) == 2
+    # outliers (0.9677.., 0.5642..) and (0.9900.., 0.5586..) are masked
+    assert reliable_flags[2] is False and reliable_flags[6] is False
+    assert 0 <= c.get_first_pass_consensus_reliability(as_floats=True) <= 1
+    assert 0 <= c.get_second_pass_consensus_reliability(as_floats=True) <= 1
+
+
+def test_not_an_oracle_rejected():
+    c = make_constrained()
+    with pytest.raises(ContractError, match="not an oracle"):
+        c.update_prediction("eve", [0.5, 0.5])
+
+
+def test_constrained_interval_check_on_input():
+    c = make_constrained()
+    with pytest.raises(AssertionError, match="interval"):
+        c.update_prediction(ORACLES[0], [1.5, 0.5])
+    with pytest.raises(AssertionError, match="interval"):
+        c.update_prediction(ORACLES[0], [-0.1, 0.5])
+
+
+def test_admin_only_oracle_value_list():
+    c = make_constrained()
+    with pytest.raises(ContractError, match="not admin"):
+        c.get_oracle_value_list("oracle_00")
+
+
+def test_replacement_vote_flow():
+    """test_contract.cairo:195-213: proposition + 1 vote -> no change,
+    second vote reaches majority -> address swapped, everything reset."""
+    c = make_constrained()
+    for o, p in zip(ORACLES, PREDICTIONS_2D):
+        c.update_prediction(o, p)
+
+    old_oracle = 6
+    c.update_proposition("Akashi", (old_oracle, "oracle_XX"))
+    assert c.get_oracle_list()[old_oracle] == "oracle_06"
+    c.vote_for_a_proposition("Akashi", 0, True)  # self-vote already set; still 1 voter
+    assert c.get_oracle_list()[old_oracle] == "oracle_06"
+    c.vote_for_a_proposition("Ozu", 0, True)
+    assert c.get_oracle_list()[old_oracle] == "oracle_XX"
+    # reset rules (contract.cairo:578-579)
+    assert c.get_replacement_propositions() == [None] * 3
+    assert not any(c.vote_matrix.values())
+    # replaced oracle keeps its old value/flags (contract.cairo:573-576)
+    dump = c.get_oracle_value_list("Akashi")
+    assert dump[old_oracle][0] == "oracle_XX"
+    assert dump[old_oracle][2] is True  # still enabled
+
+
+def test_proposition_change_forfeits_votes():
+    c = make_constrained()
+    c.update_proposition("Akashi", (0, "oracle_XX"))
+    c.vote_for_a_proposition("Ozu", 0, True)
+    # ... but majority=2 already reached -> replaced. Use majority 3 variant:
+    c2 = OracleConsensusContract(
+        ADMINS, ORACLES, required_majority=3, dimension=2
+    )
+    c2.update_proposition("Akashi", (0, "oracle_XX"))
+    c2.vote_for_a_proposition("Ozu", 0, True)
+    assert c2.vote_matrix[(1, 0)] is True
+    # changing the proposition zeroes the collected column, then self-votes
+    c2.update_proposition("Akashi", (1, "oracle_YY"))
+    assert c2.vote_matrix[(1, 0)] is False
+    assert c2.vote_matrix[(0, 0)] is True
+
+
+def test_replacement_guards():
+    c = make_constrained()
+    with pytest.raises(ContractError, match="not an admin"):
+        c.update_proposition("eve", (0, "oracle_XX"))
+    with pytest.raises(ContractError, match="wrong old oracle index"):
+        c.update_proposition("Akashi", (99, "oracle_XX"))
+    with pytest.raises(ContractError, match="already in the team"):
+        c.update_proposition("Akashi", (0, "oracle_01"))
+    c_disabled = OracleConsensusContract(
+        ADMINS, ORACLES, enable_oracle_replacement=False, dimension=2
+    )
+    with pytest.raises(ContractError, match="replacement disabled"):
+        c_disabled.update_proposition("Akashi", (0, "oracle_XX"))
+    with pytest.raises(ContractError, match="replacement disabled"):
+        c_disabled.get_replacement_propositions()
+
+
+def test_interval_panic_reverts_the_commit():
+    """A Cairo panic reverts the whole transaction: the triggering
+    oracle must stay disabled with its old value, and later commits
+    must not see the poisoned state."""
+    from svoc_tpu.consensus.wsad_engine import IntervalError
+
+    c = make_constrained()
+    # 5 oracles at [1,1], 2 at [0,0]: the smooth median lands on [1,1],
+    # mean qr = 4/7 > 1/2, so rel1 = 1 - 2*sqrt(mean_qr/2) ≈ -0.069 < 0.
+    extremes = [[1.0, 1.0]] * 5 + [[0.0, 0.0]] * 2
+    for o, p in zip(ORACLES[:6], extremes[:6]):
+        c.update_prediction(o, p)
+    with pytest.raises(IntervalError):
+        c.update_prediction(ORACLES[6], extremes[6])
+    dump = c.get_oracle_value_list("Akashi")
+    assert dump[6][2] is False  # still disabled
+    assert dump[6][1] == [0, 0]  # old (zero) value retained
+    assert c.n_active_oracles == 6
+    assert c.consensus_active is False
+
+
+def test_vote_out_of_range_target_is_harmless():
+    """Cairo's LegacyMap reads default-false/None for unknown keys, so
+    voting for a non-existent admin's proposition must not crash (and a
+    majority on an empty out-of-range column panics on unwrap)."""
+    c = make_constrained()
+    c.vote_for_a_proposition("Akashi", 5, True)  # single vote: no effect
+    assert c.get_oracle_list() == ORACLES
+    with pytest.raises(ContractError, match="unwrap"):
+        c.vote_for_a_proposition("Ozu", 5, True)  # majority on empty col
+    with pytest.raises(ContractError, match="unwrap"):
+        c.vote_for_a_proposition("Akashi", -1, True)
+        c.vote_for_a_proposition("Ozu", -1, True)
+
+
+def test_felt_encoding_path():
+    """Predictions can arrive as felt252 calldata exactly as the chain
+    client sends them (client/contract.py:218)."""
+    from svoc_tpu.ops.fixedpoint import float_to_fwsad
+
+    c = OracleConsensusContract(
+        ADMINS,
+        ORACLES,
+        constrained=False,
+        unconstrained_max_spread=10.0,
+        dimension=2,
+    )
+    c.update_prediction(
+        ORACLES[0],
+        [float_to_fwsad(-1.25), float_to_fwsad(2.5)],
+        encoding="felt",
+    )
+    dump = c.get_oracle_value_list("Akashi")
+    assert dump[0][1] == [-1_250_000, 2_500_000]
