@@ -164,9 +164,11 @@ class OracleConsensusContract:
                 max_spread=self.unconstrained_max_spread,
                 strict_interval=self.strict_interval,
             )
-        except eng.IntervalError:
-            # A Cairo panic reverts the whole transaction, including the
-            # single-oracle update above — restore it before re-raising.
+        except Exception:
+            # Any Cairo panic (interval error, division by zero in the
+            # n<4 moment formulas, ...) reverts the whole transaction,
+            # including the single-oracle update above — restore it
+            # before re-raising.
             info.enabled, info.value, self.n_active_oracles = prev
             raise
         for o, ok in zip(self.oracles, result["reliable"]):
@@ -292,6 +294,10 @@ class OracleConsensusContract:
     def get_a_specific_proposition(self, which_admin: int) -> Proposition:
         if not self.enable_oracle_replacement:
             raise ContractError("replacement disabled")
+        # LegacyMap<usize, Option> reads default to None out of range
+        # (and Python's negative-index wrap-around must not leak).
+        if not 0 <= which_admin < len(self.admins):
+            return None
         return self.replacement_propositions[which_admin]
 
     def get_predictions_dimension(self) -> int:

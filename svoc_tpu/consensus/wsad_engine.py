@@ -164,9 +164,10 @@ def two_pass_consensus(
     """Run both passes on a complete oracle block of wsad vectors.
 
     Returns a dict with wsad-int fields mirroring the contract storage
-    after an ``update_*_consensus`` call: ``essence``, ``rel1``,
-    ``rel2``, ``reliable`` (per original oracle index), ``skewness``,
-    ``kurtosis``, plus ``essence_first_pass`` and first-pass risks.
+    after an ``update_*_consensus`` call: ``essence``,
+    ``reliability_first_pass``, ``reliability_second_pass``,
+    ``reliable`` (per original oracle index), ``skewness``,
+    ``kurtosis``, plus ``essence_first_pass`` and ``quadratic_risk``.
     """
     n = len(values)
     dim = len(values[0])

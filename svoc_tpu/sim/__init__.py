@@ -1,0 +1,10 @@
+"""Oracle fleet simulation: generators, bootstrap model, Monte-Carlo bench."""
+
+from svoc_tpu.sim.generators import (  # noqa: F401
+    beta_mode,
+    generate_beta_oracles,
+    generate_gaussian_oracles,
+    generate_kumaraswamy_oracles,
+    kumaraswamy_mode,
+)
+from svoc_tpu.sim.oracle import gen_oracle_predictions  # noqa: F401

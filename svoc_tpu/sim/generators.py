@@ -1,0 +1,115 @@
+"""Synthetic oracle-fleet generators with adversarial failure injection.
+
+JAX-native equivalents of the reference's numpy prototypes in
+``contract/drafts/beta_kumaraswamy_algorithm_demo copy.ipynb``
+(``generate_beta_oracles`` / ``generate_2d_beta_oracles``) and
+``contract/drafts/gaussian_distribution_for_tests.ipynb``
+(``generate_2d_gaussian_oracles``), following the failure model of
+``documentation/README.md:105-114``: a failing oracle is a uniform
+draw over ]0,1[ (or a wide uniform in the unconstrained case), and the
+fleet is shuffled so the failing identities are hidden.
+
+All generators are fixed-shape and vmap-friendly: they return
+``(values [n, dim], honest_mask [n])`` where ``honest_mask`` marks the
+non-failing oracles *after* the shuffle (the ground truth that the
+detection benchmark tries to recover).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def beta_mode(a: float, b: float) -> float:
+    """Mode of Beta(a, b) — the essence under the constrained model
+    (notebook ``beta_mode``; ``documentation/README.md:72-76``)."""
+    return (a - 1.0) / (a + b - 2.0)
+
+
+def kumaraswamy_mode(a: float, b: float) -> float:
+    """Mode of Kumaraswamy(a, b) (notebook ``kumaraswamy_mode``)."""
+    return ((a - 1.0) / (a * b - 1.0)) ** (1.0 / a)
+
+
+def _shuffle(key, values: jnp.ndarray, honest: jnp.ndarray):
+    """Shuffle oracles so failing identities are hidden
+    (``np.random.shuffle`` in the notebook / ``oracle_scheduler.py:90``)."""
+    perm = jax.random.permutation(key, values.shape[0])
+    return values[perm], honest[perm]
+
+
+def generate_beta_oracles(
+    key,
+    n_oracles: int,
+    n_failing: int,
+    a,
+    b,
+    dim: int = 1,
+):
+    """Beta-distributed honest oracles + uniform failing oracles.
+
+    ``a``/``b`` may be scalars or per-dimension arrays (the notebook's
+    2-D variant passes per-axis parameters).
+    """
+    k_beta, k_unif, k_perm = jax.random.split(key, 3)
+    a = jnp.broadcast_to(jnp.asarray(a, jnp.float32), (dim,))
+    b = jnp.broadcast_to(jnp.asarray(b, jnp.float32), (dim,))
+    honest_vals = jax.random.beta(
+        k_beta, a[None, :], b[None, :], shape=(n_oracles - n_failing, dim)
+    )
+    failing_vals = jax.random.uniform(k_unif, (n_failing, dim))
+    values = jnp.concatenate([failing_vals, honest_vals], axis=0)
+    honest = jnp.arange(n_oracles) >= n_failing
+    return _shuffle(k_perm, values, honest)
+
+
+def generate_kumaraswamy_oracles(
+    key,
+    n_oracles: int,
+    n_failing: int,
+    a,
+    b,
+    dim: int = 1,
+):
+    """Kumaraswamy(a, b) honest oracles via inverse-CDF sampling:
+    ``X = (1 − (1 − U)^{1/b})^{1/a}``."""
+    k_u, k_unif, k_perm = jax.random.split(key, 3)
+    a = jnp.broadcast_to(jnp.asarray(a, jnp.float32), (dim,))
+    b = jnp.broadcast_to(jnp.asarray(b, jnp.float32), (dim,))
+    u = jax.random.uniform(
+        k_u, (n_oracles - n_failing, dim), minval=1e-7, maxval=1.0 - 1e-7
+    )
+    honest_vals = (1.0 - (1.0 - u) ** (1.0 / b[None, :])) ** (1.0 / a[None, :])
+    failing_vals = jax.random.uniform(k_unif, (n_failing, dim))
+    values = jnp.concatenate([failing_vals, honest_vals], axis=0)
+    honest = jnp.arange(n_oracles) >= n_failing
+    return _shuffle(k_perm, values, honest)
+
+
+def generate_gaussian_oracles(
+    key,
+    n_oracles: int,
+    n_failing: int,
+    mu,
+    sigma,
+    failing_spread: float = 10.0,
+):
+    """Unconstrained fleet: honest ~ N(mu, diag(sigma²)), failing ~
+    uniform over ``mu ± failing_spread`` (the Gaussian fixture generator,
+    ``gaussian_distribution_for_tests.ipynb``, used mu=[20,12],
+    sigma=[3,2])."""
+    mu = jnp.asarray(mu, jnp.float32)
+    sigma = jnp.asarray(sigma, jnp.float32)
+    dim = mu.shape[0]
+    k_norm, k_unif, k_perm = jax.random.split(key, 3)
+    honest_vals = (
+        mu[None, :]
+        + sigma[None, :] * jax.random.normal(k_norm, (n_oracles - n_failing, dim))
+    )
+    failing_vals = mu[None, :] + jax.random.uniform(
+        k_unif, (n_failing, dim), minval=-failing_spread, maxval=failing_spread
+    )
+    values = jnp.concatenate([failing_vals, honest_vals], axis=0)
+    honest = jnp.arange(n_oracles) >= n_failing
+    return _shuffle(k_perm, values, honest)

@@ -1,0 +1,59 @@
+"""The stochastic bootstrap oracle model, vmapped over the fleet.
+
+Reference: ``gen_oracles_predictions`` (``client/oracle_scheduler.py:
+73-92``) — for each of N oracles, the first ``n_failing`` produce
+``uniform(0,1)^M`` (the adversarial/failing model) and the rest average
+a random ``subset_size``-element bootstrap sample of the current
+sentiment-analysis window; the fleet is then shuffled to hide which
+oracles failed.
+
+Here the whole fleet is generated in one fused graph: ``vmap`` over the
+oracle axis with per-oracle PRNG keys, gathers into the shared window,
+fixed shapes throughout.  At N=1024 this is a [N, S] gather + mean —
+bandwidth-trivial, and shardable over the oracle axis
+(:mod:`svoc_tpu.parallel`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gen_oracle_predictions(
+    key,
+    window: jnp.ndarray,
+    n_oracles: int,
+    n_failing: int,
+    subset_size: int = 10,
+):
+    """Generate the fleet's predictions from a sentiment window.
+
+    Args:
+      key: PRNG key.
+      window: ``[W, M]`` sentiment vectors (the prediction window,
+        ``common.py:15-16``).
+      n_oracles / n_failing: fleet shape (``common.py:8-9`` defaults 7/2).
+      subset_size: bootstrap subset (``BOOTSTRAPING_SUBSET=10``).
+
+    Returns:
+      ``(values [n_oracles, M], honest_mask [n_oracles])`` post-shuffle.
+    """
+    w, m = window.shape
+    n_honest = n_oracles - n_failing
+    k_fail, k_boot, k_perm = jax.random.split(key, 3)
+
+    failing_vals = jax.random.uniform(k_fail, (n_failing, m))
+
+    def one_bootstrap(k):
+        # random.sample semantics: without replacement
+        # (oracle_scheduler.py:85)
+        idx = jax.random.choice(k, w, shape=(subset_size,), replace=False)
+        return jnp.mean(window[idx], axis=0)
+
+    honest_vals = jax.vmap(one_bootstrap)(jax.random.split(k_boot, n_honest))
+
+    values = jnp.concatenate([failing_vals, honest_vals], axis=0)
+    honest = jnp.arange(n_oracles) >= n_failing
+    perm = jax.random.permutation(k_perm, n_oracles)
+    return values[perm], honest[perm]

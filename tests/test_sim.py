@@ -1,0 +1,157 @@
+"""Sim layer: generators, bootstrap oracle model, Monte-Carlo acceptance.
+
+The Monte-Carlo assertions reproduce the published estimator-quality
+tables (``documentation/README.md:248-341``, mirrored in BASELINE.md)
+within sampling tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from svoc_tpu.sim.generators import (
+    beta_mode,
+    generate_beta_oracles,
+    generate_gaussian_oracles,
+    generate_kumaraswamy_oracles,
+    kumaraswamy_mode,
+)
+from svoc_tpu.sim.montecarlo import (
+    benchmark,
+    identify_failing_oracles,
+    restricted_median,
+    true_median,
+)
+from svoc_tpu.sim.oracle import gen_oracle_predictions
+
+
+def test_beta_generator_shapes_and_failure_count():
+    key = jax.random.PRNGKey(0)
+    values, honest = generate_beta_oracles(key, 7, 2, 10.0, 10.0, dim=3)
+    assert values.shape == (7, 3)
+    assert honest.shape == (7,)
+    assert int(jnp.sum(~honest)) == 2
+    assert bool(jnp.all((values >= 0) & (values <= 1)))
+
+
+def test_beta_honest_cluster_near_mode():
+    # Beta(100, 100) concentrates at 0.5 (mode == mean == 0.5).
+    key = jax.random.PRNGKey(1)
+    values, honest = generate_beta_oracles(key, 200, 0, 100.0, 100.0, dim=1)
+    assert abs(float(values.mean()) - beta_mode(100, 100)) < 0.02
+
+
+def test_kumaraswamy_generator():
+    key = jax.random.PRNGKey(2)
+    values, honest = generate_kumaraswamy_oracles(key, 500, 0, 5.0, 5.0, dim=1)
+    assert bool(jnp.all((values > 0) & (values < 1)))
+    # empirical mode near analytic mode
+    assert abs(float(jnp.median(values)) - kumaraswamy_mode(5.0, 5.0)) < 0.1
+
+
+def test_gaussian_generator():
+    key = jax.random.PRNGKey(3)
+    values, honest = generate_gaussian_oracles(
+        key, 400, 40, mu=[20.0, 12.0], sigma=[3.0, 2.0]
+    )
+    hv = values[honest]
+    np.testing.assert_allclose(np.asarray(hv.mean(0)), [20.0, 12.0], atol=0.5)
+    np.testing.assert_allclose(np.asarray(hv.std(0)), [3.0, 2.0], atol=0.5)
+
+
+def test_bootstrap_oracle_model():
+    key = jax.random.PRNGKey(4)
+    window = jax.random.dirichlet(key, jnp.ones(6), shape=(30,))
+    values, honest = gen_oracle_predictions(
+        jax.random.PRNGKey(5), window, n_oracles=7, n_failing=2, subset_size=10
+    )
+    assert values.shape == (7, 6)
+    assert int(jnp.sum(~honest)) == 2
+    # honest oracles average normalized vectors -> components sum to ~1
+    sums = jnp.sum(values, axis=-1)
+    assert bool(jnp.all(jnp.abs(sums[honest] - 1.0) < 1e-5))
+    # bootstrap means stay inside the window's convex hull
+    lo, hi = window.min(axis=0), window.max(axis=0)
+    assert bool(jnp.all(values[honest] >= lo[None, :] - 1e-6))
+    assert bool(jnp.all(values[honest] <= hi[None, :] + 1e-6))
+
+
+def test_bootstrap_is_vmappable_at_scale():
+    window = jax.random.dirichlet(jax.random.PRNGKey(0), jnp.ones(6), shape=(50,))
+    values, honest = gen_oracle_predictions(
+        jax.random.PRNGKey(1), window, n_oracles=1024, n_failing=256
+    )
+    assert values.shape == (1024, 6)
+    assert int(jnp.sum(~honest)) == 256
+
+
+def test_true_and_restricted_median_match_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(9, 3)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(true_median(jnp.array(x))), np.median(x, axis=0), atol=1e-6
+    )
+    x8 = x[:8]
+    np.testing.assert_allclose(
+        np.asarray(true_median(jnp.array(x8))), np.median(x8, axis=0), atol=1e-6
+    )
+    mask = np.array([True] * 5 + [False] * 4)
+    np.testing.assert_allclose(
+        np.asarray(restricted_median(jnp.array(x), jnp.array(mask), 5)),
+        np.median(x[mask], axis=0),
+        atol=1e-6,
+    )
+
+
+def test_identify_failing_matches_reference_rule():
+    # reference rule: rank of ||pred - median||, worst n_failing flagged
+    values = jnp.array([[0.5], [0.52], [0.48], [0.9], [0.1]])
+    guess = identify_failing_oracles(values, 2)
+    assert np.asarray(guess).tolist() == [True, True, True, False, False]
+
+
+@pytest.mark.parametrize(
+    "a,expected_success,expected_reliability,tol_s,tol_r",
+    [
+        (10.0, 40.33, 95.92, 6.0, 1.0),
+        (100.0, 72.67, 99.44, 6.0, 0.5),
+    ],
+)
+def test_montecarlo_matches_published_7_2(
+    a, expected_success, expected_reliability, tol_s, tol_r
+):
+    """documentation/README.md:254 (a=10) and :272 (a=100), N=7/2."""
+    r = benchmark(
+        jax.random.PRNGKey(42), a, a, n_oracles=7, n_failing=2, k_trials=3000
+    )
+    assert r["identification_success_pct"] == pytest.approx(
+        expected_success, abs=tol_s
+    )
+    assert r["reliability_pct"] == pytest.approx(expected_reliability, abs=tol_r)
+
+
+def test_montecarlo_adversarial_75pct_stays_reliable():
+    """documentation/README.md:318-319: N=20 with 15 failing (75%
+    adversarial) keeps reliability ~90%."""
+    r = benchmark(
+        jax.random.PRNGKey(7), 10.0, 10.0, n_oracles=20, n_failing=15, k_trials=2000
+    )
+    assert r["reliability_pct"] == pytest.approx(90.2, abs=2.0)
+    assert r["identification_success_pct"] < 10.0
+
+
+def test_montecarlo_kernel_detection_close_to_reference_rule():
+    """The on-chain two-pass detection (smooth median) should be in the
+    same quality band as the notebook's true-median rule."""
+    r = benchmark(
+        jax.random.PRNGKey(9),
+        100.0,
+        100.0,
+        n_oracles=7,
+        n_failing=2,
+        k_trials=2000,
+        use_kernel=True,
+    )
+    assert r["identification_success_pct"] == pytest.approx(72.67, abs=8.0)
+    assert r["reliability_pct"] > 98.5
