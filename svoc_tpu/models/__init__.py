@@ -1,0 +1,12 @@
+"""JAX/Flax sentiment models — the TPU replacement for the reference's
+CPU-torch HuggingFace pipeline (``client/oracle_scheduler.py:23-40``)."""
+
+from svoc_tpu.models.configs import (  # noqa: F401
+    DISTILBERT_SST2,
+    ROBERTA_GO_EMOTIONS,
+    TINY_TEST,
+    EncoderConfig,
+)
+from svoc_tpu.models.encoder import SentimentEncoder  # noqa: F401
+from svoc_tpu.models.sentiment import SentimentPipeline  # noqa: F401
+from svoc_tpu.models.tokenizer import HashingTokenizer, load_tokenizer  # noqa: F401

@@ -1,0 +1,68 @@
+"""Model configurations.
+
+``ROBERTA_GO_EMOTIONS`` matches the architecture of the reference's
+classifier ``SamLowe/roberta-base-go_emotions``
+(``client/oracle_scheduler.py:23-24``: RoBERTa-base, 28 go_emotions
+labels, multi-label sigmoid head); ``DISTILBERT_SST2`` covers
+BASELINE.json config 1 ("Single oracle: DistilBERT-SST2").  Weights are
+randomly initialized unless a converted checkpoint is supplied — the
+framework's contract is architecture + throughput parity; the
+environment has no network egress for pulling HF weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 50265
+    hidden: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    intermediate: int = 3072
+    max_len: int = 512
+    n_labels: int = 28
+    pad_id: int = 1
+    ln_eps: float = 1e-5
+    #: computation dtype — bf16 keeps the MXU fed; params stay f32.
+    dtype: Any = jnp.bfloat16
+    #: rematerialize each encoder block (jax.checkpoint) to trade
+    #: FLOPs for HBM during fine-tuning.
+    remat: bool = False
+    #: "sigmoid" (multi-label, go_emotions) or "softmax" (SST-2).
+    head: str = "sigmoid"
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden % self.n_heads == 0
+        return self.hidden // self.n_heads
+
+
+ROBERTA_GO_EMOTIONS = EncoderConfig()
+
+DISTILBERT_SST2 = EncoderConfig(
+    vocab_size=30522,
+    n_layers=6,
+    max_len=512,
+    n_labels=2,
+    pad_id=0,
+    head="softmax",
+    ln_eps=1e-12,
+)
+
+#: Small config for unit tests and CPU dry-runs.
+TINY_TEST = EncoderConfig(
+    vocab_size=1024,
+    hidden=64,
+    n_layers=2,
+    n_heads=4,
+    intermediate=128,
+    max_len=64,
+    n_labels=28,
+    dtype=jnp.float32,
+)

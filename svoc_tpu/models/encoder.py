@@ -1,0 +1,144 @@
+"""From-scratch Flax transformer encoder for sentiment classification.
+
+TPU-first design notes (not a port of HF modeling code):
+
+- All matmuls run in ``cfg.dtype`` (bfloat16 by default) with float32
+  parameters and float32 layernorm/softmax accumulations — the MXU path.
+- Attention is a single fused ``einsum`` chain over ``[B, H, T, D]``;
+  no data-dependent shapes, masks are additive float biases.
+- Each block can be rematerialized (``cfg.remat``) for fine-tuning.
+- Tensor-parallel sharding is applied externally by constraining the
+  FFN/attention kernels over the ``"model"`` mesh axis
+  (:func:`param_shardings`); the module itself stays mesh-agnostic so
+  the same code runs single-chip and pod-sharded.
+
+Architecture parity target: RoBERTa-base post-LN encoder + first-token
+classification head, matching the reference classifier
+``SamLowe/roberta-base-go_emotions`` (``client/oracle_scheduler.py:23``).
+The module returns **logits**; the multi-label sigmoid / softmax lives in
+:mod:`svoc_tpu.models.sentiment` (inference) and the loss (training).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from svoc_tpu.models.configs import EncoderConfig
+
+
+class SelfAttention(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, bias: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        b, t, _ = x.shape
+        h, d = cfg.n_heads, cfg.head_dim
+
+        q = nn.Dense(cfg.hidden, dtype=cfg.dtype, name="query")(x).reshape(b, t, h, d)
+        k = nn.Dense(cfg.hidden, dtype=cfg.dtype, name="key")(x).reshape(b, t, h, d)
+        v = nn.Dense(cfg.hidden, dtype=cfg.dtype, name="value")(x).reshape(b, t, h, d)
+
+        scale = jnp.asarray(1.0 / jnp.sqrt(d), cfg.dtype)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        scores = scores.astype(jnp.float32) + bias  # f32 softmax
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, t, cfg.hidden)
+        return nn.Dense(cfg.hidden, dtype=cfg.dtype, name="out")(ctx)
+
+
+class EncoderBlock(nn.Module):
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, bias: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        a = SelfAttention(cfg, name="attention")(x, bias)
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_attn")(
+            x + a
+        ).astype(cfg.dtype)
+        f = nn.Dense(cfg.intermediate, dtype=cfg.dtype, name="ffn_in")(x)
+        f = nn.gelu(f, approximate=False)
+        f = nn.Dense(cfg.hidden, dtype=cfg.dtype, name="ffn_out")(f)
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_ffn")(
+            x + f
+        ).astype(cfg.dtype)
+        return x
+
+
+class SentimentEncoder(nn.Module):
+    """Token ids + attention mask → classification logits ``[B, n_labels]``."""
+
+    cfg: EncoderConfig
+
+    @nn.compact
+    def __call__(self, ids: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+
+        tok = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype, name="tok_emb")(
+            ids
+        )
+        # RoBERTa-style positions: count only real tokens, offset past the
+        # pad id (parity with the reference tokenizer's position scheme).
+        pos_ids = jnp.cumsum(mask, axis=-1) * mask + cfg.pad_id
+        pos = nn.Embed(
+            cfg.max_len + cfg.pad_id + 2, cfg.hidden, dtype=cfg.dtype, name="pos_emb"
+        )(pos_ids)
+        x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_emb")(
+            tok + pos
+        ).astype(cfg.dtype)
+
+        bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e9).astype(jnp.float32)
+
+        block = nn.remat(EncoderBlock) if cfg.remat else EncoderBlock
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"block_{i}")(x, bias)
+
+        # First-token classification head (dense → tanh → out_proj), the
+        # RobertaClassificationHead shape.
+        cls = x[:, 0, :]
+        cls = jnp.tanh(nn.Dense(cfg.hidden, dtype=cfg.dtype, name="head_dense")(cls))
+        return nn.Dense(cfg.n_labels, dtype=jnp.float32, name="head_out")(cls)
+
+
+def init_params(model: SentimentEncoder, seed: int = 0, batch: int = 2) -> Dict:
+    cfg = model.cfg
+    ids = jnp.ones((batch, min(16, cfg.max_len)), jnp.int32)
+    mask = jnp.ones_like(ids)
+    return model.init(jax.random.PRNGKey(seed), ids, mask)
+
+
+def param_shardings(params: Any, mesh, model_axis: str = "model"):
+    """NamedShardings for tensor parallelism: shard FFN and attention
+    projection kernels over ``model_axis``, replicate the rest.
+
+    ``ffn_in``/``query``/``key``/``value`` kernels ``[in, out]`` split on
+    the output (column) dim; ``ffn_out``/attention-``out`` on the input
+    (row) dim — the Megatron layout, so XLA inserts one all-reduce per
+    half-block over ICI and activations stay sharded in between.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    col = ("ffn_in", "query", "key", "value")
+    row = ("ffn_out", "attention/out", "attention.out")
+
+    def spec_for(path_str: str, leaf) -> Any:
+        if getattr(leaf, "ndim", 0) == 2 and path_str.endswith("kernel"):
+            if any(k in path_str for k in col):
+                return NamedSharding(mesh, P(None, model_axis))
+            if any(k in path_str for k in row):
+                return NamedSharding(mesh, P(model_axis, None))
+        return NamedSharding(mesh, P())
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    specs = []
+    for path, leaf in flat:
+        path_str = "/".join(
+            getattr(p, "key", getattr(p, "name", str(p))) for p in path
+        )
+        specs.append(spec_for(path_str, leaf))
+    return jax.tree_util.tree_unflatten(treedef, specs)

@@ -1,0 +1,124 @@
+"""The sentiment pipeline: texts → normalized 6-D emotion vectors.
+
+Replaces ``sentiment_analysis`` + ``prediction_to_vector``
+(``client/oracle_scheduler.py:27-40``): run the classifier over a batch,
+select the 6 tracked go_emotions labels (``client/common.py:19-31``),
+and sum-normalize each vector.  On TPU the select+normalize fuses into
+the jitted forward, so the device returns ready ``[B, 6]`` prediction
+vectors and the host never touches per-label dicts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from svoc_tpu.models.configs import ROBERTA_GO_EMOTIONS, EncoderConfig
+from svoc_tpu.models.encoder import SentimentEncoder, init_params
+from svoc_tpu.models.tokenizer import load_tokenizer
+
+#: The 28 go_emotions labels in model-head order (the reference model's
+#: label space, https://huggingface.co/SamLowe/roberta-base-go_emotions).
+GO_EMOTIONS_LABELS = (
+    "admiration", "amusement", "anger", "annoyance", "approval", "caring",
+    "confusion", "curiosity", "desire", "disappointment", "disapproval",
+    "disgust", "embarrassment", "excitement", "fear", "gratitude", "grief",
+    "joy", "love", "nervousness", "optimism", "pride", "realization",
+    "relief", "remorse", "sadness", "surprise", "neutral",
+)
+
+#: The tracked subset — DIMENSION=6 (``client/common.py:19-31``).
+TRACKED_LABELS = (
+    "optimism", "anger", "annoyance", "excitement", "nervousness", "remorse",
+)
+
+TRACKED_INDICES = tuple(GO_EMOTIONS_LABELS.index(l) for l in TRACKED_LABELS)
+
+
+@partial(jax.jit, static_argnames=("label_indices", "multi_label"))
+def scores_to_vectors(
+    logits: jnp.ndarray,
+    label_indices: tuple = TRACKED_INDICES,
+    multi_label: bool = True,
+) -> jnp.ndarray:
+    """Logits ``[B, L]`` → sum-normalized tracked vectors ``[B, len(idx)]``.
+
+    ``multi_label=True`` applies per-label sigmoid (go_emotions,
+    ``top_k=None`` pipeline semantics); else softmax (SST-2).
+    Normalization is the reference's ``normalize`` (sum-to-one,
+    ``oracle_scheduler.py:20``).
+    """
+    scores = jax.nn.sigmoid(logits) if multi_label else jax.nn.softmax(logits, -1)
+    sel = scores[:, jnp.asarray(label_indices)]
+    return sel / jnp.sum(sel, axis=-1, keepdims=True)
+
+
+@dataclasses.dataclass
+class SentimentPipeline:
+    """End-to-end host→device sentiment stage with fixed batch shapes.
+
+    ``gen_classifier()`` equivalent (``oracle_scheduler.py:23-24``) —
+    construct once, call with a list of strings, get ``[B, M]`` numpy
+    vectors back.
+    """
+
+    cfg: EncoderConfig = ROBERTA_GO_EMOTIONS
+    seq_len: int = 128
+    batch_size: int = 32
+    tokenizer_name: Optional[str] = "SamLowe/roberta-base-go_emotions"
+    label_indices: tuple = TRACKED_INDICES
+    seed: int = 0
+    params: Optional[dict] = None
+
+    def __post_init__(self):
+        if max(self.label_indices) >= self.cfg.n_labels:
+            raise ValueError(
+                f"label_indices {self.label_indices} out of range for a "
+                f"{self.cfg.n_labels}-label head — pass label_indices "
+                f"matching the model (e.g. (0, 1) for SST-2)"
+            )
+        self.model = SentimentEncoder(self.cfg)
+        if self.params is None:
+            self.params = init_params(self.model, seed=self.seed)
+        self.tokenizer = load_tokenizer(
+            self.tokenizer_name,
+            self.cfg.vocab_size,
+            pad_id=self.cfg.pad_id,
+            max_len=self.seq_len,
+        )
+        multi = self.cfg.head == "sigmoid"
+        idx = self.label_indices
+
+        @jax.jit
+        def forward(params, ids, mask):
+            logits = self.model.apply(params, ids, mask)
+            return scores_to_vectors(logits, idx, multi)
+
+        self._forward = forward
+
+    @property
+    def dimension(self) -> int:
+        return len(self.label_indices)
+
+    def forward_fn(self):
+        """The raw jitted ``(params, ids, mask) → [B, M]`` device fn."""
+        return self._forward
+
+    def __call__(self, texts: Sequence[str]) -> np.ndarray:
+        """``sentiment_analysis`` equivalent: pad to full batches, run
+        the jitted forward per chunk, return ``[len(texts), M]``."""
+        out = []
+        b = self.batch_size
+        for i in range(0, len(texts), b):
+            chunk = list(texts[i : i + b])
+            n_real = len(chunk)
+            chunk += [""] * (b - n_real)  # fixed shapes — no recompiles
+            ids, mask = self.tokenizer(chunk, self.seq_len)
+            vecs = self._forward(self.params, ids, mask)
+            out.append(np.asarray(vecs[:n_real], dtype=np.float64))
+        return np.concatenate(out, axis=0) if out else np.zeros((0, self.dimension))

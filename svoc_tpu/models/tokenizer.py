@@ -1,0 +1,135 @@
+"""Host-side tokenization producing fixed-shape device batches.
+
+The reference delegates tokenization to the HF pipeline
+(``client/oracle_scheduler.py:23-24``); here tokenization is an explicit
+host stage feeding fixed ``[B, T]`` int32 batches so the device graph
+never sees dynamic shapes.
+
+Two backends:
+
+- :func:`load_tokenizer` — a cached HuggingFace tokenizer when one is
+  available on disk (``local_files_only``; the environment has no
+  egress), giving vocabulary parity with the reference classifier.
+- :class:`HashingTokenizer` — a dependency-free deterministic fallback
+  (lowercase, split on non-alphanumerics, FNV-1a hash into the vocab).
+  Architecture/throughput benchmarking does not depend on the vocab
+  mapping, only on shapes.
+
+A C++ fast path for the hashing backend lives in
+:mod:`svoc_tpu.runtime` (used automatically when the native library is
+built); this module is the reference implementation and fallback.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_FNV_OFFSET = 14695981039346656037
+_FNV_PRIME = 1099511628211
+_U64 = (1 << 64) - 1
+
+
+def _fnv1a(token: str) -> int:
+    h = _FNV_OFFSET
+    for byte in token.encode("utf-8"):
+        h = ((h ^ byte) * _FNV_PRIME) & _U64
+    return h
+
+
+class HashingTokenizer:
+    """Deterministic hashing tokenizer with BERT-style special ids.
+
+    Reserves ``[pad, bos/cls, eos/sep, unk]`` then hashes word tokens
+    into ``[n_special, vocab_size)``.
+    """
+
+    N_SPECIAL = 4
+
+    def __init__(self, vocab_size: int, pad_id: int = 1, max_len: int = 512):
+        self.vocab_size = vocab_size
+        self.pad_id = pad_id
+        self.max_len = max_len
+        specials = [i for i in range(self.N_SPECIAL)]
+        self.bos_id = next(i for i in specials if i != pad_id)
+        self.eos_id = next(
+            i for i in specials if i not in (pad_id, self.bos_id)
+        )
+
+    def _word_ids(self, text: str) -> List[int]:
+        out: List[int] = []
+        word = []
+        for ch in text.lower():
+            if ch.isalnum():
+                word.append(ch)
+            elif word:
+                out.append(self._hash_word("".join(word)))
+                word = []
+        if word:
+            out.append(self._hash_word("".join(word)))
+        return out
+
+    def _hash_word(self, word: str) -> int:
+        span = self.vocab_size - self.N_SPECIAL
+        return self.N_SPECIAL + (_fnv1a(word) % span)
+
+    def __call__(
+        self, texts: Sequence[str], seq_len: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Tokenize a batch → ``(ids [B, T], mask [B, T])`` int32."""
+        t = seq_len or self.max_len
+        b = len(texts)
+        ids = np.full((b, t), self.pad_id, dtype=np.int32)
+        mask = np.zeros((b, t), dtype=np.int32)
+        for i, text in enumerate(texts):
+            toks = [self.bos_id] + self._word_ids(text)[: t - 2] + [self.eos_id]
+            ids[i, : len(toks)] = toks
+            mask[i, : len(toks)] = 1
+        return ids, mask
+
+
+class _HFTokenizerAdapter:
+    """Wraps a HuggingFace tokenizer into the same fixed-shape call."""
+
+    def __init__(self, hf_tokenizer, max_len: int):
+        self._tok = hf_tokenizer
+        self.max_len = max_len
+        self.pad_id = hf_tokenizer.pad_token_id or 0
+        self.vocab_size = hf_tokenizer.vocab_size
+
+    def __call__(
+        self, texts: Sequence[str], seq_len: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        enc = self._tok(
+            list(texts),
+            padding="max_length",
+            truncation=True,
+            max_length=seq_len or self.max_len,
+            return_tensors="np",
+        )
+        return (
+            enc["input_ids"].astype(np.int32),
+            enc["attention_mask"].astype(np.int32),
+        )
+
+
+def load_tokenizer(
+    name_or_path: Optional[str],
+    vocab_size: int,
+    pad_id: int = 1,
+    max_len: int = 512,
+):
+    """Best-effort cached HF tokenizer, falling back to hashing.
+
+    Never touches the network (``local_files_only=True``).
+    """
+    if name_or_path:
+        try:  # pragma: no cover — depends on local HF cache contents
+            from transformers import AutoTokenizer
+
+            hf = AutoTokenizer.from_pretrained(name_or_path, local_files_only=True)
+            return _HFTokenizerAdapter(hf, max_len)
+        except Exception:
+            pass
+    return HashingTokenizer(vocab_size, pad_id=pad_id, max_len=max_len)

@@ -1,0 +1,17 @@
+"""Device-mesh parallelism: the capability layer the reference lacks.
+
+The reference simulates N oracles with a host Python loop
+(``client/oracle_scheduler.py:73-92``) and aggregates them on a
+blockchain; here the oracle fleet lives on a `jax.sharding.Mesh` and the
+consensus reductions are XLA collectives over ICI (SURVEY.md §2.5, §7.6).
+"""
+
+from svoc_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    best_mesh,
+    make_mesh,
+)
+from svoc_tpu.parallel.sharded import (  # noqa: F401
+    sharded_consensus_fn,
+    sharded_fleet_step_fn,
+)

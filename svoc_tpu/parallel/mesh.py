@@ -1,0 +1,73 @@
+"""Mesh construction and sharding policy.
+
+Axis conventions (used consistently across the framework):
+
+- ``"oracle"`` — the fleet axis: N simulated oracles sharded across
+  chips (replaces the reference's host loop over ``N_ORACLES``,
+  ``client/oracle_scheduler.py:80-87``).
+- ``"data"`` — batch/data-parallel axis for transformer inference and
+  fine-tuning (comments per step).
+- ``"model"`` — tensor-parallel axis for the transformer's feed-forward
+  / attention-head dimensions.
+
+A v5e-8 typically runs ``data×oracle = 1×8`` for the pure consensus
+simulator and ``data×model = 4×2`` or ``8×1`` for inference; all
+factorizations are expressible with :func:`make_mesh`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A named mesh factorization, e.g. ``MeshSpec(("data", "oracle"), (2, 4))``."""
+
+    axis_names: Tuple[str, ...]
+    axis_sizes: Tuple[int, ...]
+
+    @property
+    def n_devices(self) -> int:
+        return int(math.prod(self.axis_sizes))
+
+
+def make_mesh(
+    spec: MeshSpec, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build a :class:`jax.sharding.Mesh` for ``spec``.
+
+    Uses the first ``spec.n_devices`` of ``devices`` (default
+    ``jax.devices()``); raises if not enough are available.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    need = spec.n_devices
+    if len(devs) < need:
+        raise ValueError(
+            f"mesh {spec} needs {need} devices, only {len(devs)} available"
+        )
+    grid = np.array(devs[:need]).reshape(spec.axis_sizes)
+    return Mesh(grid, spec.axis_names)
+
+
+def best_mesh(
+    axis_name: str = "oracle", devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """A 1-D mesh over every available device — the default fleet layout."""
+    devs = list(devices if devices is not None else jax.devices())
+    return make_mesh(MeshSpec((axis_name,), (len(devs),)), devs)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def row_sharded(mesh: Mesh, axis_name: str) -> NamedSharding:
+    """Shard the leading array axis over ``axis_name``, replicate the rest."""
+    return NamedSharding(mesh, PartitionSpec(axis_name))

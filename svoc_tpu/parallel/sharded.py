@@ -1,0 +1,245 @@
+"""shard_map consensus + fleet step over an oracle-sharded mesh.
+
+The reference's "distribution" is logical: one Python process multiplexes
+all oracle identities and the blockchain is the reducer (SURVEY.md §2.5).
+Here the oracle axis is physically sharded over the mesh and the
+consensus becomes XLA collectives:
+
+- medians need a global view of each component → one small
+  ``all_gather`` over the oracle axis ([N, M] with M ≤ a few dozen —
+  bytes, not megabytes; rides ICI),
+- scalar risk reductions (means over N) are ``psum``,
+- the rank-based reliability mask needs the global risk vector → an
+  ``all_gather`` of N scalars.
+
+Everything is fixed-shape, so the same code jit-compiles for any mesh
+factorization, and results are bitwise independent of the device count
+(per-oracle ``fold_in`` PRNG keys, no cross-device RNG).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.8 exports the function at top level
+    from jax import shard_map as _shard_map  # type: ignore
+
+    def shard_map(f, **kw):  # replicated-output check renamed check_rep→check_vma
+        kw["check_vma"] = kw.pop("check_rep", False)
+        return _shard_map(f, **kw)
+
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
+from svoc_tpu.consensus.kernel import ConsensusConfig, ConsensusOutput
+from svoc_tpu.ops import sort as sort_ops
+from svoc_tpu.ops import stats
+
+
+def _consensus_body(cfg: ConsensusConfig, axis: str):
+    """shard_map body: ``values_local [N/d, M]`` → sharded/replicated outs."""
+
+    def body(values_local: jnp.ndarray) -> ConsensusOutput:
+        n_local, dim = values_local.shape
+        d = jax.lax.psum(1, axis)
+        n = n_local * d
+        ax = jax.lax.axis_index(axis)
+
+        # Global view for the medians: [N, M], a few KB — one ICI hop.
+        values = jax.lax.all_gather(values_local, axis, tiled=True)
+
+        # ---- FIRST PASS ----
+        all_mask = jnp.ones(n, dtype=bool)
+        essence1 = stats.masked_smooth_median(values, all_mask, cfg.smooth_mode)
+
+        # Per-shard risks; scalar mean via psum (no second gather needed
+        # for the reliability estimate).
+        qr_local = stats.quadratic_risk(values_local, essence1)
+        mean_qr = jax.lax.psum(jnp.sum(qr_local), axis) / n
+        if cfg.constrained:
+            rel1 = 1.0 - 2.0 * jnp.sqrt(mean_qr / dim)
+        else:
+            rel1 = 1.0 - jnp.minimum(cfg.max_spread, jnp.sqrt(mean_qr)) / cfg.max_spread
+
+        # Global rank mask needs all N risks: gather N scalars.
+        qr = jax.lax.all_gather(qr_local, axis, tiled=True)
+        reliable = sort_ops.reliability_mask(qr, cfg.n_failing)
+
+        # ---- SECOND PASS ----
+        if cfg.constrained:
+            essence2 = stats.masked_smooth_median(values, reliable, cfg.smooth_mode)
+        else:
+            essence2 = stats.masked_mean(values, reliable)
+        # Reference quirk: second-pass risk still centered on essence₁
+        # (contract.cairo:414/:484) — reuse qr, re-masked, via psum.
+        reliable_local = jax.lax.dynamic_slice_in_dim(
+            reliable, ax * n_local, n_local
+        )
+        n_rel = jax.lax.psum(jnp.sum(reliable_local.astype(qr_local.dtype)), axis)
+        masked_qr_sum = jax.lax.psum(jnp.sum(qr_local * reliable_local), axis)
+        mean_qr2 = masked_qr_sum / jnp.maximum(n_rel, 1.0)
+        if cfg.constrained:
+            rel2 = 1.0 - 2.0 * jnp.sqrt(mean_qr2 / dim)
+        else:
+            rel2 = 1.0 - jnp.minimum(cfg.max_spread, jnp.sqrt(mean_qr2)) / cfg.max_spread
+
+        # ---- MOMENTS over the reliable subset, psum-reduced ----
+        w = reliable_local[:, None].astype(values_local.dtype)
+        mean_rel = (
+            jax.lax.psum(jnp.sum(values_local * w, axis=0), axis)
+            / jnp.maximum(n_rel, 1.0)
+        )
+        centered = (values_local - mean_rel[None, :]) * w
+        var = jax.lax.psum(jnp.sum(centered**2, axis=0), axis) / jnp.maximum(
+            n_rel, 1.0
+        )
+        std = jnp.maximum(jnp.sqrt(var), 1e-30)
+        z = centered / std[None, :]
+        s3 = jax.lax.psum(jnp.sum(z**3, axis=0), axis)
+        s4 = jax.lax.psum(jnp.sum(z**4, axis=0), axis)
+        denom_s = jnp.maximum((n_rel - 1.0) * (n_rel - 2.0), 1.0)
+        skew = s3 * n_rel / denom_s
+        t1 = s4 * n_rel * (n_rel + 1.0) / jnp.maximum(n_rel - 1.0, 1.0)
+        t2 = 3.0 * (n_rel - 1.0) ** 2
+        kurt = (t1 - t2) / jnp.maximum((n_rel - 2.0) * (n_rel - 3.0), 1.0)
+
+        valid = jnp.logical_and(stats.interval_ok(rel1), stats.interval_ok(rel2))
+
+        return ConsensusOutput(
+            essence=essence2,
+            essence_first_pass=essence1,
+            reliability_first_pass=rel1,
+            reliability_second_pass=rel2,
+            reliable=reliable_local,
+            quadratic_risk=qr_local,
+            skewness=skew,
+            kurtosis=kurt,
+            interval_valid=valid,
+        )
+
+    return body
+
+
+def sharded_consensus_fn(
+    mesh: Mesh, cfg: ConsensusConfig, axis: str = "oracle"
+) -> Callable[[jnp.ndarray], ConsensusOutput]:
+    """Jitted two-pass consensus with ``values [N, M]`` sharded over ``axis``.
+
+    Per-oracle outputs (``reliable``, ``quadratic_risk``) come back
+    sharded over ``axis``; block outputs (essence, reliabilities,
+    moments) replicated.  Semantics identical to
+    :func:`svoc_tpu.consensus.kernel.consensus_step`
+    (equivalence-tested in ``tests/test_parallel.py``).
+    """
+    body = _consensus_body(cfg, axis)
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis, None),),
+        out_specs=ConsensusOutput(
+            essence=P(),
+            essence_first_pass=P(),
+            reliability_first_pass=P(),
+            reliability_second_pass=P(),
+            reliable=P(axis),
+            quadratic_risk=P(axis),
+            skewness=P(),
+            kurtosis=P(),
+            interval_valid=P(),
+        ),
+        check_rep=False,
+    )
+    values_sharding = NamedSharding(mesh, P(axis, None))
+    return jax.jit(mapped, in_shardings=(values_sharding,))
+
+
+def _fleet_body(
+    n_oracles: int,
+    n_failing: int,
+    subset_size: int,
+    axis: str,
+):
+    """Per-device generation of the local oracle shard.
+
+    Mirrors ``gen_oracles_predictions`` (``client/oracle_scheduler.py:
+    73-92``): a global random permutation decides which oracle slots are
+    the uniform-random failing ones (the post-shuffle view), and every
+    oracle's stream is keyed by its *global* index — so the fleet is
+    bitwise identical however it is sharded.
+    """
+
+    def body(key, window):
+        n_local = n_oracles // jax.lax.psum(1, axis)
+        ax = jax.lax.axis_index(axis)
+        w = window.shape[0]
+
+        # Same key on every device → same permutation (replicated compute).
+        perm = jax.random.permutation(jax.random.fold_in(key, 0), n_oracles)
+        failing_slot = jnp.zeros(n_oracles, bool).at[perm[:n_failing]].set(True)
+
+        global_idx = ax * n_local + jnp.arange(n_local)
+
+        def one_oracle(i):
+            k = jax.random.fold_in(key, i + 1)
+            k_fail, k_boot = jax.random.split(k)
+            fail_val = jax.random.uniform(k_fail, (window.shape[1],))
+            idx = jax.random.choice(k_boot, w, shape=(subset_size,), replace=False)
+            boot_val = jnp.mean(window[idx], axis=0)
+            return jnp.where(failing_slot[i], fail_val, boot_val)
+
+        values_local = jax.vmap(one_oracle)(global_idx)
+        honest_local = ~failing_slot[global_idx]
+        return values_local, honest_local
+
+    return body
+
+
+def sharded_fleet_step_fn(
+    mesh: Mesh,
+    cfg: ConsensusConfig,
+    n_oracles: int,
+    subset_size: int = 10,
+    axis: str = "oracle",
+):
+    """Jitted end-to-end simulation step: sentiment window → sharded
+    bootstrap fleet → sharded consensus.
+
+    ``(key, window [W, M]) → (ConsensusOutput, honest_mask [N])`` with
+    the fleet materialized only as device-local shards — the 1024-oracle
+    pod-sim configuration of BASELINE.json.
+    """
+    n_dev = mesh.devices.size
+    if n_oracles % n_dev:
+        raise ValueError(f"n_oracles={n_oracles} not divisible by mesh size {n_dev}")
+    gen = _fleet_body(n_oracles, cfg.n_failing, subset_size, axis)
+    consensus = _consensus_body(cfg, axis)
+
+    def step(key, window):
+        values_local, honest_local = gen(key, window)
+        return consensus(values_local), honest_local
+
+    mapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(
+            ConsensusOutput(
+                essence=P(),
+                essence_first_pass=P(),
+                reliability_first_pass=P(),
+                reliability_second_pass=P(),
+                reliable=P(axis),
+                quadratic_risk=P(axis),
+                skewness=P(),
+                kurtosis=P(),
+                interval_valid=P(),
+            ),
+            P(axis),
+        ),
+        check_rep=False,
+    )
+    return jax.jit(mapped)

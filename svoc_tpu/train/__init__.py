@@ -1,0 +1,13 @@
+"""Fine-tuning: sharded optax training for the sentiment encoder.
+
+New capability relative to the reference (which consumes a frozen HF
+checkpoint, ``client/oracle_scheduler.py:23-24``): the framework can
+fine-tune its classifier on labeled comment batches, data-parallel ×
+tensor-parallel over a device mesh.
+"""
+
+from svoc_tpu.train.trainer import (  # noqa: F401
+    TrainState,
+    make_sharded_train_step,
+    make_train_step,
+)

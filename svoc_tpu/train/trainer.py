@@ -1,0 +1,145 @@
+"""Jittable, mesh-shardable training step for :class:`SentimentEncoder`.
+
+Design: one pure ``train_step(state, batch) → (state, metrics)`` function
+jitted under GSPMD.  Parallelism is expressed only through shardings —
+params follow the Megatron tensor-parallel layout of
+:func:`svoc_tpu.models.encoder.param_shardings` over the ``"model"``
+axis, batches shard over ``"data"`` — and XLA inserts the ICI
+collectives (all-reduce of activations inside blocks, gradient
+all-reduce across data shards).  ``cfg.remat=True`` rematerializes
+encoder blocks so activation memory stays flat at long sequence lengths.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from svoc_tpu.models.encoder import SentimentEncoder, param_shardings
+
+
+class TrainState(NamedTuple):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+
+class Batch(NamedTuple):
+    ids: jnp.ndarray  # [B, T] int32
+    mask: jnp.ndarray  # [B, T] int32
+    labels: jnp.ndarray  # [B, n_labels] float (multi-hot) or [B] int
+
+
+def _loss_fn(model: SentimentEncoder, params, batch: Batch) -> jnp.ndarray:
+    logits = model.apply(params, batch.ids, batch.mask)
+    if model.cfg.head == "sigmoid":  # multi-label BCE (go_emotions)
+        losses = optax.sigmoid_binary_cross_entropy(logits, batch.labels)
+        return jnp.mean(jnp.sum(losses, axis=-1))
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits, batch.labels)
+    )
+
+
+def make_train_step(model: SentimentEncoder, tx: optax.GradientTransformation):
+    """Single-device/jit-only training step (no explicit shardings)."""
+
+    def train_step(state: TrainState, batch: Batch) -> Tuple[TrainState, Dict]:
+        loss, grads = jax.value_and_grad(lambda p: _loss_fn(model, p, batch))(
+            state.params
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        return (
+            TrainState(state.step + 1, params, opt_state),
+            {"loss": loss, "grad_norm": gnorm},
+        )
+
+    return jax.jit(train_step)
+
+
+def init_state(model: SentimentEncoder, params, tx) -> TrainState:
+    return TrainState(jnp.zeros((), jnp.int32), params, tx.init(params))
+
+
+def make_sharded_train_step(
+    model: SentimentEncoder,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    data_axis: str = "data",
+    model_axis: str = "model",
+    params_template: Optional[Any] = None,
+):
+    """GSPMD training step over a ``data × model`` mesh.
+
+    Returns ``(train_step, shard_state, batch_sharding)``:
+
+    - ``train_step(state, batch)`` — jitted with explicit in/out
+      shardings (params tensor-parallel, batch data-parallel),
+    - ``shard_state(state)`` — device_put a host state onto the mesh,
+    - ``batch_sharding`` — NamedSharding for incoming batches.
+    """
+    if params_template is None:
+        raise ValueError("params_template required to derive shardings")
+
+    p_shard = param_shardings(params_template, mesh, model_axis=model_axis)
+
+    scalar = NamedSharding(mesh, P())
+    batch_sharding = Batch(
+        ids=NamedSharding(mesh, P(data_axis, None)),
+        mask=NamedSharding(mesh, P(data_axis, None)),
+        labels=NamedSharding(mesh, P(data_axis)),
+    )
+
+    def _opt_state_shardings():
+        """Optimizer moments mirror the param tree as subtrees (adam's
+        ``mu``/``nu``), so match opt-state leaves to param shardings by
+        tree-path *suffix*; anything else (step counts…) replicates.
+        ``eval_shape`` keeps this allocation-free."""
+        by_path = {}
+        for path, s in jax.tree_util.tree_flatten_with_path(p_shard)[0]:
+            by_path[tuple(str(k) for k in path)] = s
+
+        def for_leaf(path, leaf):
+            keys = tuple(str(k) for k in path)
+            for start in range(len(keys)):
+                hit = by_path.get(keys[start:])
+                if hit is not None:
+                    return hit
+            return scalar
+
+        opt_shapes = jax.eval_shape(tx.init, params_template)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(opt_shapes)
+        return jax.tree_util.tree_unflatten(
+            treedef, [for_leaf(p, l) for p, l in flat]
+        )
+
+    state_shardings = TrainState(
+        step=scalar, params=p_shard, opt_state=_opt_state_shardings()
+    )
+
+    def step_fn(state: TrainState, batch: Batch):
+        loss, grads = jax.value_and_grad(lambda p: _loss_fn(model, p, batch))(
+            state.params
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(state.step + 1, params, opt_state),
+            {"loss": loss, "grad_norm": optax.global_norm(grads)},
+        )
+
+    train_step = jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings=(state_shardings, scalar),
+    )
+
+    def shard_state(state: TrainState) -> TrainState:
+        return jax.device_put(state, state_shardings)
+
+    return train_step, shard_state, batch_sharding

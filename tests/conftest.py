@@ -7,9 +7,19 @@ without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the outer environment may point JAX at real TPU hardware
+# (e.g. JAX_PLATFORMS=axon); the test suite must be hermetic and see a
+# deterministic 8-device virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon sitecustomize pins jax at the TPU platform regardless of the
+# env var — override through jax.config as well (must happen before any
+# backend is initialized).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,89 @@
+"""Sharded consensus == single-device kernel, on an 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step
+from svoc_tpu.parallel.mesh import MeshSpec, best_mesh, make_mesh
+from svoc_tpu.parallel.sharded import sharded_consensus_fn, sharded_fleet_step_fn
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert jax.device_count() >= 8, "conftest must force 8 virtual CPU devices"
+    return best_mesh("oracle")
+
+
+CFGS = [
+    ConsensusConfig(n_failing=2, constrained=True),
+    ConsensusConfig(n_failing=3, constrained=False, max_spread=10.0),
+]
+
+
+@pytest.mark.parametrize("cfg", CFGS, ids=["constrained", "unconstrained"])
+def test_sharded_matches_single_device(mesh, cfg):
+    key = jax.random.PRNGKey(7)
+    n, m = 64, 6
+    values = jax.random.uniform(key, (n, m))
+    ref = consensus_step(values, cfg)
+    fn = sharded_consensus_fn(mesh, cfg)
+    out = fn(values)
+
+    np.testing.assert_allclose(out.essence, ref.essence, rtol=1e-5)
+    np.testing.assert_allclose(
+        out.essence_first_pass, ref.essence_first_pass, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(out.reliability_first_pass),
+        float(ref.reliability_first_pass),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(out.reliability_second_pass),
+        float(ref.reliability_second_pass),
+        rtol=1e-5,
+    )
+    np.testing.assert_array_equal(np.asarray(out.reliable), np.asarray(ref.reliable))
+    np.testing.assert_allclose(out.quadratic_risk, ref.quadratic_risk, rtol=1e-5)
+    np.testing.assert_allclose(out.skewness, ref.skewness, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out.kurtosis, ref.kurtosis, rtol=1e-4, atol=1e-5)
+
+
+def test_fleet_step_sharding_invariance(mesh):
+    """The fleet is keyed by global oracle index, so a 1-device and an
+    8-device mesh must produce identical fleets and consensus."""
+    cfg = ConsensusConfig(n_failing=8, constrained=True)
+    n_oracles, w, m = 64, 50, 6
+    key = jax.random.PRNGKey(3)
+    window = jax.random.uniform(jax.random.PRNGKey(11), (w, m))
+
+    mesh1 = make_mesh(MeshSpec(("oracle",), (1,)))
+    out8, honest8 = sharded_fleet_step_fn(mesh, cfg, n_oracles)(key, window)
+    out1, honest1 = sharded_fleet_step_fn(mesh1, cfg, n_oracles)(key, window)
+
+    np.testing.assert_array_equal(np.asarray(honest8), np.asarray(honest1))
+    np.testing.assert_allclose(out8.essence, out1.essence, rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(out8.reliable), np.asarray(out1.reliable)
+    )
+    # sanity: fleet injects the configured number of failures
+    assert int(jnp.sum(~honest8)) == cfg.n_failing
+
+
+def test_fleet_step_detects_failures(mesh):
+    """With a tight honest window, rank-based masking should flag mostly
+    the injected uniform oracles."""
+    cfg = ConsensusConfig(n_failing=8, constrained=True)
+    n_oracles, w, m = 64, 50, 6
+    # Tight honest cluster near 0.5 → failing uniforms stick out.
+    window = 0.5 + 0.01 * jax.random.normal(jax.random.PRNGKey(0), (w, m))
+    window = jnp.clip(window, 0.0, 1.0)
+    fn = sharded_fleet_step_fn(mesh, cfg, n_oracles)
+    hits = 0
+    trials = 10
+    for t in range(trials):
+        out, honest = fn(jax.random.PRNGKey(100 + t), window)
+        hits += int(jnp.all(out.reliable == honest))
+    assert hits >= 8, f"only {hits}/{trials} exact identifications"
