@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""End-to-end throughput benchmark: HN comments -> sentiment vectors ->
+1024-oracle stochastic fleet -> two-pass consensus.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "comments/sec", "vs_baseline": N}``
+
+Baseline: the reference client classifies a 30-comment window every 5 s
+with 7 oracles on CPU torch (~6 comments/sec; ``client/common.py:11``,
+``client/oracle_scheduler.py:171`` — see SURVEY.md §6).  Here the same
+pipeline — tokenize on host, jitted bf16 RoBERTa-base forward, tracked
+go_emotions labels sum-normalized on device, bootstrap oracle fleet +
+consensus as one fused XLA graph — runs on whatever ``jax.devices()``
+offers (one TPU chip under the driver).
+
+Env knobs: ``SVOC_BENCH_SMALL=1`` shrinks everything for CPU smoke
+runs; ``SVOC_BENCH_SECONDS`` (default 10) sets the timed window.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REFERENCE_COMMENTS_PER_SEC = 6.0  # 30 comments / 5 s simulation step
+
+
+def main() -> None:
+    small = os.environ.get("SVOC_BENCH_SMALL") == "1"
+    seconds = float(os.environ.get("SVOC_BENCH_SECONDS", "10"))
+
+    from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step
+    from svoc_tpu.models.configs import ROBERTA_GO_EMOTIONS, TINY_TEST
+    from svoc_tpu.models.sentiment import SentimentPipeline
+    from svoc_tpu.sim.oracle import gen_oracle_predictions
+
+    if small:
+        enc_cfg, batch, seq, n_oracles = TINY_TEST, 32, 32, 64
+    else:
+        enc_cfg, batch, seq, n_oracles = ROBERTA_GO_EMOTIONS, 256, 128, 1024
+
+    # PREDICTION_WINDOW (client/common.py:15), capped by the batch so the
+    # warmed-up shapes are exactly the timed-loop shapes.
+    window_size = min(50, batch)
+    ccfg = ConsensusConfig(n_failing=max(2, n_oracles // 8), constrained=True)
+
+    pipe = SentimentPipeline(
+        cfg=enc_cfg,
+        seq_len=seq,
+        batch_size=batch,
+        tokenizer_name=None if small else "SamLowe/roberta-base-go_emotions",
+    )
+    forward = pipe.forward_fn()
+
+    @jax.jit
+    def fleet_consensus(key, window):
+        values, honest = gen_oracle_predictions(
+            key, window, n_oracles, ccfg.n_failing, subset_size=10
+        )
+        out = consensus_step(values, ccfg)
+        return out.essence, out.reliability_second_pass, honest
+
+    # Pre-tokenize a rotating pool of batches so host tokenization
+    # overlaps device compute honestly (the io layer double-buffers the
+    # same way); tokenization cost is re-measured separately below.
+    from svoc_tpu.io.scraper import SyntheticSource
+
+    n_pool = 8
+    comments = SyntheticSource(batch=n_pool * batch, seed=0)()
+    t_tok0 = time.perf_counter()
+    pool = [
+        pipe.tokenizer(comments[i * batch : (i + 1) * batch], seq)
+        for i in range(n_pool)
+    ]
+    tok_per_sec = n_pool * batch / (time.perf_counter() - t_tok0)
+    pool = [(jnp.asarray(ids), jnp.asarray(mask)) for ids, mask in pool]
+
+    # Warmup / compile.
+    vecs = forward(pipe.params, *pool[0])
+    window = jnp.tile(vecs[:1], (window_size, 1))
+    key = jax.random.PRNGKey(0)
+    essence, rel2, _ = fleet_consensus(key, window)
+    jax.block_until_ready((vecs, essence))
+
+    # Timed loop: each iteration = classify one batch of comments and
+    # run a full fleet+consensus update on the refreshed window.
+    n_comments = 0
+    steps = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        ids, mask = pool[steps % n_pool]
+        vecs = forward(pipe.params, ids, mask)
+        window = vecs[:window_size]
+        key = jax.random.fold_in(key, steps)
+        essence, rel2, _ = fleet_consensus(key, window)
+        n_comments += batch
+        steps += 1
+    jax.block_until_ready(essence)
+    elapsed = time.perf_counter() - t0
+
+    device_cps = n_comments / elapsed
+    # End-to-end rate is gated by the slower of device compute and host
+    # tokenization running in parallel (double-buffered pipeline).
+    value = min(device_cps, tok_per_sec)
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "end-to-end HN-comment throughput: sentiment "
+                    f"({'tiny-f32' if small else 'roberta-base-bf16'}, seq {seq}) "
+                    f"-> {n_oracles}-oracle bootstrap fleet -> two-pass consensus"
+                ),
+                "value": round(value, 2),
+                "unit": "comments/sec",
+                "vs_baseline": round(value / REFERENCE_COMMENTS_PER_SEC, 2),
+                "detail": {
+                    "device_comments_per_sec": round(device_cps, 2),
+                    "host_tokenize_per_sec": round(tok_per_sec, 2),
+                    "steps": steps,
+                    "batch": batch,
+                    "seq_len": seq,
+                    "n_oracles": n_oracles,
+                    "consensus_reliability2": float(rel2),
+                    "elapsed_s": round(elapsed, 2),
+                    "backend": jax.devices()[0].platform,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

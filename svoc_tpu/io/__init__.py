@@ -1,0 +1,16 @@
+"""Host-side ingest and chain I/O.
+
+- :mod:`svoc_tpu.io.comment_store` — the durable comment database +
+  circular window reader (the reference's sqlite layer,
+  ``client/scraper.py:44-62`` + ``client/oracle_scheduler.py:44-69``).
+- :mod:`svoc_tpu.io.scraper` — Hacker News ingest loop (Selenium-gated)
+  with a synthetic offline source for benchmarks and tests.
+- :mod:`svoc_tpu.io.chain` — the Starknet adapter: felt252↔float codec,
+  account registry, read/write wrappers over a pluggable backend
+  (real ``starknet.py`` RPC or the in-memory contract simulator).
+"""
+
+from svoc_tpu.io.comment_store import CommentStore
+from svoc_tpu.io.chain import ChainAdapter, LocalChainBackend
+
+__all__ = ["CommentStore", "ChainAdapter", "LocalChainBackend"]

@@ -1,0 +1,332 @@
+"""Starknet chain adapter: typed reads/writes over a pluggable backend.
+
+Reference: ``client/contract.py`` — felt252↔float codec (``:35-53``),
+per-oracle ``Account`` registry loaded from ``data/sepolia.json``
+(``:61-90``), typed ``call_*`` read wrappers (``:131-190``), sequential
+per-oracle signed writes (``:200-264``), and index↔address resolution
+(``:95-123``).
+
+The rebuild splits this into:
+
+- :class:`ChainBackend` — the protocol: ``call(fn) -> felts`` and
+  ``invoke(caller, fn, **kwargs)``.
+- :class:`LocalChainBackend` — the in-memory contract simulator
+  (:class:`svoc_tpu.consensus.state.OracleConsensusContract`) speaking
+  the same felt calldata; the test/simulation double for the Starknet
+  VM (replaces the reference's Sepolia round-trip *and* its Cairo
+  test-VM impersonation harness).
+- :class:`StarknetBackend` — the real Sepolia path via ``starknet.py``
+  with the reference's V3 resource bounds; import-gated so the
+  framework works in zero-egress environments.
+- :class:`ChainAdapter` — the typed API used by the command layer,
+  protocol-identical for both backends.
+
+Addresses are plain ints (the felt address space); the adapter formats
+hex like the reference's ``to_hex`` where string forms are exposed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import Any, Dict, List, Optional, Protocol, Sequence, Tuple
+
+import numpy as np
+
+from svoc_tpu.consensus.state import OracleConsensusContract
+from svoc_tpu.ops.fixedpoint import (
+    float_to_fwsad,
+    fwsad_to_float,
+    wsad_to_felt,
+)
+
+#: Reference V3 transaction resource bounds (``client/contract.py:29-32``).
+RESOURCE_BOUND_L1_GAS = (259806, 153060543928007)
+
+
+def to_hex(x: int) -> str:
+    return f"0x{x:0x}"
+
+
+def from_hex(x: str) -> int:
+    return int(x, 16)
+
+
+class ChainBackend(Protocol):
+    def call(self, function_name: str) -> Any: ...
+
+    def call_as(self, caller: int, function_name: str) -> Any: ...
+
+    def invoke(self, caller: int, function_name: str, /, **kwargs) -> None: ...
+
+
+class LocalChainBackend:
+    """In-memory chain: the contract simulator behind the felt ABI.
+
+    Values cross this boundary exactly as they would cross the real one
+    — wsad ints two's-complement-wrapped around the felt prime — so the
+    adapter's codec path is exercised identically for sim and Sepolia.
+    """
+
+    def __init__(self, contract: OracleConsensusContract):
+        self.contract = contract
+
+    # -- reads: mirror the Cairo view entrypoints --------------------------
+
+    def call(self, function_name: str) -> Any:
+        c = self.contract
+        if function_name == "get_consensus_value":
+            return [wsad_to_felt(x) for x in c.get_consensus_value()]
+        if function_name == "get_skewness":
+            return [wsad_to_felt(x) for x in c.get_skewness()]
+        if function_name == "get_kurtosis":
+            return [wsad_to_felt(x) for x in c.get_kurtosis()]
+        if function_name == "get_first_pass_consensus_reliability":
+            return wsad_to_felt(c.get_first_pass_consensus_reliability())
+        if function_name == "get_second_pass_consensus_reliability":
+            return wsad_to_felt(c.get_second_pass_consensus_reliability())
+        if function_name == "consensus_active":
+            return c.consensus_active
+        if function_name == "get_admin_list":
+            return list(c.get_admin_list())
+        if function_name == "get_oracle_list":
+            return list(c.get_oracle_list())
+        if function_name == "get_predictions_dimension":
+            return c.get_predictions_dimension()
+        if function_name == "get_replacement_propositions":
+            return list(c.get_replacement_propositions())
+        raise KeyError(f"unknown view function {function_name!r}")
+
+    def call_as(self, caller: int, function_name: str) -> Any:
+        if function_name == "get_oracle_value_list":
+            return self.contract.get_oracle_value_list(caller)
+        raise KeyError(f"unknown caller-view function {function_name!r}")
+
+    # -- writes: the three invoke entrypoints ------------------------------
+
+    def invoke(self, caller: int, function_name: str, /, **kwargs) -> None:
+        c = self.contract
+        if function_name == "update_prediction":
+            c.update_prediction(caller, kwargs["prediction"], encoding="felt")
+        elif function_name == "update_proposition":
+            c.update_proposition(caller, kwargs["proposition"])
+        elif function_name == "vote_for_a_proposition":
+            c.vote_for_a_proposition(
+                caller, kwargs["which_admin"], kwargs["support_his_proposition"]
+            )
+        else:
+            raise KeyError(f"unknown invoke function {function_name!r}")
+
+
+class StarknetBackend:  # pragma: no cover — needs starknet.py + network
+    """Sepolia JSON-RPC backend (``client/contract.py`` semantics)."""
+
+    def __init__(
+        self,
+        node_url: str,
+        deployed_address: int,
+        accounts: Dict[int, Any],
+    ):
+        try:
+            from starknet_py.contract import Contract
+            from starknet_py.net.client_models import ResourceBounds
+            from starknet_py.net.full_node_client import FullNodeClient
+        except ImportError as e:
+            raise RuntimeError(
+                "StarknetBackend needs the 'starknet.py' package; use "
+                "LocalChainBackend for simulation"
+            ) from e
+        self._Contract = Contract
+        self._bounds = ResourceBounds(*RESOURCE_BOUND_L1_GAS)
+        self.client = FullNodeClient(node_url=node_url)
+        self.deployed_address = deployed_address
+        self.accounts = accounts  # address -> starknet Account
+        self._read_contract = asyncio.run(
+            Contract.from_address(provider=self.client, address=deployed_address)
+        )
+
+    def call(self, function_name: str) -> Any:
+        return asyncio.run(
+            self._read_contract.functions[function_name].call()
+        )[0]
+
+    def call_as(self, caller: int, function_name: str) -> Any:
+        contract = asyncio.run(
+            self._Contract.from_address(
+                provider=self.accounts[caller], address=self.deployed_address
+            )
+        )
+        return asyncio.run(contract.functions[function_name].call())[0]
+
+    def invoke(self, caller: int, function_name: str, /, **kwargs) -> None:
+        contract = asyncio.run(
+            self._Contract.from_address(
+                provider=self.accounts[caller], address=self.deployed_address
+            )
+        )
+        asyncio.run(
+            contract.functions[function_name].invoke_v3(
+                **kwargs, l1_resource_bounds=self._bounds
+            )
+        )
+
+
+def load_account_data(path: str) -> Tuple[List[dict], List[dict]]:
+    """Parse the ``data/sepolia.json`` layout (``client/contract.py:61-71``,
+    template at ``client/README.md:38-77``): 3 admin + 8 oracle entries of
+    ``{address, private_key, public_key}``."""
+    with open(path) as f:
+        data = json.load(f)
+    return data["admins"], data["oracles"]
+
+
+class ChainAdapter:
+    """The typed chain API (``call_*`` / ``invoke_*`` parity)."""
+
+    def __init__(self, backend: ChainBackend):
+        self.backend = backend
+        #: Last-read cache, the ``globalState.remote_*`` equivalent
+        #: (``client/common.py:43-55``) — rehydrated by ``resume``.
+        self.cache: Dict[str, Any] = {}
+
+    # -- reads (client/contract.py:131-190) --------------------------------
+
+    def call_consensus(self) -> List[float]:
+        v = [fwsad_to_float(x) for x in self.backend.call("get_consensus_value")]
+        self.cache["consensus"] = v
+        return v
+
+    def call_skewness(self) -> List[float]:
+        v = [fwsad_to_float(x) for x in self.backend.call("get_skewness")]
+        self.cache["skewness"] = v
+        return v
+
+    def call_kurtosis(self) -> List[float]:
+        v = [fwsad_to_float(x) for x in self.backend.call("get_kurtosis")]
+        self.cache["kurtosis"] = v
+        return v
+
+    def call_first_pass_consensus_reliability(self) -> float:
+        v = fwsad_to_float(
+            self.backend.call("get_first_pass_consensus_reliability")
+        )
+        self.cache["reliability_first_pass"] = v
+        return v
+
+    def call_second_pass_consensus_reliability(self) -> float:
+        v = fwsad_to_float(
+            self.backend.call("get_second_pass_consensus_reliability")
+        )
+        self.cache["reliability_second_pass"] = v
+        return v
+
+    def call_consensus_active(self) -> bool:
+        v = bool(self.backend.call("consensus_active"))
+        self.cache["consensus_active"] = v
+        return v
+
+    def call_admin_list(self) -> List:
+        v = self.backend.call("get_admin_list")
+        self.cache["admin_list"] = v
+        return v
+
+    def call_oracle_list(self) -> List:
+        v = self.backend.call("get_oracle_list")
+        self.cache["oracle_list"] = v
+        return v
+
+    def call_dimension(self) -> int:
+        v = int(self.backend.call("get_predictions_dimension"))
+        self.cache["dimension"] = v
+        return v
+
+    def call_replacement_propositions(self) -> List:
+        v = self.backend.call("get_replacement_propositions")
+        self.cache["replacement_propositions"] = v
+        return v
+
+    def call_oracle_value_list(self, caller) -> List:
+        v = self.backend.call_as(caller, "get_oracle_value_list")
+        self.cache["oracle_value_list"] = v
+        return v
+
+    # -- index/address resolution (client/contract.py:95-123) --------------
+
+    def address_to_oracle_index(self, address) -> int:
+        return self.call_oracle_list().index(address)
+
+    def oracle_index_to_address(self, index: int):
+        return self.call_oracle_list()[index]
+
+    def address_to_admin_index(self, address) -> int:
+        return self.call_admin_list().index(address)
+
+    def admin_index_to_address(self, index: int):
+        return self.call_admin_list()[index]
+
+    # -- writes (client/contract.py:200-264) -------------------------------
+
+    def invoke_update_prediction(self, oracle_address, prediction) -> None:
+        felts = [float_to_fwsad(float(x)) for x in np.asarray(prediction).ravel()]
+        self.backend.invoke(
+            oracle_address, "update_prediction", prediction=felts
+        )
+
+    def update_all_the_predictions(self, predictions: Sequence) -> int:
+        """One signed tx per oracle, in oracle-list order
+        (``client/contract.py:200-208``); returns tx count."""
+        oracles = self.call_oracle_list()
+        n = 0
+        for oracle, prediction in zip(oracles, predictions):
+            self.invoke_update_prediction(oracle, prediction)
+            n += 1
+        return n
+
+    def invoke_update_proposition(
+        self,
+        admin_address,
+        old_oracle_index: Optional[int] = None,
+        new_oracle_address: Optional[int] = None,
+    ) -> None:
+        if (old_oracle_index is None) != (new_oracle_address is None):
+            raise ValueError(
+                "old_oracle_index and new_oracle_address must be both set "
+                "or both None"
+            )
+        proposition = (
+            None
+            if old_oracle_index is None
+            else (old_oracle_index, new_oracle_address)
+        )
+        self.backend.invoke(
+            admin_address, "update_proposition", proposition=proposition
+        )
+
+    def invoke_vote_for_a_proposition(
+        self, admin_address, which_admin: int, support: bool
+    ) -> None:
+        self.backend.invoke(
+            admin_address,
+            "vote_for_a_proposition",
+            which_admin=which_admin,
+            support_his_proposition=support,
+        )
+
+    def resume(self) -> Dict[str, Any]:
+        """Composite chain read-back (the ``resume`` command,
+        ``client/web_interface.py:205-225``): refresh every cached view."""
+        self.call_consensus_active()
+        self.call_consensus()
+        self.call_first_pass_consensus_reliability()
+        self.call_second_pass_consensus_reliability()
+        self.call_skewness()
+        self.call_kurtosis()
+        self.call_admin_list()
+        self.call_oracle_list()
+        self.call_dimension()
+        try:
+            self.call_replacement_propositions()
+        except Exception:
+            self.cache["replacement_propositions"] = None  # replacement disabled
+        return dict(self.cache)

@@ -1,0 +1,156 @@
+"""Hacker News comment ingest.
+
+Reference: ``client/scraper.py`` — a headless-Firefox Selenium loop that
+loads ``news.ycombinator.com/newcomments``, extracts ``div.commtext``
+texts in-page (``client/hn_scraper.js:3-9``), appends them to the
+comment DB and sleeps ``rate`` seconds (default 600, ~30 posts/10 min —
+``client/README.md:85``), with a catch-up wait derived from the last
+stored timestamp on restart (``scraper.py:78-86``).
+
+Here the ingest loop is a small host-side pipeline stage over a
+pluggable *source*:
+
+- :class:`SeleniumHNSource` — behavior parity with the reference
+  (requires ``selenium`` + Firefox; unavailable in this image, so it is
+  import-gated and raises a clear error at construction),
+- :class:`SyntheticSource` — deterministic offline comment generator
+  for tests/benchmarks and the zero-egress environment.
+
+The loop itself (:func:`run_scraper`) is source-agnostic and can be run
+in a thread (the reference runs it as a subprocess, ``main.py:38``).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from svoc_tpu.io.comment_store import CommentStore
+
+#: Default scrape period in seconds (``scraper.py:21``).
+DEFAULT_RATE_S = 600
+
+HN_URL = "https://news.ycombinator.com/newcomments"
+#: The DOM selector extracted in-page (``client/hn_scraper.js:3``).
+COMMENT_SELECTOR = "div.commtext"
+
+
+class SeleniumHNSource:
+    """Live HN source with the reference's Selenium behavior."""
+
+    def __init__(self, headless: bool = True, timeout_s: float = 10.0):
+        try:
+            from selenium import webdriver
+            from selenium.webdriver.firefox.options import Options
+        except ImportError as e:  # pragma: no cover — selenium not baked in
+            raise RuntimeError(
+                "SeleniumHNSource needs the 'selenium' package and a "
+                "Firefox driver; use SyntheticSource in offline "
+                "environments"
+            ) from e
+        options = Options()
+        if headless:
+            options.add_argument("--headless")
+        self._webdriver = webdriver
+        self._driver = webdriver.Firefox(options=options)
+        self._timeout_s = timeout_s
+
+    def __call__(self) -> List[str]:  # pragma: no cover — needs a browser
+        from selenium.webdriver.common.by import By
+        from selenium.webdriver.support import expected_conditions as EC
+        from selenium.webdriver.support.ui import WebDriverWait
+
+        d = self._driver
+        d.get(HN_URL)
+        WebDriverWait(d, self._timeout_s).until(
+            EC.presence_of_element_located((By.CSS_SELECTOR, COMMENT_SELECTOR))
+        )
+        # The same extraction the reference runs in-page
+        # (hn_scraper.js:3-9), as a one-line script.
+        return d.execute_script(
+            "return Array.from(document.querySelectorAll('div.commtext'))"
+            ".map(e => e.textContent.trim());"
+        )
+
+    def close(self) -> None:  # pragma: no cover
+        self._driver.quit()
+
+
+class SyntheticSource:
+    """Deterministic offline comment batches (HN-comment-shaped text)."""
+
+    _VOCAB = (
+        "the a this compiler startup latency throughput rust python jax "
+        "tpu actually interesting scale database network kernel cache "
+        "memory model vector consensus oracle distributed blockchain "
+        "performance benchmark thread async await parse build deploy"
+    ).split()
+
+    def __init__(self, batch: int = 30, seed: int = 0):
+        self.batch = batch
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self) -> List[str]:
+        out = []
+        for _ in range(self.batch):
+            k = int(self._rng.integers(8, 60))
+            out.append(" ".join(self._rng.choice(self._VOCAB, size=k)))
+        return out
+
+
+def catch_up_delay_s(
+    last_timestamp: Optional[str], rate_s: float, now: Optional[float] = None
+) -> float:
+    """Seconds to sleep before the first scrape so restarts keep the
+    cadence (``scraper.py:78-86``): wait out the remainder of the period
+    that started at the last stored comment."""
+    if not last_timestamp:
+        return 0.0
+    try:
+        parsed = _dt.datetime.fromisoformat(last_timestamp)
+    except ValueError:
+        return 0.0
+    if parsed.tzinfo is None:
+        # sqlite CURRENT_TIMESTAMP stores naive UTC (the reference
+        # compares against utcnow, scraper.py:81) — don't let
+        # .timestamp() reinterpret it in the local zone.
+        parsed = parsed.replace(tzinfo=_dt.timezone.utc)
+    last = parsed.timestamp()
+    now = time.time() if now is None else now
+    elapsed = now - last
+    if elapsed < 0 or elapsed >= rate_s:
+        return 0.0
+    return rate_s - elapsed
+
+
+def run_scraper(
+    store: CommentStore,
+    source: Callable[[], Sequence[str]],
+    rate_s: float = DEFAULT_RATE_S,
+    max_rounds: Optional[int] = None,
+    stop_event: Optional[threading.Event] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> int:
+    """The scrape loop (``scraper.py:74-94``); returns comments stored.
+
+    ``max_rounds``/``stop_event`` bound the reference's infinite loop
+    for embedding in tests and the CLI.
+    """
+    total = 0
+    delay = catch_up_delay_s(store.last_timestamp(), rate_s)
+    if delay:
+        sleep(delay)
+    rounds = 0
+    while max_rounds is None or rounds < max_rounds:
+        if stop_event is not None and stop_event.is_set():
+            break
+        total += store.save(source())
+        rounds += 1
+        if max_rounds is not None and rounds >= max_rounds:
+            break
+        sleep(rate_s)
+    return total

@@ -85,8 +85,10 @@ class SentimentEncoder(nn.Module):
         # RoBERTa-style positions: count only real tokens, offset past the
         # pad id (parity with the reference tokenizer's position scheme).
         pos_ids = jnp.cumsum(mask, axis=-1) * mask + cfg.pad_id
+        # Table height max_len + pad_id + 1 = 514 for RoBERTa-base — the
+        # HF max_position_embeddings, so converted checkpoints load 1:1.
         pos = nn.Embed(
-            cfg.max_len + cfg.pad_id + 2, cfg.hidden, dtype=cfg.dtype, name="pos_emb"
+            cfg.max_len + cfg.pad_id + 1, cfg.hidden, dtype=cfg.dtype, name="pos_emb"
         )(pos_ids)
         x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_emb")(
             tok + pos
@@ -124,7 +126,7 @@ def param_shardings(params: Any, mesh, model_axis: str = "model"):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     col = ("ffn_in", "query", "key", "value")
-    row = ("ffn_out", "attention/out", "attention.out")
+    row = ("ffn_out", "attention/out")
 
     def spec_for(path_str: str, leaf) -> Any:
         if getattr(leaf, "ndim", 0) == 2 and path_str.endswith("kernel"):

@@ -91,6 +91,18 @@ class SentimentPipeline:
             pad_id=self.cfg.pad_id,
             max_len=self.seq_len,
         )
+        if (
+            self.tokenizer.vocab_size > self.cfg.vocab_size
+            or self.tokenizer.pad_id != self.cfg.pad_id
+        ):
+            # A cached HF tokenizer that doesn't match the model config
+            # would emit ids the embedding gather silently clamps —
+            # fall back to the hashing tokenizer sized for this model.
+            from svoc_tpu.models.tokenizer import HashingTokenizer
+
+            self.tokenizer = HashingTokenizer(
+                self.cfg.vocab_size, pad_id=self.cfg.pad_id, max_len=self.seq_len
+            )
         multi = self.cfg.head == "sigmoid"
         idx = self.label_indices
 

@@ -12,7 +12,7 @@ encoder blocks so activation memory stays flat at long sequence lengths.
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple, Optional, Tuple
+from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -44,22 +44,26 @@ def _loss_fn(model: SentimentEncoder, params, batch: Batch) -> jnp.ndarray:
     )
 
 
-def make_train_step(model: SentimentEncoder, tx: optax.GradientTransformation):
-    """Single-device/jit-only training step (no explicit shardings)."""
+def _step_body(model: SentimentEncoder, tx: optax.GradientTransformation):
+    """The unjitted update: shared by the plain and sharded factories."""
 
-    def train_step(state: TrainState, batch: Batch) -> Tuple[TrainState, Dict]:
+    def step_fn(state: TrainState, batch: Batch) -> Tuple[TrainState, Dict]:
         loss, grads = jax.value_and_grad(lambda p: _loss_fn(model, p, batch))(
             state.params
         )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        gnorm = optax.global_norm(grads)
         return (
             TrainState(state.step + 1, params, opt_state),
-            {"loss": loss, "grad_norm": gnorm},
+            {"loss": loss, "grad_norm": optax.global_norm(grads)},
         )
 
-    return jax.jit(train_step)
+    return step_fn
+
+
+def make_train_step(model: SentimentEncoder, tx: optax.GradientTransformation):
+    """Single-device/jit-only training step (no explicit shardings)."""
+    return jax.jit(_step_body(model, tx))
 
 
 def init_state(model: SentimentEncoder, params, tx) -> TrainState:
@@ -70,9 +74,10 @@ def make_sharded_train_step(
     model: SentimentEncoder,
     tx: optax.GradientTransformation,
     mesh: Mesh,
+    *,
+    params_template: Any,
     data_axis: str = "data",
     model_axis: str = "model",
-    params_template: Optional[Any] = None,
 ):
     """GSPMD training step over a ``data × model`` mesh.
 
@@ -83,9 +88,6 @@ def make_sharded_train_step(
     - ``shard_state(state)`` — device_put a host state onto the mesh,
     - ``batch_sharding`` — NamedSharding for incoming batches.
     """
-    if params_template is None:
-        raise ValueError("params_template required to derive shardings")
-
     p_shard = param_shardings(params_template, mesh, model_axis=model_axis)
 
     scalar = NamedSharding(mesh, P())
@@ -122,19 +124,8 @@ def make_sharded_train_step(
         step=scalar, params=p_shard, opt_state=_opt_state_shardings()
     )
 
-    def step_fn(state: TrainState, batch: Batch):
-        loss, grads = jax.value_and_grad(lambda p: _loss_fn(model, p, batch))(
-            state.params
-        )
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return (
-            TrainState(state.step + 1, params, opt_state),
-            {"loss": loss, "grad_norm": optax.global_norm(grads)},
-        )
-
     train_step = jax.jit(
-        step_fn,
+        _step_body(model, tx),
         in_shardings=(state_shardings, batch_sharding),
         out_shardings=(state_shardings, scalar),
     )

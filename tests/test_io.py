@@ -1,0 +1,149 @@
+"""io layer: comment store window semantics, scraper loop, chain adapter."""
+
+import numpy as np
+import pytest
+
+from svoc_tpu.consensus.state import OracleConsensusContract
+from svoc_tpu.io.chain import ChainAdapter, LocalChainBackend, to_hex
+from svoc_tpu.io.comment_store import CommentStore
+from svoc_tpu.io.scraper import SyntheticSource, catch_up_delay_s, run_scraper
+
+
+class TestCommentStore:
+    def test_schema_roundtrip(self):
+        with CommentStore() as s:
+            assert s.count() == 0
+            assert s.save(["a", "b", "", "c"]) == 3  # empties dropped
+            assert s.count() == 3
+            assert s.last_timestamp() is not None
+
+    def test_window_advances_before_reading(self):
+        """read_window_from_db quirk (oracle_scheduler.py:52): the cursor
+        moves by `window` first, so consecutive reads walk the table."""
+        with CommentStore() as s:
+            s.save([f"c{i}" for i in range(200)])
+            comments, dates, pos1 = s.read_window(0, window=50, limit=30)
+            assert len(comments) == 30 and len(dates) == 30
+            assert pos1 == 50
+            _, _, pos2 = s.read_window(pos1, window=50, limit=30)
+            assert pos2 == 100
+
+    def test_window_wraps_to_zero(self):
+        """position+window >= N resets to 0 (oracle_scheduler.py:53)."""
+        with CommentStore() as s:
+            s.save([f"c{i}" for i in range(120)])
+            _, _, pos = s.read_window(60, window=50, limit=30)
+            assert pos == 0
+
+    def test_empty_store(self):
+        with CommentStore() as s:
+            assert s.read_window(0) == ([], [], 0)
+
+    def test_reference_limit_quirk(self):
+        """Window constant 50 but SQL LIMIT 30 (common.py:15 vs
+        oracle_scheduler.py:61) — defaults preserve it."""
+        with CommentStore() as s:
+            s.save([f"c{i}" for i in range(200)])
+            comments, _, _ = s.read_window(0)
+            assert len(comments) == 30
+
+
+class TestScraper:
+    def test_loop_bounded_rounds(self):
+        with CommentStore() as s:
+            src = SyntheticSource(batch=7, seed=3)
+            slept = []
+            n = run_scraper(
+                s, src, rate_s=600, max_rounds=3, sleep=slept.append
+            )
+            assert n == 21 and s.count() == 21
+            assert slept == [600, 600]  # no sleep after the last round
+
+    def test_catch_up_delay(self):
+        import datetime
+
+        now = 1_000_000.0
+        # Naive UTC string, exactly as sqlite CURRENT_TIMESTAMP stores it.
+        last = datetime.datetime.fromtimestamp(
+            now - 100, tz=datetime.timezone.utc
+        ).replace(tzinfo=None).isoformat()
+        assert catch_up_delay_s(last, 600, now=now) == pytest.approx(500)
+        assert catch_up_delay_s(last, 60, now=now) == 0.0
+        assert catch_up_delay_s(None, 600, now=now) == 0.0
+        assert catch_up_delay_s("not-a-date", 600, now=now) == 0.0
+
+
+def make_adapter(dimension=2, constrained=True, max_spread=0.0):
+    admins = [0xA0, 0xA1, 0xA2]
+    oracles = [0x10 + i for i in range(7)]
+    contract = OracleConsensusContract(
+        admins=admins,
+        oracles=oracles,
+        required_majority=2,
+        n_failing_oracles=2,
+        constrained=constrained,
+        unconstrained_max_spread=max_spread,
+        dimension=dimension,
+    )
+    return ChainAdapter(LocalChainBackend(contract)), contract
+
+
+class TestChainAdapter:
+    def test_reads_empty_state(self):
+        adapter, _ = make_adapter()
+        assert adapter.call_consensus() == [0.0, 0.0]
+        assert adapter.call_consensus_active() is False
+        assert adapter.call_dimension() == 2
+        assert len(adapter.call_oracle_list()) == 7
+        assert len(adapter.call_admin_list()) == 3
+
+    def test_update_all_predictions_roundtrip(self):
+        """Floats encode to felt calldata, cross the ABI, decode back —
+        including the negative-value two's-complement path."""
+        adapter, contract = make_adapter(constrained=False, max_spread=10.0)
+        rng = np.random.default_rng(0)
+        preds = rng.normal([20, -12], 1.0, size=(7, 2))
+        assert adapter.update_all_the_predictions(preds) == 7
+        assert adapter.call_consensus_active() is True
+        consensus = adapter.call_consensus()
+        assert consensus[0] == pytest.approx(20, abs=1.5)
+        assert consensus[1] == pytest.approx(-12, abs=1.5)  # negative decode
+        rel2 = adapter.call_second_pass_consensus_reliability()
+        assert 0 < rel2 <= 1
+
+    def test_index_address_resolution(self):
+        adapter, _ = make_adapter()
+        assert adapter.oracle_index_to_address(3) == 0x13
+        assert adapter.address_to_oracle_index(0x13) == 3
+        assert adapter.admin_index_to_address(1) == 0xA1
+        assert adapter.address_to_admin_index(0xA2) == 2
+
+    def test_vote_flow_through_adapter(self):
+        adapter, contract = make_adapter()
+        adapter.invoke_update_proposition(0xA0, 6, 0x99)
+        assert adapter.call_replacement_propositions()[0] == (6, 0x99)
+        adapter.invoke_vote_for_a_proposition(0xA1, 0, True)
+        assert adapter.oracle_index_to_address(6) == 0x99
+
+    def test_invoke_proposition_validates_arg_pairing(self):
+        adapter, _ = make_adapter()
+        with pytest.raises(ValueError):
+            adapter.invoke_update_proposition(0xA0, 6, None)
+
+    def test_resume_rehydrates_cache(self):
+        adapter, _ = make_adapter()
+        state = adapter.resume()
+        assert state["consensus_active"] is False
+        assert state["dimension"] == 2
+        assert state["oracle_list"] == [0x10 + i for i in range(7)]
+        assert state["replacement_propositions"] == [None, None, None]
+
+    def test_admin_only_value_list(self):
+        adapter, _ = make_adapter()
+        with pytest.raises(Exception):
+            adapter.call_oracle_value_list(0x10)
+        values = adapter.call_oracle_value_list(0xA0)
+        assert len(values) == 7
+
+    def test_to_hex(self):
+        assert to_hex(255) == "0xff"
