@@ -66,48 +66,60 @@ def main() -> None:
         out = consensus_step(values, ccfg)
         return out.essence, out.reliability_second_pass, honest
 
-    # Pre-tokenize a rotating pool of batches so host tokenization
-    # overlaps device compute honestly (the io layer double-buffers the
-    # same way); tokenization cost is re-measured separately below.
+    # Host tokenization runs in a producer thread (the C++ tokenizer
+    # releases the GIL) feeding a double-buffered queue — the measured
+    # rate is the real overlapped end-to-end throughput, not a model.
+    from svoc_tpu.io.pipeline import PrefetchPipeline
     from svoc_tpu.io.scraper import SyntheticSource
 
     n_pool = 8
     comments = SyntheticSource(batch=n_pool * batch, seed=0)()
+    batches = [comments[i * batch : (i + 1) * batch] for i in range(n_pool)]
     t_tok0 = time.perf_counter()
-    pool = [
-        pipe.tokenizer(comments[i * batch : (i + 1) * batch], seq)
-        for i in range(n_pool)
-    ]
+    for chunk in batches:
+        pipe.tokenizer(chunk, seq)
     tok_per_sec = n_pool * batch / (time.perf_counter() - t_tok0)
-    pool = [(jnp.asarray(ids), jnp.asarray(mask)) for ids, mask in pool]
+
+    def endless_batches():
+        i = 0
+        while True:
+            yield batches[i % n_pool]
+            i += 1
 
     # Warmup / compile.
-    vecs = forward(pipe.params, *pool[0])
+    ids0, mask0 = pipe.tokenizer(batches[0], seq)
+    vecs = forward(pipe.params, jnp.asarray(ids0), jnp.asarray(mask0))
     window = jnp.tile(vecs[:1], (window_size, 1))
     key = jax.random.PRNGKey(0)
     essence, rel2, _ = fleet_consensus(key, window)
     jax.block_until_ready((vecs, essence))
 
-    # Timed loop: each iteration = classify one batch of comments and
-    # run a full fleet+consensus update on the refreshed window.
     n_comments = 0
     steps = 0
-    t0 = time.perf_counter()
-    while time.perf_counter() - t0 < seconds:
-        ids, mask = pool[steps % n_pool]
-        vecs = forward(pipe.params, ids, mask)
-        window = vecs[:window_size]
-        key = jax.random.fold_in(key, steps)
-        essence, rel2, _ = fleet_consensus(key, window)
-        n_comments += batch
-        steps += 1
-    jax.block_until_ready(essence)
-    elapsed = time.perf_counter() - t0
+    with PrefetchPipeline(
+        endless_batches(),
+        pipe.tokenizer,
+        seq_len=seq,
+        depth=4,
+        # H2D transfer happens on the producer thread too, so the
+        # consumer loop only dispatches device compute.
+        device_put=lambda b: jax.device_put((jnp.asarray(b[0]), jnp.asarray(b[1]))),
+    ) as stream:
+        t0 = time.perf_counter()
+        for ids, mask in stream:
+            vecs = forward(pipe.params, ids, mask)
+            window = vecs[:window_size]
+            key = jax.random.fold_in(key, steps)
+            essence, rel2, _ = fleet_consensus(key, window)
+            n_comments += batch
+            steps += 1
+            if time.perf_counter() - t0 >= seconds:
+                break
+        jax.block_until_ready(essence)
+        elapsed = time.perf_counter() - t0
 
-    device_cps = n_comments / elapsed
-    # End-to-end rate is gated by the slower of device compute and host
-    # tokenization running in parallel (double-buffered pipeline).
-    value = min(device_cps, tok_per_sec)
+    value = n_comments / elapsed
+    device_cps = value  # overlapped pipeline: one measured rate
 
     print(
         json.dumps(

@@ -1,0 +1,14 @@
+"""Application layer: the session state, command API, and CLI.
+
+Reproduces the reference client's user surface — the ``eel`` command
+language of ``client/web_interface.py:14-55`` and the process entry of
+``client/main.py`` — over the TPU-native stack: the fetch path runs the
+jitted sentiment + fleet + consensus graphs, the chain path goes through
+:mod:`svoc_tpu.io.chain` (local simulator by default, Sepolia when
+configured).
+"""
+
+from svoc_tpu.apps.session import Session, SessionConfig
+from svoc_tpu.apps.commands import CommandConsole
+
+__all__ = ["Session", "SessionConfig", "CommandConsole"]

@@ -1,0 +1,90 @@
+"""CLI entry point (``client/main.py`` parity).
+
+``python -m svoc_tpu.apps.cli [--dimension N] [--scraper] [--rate R]
+[--live_mode] [--disable_startup_fetch] [--seed-comments N]``
+
+Flags mirror ``client/main.py:15-24``; ``--disable_sepolia`` is implied
+(the local chain simulator is the default backend — pass
+``--contract-info`` + ``--accounts`` for the Sepolia path once
+``starknet.py`` is available).  Instead of the eel web UI, commands are
+read from stdin (same command language, ``help`` to list).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from svoc_tpu.apps.commands import CommandConsole
+from svoc_tpu.apps.session import Session, SessionConfig
+from svoc_tpu.io.comment_store import CommentStore
+from svoc_tpu.io.scraper import SyntheticSource
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="svoc",
+        description="TPU-native stochastic vector oracle consensus client",
+    )
+    p.add_argument("--dimension", type=int, default=6)
+    p.add_argument("--n-oracles", type=int, default=7)
+    p.add_argument("--n-failing", type=int, default=2)
+    p.add_argument("--scraper", action="store_true",
+                   help="run the ingest loop in the background")
+    p.add_argument("--rate", type=float, default=600.0,
+                   help="scraper period in seconds (main.py:23)")
+    p.add_argument("--refresh", type=float, default=5.0,
+                   help="auto_fetch period in seconds (common.py:11)")
+    p.add_argument("--live-scraper", action="store_true",
+                   help="scrape HN via Selenium when available")
+    p.add_argument("--live_mode", action="store_true")
+    p.add_argument("--disable_startup_fetch", action="store_true")
+    p.add_argument("--db", default=":memory:",
+                   help="comment store path (reference: data/comments.db)")
+    p.add_argument("--seed-comments", type=int, default=200,
+                   help="pre-seed an empty store with N synthetic comments")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    store = CommentStore(args.db)
+    if store.count() == 0 and args.seed_comments:
+        store.save(SyntheticSource(batch=args.seed_comments)())
+
+    session = Session(
+        config=SessionConfig(
+            n_oracles=args.n_oracles,
+            n_failing=args.n_failing,
+            dimension=args.dimension,
+            refresh_rate_s=args.refresh,
+            scraper_rate_s=args.rate,
+            live_scraper=args.live_scraper,
+        ),
+        store=store,
+    )
+    console = CommandConsole(session, write=print)
+
+    if args.scraper:
+        console.query("scraper on")
+    if args.live_mode:
+        console.query("live_mode on")
+    if not args.disable_startup_fetch:
+        # main.py:51-54 boots with resume + fetch.
+        console.query("resume")
+        console.query("fetch")
+
+    print("svoc console — 'help' for commands, 'exit' to quit")
+    while session.application_on:
+        try:
+            line = input("> ")
+        except EOFError:
+            break
+        console.query(line)
+    console.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,374 @@
+"""The textual command API (``client/web_interface.py`` parity).
+
+``CommandConsole.query(text)`` implements the command language
+documented at ``web_interface.py:14-55`` and dispatched at ``:133-303``.
+Instead of pushing to an eel websocket, every command returns the
+console lines it produced (and streams them through an optional
+``write`` callback), so the same dispatcher serves the CLI REPL, tests,
+and any future UI.
+
+Differences from the reference, on purpose:
+
+- ``scraper on/off`` actually works (background thread over the
+  session's comment store; the reference stubs it, ``:228-229``),
+- errors surface as ``error: ...`` lines rather than a generic
+  "An error has occurred" with the traceback on stdout,
+- ``auto_fetch`` runs a daemon timer thread instead of an eel sleep
+  loop (``oracle_scheduler.py:163-171``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from svoc_tpu.apps.session import Session
+
+HELP = """Commands:
+    - help / clear / exit
+
+    - fetch
+    - auto_fetch on/off (default: off)
+    - scraper on/off (default: off)
+    - live_mode on/off (default: off)
+    - metrics (throughput / latency counters)
+
+    - contract_declaration_address
+    - contract_address
+
+    - (S) commit (send update_prediction for each oracle)
+
+    - (S) resume
+    - (S) consensus
+    - (S) reliability_first_pass
+    - (S) reliability
+
+    - (S) is_consensus_active
+
+    - (S) admin_list
+    - (S) oracle_list
+    - (S) dimension
+    - (S) replacement_menu
+    - (S) replacement_propositions
+
+    - (S) update_proposition <caller_admin> None
+    - (S) update_proposition <caller_admin> <old_oracle> <new_oracle>
+    - (S) vote_for_a_proposition <caller_admin> <which_admin> yes/no
+
+For <admin> <oracle> arguments, specify either the contract index or
+the address starting with "0x".
+
+(S) indicates a chain interaction (local simulator or Sepolia).
+"""
+
+
+def on_off_to_bool(x: str) -> bool:
+    return x == "on"
+
+
+class CommandConsole:
+    """Stateful command dispatcher over a :class:`Session`."""
+
+    def __init__(
+        self,
+        session: Optional[Session] = None,
+        write: Optional[Callable[[str], None]] = None,
+    ):
+        self.session = session or Session()
+        self._write = write
+        self._auto_fetch_thread: Optional[threading.Thread] = None
+        self._scraper_stop: Optional[threading.Event] = None
+        self._scraper_thread: Optional[threading.Thread] = None
+
+    # -- address/index parsing (web_interface.py:71-107) -------------------
+
+    def _make_oracle_index(self, token: str) -> int:
+        if token.upper().startswith("0X"):
+            return self.session.adapter.address_to_oracle_index(int(token, 16))
+        return int(token)
+
+    def _make_admin_address(self, token: str):
+        if token.upper().startswith("0X"):
+            return int(token, 16)
+        return self.session.adapter.admin_index_to_address(int(token))
+
+    def _make_admin_index(self, token: str) -> int:
+        if token.upper().startswith("0X"):
+            return self.session.adapter.address_to_admin_index(int(token, 16))
+        return int(token)
+
+    def _propositions_as_str(self, only_not_none: bool = False) -> List[str]:
+        lines = []
+        for index, prop in enumerate(
+            self.session.adapter.call_replacement_propositions()
+        ):
+            if prop is None:
+                if not only_not_none:
+                    lines.append(f"- Admin {index} : None")
+            else:
+                lines.append(f"- Admin {index} :")
+                lines.append(f"  - {prop[0]} -> {hex(prop[1])}")
+        return lines
+
+    # -- dispatcher (web_interface.py:133-303) ------------------------------
+
+    def query(self, text: str) -> List[str]:
+        out: List[str] = []
+
+        def emit(line: str) -> None:
+            out.append(line)
+            if self._write:
+                self._write(line)
+
+        parts = text.split()
+        if not parts:
+            return out
+        cmd, args = parts[0], parts[1:]
+        adapter = self.session.adapter
+
+        try:
+            if cmd == "help":
+                emit(HELP)
+            elif cmd == "clear":
+                emit("\x1b[clear]")
+            elif cmd == "exit":
+                self.stop()
+                self.session.application_on = False
+            elif cmd == "fetch":
+                emit("Processing ..")
+                preview = self.session.fetch()
+                emit(
+                    f"fetched {preview['n_comments']} comments -> "
+                    f"{self.session.config.n_oracles} oracle predictions"
+                )
+                emit(
+                    "mean   : "
+                    + ", ".join(f"{x:0.3f}" for x in preview["mean"])
+                )
+                emit(
+                    "median : "
+                    + ", ".join(f"{x:0.3f}" for x in preview["median"])
+                )
+                suspects = [
+                    str(i)
+                    for i, r in enumerate(preview["normalized_ranks"])
+                    if r <= 0.2  # the UI's red threshold (simulation_graphics.js:97-99)
+                ]
+                emit("suspected failing oracles : " + ", ".join(suspects))
+            elif cmd == "auto_fetch":
+                if len(args) != 1:
+                    emit("Unexpected number of arguments.")
+                    return out
+                self.session.auto_fetch = on_off_to_bool(args[0])
+                if self.session.auto_fetch:
+                    emit("Auto-Fetch: ENABLED")
+                    self._start_auto_fetch()
+                else:
+                    emit("Auto-Fetch: DISABLED")
+            elif cmd == "commit":
+                if self.session.predictions is None:
+                    emit("Fetch before!")
+                else:
+                    emit("Commit predictions...")
+                    n = self.session.commit()
+                    emit(f"Done ({n} transactions).")
+            elif cmd == "consensus":
+                consensus = adapter.call_consensus()
+                emit("consensus :\n" + ",".join(f"{x:0.2f}" for x in consensus))
+            elif cmd == "reliability_first_pass":
+                emit(
+                    "reliability_first_pass : "
+                    f"{adapter.call_first_pass_consensus_reliability()}"
+                )
+            elif cmd == "reliability":
+                emit(
+                    "reliability : "
+                    f"{adapter.call_second_pass_consensus_reliability()}"
+                )
+            elif cmd == "resume":
+                state = adapter.resume()
+                emit(f"consensus_active: {state['consensus_active']}")
+                emit(
+                    "consensus : "
+                    + ", ".join(f"{x:0.2f}" for x in state["consensus"])
+                )
+                emit(
+                    "reliability_first_pass : "
+                    f"{state['reliability_first_pass']:0.3f}"
+                )
+                emit(
+                    "reliability_second_pass : "
+                    f"{state['reliability_second_pass']:0.3f}"
+                )
+                emit(
+                    "skewness : "
+                    + ", ".join(f"{x:0.2f}" for x in state["skewness"])
+                )
+                emit(
+                    "kurtosis : "
+                    + ", ".join(f"{x:0.2f}" for x in state["kurtosis"])
+                )
+            elif cmd == "is_consensus_active":
+                emit(f"Is consensus active: {adapter.call_consensus_active()}")
+            elif cmd == "admin_list":
+                emit("[Admin list]")
+                for idx, admin in enumerate(adapter.call_admin_list()):
+                    emit(f"Admin {idx} : {admin}")
+            elif cmd == "oracle_list":
+                emit("[Oracle list]")
+                for idx, oracle in enumerate(adapter.call_oracle_list()):
+                    emit(f"Oracle {idx} : {oracle}")
+            elif cmd == "dimension":
+                emit(f"Dimension: {adapter.call_dimension()}")
+            elif cmd in ("replacement_propositions", "replacement_menu"):
+                emit("Replacement propositions :")
+                for line in self._propositions_as_str():
+                    emit(line)
+            elif cmd == "update_proposition":
+                caller = self._make_admin_address(args[0])
+                if len(args) == 2 and args[1] == "None":
+                    adapter.invoke_update_proposition(caller)
+                    emit("Done.")
+                elif len(args) == 3:
+                    old_oracle = self._make_oracle_index(args[1])
+                    # New address: 0x-hex or decimal, like every other
+                    # address argument (help text contract).
+                    new_oracle = (
+                        int(args[2], 16)
+                        if args[2].upper().startswith("0X")
+                        else int(args[2])
+                    )
+                    adapter.invoke_update_proposition(
+                        caller, old_oracle, new_oracle
+                    )
+                    emit("Done.")
+                else:
+                    emit("Unexpected number of arguments.")
+            elif cmd == "vote_for_a_proposition":
+                if len(args) != 3:
+                    emit("Unexpected number of arguments.")
+                    return out
+                if args[2].upper() == "YES":
+                    value = True
+                elif args[2].upper() == "NO":
+                    value = False
+                else:
+                    emit("Invalid command: only yes/no accepted")
+                    return out
+                caller = self._make_admin_address(args[0])
+                which = self._make_admin_index(args[1])
+                adapter.invoke_vote_for_a_proposition(caller, which, value)
+                emit("Done.")
+            elif cmd == "get_oracle_value_list":
+                caller = self._make_admin_address(args[0]) if args else (
+                    adapter.call_admin_list()[0]
+                )
+                for row in adapter.call_oracle_value_list(caller):
+                    emit(str(row))
+            elif cmd == "contract_declaration_address":
+                emit(
+                    "Contract Declaration Address :\n"
+                    f"{self.session.config.declared_address}"
+                )
+            elif cmd == "contract_address":
+                emit(
+                    f"Contract Address :\n{self.session.config.deployed_address}"
+                )
+            elif cmd == "scraper":
+                if len(args) != 1:
+                    emit("Unexpected number of arguments.")
+                    return out
+                if on_off_to_bool(args[0]):
+                    source_name = self._start_scraper()
+                    emit(f"Scraper: ENABLED ({source_name})")
+                else:
+                    self._stop_scraper()
+                    emit("Scraper: DISABLED")
+            elif cmd == "metrics":
+                from svoc_tpu.utils.metrics import registry as _metrics
+
+                lines = _metrics.report()
+                for line in lines or ["no metrics recorded yet"]:
+                    emit(line)
+            elif cmd == "live_mode":
+                emit("Not implemented yet.")  # parity: web_interface.py:228
+            else:
+                emit(f"Unknown command: {cmd} (try 'help')")
+        except Exception as e:  # the dispatcher never crashes the REPL
+            emit(f"error: {type(e).__name__}: {e}")
+        return out
+
+    # -- background loops ---------------------------------------------------
+
+    def _start_auto_fetch(self) -> None:
+        """simulation_mode (oracle_scheduler.py:163-171): fetch every
+        ``refresh_rate_s`` while the flag holds."""
+        if self._auto_fetch_thread and self._auto_fetch_thread.is_alive():
+            return
+
+        def loop():
+            import time
+
+            while self.session.auto_fetch and self.session.application_on:
+                try:
+                    self.session.fetch()
+                except Exception as e:
+                    # Surface the failure (once per distinct message) and
+                    # count it, instead of silently spinning.
+                    msg = f"auto_fetch error: {type(e).__name__}: {e}"
+                    if msg != getattr(self, "_last_auto_fetch_error", None):
+                        self._last_auto_fetch_error = msg
+                        if self._write:
+                            self._write(msg)
+                    from svoc_tpu.utils.metrics import registry as _m
+
+                    _m.counter("auto_fetch_errors").add(1)
+                time.sleep(self.session.config.refresh_rate_s)
+
+        self._auto_fetch_thread = threading.Thread(target=loop, daemon=True)
+        self._auto_fetch_thread.start()
+
+    def _start_scraper(self) -> str:
+        """Start the ingest loop; returns the source actually used
+        ("hn-live" when Selenium is available and requested, else the
+        offline synthetic generator)."""
+        if self._scraper_thread and self._scraper_thread.is_alive():
+            return "already running"
+        from svoc_tpu.io.scraper import (
+            SeleniumHNSource,
+            SyntheticSource,
+            run_scraper,
+        )
+
+        source, source_name = None, "synthetic"
+        if self.session.config.live_scraper:
+            try:
+                source, source_name = SeleniumHNSource(), "hn-live"
+            except RuntimeError:
+                source_name = "synthetic (selenium unavailable)"
+        if source is None:
+            source = SyntheticSource()
+
+        self._scraper_stop = threading.Event()
+        stop = self._scraper_stop
+
+        def loop():
+            run_scraper(
+                self.session.store,
+                source,
+                rate_s=self.session.config.scraper_rate_s,
+                stop_event=stop,
+                sleep=lambda s: stop.wait(s),
+            )
+
+        self._scraper_thread = threading.Thread(target=loop, daemon=True)
+        self._scraper_thread.start()
+        return source_name
+
+    def _stop_scraper(self) -> None:
+        if self._scraper_stop is not None:
+            self._scraper_stop.set()
+
+    def stop(self) -> None:
+        self.session.auto_fetch = False
+        self._stop_scraper()

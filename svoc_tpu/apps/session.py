@@ -1,0 +1,204 @@
+"""Session state + the fetch/commit simulation engine.
+
+The reference keeps everything in the mutable ``globalState`` singleton
+(``client/common.py:36-77``) and spreads the fetch path over
+``oracle_scheduler.py`` (``simulation_fetch`` → ``sentiment_analysis`` →
+``gen_oracles_predictions`` → ``predictions_to_eel_values``).  Here the
+session is an explicit object owning:
+
+- the comment store + circular cursor (``globalState.simulation_step``),
+- the sentiment vectorizer (the jitted pipeline; injectable so tests
+  and the pure-synthetic mode skip transformer weights),
+- the jitted bootstrap-fleet generator,
+- the chain adapter (local simulator or Sepolia),
+- the last fleet predictions (``globalState.predictions``).
+
+Defaults mirror ``client/common.py:7-31``: 7 oracles, 2 failing, window
+50/limit 30, bootstrap subset 10, 6 go_emotions labels, 5 s refresh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from svoc_tpu.consensus.state import OracleConsensusContract
+from svoc_tpu.io.chain import ChainAdapter, LocalChainBackend
+from svoc_tpu.io.comment_store import (
+    PREDICTION_WINDOW,
+    SQL_FETCH_LIMIT,
+    CommentStore,
+)
+from svoc_tpu.ops.stats import rank_array
+from svoc_tpu.sim.oracle import gen_oracle_predictions
+from svoc_tpu.utils.metrics import registry as metrics
+
+
+@dataclasses.dataclass
+class SessionConfig:
+    """``client/common.py:7-31`` constants, as explicit configuration."""
+
+    n_oracles: int = 7
+    n_failing: int = 2
+    dimension: int = 6
+    bootstrap_subset: int = 10
+    window: int = PREDICTION_WINDOW
+    fetch_limit: int = SQL_FETCH_LIMIT
+    #: auto-fetch period (SIMULATION_REFRESH_RATE, common.py:11).
+    refresh_rate_s: float = 5.0
+    #: scraper period (scraper.py:21 default 600 s) — a separate cadence.
+    scraper_rate_s: float = 600.0
+    #: use the live Selenium HN source when available (else synthetic).
+    live_scraper: bool = False
+    constrained: bool = True
+    max_spread: float = 0.0
+    required_majority: int = 2
+    n_admins: int = 3
+    seed: int = 0
+    #: Deployment info (``data/contract_info.json`` fields).
+    declared_address: Optional[str] = None
+    deployed_address: Optional[str] = None
+
+
+def _default_contract(cfg: SessionConfig) -> OracleConsensusContract:
+    """A local contract with synthetic felt-style addresses (admins
+    0xA0…, oracles 0x10…, the test fixtures' role layout)."""
+    return OracleConsensusContract(
+        admins=[0xA0 + i for i in range(cfg.n_admins)],
+        oracles=[0x10 + i for i in range(cfg.n_oracles)],
+        required_majority=cfg.required_majority,
+        n_failing_oracles=cfg.n_failing,
+        constrained=cfg.constrained,
+        unconstrained_max_spread=cfg.max_spread,
+        dimension=cfg.dimension,
+    )
+
+
+@partial(jax.jit, static_argnames=("n_oracles", "n_failing", "subset"))
+def _fleet(key, window, n_oracles, n_failing, subset):
+    return gen_oracle_predictions(key, window, n_oracles, n_failing, subset)
+
+
+@jax.jit
+def _preview_stats(values):
+    """``predictions_to_eel_values`` math (``oracle_scheduler.py:106-134``):
+    fleet mean, fleet median, and per-oracle normalized rank of deviation
+    from the mean (rank 0 = most deviant — suspected failing)."""
+    mean = jnp.mean(values, axis=0)
+    median = jnp.median(values, axis=0)
+    dev = jnp.linalg.norm(values - mean[None, :], axis=-1)
+    normalized, _ranks = rank_array(dev)
+    return mean, median, normalized
+
+
+class Session:
+    """One client session (the ``globalState`` replacement)."""
+
+    def __init__(
+        self,
+        config: Optional[SessionConfig] = None,
+        store: Optional[CommentStore] = None,
+        vectorizer: Optional[Callable[[Sequence[str]], np.ndarray]] = None,
+        adapter: Optional[ChainAdapter] = None,
+    ):
+        self.config = config or SessionConfig()
+        self.store = store or CommentStore()
+        self._vectorizer = vectorizer
+        self.adapter = adapter or ChainAdapter(
+            LocalChainBackend(_default_contract(self.config))
+        )
+        self.predictions: Optional[np.ndarray] = None
+        self.last_preview: Optional[Dict] = None
+        self.simulation_step: int = 0
+        self.auto_fetch: bool = False
+        self.application_on: bool = True
+        self._key = jax.random.PRNGKey(self.config.seed)
+
+    # -- sentiment stage ----------------------------------------------------
+
+    @property
+    def vectorizer(self) -> Callable[[Sequence[str]], np.ndarray]:
+        """texts → ``[B, dimension]`` vectors; the jitted RoBERTa pipeline
+        by default (``gen_classifier`` equivalent), built lazily so
+        sessions that never fetch don't pay transformer init.  The label
+        subset is sized to ``config.dimension`` (the 6 tracked
+        go_emotions labels when it is 6, the first ``dimension`` labels
+        of the 28-label head otherwise) so fetch output always matches
+        the contract's dimension."""
+        if self._vectorizer is None:
+            from svoc_tpu.models.sentiment import (
+                GO_EMOTIONS_LABELS,
+                TRACKED_INDICES,
+                SentimentPipeline,
+            )
+
+            dim = self.config.dimension
+            if dim == len(TRACKED_INDICES):
+                indices = TRACKED_INDICES
+            elif dim <= len(GO_EMOTIONS_LABELS):
+                indices = tuple(range(dim))
+            else:
+                raise ValueError(
+                    f"dimension {dim} exceeds the {len(GO_EMOTIONS_LABELS)}"
+                    "-label head — pass an explicit vectorizer"
+                )
+            self._vectorizer = SentimentPipeline(label_indices=indices)
+        return self._vectorizer
+
+    # -- the fetch path (simulation_fetch, oracle_scheduler.py:155-161) -----
+
+    def fetch(self) -> Dict:
+        """One simulation step: window → sentiment → fleet → preview.
+
+        Returns the preview dict (fleet values, mean/median, normalized
+        deviation ranks, honest ground truth) and caches ``predictions``
+        for ``commit``.
+        """
+        with metrics.timer("fetch_latency").time():
+            comments, _dates, self.simulation_step = self.store.read_window(
+                self.simulation_step, self.config.window, self.config.fetch_limit
+            )
+            if not comments:
+                raise RuntimeError(
+                    "comment store is empty — run the scraper (or seed the "
+                    "store) before fetching"
+                )
+            window = jnp.asarray(
+                np.asarray(self.vectorizer(comments), dtype=np.float32)
+            )
+            self._key, sub = jax.random.split(self._key)
+            values, honest = _fleet(
+                sub,
+                window,
+                self.config.n_oracles,
+                self.config.n_failing,
+                self.config.bootstrap_subset,
+            )
+            mean, median, ranks = _preview_stats(values)
+        metrics.counter("comments_processed").add(len(comments))
+        self.predictions = np.asarray(values, dtype=np.float64)
+        self.last_preview = {
+            "values": self.predictions,
+            "mean": np.asarray(mean),
+            "median": np.asarray(median),
+            "normalized_ranks": np.asarray(ranks),
+            "honest": np.asarray(honest),
+            "n_comments": len(comments),
+        }
+        return self.last_preview
+
+    # -- the commit path (contract.py:200-208) ------------------------------
+
+    def commit(self) -> int:
+        """Send every oracle's prediction as its own signed tx."""
+        if self.predictions is None:
+            raise RuntimeError("fetch before commit")
+        with metrics.timer("commit_latency").time():
+            n = self.adapter.update_all_the_predictions(self.predictions)
+        metrics.counter("chain_transactions").add(n)
+        return n

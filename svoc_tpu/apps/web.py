@@ -1,0 +1,221 @@
+"""Minimal web UI (reference L7 parity, dependency-free).
+
+The reference serves an ``eel`` app — Chart.js scatter plots per label
+pair, reliability progress bars, a console wired to ``query()``, and an
+oracle-replacement menu (``client/web/``, SURVEY.md §2.3).  This module
+reproduces that surface with the standard library only (the image has
+no ``eel``/CDN access): an ``http.server`` serving one self-contained
+HTML page (hand-rolled canvas scatter plots) plus two JSON endpoints:
+
+- ``POST /api/query`` — body = command text, response = console lines
+  (the same :class:`svoc_tpu.apps.commands.CommandConsole` dispatcher
+  the CLI uses; SURVEY's eel-websocket boundary becomes plain HTTP),
+- ``GET /api/state`` — the last fetch preview + cached chain state,
+  driving the plots and progress bars.
+
+Start with ``python -m svoc_tpu.apps.web`` or ``serve(console)``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from svoc_tpu.apps.commands import CommandConsole
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>svoc console</title>
+<style>
+ body { font-family: monospace; background: #111; color: #ddd; margin: 1rem; }
+ h2 { color: #8cf; }
+ #plots { display: flex; flex-wrap: wrap; gap: 1rem; }
+ canvas { background: #1a1a2a; border: 1px solid #345; }
+ #console { background: #000; padding: .5rem; height: 14rem; overflow-y: scroll;
+            white-space: pre-wrap; border: 1px solid #345; }
+ #cmd { width: 100%; background: #222; color: #ddd; border: 1px solid #345;
+        font-family: monospace; padding: .3rem; }
+ .bar { background: #333; height: 1rem; width: 20rem; }
+ .bar > div { height: 100%; background: #4c4; }
+ .bar.low > div { background: #c44; }
+</style></head>
+<body>
+<h2>svoc — stochastic vector oracle consensus</h2>
+<div>reliability first pass <div class="bar" id="rel1"><div style="width:0"></div></div>
+     reliability second pass <div class="bar" id="rel2"><div style="width:0"></div></div></div>
+<div id="plots"></div>
+<div id="console"></div>
+<input id="cmd" placeholder="command ('help' to list)" autofocus>
+<script>
+const consoleEl = document.getElementById('console');
+function writeLines(lines) {
+  for (const l of lines) {
+    if (l === '\\x1b[clear]') { consoleEl.textContent = ''; continue; }
+    consoleEl.textContent += l + '\\n';
+  }
+  consoleEl.scrollTop = consoleEl.scrollHeight;
+}
+async function query(text) {
+  const r = await fetch('/api/query', {method: 'POST', body: text});
+  writeLines(await r.json());
+  refresh();
+}
+document.getElementById('cmd').addEventListener('keydown', e => {
+  if (e.key === 'Enter') { query(e.target.value); e.target.value = ''; }
+});
+function drawScatter(canvas, pts, colors, mean, median) {
+  const ctx = canvas.getContext('2d');
+  ctx.clearRect(0, 0, canvas.width, canvas.height);
+  const pad = 20, w = canvas.width - 2 * pad, h = canvas.height - 2 * pad;
+  const xs = pts.map(p => p[0]).concat([mean[0], median[0]]);
+  const ys = pts.map(p => p[1]).concat([mean[1], median[1]]);
+  const x0 = Math.min(...xs), x1 = Math.max(...xs);
+  const y0 = Math.min(...ys), y1 = Math.max(...ys);
+  const sx = v => pad + w * (v - x0) / (x1 - x0 + 1e-9);
+  const sy = v => pad + h * (1 - (v - y0) / (y1 - y0 + 1e-9));
+  pts.forEach((p, i) => {
+    ctx.fillStyle = colors[i];
+    ctx.beginPath(); ctx.arc(sx(p[0]), sy(p[1]), 4, 0, 7); ctx.fill();
+  });
+  ctx.fillStyle = '#8cf';
+  ctx.fillRect(sx(mean[0]) - 3, sy(mean[1]) - 3, 6, 6);
+  ctx.fillStyle = '#fc3';
+  ctx.fillRect(sx(median[0]) - 3, sy(median[1]) - 3, 6, 6);
+}
+async function refresh() {
+  const r = await fetch('/api/state');
+  const s = await r.json();
+  for (const [id, v] of [['rel1', s.reliability_first_pass],
+                         ['rel2', s.reliability_second_pass]]) {
+    const bar = document.getElementById(id);
+    const pct = Math.max(0, Math.min(100, (v || 0) * 100));
+    bar.firstElementChild.style.width = pct + '%';
+    bar.classList.toggle('low', pct < 50);  // sepolia_graphics.js:53-69
+  }
+  const plots = document.getElementById('plots');
+  plots.innerHTML = '';
+  if (!s.preview) return;
+  const vals = s.preview.values, ranks = s.preview.normalized_ranks;
+  const dim = vals[0].length;
+  for (let c = 0; c + 1 < dim; c += 2) {  // one plot per label pair
+    const canvas = document.createElement('canvas');
+    canvas.width = 260; canvas.height = 220;
+    plots.appendChild(canvas);
+    const pts = vals.map(v => [v[c], v[c + 1]]);
+    // red when normalized rank <= 0.2 (simulation_graphics.js:97-99)
+    const colors = ranks.map(r => r <= 0.2 ? '#e55' : '#5b5');
+    drawScatter(canvas, pts,
+      colors,
+      [s.preview.mean[c], s.preview.mean[c + 1]],
+      [s.preview.median[c], s.preview.median[c + 1]]);
+  }
+}
+refresh();
+</script></body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    console: CommandConsole  # set by serve()
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        if self.path == "/":
+            self._send(200, _PAGE.encode(), "text/html; charset=utf-8")
+        elif self.path == "/api/state":
+            session = self.console.session
+            state = dict(session.adapter.cache)
+            preview = session.last_preview
+            payload = {
+                "reliability_first_pass": state.get("reliability_first_pass"),
+                "reliability_second_pass": state.get("reliability_second_pass"),
+                "consensus": state.get("consensus"),
+                "consensus_active": state.get("consensus_active"),
+                "preview": None
+                if preview is None
+                else {
+                    "values": preview["values"].tolist(),
+                    "mean": preview["mean"].tolist(),
+                    "median": preview["median"].tolist(),
+                    "normalized_ranks": preview["normalized_ranks"].tolist(),
+                },
+            }
+            self._send(200, json.dumps(payload).encode(), "application/json")
+        else:
+            self._send(404, b"not found", "text/plain")
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/api/query":
+            self._send(404, b"not found", "text/plain")
+            return
+        length = int(self.headers.get("Content-Length", "0"))
+        text = self.rfile.read(length).decode("utf-8", "replace")
+        lines = self.console.query(text)
+        self._send(200, json.dumps(lines).encode(), "application/json")
+
+    def log_message(self, *args):  # silence request logging
+        pass
+
+
+def serve(
+    console: CommandConsole,
+    host: str = "127.0.0.1",
+    port: int = 8100,
+    block: bool = True,
+) -> Tuple[ThreadingHTTPServer, Optional[threading.Thread]]:
+    """Start the UI server.  ``block=False`` runs it on a daemon thread
+    and returns ``(server, thread)`` (the test/embedding mode; the
+    reference's ``eel.start(block=False)``, ``web_interface.py:61-67``)."""
+    handler = type("BoundHandler", (_Handler,), {"console": console})
+    server = ThreadingHTTPServer((host, port), handler)
+    if block:  # pragma: no cover — interactive mode
+        server.serve_forever()
+        return server, None
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread
+
+
+def main(argv=None) -> int:  # pragma: no cover — interactive entry
+    import argparse
+
+    from svoc_tpu.apps.cli import build_parser
+
+    parser = build_parser()
+    parser.add_argument("--port", type=int, default=8100)
+    parser.add_argument("--host", default="127.0.0.1")
+    args = parser.parse_args(argv)
+
+    from svoc_tpu.apps.session import Session, SessionConfig
+    from svoc_tpu.io.comment_store import CommentStore
+    from svoc_tpu.io.scraper import SyntheticSource
+
+    store = CommentStore(args.db)
+    if store.count() == 0 and args.seed_comments:
+        store.save(SyntheticSource(batch=args.seed_comments)())
+    session = Session(
+        config=SessionConfig(
+            n_oracles=args.n_oracles,
+            n_failing=args.n_failing,
+            dimension=args.dimension,
+            refresh_rate_s=args.refresh,
+            scraper_rate_s=args.rate,
+            live_scraper=args.live_scraper,
+        ),
+        store=store,
+    )
+    console = CommandConsole(session, write=print)
+    print(f"svoc web UI on http://{args.host}:{args.port}")
+    serve(console, host=args.host, port=args.port, block=True)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -34,7 +34,7 @@ from typing import Any, Dict, List, Optional, Protocol, Sequence, Tuple
 
 import numpy as np
 
-from svoc_tpu.consensus.state import OracleConsensusContract
+from svoc_tpu.consensus.state import ContractError, OracleConsensusContract
 from svoc_tpu.ops.fixedpoint import (
     float_to_fwsad,
     fwsad_to_float,
@@ -100,7 +100,14 @@ class LocalChainBackend:
 
     def call_as(self, caller: int, function_name: str) -> Any:
         if function_name == "get_oracle_value_list":
-            return self.contract.get_oracle_value_list(caller)
+            # Same encoding the chain would use: wsad values prime-wrapped
+            # to felt252 (contract.cairo:772-798 returns FeltVectors).
+            return [
+                (addr, [wsad_to_felt(x) for x in vec], enabled, reliable)
+                for addr, vec, enabled, reliable in (
+                    self.contract.get_oracle_value_list(caller)
+                )
+            ]
         raise KeyError(f"unknown caller-view function {function_name!r}")
 
     # -- writes: the three invoke entrypoints ------------------------------
@@ -247,7 +254,14 @@ class ChainAdapter:
         return v
 
     def call_oracle_value_list(self, caller) -> List:
-        v = self.backend.call_as(caller, "get_oracle_value_list")
+        """Admin-only raw dump, decoded: ``(address, [floats], enabled,
+        reliable)`` per oracle (``client/contract.py:188-190``)."""
+        v = [
+            (addr, [fwsad_to_float(x) for x in vec], enabled, reliable)
+            for addr, vec, enabled, reliable in self.backend.call_as(
+                caller, "get_oracle_value_list"
+            )
+        ]
         self.cache["oracle_value_list"] = v
         return v
 
@@ -327,6 +341,8 @@ class ChainAdapter:
         self.call_dimension()
         try:
             self.call_replacement_propositions()
-        except Exception:
-            self.cache["replacement_propositions"] = None  # replacement disabled
+        except ContractError:
+            # Contract deployed with replacement disabled; anything else
+            # (RPC failures, codec bugs) propagates like the other reads.
+            self.cache["replacement_propositions"] = None
         return dict(self.cache)

@@ -1,0 +1,146 @@
+"""HF RoBERTa checkpoint → :class:`SentimentEncoder` params.
+
+The reference's classifier is the HF torch model
+``SamLowe/roberta-base-go_emotions`` (``client/oracle_scheduler.py:23``);
+this converter maps a ``RobertaForSequenceClassification`` state dict
+onto the from-scratch Flax encoder so real weights (when present in the
+local HF cache — the environment has no egress) drive the TPU pipeline.
+
+Architecture correspondences (verified logit-for-logit against torch in
+``tests/test_convert.py``):
+
+- ``embeddings.word_embeddings``            → ``tok_emb``
+- ``embeddings.position_embeddings``        → ``pos_emb`` (same
+  cumsum-past-pad position scheme, table height ``max_len + pad + 1``)
+- ``embeddings.token_type_embeddings[0]``   → folded into ``pos_emb``
+  (RoBERTa uses a single token type, added uniformly)
+- ``encoder.layer.i.attention.self.q/k/v``  → ``block_i/attention/{query,key,value}``
+- ``attention.output.dense``                → ``block_i/attention/out``
+- ``attention.output.LayerNorm``            → ``block_i/ln_attn``
+- ``intermediate.dense`` / ``output.dense`` → ``block_i/ffn_in`` / ``ffn_out``
+- ``output.LayerNorm``                      → ``block_i/ln_ffn``
+- ``classifier.dense`` / ``out_proj``       → ``head_dense`` / ``head_out``
+
+Torch ``Linear`` weights are ``[out, in]`` and transpose to flax
+``[in, out]`` kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from svoc_tpu.models.configs import EncoderConfig
+from svoc_tpu.models.encoder import SentimentEncoder
+
+
+def _t(w) -> np.ndarray:
+    return np.asarray(w, dtype=np.float32).T
+
+
+def _a(w) -> np.ndarray:
+    return np.asarray(w, dtype=np.float32)
+
+
+def config_from_hf(hf_config, head: str = "sigmoid") -> EncoderConfig:
+    """Derive an :class:`EncoderConfig` from a HF ``RobertaConfig``."""
+    return EncoderConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        intermediate=hf_config.intermediate_size,
+        max_len=hf_config.max_position_embeddings - hf_config.pad_token_id - 1,
+        n_labels=hf_config.num_labels,
+        pad_id=hf_config.pad_token_id,
+        ln_eps=hf_config.layer_norm_eps,
+        head=head,
+    )
+
+
+def convert_roberta_state_dict(
+    state_dict: Dict[str, Any], cfg: EncoderConfig
+) -> Dict[str, Any]:
+    """Torch ``RobertaForSequenceClassification`` state dict → flax
+    params for ``SentimentEncoder(cfg)``."""
+    sd = {k: v.detach().cpu().numpy() for k, v in state_dict.items()}
+    pre = "roberta."
+
+    pos = _a(sd[pre + "embeddings.position_embeddings.weight"])
+    type0 = _a(sd[pre + "embeddings.token_type_embeddings.weight"])[0]
+    params: Dict[str, Any] = {
+        "tok_emb": {
+            "embedding": _a(sd[pre + "embeddings.word_embeddings.weight"])
+        },
+        # token type 0 is added to every position uniformly — fold it in.
+        "pos_emb": {"embedding": pos + type0[None, :]},
+        "ln_emb": {
+            "scale": _a(sd[pre + "embeddings.LayerNorm.weight"]),
+            "bias": _a(sd[pre + "embeddings.LayerNorm.bias"]),
+        },
+        "head_dense": {
+            "kernel": _t(sd["classifier.dense.weight"]),
+            "bias": _a(sd["classifier.dense.bias"]),
+        },
+        "head_out": {
+            "kernel": _t(sd["classifier.out_proj.weight"]),
+            "bias": _a(sd["classifier.out_proj.bias"]),
+        },
+    }
+
+    for i in range(cfg.n_layers):
+        hf = f"{pre}encoder.layer.{i}."
+        params[f"block_{i}"] = {
+            "attention": {
+                "query": {
+                    "kernel": _t(sd[hf + "attention.self.query.weight"]),
+                    "bias": _a(sd[hf + "attention.self.query.bias"]),
+                },
+                "key": {
+                    "kernel": _t(sd[hf + "attention.self.key.weight"]),
+                    "bias": _a(sd[hf + "attention.self.key.bias"]),
+                },
+                "value": {
+                    "kernel": _t(sd[hf + "attention.self.value.weight"]),
+                    "bias": _a(sd[hf + "attention.self.value.bias"]),
+                },
+                "out": {
+                    "kernel": _t(sd[hf + "attention.output.dense.weight"]),
+                    "bias": _a(sd[hf + "attention.output.dense.bias"]),
+                },
+            },
+            "ln_attn": {
+                "scale": _a(sd[hf + "attention.output.LayerNorm.weight"]),
+                "bias": _a(sd[hf + "attention.output.LayerNorm.bias"]),
+            },
+            "ffn_in": {
+                "kernel": _t(sd[hf + "intermediate.dense.weight"]),
+                "bias": _a(sd[hf + "intermediate.dense.bias"]),
+            },
+            "ffn_out": {
+                "kernel": _t(sd[hf + "output.dense.weight"]),
+                "bias": _a(sd[hf + "output.dense.bias"]),
+            },
+            "ln_ffn": {
+                "scale": _a(sd[hf + "output.LayerNorm.weight"]),
+                "bias": _a(sd[hf + "output.LayerNorm.bias"]),
+            },
+        }
+
+    return {"params": params}
+
+
+def load_hf_checkpoint(name_or_path: str, head: str = "sigmoid"):
+    """Load a cached HF checkpoint → ``(SentimentEncoder, params)``.
+
+    Requires the model in the local HF cache (no egress).
+    """
+    from transformers import AutoModelForSequenceClassification
+
+    model = AutoModelForSequenceClassification.from_pretrained(
+        name_or_path, local_files_only=True
+    )
+    cfg = config_from_hf(model.config, head=head)
+    params = convert_roberta_state_dict(model.state_dict(), cfg)
+    return SentimentEncoder(cfg), params

@@ -97,11 +97,13 @@ class SentimentPipeline:
         ):
             # A cached HF tokenizer that doesn't match the model config
             # would emit ids the embedding gather silently clamps —
-            # fall back to the hashing tokenizer sized for this model.
-            from svoc_tpu.models.tokenizer import HashingTokenizer
-
-            self.tokenizer = HashingTokenizer(
-                self.cfg.vocab_size, pad_id=self.cfg.pad_id, max_len=self.seq_len
+            # fall back to a hashing tokenizer sized for this model
+            # (native C++ when available, via the same selection logic).
+            self.tokenizer = load_tokenizer(
+                None,
+                self.cfg.vocab_size,
+                pad_id=self.cfg.pad_id,
+                max_len=self.seq_len,
             )
         multi = self.cfg.head == "sigmoid"
         idx = self.label_indices

@@ -96,7 +96,9 @@ class _HFTokenizerAdapter:
         self._tok = hf_tokenizer
         self.max_len = max_len
         self.pad_id = hf_tokenizer.pad_token_id or 0
-        self.vocab_size = hf_tokenizer.vocab_size
+        # len() includes added special tokens; .vocab_size does not —
+        # the larger figure is the real id range the model must cover.
+        self.vocab_size = max(len(hf_tokenizer), hf_tokenizer.vocab_size)
 
     def __call__(
         self, texts: Sequence[str], seq_len: Optional[int] = None
@@ -120,7 +122,8 @@ def load_tokenizer(
     pad_id: int = 1,
     max_len: int = 512,
 ):
-    """Best-effort cached HF tokenizer, falling back to hashing.
+    """Best-effort cached HF tokenizer, falling back to hashing (the
+    native C++ batch tokenizer when it builds, else the Python one).
 
     Never touches the network (``local_files_only=True``).
     """
@@ -132,4 +135,9 @@ def load_tokenizer(
             return _HFTokenizerAdapter(hf, max_len)
         except Exception:
             pass
-    return HashingTokenizer(vocab_size, pad_id=pad_id, max_len=max_len)
+    try:
+        from svoc_tpu.runtime import NativeHashingTokenizer
+
+        return NativeHashingTokenizer(vocab_size, pad_id=pad_id, max_len=max_len)
+    except RuntimeError:
+        return HashingTokenizer(vocab_size, pad_id=pad_id, max_len=max_len)

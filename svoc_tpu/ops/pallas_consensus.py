@@ -1,0 +1,236 @@
+"""Fused two-pass consensus as a single Pallas TPU kernel.
+
+The XLA version (:func:`svoc_tpu.consensus.kernel.consensus_step`)
+compiles to a dozen fused loops with intermediate HBM round-trips for
+the sorts; at fleet scale (N=1024, M≤32) the whole working set is a few
+hundred KB, so this kernel keeps *everything* resident in VMEM and
+computes both passes in one launch.
+
+Selection without sorting: Mosaic has no general sort lowering, so
+order statistics are computed by **rank counting** — for a key vector
+``k`` the rank of element i is ``Σ_j [k_j < k_i or (k_j == k_i and
+j > i)]``, the exact stable order of the reference's
+``IndexedMergeSort`` (``contract/src/sort.cairo:13-61``: ascending
+values, ties in descending index).  The O(N²) comparison matrix
+reduces to ranks on the MXU (HIGHEST precision — bf16 rounding would
+corrupt the counts), and the value at rank r is recovered with a
+one-hot matmul.  Semantics match ``consensus_step`` with
+``smooth_mode="cairo"`` (equivalence-tested in
+``tests/test_pallas_consensus.py``).  Fleets above
+:data:`PALLAS_MAX_ORACLES` fall back to the XLA kernel — see the
+constant's note on Mosaic compile scaling.
+
+Mosaic constraints shape the code: no scalar VMEM stores and no 1-D →
+0-D reductions, so every tensor stays 2-D ([N,1] columns, [1,M] rows,
+[1,1] scalars) and every reduction keeps dims.
+
+On non-TPU backends the kernel runs in interpreter mode (slow, for
+tests); :func:`fused_consensus` picks automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from svoc_tpu.consensus.kernel import ConsensusConfig
+
+
+def _stable_rank_2d(key_col: jnp.ndarray) -> jnp.ndarray:
+    """Rank of each element of ``key_col [N, 1]`` in the Cairo order
+    (ascending value, ties by descending index).  Returns ``[N, 1]`` f32
+    (exact integers — N ≪ 2²⁴).
+
+    The row reduction of the [N, N] comparison matrix runs as an MXU
+    matmul against a ones vector: at N=1024 the kernel needs 13 of
+    these, and matmul keeps both compile time and runtime far below the
+    equivalent VPU multi-reductions."""
+    n = key_col.shape[0]
+    idx = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)  # row i
+    jdx = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)  # col j
+    ki = key_col  # [N, 1] broadcasts over columns
+    kj = key_col.reshape(1, n)
+    before = ((kj < ki) | ((kj == ki) & (jdx > idx))).astype(jnp.float32)
+    ones = jnp.ones((n, 1), jnp.float32)
+    # HIGHEST precision: the TPU MXU otherwise rounds inputs to bf16,
+    # corrupting both the integer counts and downstream selections.
+    ranks = jax.lax.dot_general(
+        before,
+        ones,
+        (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.round(ranks)
+
+
+def _value_at_rank(col, ranks, r: int):
+    """``[1, 1]`` value of ``col [N, 1]`` whose rank equals ``r``."""
+    sel = (ranks == r).astype(jnp.float32)  # [N, 1] one-hot
+    return jax.lax.dot_general(
+        sel.reshape(1, -1),
+        col,
+        (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _column_smooth_median(col, mask_col, m: int):
+    """Cairo smooth median of the ``m`` unmasked entries: mean of ranks
+    m//2-1 and m//2 (``math.cairo:113-126`` degenerate branch).  [1,1]."""
+    key = col if mask_col is None else jnp.where(mask_col, col, jnp.inf)
+    ranks = _stable_rank_2d(key)
+    a = _value_at_rank(col, ranks, m // 2 - 1)
+    b = _value_at_rank(col, ranks, m // 2)
+    return (a + b) * 0.5
+
+
+def _consensus_kernel(
+    values_ref,
+    essence_ref,
+    essence1_ref,
+    rel_ref,
+    mask_ref,
+    qr_ref,
+    moments_ref,
+    *,
+    cfg: ConsensusConfig,
+    n: int,
+    dim: int,
+):
+    v = values_ref[:]  # [N, M] f32, fully VMEM-resident
+    cols = [v[:, c : c + 1] for c in range(dim)]
+
+    # ---- FIRST PASS ----
+    essence1 = jnp.concatenate(
+        [_column_smooth_median(c, None, n) for c in cols], axis=1
+    )  # [1, M]
+    diff = v - essence1
+    qr = jnp.sum(diff * diff, axis=1, keepdims=True)  # [N, 1]
+
+    def reliability(mean_qr):  # [1,1] -> [1,1]
+        if cfg.constrained:
+            return 1.0 - 2.0 * jnp.sqrt(mean_qr / dim)
+        u = jnp.sqrt(mean_qr)
+        return 1.0 - jnp.minimum(cfg.max_spread, u) / cfg.max_spread
+
+    rel1 = reliability(jnp.sum(qr, axis=0, keepdims=True) / n)
+
+    # Worst n_failing by risk → unreliable (contract.cairo:345-363).
+    risk_rank = _stable_rank_2d(qr)
+    reliable = risk_rank < (n - cfg.n_failing)  # [N, 1] bool
+
+    # ---- SECOND PASS (m = n - n_failing is static) ----
+    m = n - cfg.n_failing
+    if cfg.constrained:
+        essence2 = jnp.concatenate(
+            [_column_smooth_median(c, reliable, m) for c in cols], axis=1
+        )
+    else:
+        w = reliable.astype(jnp.float32)
+        essence2 = jnp.sum(v * w, axis=0, keepdims=True) / m
+    # Reference quirk: second-pass risk centered on essence₁.
+    rel2 = reliability(
+        jnp.sum(jnp.where(reliable, qr, 0.0), axis=0, keepdims=True) / m
+    )
+
+    # ---- MOMENTS over the reliable subset ----
+    w = reliable.astype(jnp.float32)  # [N, 1]
+    mean_rel = jnp.sum(v * w, axis=0, keepdims=True) / m  # [1, M]
+    centered = (v - mean_rel) * w
+    var = jnp.sum(centered * centered, axis=0, keepdims=True) / m
+    std = jnp.maximum(jnp.sqrt(var), 1e-30)
+    z = centered / std
+    mf = jnp.float32(m)
+    skew = jnp.sum(z**3, axis=0, keepdims=True) * mf / ((mf - 1.0) * (mf - 2.0))
+    t1 = jnp.sum(z**4, axis=0, keepdims=True) * mf * (mf + 1.0) / (mf - 1.0)
+    kurt = (t1 - 3.0 * (mf - 1.0) ** 2) / ((mf - 2.0) * (mf - 3.0))
+
+    essence_ref[:] = essence2
+    essence1_ref[:] = essence1
+    rel_ref[:] = jnp.concatenate([rel1, rel2], axis=1)  # [1, 2]
+    mask_ref[:] = reliable.astype(jnp.int32)
+    qr_ref[:] = qr
+    moments_ref[:] = jnp.concatenate([skew, kurt], axis=0)  # [2, M]
+
+
+class FusedConsensusOutput(NamedTuple):
+    essence: jnp.ndarray  # [M]
+    essence_first_pass: jnp.ndarray  # [M]
+    reliability_first_pass: jnp.ndarray  # scalar
+    reliability_second_pass: jnp.ndarray  # scalar
+    reliable: jnp.ndarray  # [N] bool
+    quadratic_risk: jnp.ndarray  # [N]
+    skewness: jnp.ndarray  # [M]
+    kurtosis: jnp.ndarray  # [M]
+
+
+#: Largest fleet the Pallas kernel compiles for.  The rank-counting
+#: kernel materializes [N, N] comparison tiles that Mosaic fully
+#: unrolls, so compile time grows ~quadratically (5 s at N=64, ~1 min
+#: at N=128, >10 min at N=1024).  The kernel's win is launch latency on
+#: small/medium fleets (the reference's N=7..64); above the cap
+#: :func:`fused_consensus` transparently runs the XLA graph, which is
+#: already ~1 ms at N=1024.
+PALLAS_MAX_ORACLES = 128
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "interpret"))
+def fused_consensus(
+    values: jnp.ndarray, cfg: ConsensusConfig, interpret: bool | None = None
+) -> FusedConsensusOutput:
+    """One-launch two-pass consensus on ``values [N, M]`` (float32).
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter
+    elsewhere (tests).  Fleets larger than :data:`PALLAS_MAX_ORACLES`
+    route to the XLA kernel with identical semantics and outputs.
+    """
+    n, dim = values.shape
+    # The kernel implements only the cairo degenerate smooth median;
+    # other smooth modes take the XLA path so semantics never depend on
+    # fleet size.
+    if n > PALLAS_MAX_ORACLES or cfg.smooth_mode != "cairo":
+        from svoc_tpu.consensus.kernel import consensus_step
+
+        out = consensus_step(values.astype(jnp.float32), cfg)
+        return FusedConsensusOutput(
+            essence=out.essence,
+            essence_first_pass=out.essence_first_pass,
+            reliability_first_pass=out.reliability_first_pass,
+            reliability_second_pass=out.reliability_second_pass,
+            reliable=out.reliable,
+            quadratic_risk=out.quadratic_risk,
+            skewness=out.skewness,
+            kurtosis=out.kurtosis,
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    values = values.astype(jnp.float32)
+    kernel = functools.partial(_consensus_kernel, cfg=cfg, n=n, dim=dim)
+    essence, essence1, rel, mask, qr, moments = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((1, dim), jnp.float32),
+            jax.ShapeDtypeStruct((1, dim), jnp.float32),
+            jax.ShapeDtypeStruct((1, 2), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((2, dim), jnp.float32),
+        ),
+        interpret=interpret,
+    )(values)
+    return FusedConsensusOutput(
+        essence=essence[0],
+        essence_first_pass=essence1[0],
+        reliability_first_pass=rel[0, 0],
+        reliability_second_pass=rel[0, 1],
+        reliable=mask[:, 0].astype(bool),
+        quadratic_risk=qr[:, 0],
+        skewness=moments[0],
+        kurtosis=moments[1],
+    )

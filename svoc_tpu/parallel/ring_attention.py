@@ -1,0 +1,136 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+Long-context capability absent from the reference (its only sequences
+are ≤512-token tokenizer outputs, SURVEY.md §5), built TPU-first: the
+sequence axis is sharded over the mesh, each device holds a Q/K/V block,
+and K/V blocks rotate around the ring via ``jax.lax.ppermute`` while a
+streaming (flash-style) softmax accumulates exact results — O(T/d)
+memory per device, compute/communication overlapped by XLA, collectives
+riding ICI neighbor links.
+
+The streaming accumulator is the standard online-softmax recurrence: for
+each incoming K/V block, rescale the running numerator/denominator by
+``exp(m_old − m_new)`` where ``m`` is the running row max.  Exactness
+(vs a monolithic softmax) is tested on an 8-device CPU mesh in
+``tests/test_ring_attention.py``.
+
+Layout: ``[batch, seq_shard, heads, head_dim]`` blocks, matching the
+encoder's attention layout (:mod:`svoc_tpu.models.encoder`).  Key
+padding masks travel around the ring with their K/V blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from svoc_tpu.parallel.sharded import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, kmask, scale):
+    """Scores + masked exp-stats for one K/V block.
+
+    Returns ``(m_blk [B,H,Tq], p [B,H,Tq,Tk], pv [B,Tq,H,D])`` where
+    ``p`` is un-normalized exp(scores − m_blk)."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    scores = jnp.where(kmask[:, None, None, :] > 0, scores, NEG_INF)
+    m_blk = jnp.max(scores, axis=-1)
+    p = jnp.exp(scores - m_blk[..., None])
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return m_blk, p, pv
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    kmask: Optional[jnp.ndarray] = None,
+    *,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Exact non-causal attention with K/V rotating over ``axis_name``.
+
+    Call inside ``shard_map``: every argument is the device-local block
+    ``q/k/v [B, T_local, H, D]``, ``kmask [B, T_local]`` (1 = real
+    token).  Returns the local output block ``[B, T_local, H, D]``.
+    """
+    if kmask is None:
+        kmask = jnp.ones(k.shape[:2], dtype=jnp.int32)
+    n_dev = jax.lax.psum(1, axis_name)
+    b, t_local, h, d = q.shape
+    scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(d))
+
+    # Running stats: row max m, denominator l, numerator o.
+    m0 = jnp.full((b, h, t_local), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+
+    def accumulate(k_blk, v_blk, mask_blk, m, l, o):
+        m_blk, p, pv = _block_attn(q, k_blk, v_blk, mask_blk, scale)
+        m_new = jnp.maximum(m, m_blk)
+        corr = jnp.exp(m - m_new)
+        corr_blk = jnp.exp(m_blk - m_new)
+        l = l * corr + jnp.sum(p, axis=-1) * corr_blk
+        # corr is [B,H,Tq] — broadcast onto the [B,Tq,H,D] accumulator.
+        corr_o = jnp.transpose(corr, (0, 2, 1))[..., None]
+        corr_pv = jnp.transpose(corr_blk, (0, 2, 1))[..., None]
+        o = o * corr_o + pv.astype(jnp.float32) * corr_pv
+        return m_new, l, o
+
+    # Local block first, then n_dev−1 rotations — no discarded final hop.
+    m, l, o = accumulate(k, v, kmask, m0, l0, o0)
+
+    def step(i, carry):
+        k_blk, v_blk, mask_blk, m, l, o = carry
+        # Rotate K/V (+ their padding mask) one hop around the ring.
+        perm = [(s, (s + 1) % n_dev) for s in range(n_dev)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
+        m, l, o = accumulate(k_blk, v_blk, mask_blk, m, l, o)
+        return (k_blk, v_blk, mask_blk, m, l, o)
+
+    k_blk, v_blk, mask_blk, m, l, o = jax.lax.fori_loop(
+        0, n_dev - 1, step, (k, v, kmask, m, l, o)
+    )
+    l_t = jnp.transpose(l, (0, 2, 1))[..., None]  # [B,Tq,H,1]
+    return (o / jnp.maximum(l_t, 1e-30)).astype(q.dtype)
+
+
+def ring_attention_fn(
+    mesh: Mesh, seq_axis: str = "seq"
+) -> Callable[..., jnp.ndarray]:
+    """Jitted ``(q, k, v, kmask) → out`` with the sequence dimension
+    sharded over ``seq_axis`` (batch/head dims replicated; compose with
+    data sharding by passing a multi-axis mesh and sharded inputs)."""
+    spec = P(None, seq_axis, None, None)
+    mask_spec = P(None, seq_axis)
+
+    def body(q, k, v, kmask):
+        return ring_attention(q, k, v, kmask, axis_name=seq_axis)
+
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, mask_spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return jax.jit(mapped)
+
+
+def dense_attention_reference(q, k, v, kmask=None):
+    """Monolithic-softmax reference for equivalence tests (the encoder's
+    attention math, :class:`svoc_tpu.models.encoder.SelfAttention`)."""
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if kmask is not None:
+        scores = jnp.where(kmask[:, None, None, :] > 0, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)

@@ -1,0 +1,23 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The compute path is JAX/XLA; this package holds the host-side native
+pieces around it — currently the batch tokenizer feeding the input
+pipeline (the stage the end-to-end benchmark is bound by).
+
+The shared library is built on demand with ``g++ -O3`` into
+``svoc_tpu/runtime/_build/`` and loaded with :mod:`ctypes`; every
+consumer falls back to the pure-Python implementation when no compiler
+is available, so the framework never hard-requires the native path.
+"""
+
+from svoc_tpu.runtime.native import (
+    NativeHashingTokenizer,
+    load_native_library,
+    native_available,
+)
+
+__all__ = [
+    "NativeHashingTokenizer",
+    "load_native_library",
+    "native_available",
+]

@@ -1,0 +1,137 @@
+"""Build + ctypes bindings for the native runtime library.
+
+``load_native_library()`` compiles ``tokenizer.cpp`` (and future
+translation units) into ``_build/libsvoc_runtime.so`` the first time it
+is called, memoizing the handle; failures (no compiler, read-only
+checkout) degrade to ``None`` and the Python fallbacks take over.
+
+:class:`NativeHashingTokenizer` is call-compatible with
+:class:`svoc_tpu.models.tokenizer.HashingTokenizer` and bit-identical
+on ASCII text (equality-tested in ``tests/test_runtime.py``); ctypes
+releases the GIL during the batch call, so tokenization overlaps
+device compute in the streaming pipeline.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_SRC_DIR = Path(__file__).resolve().parent
+_BUILD_DIR = _SRC_DIR / "_build"
+_LIB_PATH = _BUILD_DIR / "libsvoc_runtime.so"
+_SOURCES = ["tokenizer.cpp"]
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _compile() -> bool:
+    try:
+        srcs = [str(_SRC_DIR / s) for s in _SOURCES]
+        newest_src = max(os.path.getmtime(s) for s in srcs)
+        if _LIB_PATH.exists() and os.path.getmtime(_LIB_PATH) >= newest_src:
+            return True
+        _BUILD_DIR.mkdir(exist_ok=True)
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+             "-std=c++17", "-o", str(_LIB_PATH), *srcs],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        # No compiler / read-only checkout / missing sources: the
+        # Python fallback takes over.
+        return False
+
+
+def load_native_library() -> Optional[ctypes.CDLL]:
+    """Compile-on-demand + load; ``None`` when unavailable."""
+    global _lib, _load_attempted
+    with _lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        if not _compile():
+            return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+            lib.svoc_tokenize_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),  # texts
+                ctypes.c_int,  # n_texts
+                ctypes.c_int,  # seq_len
+                ctypes.c_int64,  # vocab_size
+                ctypes.c_int32,  # pad_id
+                ctypes.c_int32,  # bos_id
+                ctypes.c_int32,  # eos_id
+                ctypes.POINTER(ctypes.c_int32),  # ids out
+                ctypes.POINTER(ctypes.c_int32),  # mask out
+            ]
+            lib.svoc_tokenize_batch.restype = None
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native_library() is not None
+
+
+class NativeHashingTokenizer:
+    """Drop-in native replacement for ``HashingTokenizer``.
+
+    Same special-id layout (pad/bos/eos among ids 0..3) and the same
+    FNV-1a word hashing; raises :class:`RuntimeError` at construction
+    when the native library cannot be built.
+    """
+
+    N_SPECIAL = 4
+
+    def __init__(self, vocab_size: int, pad_id: int = 1, max_len: int = 512):
+        lib = load_native_library()
+        if lib is None:
+            raise RuntimeError(
+                "native runtime unavailable (no g++ or build failed) — "
+                "use svoc_tpu.models.tokenizer.HashingTokenizer"
+            )
+        self._lib = lib
+        self.vocab_size = vocab_size
+        self.pad_id = pad_id
+        self.max_len = max_len
+        specials = list(range(self.N_SPECIAL))
+        self.bos_id = next(i for i in specials if i != pad_id)
+        self.eos_id = next(
+            i for i in specials if i not in (pad_id, self.bos_id)
+        )
+
+    def __call__(
+        self, texts: Sequence[str], seq_len: Optional[int] = None
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        t = seq_len or self.max_len
+        b = len(texts)
+        ids = np.empty((b, t), dtype=np.int32)
+        mask = np.empty((b, t), dtype=np.int32)
+        encoded = [s.encode("utf-8") for s in texts]
+        arr = (ctypes.c_char_p * b)(*encoded)
+        self._lib.svoc_tokenize_batch(
+            arr,
+            b,
+            t,
+            self.vocab_size,
+            self.pad_id,
+            self.bos_id,
+            self.eos_id,
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            mask.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return ids, mask

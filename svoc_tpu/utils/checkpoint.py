@@ -1,0 +1,180 @@
+"""Checkpoint / resume.
+
+The reference has no model checkpoints; its durable state is the
+on-chain contract storage (rehydrated by ``resume``), the sqlite
+comment DB, and the deployment JSON files (SURVEY.md §5).  The TPU
+framework adds two things worth persisting:
+
+- **Training state** (:class:`svoc_tpu.train.trainer.TrainState`) —
+  saved with orbax, which handles sharded arrays natively: each host
+  writes its shards, restore re-shards onto the current mesh.
+- **Simulation state** — the contract simulator + session cursor, so a
+  long-running local simulation survives restarts the way the chain
+  does for the real deployment.  Exact wsad ints and vote state are
+  plain Python data, saved as JSON next to the orbax directory.
+
+Both paths are exercised in ``tests/test_checkpoint.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict
+
+from svoc_tpu.consensus.state import OracleConsensusContract
+from svoc_tpu.train.trainer import TrainState
+
+
+# ---------------------------------------------------------------------------
+# Training state (orbax)
+# ---------------------------------------------------------------------------
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_train_state(path: str, state: TrainState) -> None:
+    """Write a :class:`TrainState` checkpoint (orbax PyTree format)."""
+    _checkpointer().save(os.path.abspath(path), state)
+
+
+def restore_train_state(path: str, template: TrainState) -> TrainState:
+    """Restore a checkpoint onto ``template``'s tree structure.
+
+    The template (e.g. a freshly built ``init_state(...)``, or an
+    ``eval_shape`` + ``device_put`` abstract state for sharded restore)
+    supplies the typed pytree nodes — optax opt-state NamedTuples don't
+    survive an untyped restore — and, when its leaves carry shardings,
+    the placement onto the current mesh."""
+    restored = _checkpointer().restore(os.path.abspath(path), item=template)
+    if isinstance(restored, TrainState):
+        return restored
+    if isinstance(restored, dict):
+        return TrainState(**restored)
+    return TrainState(*restored)
+
+
+# ---------------------------------------------------------------------------
+# Simulation / contract state (JSON)
+# ---------------------------------------------------------------------------
+
+_SCHEMA_VERSION = 1
+
+
+def contract_to_dict(c: OracleConsensusContract) -> Dict[str, Any]:
+    """Serialize every storage slot of the contract simulator."""
+    return {
+        "version": _SCHEMA_VERSION,
+        "admins": list(c.admins),
+        "oracles": [
+            {
+                "address": o.address,
+                "enabled": o.enabled,
+                "reliable": o.reliable,
+                "value": list(o.value),
+            }
+            for o in c.oracles
+        ],
+        "enable_oracle_replacement": c.enable_oracle_replacement,
+        "required_majority": c.required_majority,
+        "n_failing_oracles": c.n_failing_oracles,
+        "constrained": c.constrained,
+        "unconstrained_max_spread": c.unconstrained_max_spread,
+        "dimension": c.dimension,
+        "strict_interval": c.strict_interval,
+        "n_active_oracles": c.n_active_oracles,
+        "consensus_active": c.consensus_active,
+        "consensus_value": list(c.consensus_value),
+        "reliability_first_pass": c.reliability_first_pass,
+        "reliability_second_pass": c.reliability_second_pass,
+        "skewness": list(c.skewness),
+        "kurtosis": list(c.kurtosis),
+        "vote_matrix": [
+            [i, j, v] for (i, j), v in c.vote_matrix.items() if v
+        ],
+        "replacement_propositions": [
+            list(p) if p is not None else None
+            for p in c.replacement_propositions
+        ],
+    }
+
+
+def contract_from_dict(d: Dict[str, Any]) -> OracleConsensusContract:
+    if d.get("version") != _SCHEMA_VERSION:
+        raise ValueError(f"unknown contract snapshot version {d.get('version')}")
+    c = OracleConsensusContract(
+        admins=d["admins"],
+        oracles=[o["address"] for o in d["oracles"]],
+        enable_oracle_replacement=d["enable_oracle_replacement"],
+        required_majority=d["required_majority"],
+        n_failing_oracles=d["n_failing_oracles"],
+        constrained=d["constrained"],
+        unconstrained_max_spread=0.0,
+        dimension=d["dimension"],
+        strict_interval=d["strict_interval"],
+    )
+    c.unconstrained_max_spread = int(d["unconstrained_max_spread"])
+    for info, o in zip(c.oracles, d["oracles"]):
+        info.enabled = o["enabled"]
+        info.reliable = o["reliable"]
+        info.value = [int(x) for x in o["value"]]
+    c.n_active_oracles = d["n_active_oracles"]
+    c.consensus_active = d["consensus_active"]
+    c.consensus_value = [int(x) for x in d["consensus_value"]]
+    c.reliability_first_pass = int(d["reliability_first_pass"])
+    c.reliability_second_pass = int(d["reliability_second_pass"])
+    c.skewness = [int(x) for x in d["skewness"]]
+    c.kurtosis = [int(x) for x in d["kurtosis"]]
+    for i, j, v in d["vote_matrix"]:
+        c.vote_matrix[(i, j)] = v
+    c.replacement_propositions = [
+        tuple(p) if p is not None else None
+        for p in d["replacement_propositions"]
+    ]
+    return c
+
+
+def save_simulation(path: str, session) -> None:
+    """Persist a :class:`svoc_tpu.apps.session.Session`'s durable state:
+    the local contract + the circular-window cursor (the volatile
+    ``globalState.simulation_step`` the reference loses on restart)."""
+    from svoc_tpu.io.chain import LocalChainBackend
+
+    backend = session.adapter.backend
+    if not isinstance(backend, LocalChainBackend):
+        raise ValueError(
+            "save_simulation only applies to local-simulator sessions; "
+            "Sepolia state lives on chain (use the resume command)"
+        )
+    payload = {
+        "version": _SCHEMA_VERSION,
+        "contract": contract_to_dict(backend.contract),
+        "simulation_step": session.simulation_step,
+        "config": dataclasses.asdict(session.config),
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f)
+
+
+def restore_simulation(path: str, session) -> None:
+    """Rehydrate ``session`` in place from :func:`save_simulation` —
+    contract, cursor, *and* config (so fleet shape always matches the
+    restored contract; a stale vectorizer sized for the old config is
+    dropped when the dimension changed)."""
+    from svoc_tpu.apps.session import SessionConfig
+    from svoc_tpu.io.chain import ChainAdapter, LocalChainBackend
+
+    with open(path) as f:
+        payload = json.load(f)
+    contract = contract_from_dict(payload["contract"])
+    restored_config = SessionConfig(**payload["config"])
+    if restored_config.dimension != session.config.dimension:
+        session._vectorizer = None
+    session.config = restored_config
+    session.adapter = ChainAdapter(LocalChainBackend(contract))
+    session.simulation_step = payload["simulation_step"]

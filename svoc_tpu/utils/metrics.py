@@ -1,0 +1,110 @@
+"""Throughput / latency metrics and profiler hooks.
+
+The reference's only instrumentation is Cairo gas budgets and print
+statements (SURVEY.md §5); the framework's north-star metric is
+end-to-end comments/sec and consensus-update latency, so those get
+first-class counters here, used by ``bench.py`` and the apps loop.
+
+``jax.profiler`` tracing is wrapped so a session can be profiled with
+one flag and inspected in TensorBoard/XProf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional
+
+
+@dataclass
+class Counter:
+    """A monotone event counter with rate reporting."""
+
+    count: float = 0.0
+    started_at: float = field(default_factory=time.perf_counter)
+
+    def add(self, n: float = 1.0) -> None:
+        self.count += n
+
+    def rate(self) -> float:
+        elapsed = time.perf_counter() - self.started_at
+        return self.count / elapsed if elapsed > 0 else 0.0
+
+    def reset(self) -> None:
+        self.count = 0.0
+        self.started_at = time.perf_counter()
+
+
+@dataclass
+class LatencyTimer:
+    """Running latency stats (count / mean / max, EMA of recent)."""
+
+    n: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+    ema_s: Optional[float] = None
+    ema_alpha: float = 0.1
+
+    def observe(self, seconds: float) -> None:
+        self.n += 1
+        self.total_s += seconds
+        self.max_s = max(self.max_s, seconds)
+        self.ema_s = (
+            seconds
+            if self.ema_s is None
+            else self.ema_alpha * seconds + (1 - self.ema_alpha) * self.ema_s
+        )
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.n if self.n else 0.0
+
+    @contextlib.contextmanager
+    def time(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0)
+
+
+class MetricsRegistry:
+    """Named counters/timers + one-line reporting."""
+
+    def __init__(self) -> None:
+        self.counters: Dict[str, Counter] = {}
+        self.timers: Dict[str, LatencyTimer] = {}
+
+    def counter(self, name: str) -> Counter:
+        return self.counters.setdefault(name, Counter())
+
+    def timer(self, name: str) -> LatencyTimer:
+        return self.timers.setdefault(name, LatencyTimer())
+
+    def report(self) -> List[str]:
+        lines = []
+        for name, c in sorted(self.counters.items()):
+            lines.append(f"{name}: {c.count:,.0f} ({c.rate():,.1f}/s)")
+        for name, t in sorted(self.timers.items()):
+            lines.append(
+                f"{name}: n={t.n} mean={t.mean_s * 1e3:.2f}ms "
+                f"max={t.max_s * 1e3:.2f}ms"
+            )
+        return lines
+
+
+#: Process-wide default registry (the apps layer and bench use this).
+registry = MetricsRegistry()
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str) -> Iterator[None]:
+    """``jax.profiler`` trace around a block; view with TensorBoard."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,163 @@
+"""apps layer: session fetch/commit engine and the command console."""
+
+import numpy as np
+import pytest
+
+from svoc_tpu.apps.commands import CommandConsole
+from svoc_tpu.apps.session import Session, SessionConfig
+from svoc_tpu.io.comment_store import CommentStore
+from svoc_tpu.io.scraper import SyntheticSource
+
+
+def fake_vectorizer(texts):
+    """Cheap deterministic stand-in for the sentiment pipeline."""
+    rng = np.random.default_rng(len(texts))
+    v = rng.uniform(0.05, 0.95, size=(len(texts), 6))
+    return v / v.sum(axis=1, keepdims=True)
+
+
+def make_session(**cfg_kwargs) -> Session:
+    store = CommentStore()
+    store.save(SyntheticSource(batch=200)())
+    return Session(
+        config=SessionConfig(**cfg_kwargs),
+        store=store,
+        vectorizer=fake_vectorizer,
+    )
+
+
+class TestSession:
+    def test_fetch_produces_fleet_predictions(self):
+        s = make_session()
+        preview = s.fetch()
+        assert s.predictions.shape == (7, 6)
+        assert preview["n_comments"] == 30
+        assert preview["mean"].shape == (6,)
+        assert preview["honest"].sum() == 5  # 7 oracles - 2 failing
+        # Cursor advanced (circular window semantics).
+        assert s.simulation_step == 50
+
+    def test_fetch_on_empty_store_raises(self):
+        s = Session(config=SessionConfig(), vectorizer=fake_vectorizer)
+        with pytest.raises(RuntimeError, match="empty"):
+            s.fetch()
+
+    def test_commit_requires_fetch(self):
+        s = make_session()
+        with pytest.raises(RuntimeError, match="etch"):
+            s.commit()
+
+    def test_fetch_commit_activates_consensus(self):
+        s = make_session()
+        s.fetch()
+        assert s.commit() == 7
+        assert s.adapter.call_consensus_active() is True
+        consensus = s.adapter.call_consensus()
+        # Honest oracles average sum-to-one sentiment vectors, so the
+        # robust consensus must stay inside the simplex neighborhood.
+        assert all(0.0 < x < 1.0 for x in consensus)
+
+    def test_successive_fetches_differ(self):
+        s = make_session()
+        p1 = dict(s.fetch())
+        p2 = s.fetch()
+        assert not np.allclose(p1["values"], p2["values"])
+
+
+class TestCommandConsole:
+    def make(self):
+        return CommandConsole(make_session())
+
+    def test_help_and_unknown(self):
+        c = self.make()
+        assert any("Commands" in line for line in c.query("help"))
+        assert any("Unknown command" in line for line in c.query("bogus"))
+        assert c.query("") == []
+
+    def test_fetch_then_commit_then_resume(self):
+        c = self.make()
+        out = c.query("fetch")
+        assert any("fetched 30 comments" in line for line in out)
+        out = c.query("commit")
+        assert any("Done (7 transactions)." in line for line in out)
+        out = c.query("resume")
+        assert any("consensus_active: True" in line for line in out)
+        out = c.query("reliability")
+        assert any("reliability :" in line for line in out)
+
+    def test_commit_before_fetch(self):
+        c = self.make()
+        assert c.query("commit") == ["Fetch before!"]
+
+    def test_listing_commands(self):
+        c = self.make()
+        assert len(c.query("admin_list")) == 4  # header + 3 admins
+        assert len(c.query("oracle_list")) == 8  # header + 7 oracles
+        assert c.query("dimension") == ["Dimension: 6"]
+        assert any(
+            "Admin 0 : None" in line
+            for line in c.query("replacement_propositions")
+        )
+
+    def test_replacement_vote_flow_by_index_and_address(self):
+        c = self.make()
+        # admin 0 proposes replacing oracle 6 with 0x99.
+        out = c.query("update_proposition 0 6 0x99")
+        assert out == ["Done."]
+        out = c.query("replacement_propositions")
+        assert any("6 -> 0x99" in line for line in out)
+        # second vote by address reaches majority -> swap.
+        addr = hex(c.session.adapter.call_admin_list()[1])
+        assert c.query(f"vote_for_a_proposition {addr} 0 yes") == ["Done."]
+        assert c.session.adapter.oracle_index_to_address(6) == 0x99
+        # propositions reset after replacement.
+        out = c.query("replacement_propositions")
+        assert all("->" not in line for line in out)
+
+    def test_update_proposition_none_clears(self):
+        c = self.make()
+        c.query("update_proposition 0 6 0x99")
+        assert c.query("update_proposition 0 None") == ["Done."]
+        out = c.query("replacement_propositions")
+        assert all("->" not in line for line in out)
+
+    def test_vote_rejects_bad_arg(self):
+        c = self.make()
+        out = c.query("vote_for_a_proposition 0 0 maybe")
+        assert out == ["Invalid command: only yes/no accepted"]
+
+    def test_errors_do_not_crash(self):
+        c = self.make()
+        out = c.query("update_proposition 99 6 0x99")
+        assert any(line.startswith("error:") for line in out)
+
+    def test_exit_stops_session(self):
+        c = self.make()
+        c.query("exit")
+        assert c.session.application_on is False
+
+    def test_write_callback_streams(self):
+        lines = []
+        c = CommandConsole(make_session(), write=lines.append)
+        c.query("dimension")
+        assert lines == ["Dimension: 6"]
+
+    def test_get_oracle_value_list_default_admin(self):
+        c = self.make()
+        out = c.query("get_oracle_value_list")
+        assert len(out) == 7
+
+
+class TestCli:
+    def test_cli_smoke(self, monkeypatch, capsys):
+        import svoc_tpu.apps.cli as cli
+
+        inputs = iter(["dimension", "exit"])
+        monkeypatch.setattr(
+            "builtins.input", lambda *_: next(inputs)
+        )
+        # Avoid the transformer pipeline: startup fetch disabled.
+        rc = cli.main(["--disable_startup_fetch", "--seed-comments", "50"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Dimension: 6" in out

@@ -1,0 +1,97 @@
+"""Checkpoint/resume: train state via orbax, simulation state via JSON."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from svoc_tpu.models.configs import TINY_TEST
+from svoc_tpu.models.encoder import SentimentEncoder, init_params
+from svoc_tpu.train.trainer import Batch, init_state, make_train_step
+from svoc_tpu.utils.checkpoint import (
+    contract_from_dict,
+    contract_to_dict,
+    restore_simulation,
+    restore_train_state,
+    save_simulation,
+    save_train_state,
+)
+
+
+class TestTrainStateCheckpoint:
+    def test_roundtrip_resumes_identically(self, tmp_path):
+        model = SentimentEncoder(TINY_TEST)
+        params = init_params(model, seed=0)
+        tx = optax.adamw(1e-3)
+        step = make_train_step(model, tx)
+        state = init_state(model, params, tx)
+        batch = Batch(
+            ids=jnp.ones((2, 16), jnp.int32),
+            mask=jnp.ones((2, 16), jnp.int32),
+            labels=jnp.zeros((2, TINY_TEST.n_labels), jnp.float32),
+        )
+        state, _ = step(state, batch)
+
+        path = str(tmp_path / "ckpt")
+        save_train_state(path, state)
+        template = init_state(model, params, tx)
+        restored = restore_train_state(path, template)
+        assert int(restored.step) == int(state.step)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            state.params,
+            restored.params,
+        )
+
+        # The restored state must continue training bit-compatibly.
+        s1, m1 = step(state, batch)
+        s2, m2 = step(restored, batch)
+        assert float(m1["loss"]) == pytest.approx(float(m2["loss"]), rel=1e-6)
+
+
+class TestSimulationCheckpoint:
+    def make_session(self):
+        from tests.test_apps import make_session
+
+        return make_session()
+
+    def test_contract_dict_roundtrip_mid_vote(self):
+        from svoc_tpu.consensus.state import OracleConsensusContract
+
+        c = OracleConsensusContract(
+            admins=["a0", "a1", "a2"],
+            oracles=[f"o{i}" for i in range(7)],
+            dimension=2,
+        )
+        rng = np.random.default_rng(0)
+        for i in range(7):
+            c.update_prediction(f"o{i}", rng.uniform(0.01, 0.99, 2))
+        c.update_proposition("a0", (6, "o_new"))  # one vote collected
+
+        c2 = contract_from_dict(contract_to_dict(c))
+        assert c2.consensus_active
+        assert c2.get_consensus_value() == c.get_consensus_value()
+        assert c2.get_skewness() == c.get_skewness()
+        assert c2.replacement_propositions == [(6, "o_new"), None, None]
+        # The pending vote survives: one more vote completes the swap.
+        c2.vote_for_a_proposition("a1", 0, True)
+        assert c2.get_oracle_list()[6] == "o_new"
+
+    def test_session_save_restore(self, tmp_path):
+        s = self.make_session()
+        s.fetch()
+        s.commit()
+        cursor = s.simulation_step
+        consensus = s.adapter.call_consensus()
+
+        path = str(tmp_path / "sim.json")
+        save_simulation(path, s)
+
+        s2 = self.make_session()
+        restore_simulation(path, s2)
+        assert s2.simulation_step == cursor
+        assert s2.adapter.call_consensus_active() is True
+        assert s2.adapter.call_consensus() == consensus

@@ -1,0 +1,73 @@
+"""Architecture parity: converted HF RoBERTa weights must reproduce the
+torch model's logits through the from-scratch Flax encoder."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from svoc_tpu.models.convert import (  # noqa: E402
+    config_from_hf,
+    convert_roberta_state_dict,
+)
+from svoc_tpu.models.encoder import SentimentEncoder  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_model():
+    config = transformers.RobertaConfig(
+        vocab_size=256,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=34,  # max_len 32 + pad 1 + 1
+        num_labels=5,
+        pad_token_id=1,
+        layer_norm_eps=1e-5,
+    )
+    torch.manual_seed(0)
+    model = transformers.RobertaForSequenceClassification(config)
+    model.eval()
+    return model
+
+
+def test_logit_parity_with_torch(tiny_hf_model):
+    cfg = config_from_hf(tiny_hf_model.config)
+    assert cfg.dtype == jnp.bfloat16  # default; override for the test
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = convert_roberta_state_dict(tiny_hf_model.state_dict(), cfg)
+    flax_model = SentimentEncoder(cfg)
+
+    rng = np.random.default_rng(0)
+    b, t = 3, 16
+    ids = rng.integers(4, 256, size=(b, t)).astype(np.int32)
+    lengths = [16, 9, 5]
+    mask = np.zeros((b, t), np.int32)
+    for i, ln in enumerate(lengths):
+        mask[i, :ln] = 1
+        ids[i, ln:] = cfg.pad_id
+
+    with torch.no_grad():
+        torch_logits = tiny_hf_model(
+            input_ids=torch.tensor(ids.astype(np.int64)),
+            attention_mask=torch.tensor(mask.astype(np.int64)),
+        ).logits.numpy()
+
+    flax_logits = np.asarray(
+        flax_model.apply(params, jnp.asarray(ids), jnp.asarray(mask))
+    )
+    np.testing.assert_allclose(flax_logits, torch_logits, atol=2e-4)
+
+
+def test_config_mapping(tiny_hf_model):
+    cfg = config_from_hf(tiny_hf_model.config)
+    assert cfg.vocab_size == 256
+    assert cfg.n_layers == 2
+    assert cfg.n_labels == 5
+    assert cfg.max_len == 32
+    assert cfg.pad_id == 1

@@ -1,0 +1,34 @@
+"""Metrics utilities."""
+
+from svoc_tpu.utils.metrics import Counter, LatencyTimer, MetricsRegistry
+
+
+def test_counter_rate():
+    c = Counter()
+    c.add(10)
+    c.add(5)
+    assert c.count == 15
+    assert c.rate() > 0
+    c.reset()
+    assert c.count == 0
+
+
+def test_latency_timer():
+    t = LatencyTimer()
+    with t.time():
+        pass
+    t.observe(0.5)
+    assert t.n == 2
+    assert t.max_s >= 0.5
+    assert 0 < t.mean_s <= 0.5
+    assert t.ema_s is not None
+
+
+def test_registry_report():
+    r = MetricsRegistry()
+    r.counter("comments").add(100)
+    with r.timer("consensus").time():
+        pass
+    lines = r.report()
+    assert any("comments" in line for line in lines)
+    assert any("consensus" in line for line in lines)

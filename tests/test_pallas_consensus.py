@@ -1,0 +1,80 @@
+"""Fused Pallas consensus vs the XLA kernel (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step
+from svoc_tpu.ops.pallas_consensus import fused_consensus
+
+
+def fleets(key, n, dim, constrained=True):
+    if constrained:
+        return jax.random.uniform(key, (n, dim), minval=0.01, maxval=0.99)
+    return 20.0 + 3.0 * jax.random.normal(key, (n, dim))
+
+
+CASES = [
+    (7, 2, 2, True),
+    (7, 2, 6, True),
+    (7, 2, 2, False),
+    (16, 4, 3, True),
+    (64, 16, 6, True),
+    (256, 64, 6, True),  # > PALLAS_MAX_ORACLES: exercises the XLA fallback
+]
+
+
+@pytest.mark.parametrize("n,f,dim,constrained", CASES)
+def test_matches_xla_kernel(n, f, dim, constrained):
+    cfg = ConsensusConfig(
+        n_failing=f, constrained=constrained, max_spread=10.0
+    )
+    values = fleets(jax.random.PRNGKey(n * dim), n, dim, constrained)
+    ref = consensus_step(values, cfg)
+    out = fused_consensus(values, cfg)
+
+    np.testing.assert_allclose(
+        np.asarray(out.essence), np.asarray(ref.essence), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.essence_first_pass),
+        np.asarray(ref.essence_first_pass),
+        atol=1e-5,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.reliable), np.asarray(ref.reliable)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.quadratic_risk),
+        np.asarray(ref.quadratic_risk),
+        atol=1e-5,
+    )
+    assert float(out.reliability_first_pass) == pytest.approx(
+        float(ref.reliability_first_pass), abs=1e-5
+    )
+    assert float(out.reliability_second_pass) == pytest.approx(
+        float(ref.reliability_second_pass), abs=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.skewness), np.asarray(ref.skewness), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.kurtosis), np.asarray(ref.kurtosis), atol=1e-3
+    )
+
+
+def test_tie_order_matches_cairo_sort():
+    """Duplicate risk values: the stable index tiebreak must pick the
+    same unreliable set as the host merge sort."""
+    cfg = ConsensusConfig(n_failing=2, constrained=True)
+    # Three identical outliers — only two may be masked, lowest indices
+    # first in the stable order.
+    values = jnp.array(
+        [[0.5], [0.5], [0.9], [0.9], [0.9], [0.5], [0.5]], jnp.float32
+    )
+    ref = consensus_step(values, cfg)
+    out = fused_consensus(values, cfg)
+    np.testing.assert_array_equal(
+        np.asarray(out.reliable), np.asarray(ref.reliable)
+    )

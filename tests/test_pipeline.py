@@ -1,0 +1,66 @@
+"""Prefetch pipeline: ordering, backpressure, error propagation."""
+
+import time
+
+import numpy as np
+import pytest
+
+from svoc_tpu.io.comment_store import CommentStore
+from svoc_tpu.io.pipeline import PrefetchPipeline, window_source
+from svoc_tpu.io.scraper import SyntheticSource
+from svoc_tpu.models.tokenizer import HashingTokenizer
+
+
+def test_yields_all_batches_in_order():
+    batches = [[f"text {i} {j}" for j in range(4)] for i in range(10)]
+    tok = HashingTokenizer(1024)
+    with PrefetchPipeline(batches, tok, seq_len=16) as pipe:
+        out = list(pipe)
+    assert len(out) == 10
+    ref_ids, _ = tok(batches[3], 16)
+    np.testing.assert_array_equal(out[3][0], ref_ids)
+
+
+def test_overlaps_slow_consumer():
+    """Producer keeps the queue warm while the consumer is busy."""
+    produced = []
+
+    def tok(texts, seq_len):
+        produced.append(time.perf_counter())
+        return np.zeros((len(texts), seq_len), np.int32), np.zeros(
+            (len(texts), seq_len), np.int32
+        )
+
+    batches = [["a"] * 2 for _ in range(4)]
+    with PrefetchPipeline(batches, tok, seq_len=8, depth=2) as pipe:
+        it = iter(pipe)
+        next(it)
+        time.sleep(0.2)  # consumer busy; producer should have refilled
+        assert len(produced) >= 3
+
+
+def test_error_propagates():
+    def bad_tok(texts, seq_len):
+        raise ValueError("boom")
+
+    with PrefetchPipeline([["a"]], bad_tok, seq_len=8) as pipe:
+        with pytest.raises(ValueError, match="boom"):
+            next(iter(pipe))
+
+
+def test_window_source_reads_store():
+    store = CommentStore()
+    store.save(SyntheticSource(batch=120)())
+    windows = list(
+        window_source(store, window=50, limit=30, max_windows=3)
+    )
+    assert len(windows) == 3
+    assert all(len(w) == 30 for w in windows)
+
+
+def test_empty_store_ends_pipeline():
+    store = CommentStore()
+    tok = HashingTokenizer(1024)
+    src = window_source(store, window=50, limit=30)
+    with PrefetchPipeline(src, tok, seq_len=16) as pipe:
+        assert list(pipe) == []

@@ -1,0 +1,63 @@
+"""Web UI server: page, query endpoint, state endpoint."""
+
+import json
+import urllib.request
+
+import pytest
+
+from svoc_tpu.apps.commands import CommandConsole
+from svoc_tpu.apps.web import serve
+from tests.test_apps import make_session
+
+
+@pytest.fixture()
+def server():
+    console = CommandConsole(make_session())
+    srv, thread = serve(console, port=0, block=False)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield base, console
+    srv.shutdown()
+
+
+def post(base, text):
+    req = urllib.request.Request(
+        f"{base}/api/query", data=text.encode(), method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def get(base, path):
+    with urllib.request.urlopen(f"{base}{path}", timeout=10) as r:
+        return r.read()
+
+
+class TestWebUI:
+    def test_page_served(self, server):
+        base, _ = server
+        page = get(base, "/").decode()
+        assert "svoc" in page and "drawScatter" in page
+
+    def test_query_endpoint_runs_commands(self, server):
+        base, _ = server
+        assert post(base, "dimension") == ["Dimension: 6"]
+        out = post(base, "fetch")
+        assert any("fetched 30 comments" in line for line in out)
+        assert post(base, "commit")[-1] == "Done (7 transactions)."
+
+    def test_state_endpoint_reflects_session(self, server):
+        base, _ = server
+        state = json.loads(get(base, "/api/state"))
+        assert state["preview"] is None
+        post(base, "fetch")
+        post(base, "commit")
+        post(base, "resume")
+        state = json.loads(get(base, "/api/state"))
+        assert state["consensus_active"] is True
+        assert len(state["preview"]["values"]) == 7
+        assert 0 < state["reliability_second_pass"] <= 1
+
+    def test_unknown_path_404(self, server):
+        base, _ = server
+        with pytest.raises(urllib.error.HTTPError):
+            get(base, "/nope")
