@@ -29,8 +29,10 @@ HELP = """Commands:
 
     - fetch
     - auto_fetch on/off (default: off)
+    - auto_commit on/off (default: off, ie. fetch => commit)
+    - auto_resume on/off (default: off, ie. commit => resume)
     - scraper on/off (default: off)
-    - live_mode on/off (default: off)
+    - live_mode on/off (default: off; scraper + auto_fetch + auto_commit)
     - metrics (throughput / latency counters)
 
     - contract_declaration_address
@@ -165,6 +167,24 @@ class CommandConsole:
                     self._start_auto_fetch()
                 else:
                     emit("Auto-Fetch: DISABLED")
+            elif cmd == "auto_commit":
+                if len(args) != 1:
+                    emit("Unexpected number of arguments.")
+                    return out
+                self.session.auto_commit = on_off_to_bool(args[0])
+                emit(
+                    "Auto-Commit: "
+                    + ("ENABLED" if self.session.auto_commit else "DISABLED")
+                )
+            elif cmd == "auto_resume":
+                if len(args) != 1:
+                    emit("Unexpected number of arguments.")
+                    return out
+                self.session.auto_resume = on_off_to_bool(args[0])
+                emit(
+                    "Auto-Resume: "
+                    + ("ENABLED" if self.session.auto_resume else "DISABLED")
+                )
             elif cmd == "commit":
                 if self.session.predictions is None:
                     emit("Fetch before!")
@@ -291,7 +311,24 @@ class CommandConsole:
                 for line in lines or ["no metrics recorded yet"]:
                     emit(line)
             elif cmd == "live_mode":
-                emit("Not implemented yet.")  # parity: web_interface.py:228
+                # The reference stubs this (web_interface.py:228;
+                # oracle_scheduler.py:174-182 TODO).  Here it is the
+                # full live pipeline: ingest + classify + commit.
+                if len(args) != 1:
+                    emit("Unexpected number of arguments.")
+                    return out
+                if on_off_to_bool(args[0]):
+                    source_name = self._start_scraper()
+                    self.session.auto_commit = True
+                    self.session.auto_fetch = True
+                    self._start_auto_fetch()
+                    emit(f"Live mode: ENABLED (scraper={source_name}, "
+                         "auto_fetch+auto_commit on)")
+                else:
+                    self.session.auto_fetch = False
+                    self.session.auto_commit = False
+                    self._stop_scraper()
+                    emit("Live mode: DISABLED")
             else:
                 emit(f"Unknown command: {cmd} (try 'help')")
         except Exception as e:  # the dispatcher never crashes the REPL
@@ -302,16 +339,27 @@ class CommandConsole:
 
     def _start_auto_fetch(self) -> None:
         """simulation_mode (oracle_scheduler.py:163-171): fetch every
-        ``refresh_rate_s`` while the flag holds."""
-        if self._auto_fetch_thread and self._auto_fetch_thread.is_alive():
-            return
+        ``refresh_rate_s`` while the flag holds.
+
+        Each start bumps a generation token; a superseded loop exits at
+        its next check even if off→on toggles race its wind-down, so
+        exactly one loop serves the current enable."""
+        gen = self._auto_fetch_gen = getattr(self, "_auto_fetch_gen", 0) + 1
 
         def loop():
             import time
 
-            while self.session.auto_fetch and self.session.application_on:
+            while (
+                gen == self._auto_fetch_gen
+                and self.session.auto_fetch
+                and self.session.application_on
+            ):
                 try:
                     self.session.fetch()
+                    if self.session.auto_commit:
+                        self.session.commit()
+                        if self.session.auto_resume:
+                            self.session.adapter.resume()
                 except Exception as e:
                     # Surface the failure (once per distinct message) and
                     # count it, instead of silently spinning.
@@ -333,7 +381,12 @@ class CommandConsole:
         ("hn-live" when Selenium is available and requested, else the
         offline synthetic generator)."""
         if self._scraper_thread and self._scraper_thread.is_alive():
-            return "already running"
+            if self._scraper_stop is not None and self._scraper_stop.is_set():
+                # A just-stopped thread is winding down — wait it out so
+                # the restart actually starts a fresh loop.
+                self._scraper_thread.join(timeout=5)
+            else:
+                return "already running"
         from svoc_tpu.io.scraper import (
             SeleniumHNSource,
             SyntheticSource,

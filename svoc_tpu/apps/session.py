@@ -116,6 +116,11 @@ class Session:
         self.last_preview: Optional[Dict] = None
         self.simulation_step: int = 0
         self.auto_fetch: bool = False
+        #: fetch ⇒ commit (help text web_interface.py:22; unimplemented
+        #: in the reference, functional here).
+        self.auto_commit: bool = False
+        #: commit ⇒ resume (help text web_interface.py:23).
+        self.auto_resume: bool = False
         self.application_on: bool = True
         self._key = jax.random.PRNGKey(self.config.seed)
 

@@ -1,0 +1,99 @@
+"""Contract deployment calldata.
+
+The consensus configuration is frozen at deploy time in the Cairo
+constructor's calldata (``contract/src/contract.cairo:236-265``; worked
+example at ``contract/README.md:41-66``).  Layout, in order:
+
+``[n_admins, *admins, enable_oracle_replacement, required_majority,
+n_failing_oracles, constrained, unconstrained_max_spread(fwsad),
+dimension, n_oracles, *oracles]``
+
+:func:`constructor_calldata` builds that list from a typed config (the
+shape :class:`svoc_tpu.consensus.state.OracleConsensusContract` takes),
+and :func:`parse_constructor_calldata` inverts it — used to
+cross-check a deployed contract against a local simulator, and
+round-trip-tested against the reference test deployments.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence
+
+from svoc_tpu.ops.fixedpoint import fwsad_to_float, float_to_fwsad
+
+
+@dataclass(frozen=True)
+class DeployConfig:
+    admins: Sequence[int]
+    oracles: Sequence[int]
+    enable_oracle_replacement: bool = True
+    required_majority: int = 2
+    n_failing_oracles: int = 2
+    constrained: bool = True
+    unconstrained_max_spread: float = 0.0
+    dimension: int = 2
+
+
+def constructor_calldata(cfg: DeployConfig) -> List[int]:
+    """``cfg`` → felt calldata list (``contract.cairo:236-265`` order)."""
+    return [
+        len(cfg.admins),
+        *[int(a) for a in cfg.admins],
+        int(cfg.enable_oracle_replacement),
+        int(cfg.required_majority),
+        int(cfg.n_failing_oracles),
+        int(cfg.constrained),
+        float_to_fwsad(cfg.unconstrained_max_spread),
+        int(cfg.dimension),
+        len(cfg.oracles),
+        *[int(o) for o in cfg.oracles],
+    ]
+
+
+def parse_constructor_calldata(calldata: Sequence[int]) -> DeployConfig:
+    """Inverse of :func:`constructor_calldata` (validates lengths)."""
+    data = [int(x) for x in calldata]
+    i = 0
+    n_admins = data[i]; i += 1
+    admins = data[i : i + n_admins]; i += n_admins
+    enable = bool(data[i]); i += 1
+    majority = data[i]; i += 1
+    n_failing = data[i]; i += 1
+    constrained = bool(data[i]); i += 1
+    max_spread = fwsad_to_float(data[i]); i += 1
+    dimension = data[i]; i += 1
+    n_oracles = data[i]; i += 1
+    oracles = data[i : i + n_oracles]; i += n_oracles
+    if i != len(data):
+        raise ValueError(
+            f"calldata has {len(data)} felts, layout consumed {i}"
+        )
+    return DeployConfig(
+        admins=admins,
+        oracles=oracles,
+        enable_oracle_replacement=enable,
+        required_majority=majority,
+        n_failing_oracles=n_failing,
+        constrained=constrained,
+        unconstrained_max_spread=max_spread,
+        dimension=dimension,
+    )
+
+
+def simulator_from_calldata(calldata: Sequence[int]):
+    """Deploy an in-memory contract simulator from chain calldata — the
+    local twin of a real deployment."""
+    from svoc_tpu.consensus.state import OracleConsensusContract
+
+    cfg = parse_constructor_calldata(calldata)
+    return OracleConsensusContract(
+        admins=list(cfg.admins),
+        oracles=list(cfg.oracles),
+        enable_oracle_replacement=cfg.enable_oracle_replacement,
+        required_majority=cfg.required_majority,
+        n_failing_oracles=cfg.n_failing_oracles,
+        constrained=cfg.constrained,
+        unconstrained_max_spread=cfg.unconstrained_max_spread,
+        dimension=cfg.dimension,
+    )

@@ -1,0 +1,171 @@
+"""Flash attention forward as a Pallas TPU kernel.
+
+The encoder's attention (:class:`svoc_tpu.models.encoder.SelfAttention`)
+materializes [B, H, T, T] score tensors in HBM; this kernel never does —
+Q is processed in VMEM blocks against K/V blocks with the online-softmax
+recurrence (running max / denominator / accumulator in VMEM scratch),
+so memory is O(block²) and HBM traffic is one read of Q/K/V and one
+write of O.  Same math as the dense path and as
+:func:`svoc_tpu.parallel.ring_attention.ring_attention` — the ring
+kernel distributes over devices, this one tiles within a device; they
+compose (ring outer, flash inner) for long-context.
+
+Grid: ``(batch·heads, Tq/block_q)``; each program owns one Q block and
+loops over K/V blocks with ``fori_loop`` (compiled once — no Mosaic
+code-size blowup at long T).  Padding is a per-key boolean mask.
+
+Non-TPU backends run in interpreter mode (tests); use
+:func:`flash_attention` which picks automatically.
+
+Deployment note: the tunneled "axon" TPU backend used by this
+project's driver hangs its remote compiler on any ``pallas_call`` with
+a ``grid=`` (gridless kernels such as
+:mod:`svoc_tpu.ops.pallas_consensus` compile fine — verified
+empirically; even a trivial copy kernel with a 2-D grid never returns).
+On TPU the compiled kernel is therefore **opt-in** via
+``SVOC_FLASH_ATTENTION=1`` (standard libtpu toolchains compile it
+normally); without the opt-in, TPU execution uses the XLA dense path,
+whose fusion is adequate at the classifier's T≤512.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref,  # [1, bq, D]
+    k_ref,  # [1, T, D]
+    v_ref,  # [1, T, D]
+    mask_ref,  # [1, T]
+    o_ref,  # [1, bq, D]
+    *,
+    block_k: int,
+    scale: float,
+):
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    t = k_ref.shape[1]
+    n_blocks = t // block_k
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [bq, D]
+
+    def body(ki, carry):
+        m, l, acc = carry
+        start = ki * block_k
+        k_blk = k_ref[0, pl.ds(start, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(start, block_k), :].astype(jnp.float32)
+        kmask = mask_ref[0, pl.ds(start, block_k)]  # [bk]
+
+        scores = jax.lax.dot_general(
+            q,
+            k_blk,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        scores = jnp.where(kmask[None, :] > 0, scores, NEG_INF)
+
+        m_blk = jnp.max(scores, axis=1, keepdims=True)  # [bq, 1]
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(scores - m_new)  # [bq, bk]
+        corr = jnp.exp(m - m_new)  # [bq, 1]
+        l = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(
+            p,
+            v_blk,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    _m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    kmask: jnp.ndarray | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """``q/k/v [B, T, H, D]``, ``kmask [B, T]`` (1 = real key) →
+    ``[B, T, H, D]``.  T must divide by the block sizes (pad the batch
+    to the model's fixed seq_len upstream, as the pipeline already
+    does)."""
+    b, t, h, d = q.shape
+    if kmask is None:
+        kmask = jnp.ones((b, t), jnp.int32)
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"seq len {t} not divisible by blocks {block_q}/{block_k}")
+    if interpret is None:
+        if jax.default_backend() == "tpu":
+            import os
+
+            if os.environ.get("SVOC_FLASH_ATTENTION") != "1":
+                # Gridded pallas_call hangs the axon remote compiler
+                # (module docstring) — XLA dense path unless opted in.
+                from svoc_tpu.parallel.ring_attention import (
+                    dense_attention_reference,
+                )
+
+                return dense_attention_reference(q, k, v, kmask)
+            interpret = False
+        else:
+            interpret = True
+
+    # [B, T, H, D] → [B·H, T, D] rows per (batch, head) program family.
+    qf = jnp.transpose(q, (0, 2, 1, 3)).reshape(b * h, t, d)
+    kf = jnp.transpose(k, (0, 2, 1, 3)).reshape(b * h, t, d)
+    vf = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * h, t, d)
+    maskf = jnp.repeat(kmask, h, axis=0)  # [B·H, T]
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, scale=1.0 / (d**0.5)
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, d), lambda bh, qi: (bh, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, t, d), lambda bh, qi: (bh, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, t, d), lambda bh, qi: (bh, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, t), lambda bh, qi: (bh, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda bh, qi: (bh, qi, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, maskf)
+
+    return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))

@@ -64,6 +64,48 @@ def best_mesh(
     return make_mesh(MeshSpec((axis_name,), (len(devs),)), devs)
 
 
+def hybrid_mesh(
+    ici_spec: MeshSpec,
+    dcn_axis: str = "replica",
+    n_slices: Optional[int] = None,
+) -> Mesh:
+    """Multi-host/multi-slice mesh: ``dcn_axis`` ranges over slices
+    (data-center network) and ``ici_spec`` factorizes the chips inside
+    each slice (inter-chip interconnect).
+
+    Sharding policy follows from the fabric speeds: put data/replica
+    parallelism (one gradient all-reduce per step) on ``dcn_axis`` and
+    everything chatty — tensor/sequence/oracle axes, whose collectives
+    run per layer or per consensus step — on the ICI axes of
+    ``ici_spec``.  This is the TPU-native counterpart of the scale-out
+    role NCCL/MPI backends play elsewhere; XLA routes each collective
+    over the right fabric from the mesh topology, no transport code.
+
+    With a single slice (or on CPU test backends) this degrades to a
+    ``make_mesh`` over ``(dcn_axis=1) × ici_spec``.
+    """
+    from jax.experimental import mesh_utils
+
+    if n_slices is None:
+        # A slice is a granule of devices sharing slice_index — NOT
+        # total_devices / ici_size (a single big slice is one slice).
+        # Backends without slice_index (CPU test meshes) are one slice.
+        slice_ids = {
+            getattr(d, "slice_index", 0) for d in jax.devices()
+        }
+        n_slices = len(slice_ids)
+    axis_names = (dcn_axis,) + ici_spec.axis_names
+    if n_slices == 1:
+        return make_mesh(
+            MeshSpec(axis_names, (1,) + ici_spec.axis_sizes)
+        )
+    grid = mesh_utils.create_hybrid_device_mesh(
+        ici_spec.axis_sizes,
+        dcn_mesh_shape=(n_slices,) + (1,) * len(ici_spec.axis_sizes),
+    )
+    return Mesh(grid.reshape((n_slices,) + ici_spec.axis_sizes), axis_names)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

@@ -1,0 +1,117 @@
+"""Sequence-parallel encoder forward: the full model under shard_map.
+
+Long-context inference path: activations are sharded over a ``"seq"``
+mesh axis for the *entire* forward — embeddings, every encoder block,
+and the classification head — so per-device activation memory scales as
+T/d and sequence length is bounded by the mesh, not one chip's HBM.
+Collectives used (all riding ICI):
+
+- one tiny ``all_gather`` of per-shard token counts for the global
+  RoBERTa position ids (positions count real tokens across shards),
+- ``ppermute`` K/V ring rotations inside each block's attention
+  (:func:`svoc_tpu.parallel.ring_attention.ring_attention`),
+- one ``psum`` to deliver the CLS (global position 0) vector from
+  shard 0 to the replicated classifier head.
+
+The function consumes the exact params tree of
+:class:`svoc_tpu.models.encoder.SentimentEncoder` — no separate weight
+format — and matches its logits (equivalence-tested on the 8-device
+CPU mesh in ``tests/test_sp_encoder.py``).  Dense layers are expressed
+directly on the param leaves (``x @ kernel + bias``) because the flax
+module applies to full arrays while this path runs on sequence shards.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from svoc_tpu.models.configs import EncoderConfig
+from svoc_tpu.parallel.ring_attention import ring_attention
+from svoc_tpu.parallel.sharded import shard_map
+
+
+def _dense(x, p):
+    return jnp.einsum("...i,io->...o", x, p["kernel"]) + p["bias"]
+
+
+def _layernorm(x, p, eps):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mean) ** 2, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return y * p["scale"] + p["bias"]
+
+
+def _global_position_ids(mask_local, cfg, axis):
+    """RoBERTa position ids across sequence shards: every real token's
+    position is its global count of preceding real tokens + pad_id + 1
+    (``encoder.py`` uses ``cumsum(mask) * mask + pad_id``)."""
+    n_dev = jax.lax.psum(1, axis)
+    ax = jax.lax.axis_index(axis)
+    local_counts = jnp.sum(mask_local, axis=1)  # [B]
+    all_counts = jax.lax.all_gather(local_counts, axis)  # [d, B]
+    shard_ids = jnp.arange(n_dev)[:, None]
+    prefix = jnp.sum(
+        jnp.where(shard_ids < ax, all_counts, 0), axis=0
+    )  # [B] tokens before this shard
+    local_cumsum = jnp.cumsum(mask_local, axis=-1)
+    return (prefix[:, None] + local_cumsum) * mask_local + cfg.pad_id
+
+
+def _block(x, bias_mask_local, params, cfg, axis):
+    """One EncoderBlock (``encoder.py:54-70``) on sequence shards."""
+    h, d = cfg.n_heads, cfg.head_dim
+    b, t_local, _ = x.shape
+
+    ap = params["attention"]
+    q = _dense(x, ap["query"]).reshape(b, t_local, h, d)
+    k = _dense(x, ap["key"]).reshape(b, t_local, h, d)
+    v = _dense(x, ap["value"]).reshape(b, t_local, h, d)
+    ctx = ring_attention(q, k, v, bias_mask_local, axis_name=axis)
+    a = _dense(ctx.reshape(b, t_local, cfg.hidden), ap["out"])
+
+    x = _layernorm(x + a, params["ln_attn"], cfg.ln_eps).astype(cfg.dtype)
+    f = _dense(x, params["ffn_in"])
+    f = jax.nn.gelu(f, approximate=False)
+    f = _dense(f, params["ffn_out"])
+    return _layernorm(x + f, params["ln_ffn"], cfg.ln_eps).astype(cfg.dtype)
+
+
+def sequence_parallel_forward_fn(
+    mesh: Mesh, cfg: EncoderConfig, seq_axis: str = "seq"
+) -> Callable:
+    """Jitted ``(params, ids [B, T], mask [B, T]) → logits [B, n_labels]``
+    with ``T`` sharded over ``seq_axis`` (``T`` divisible by the axis
+    size); params and logits replicated."""
+
+    def body(params, ids_local, mask_local):
+        p = params["params"]
+        ax_idx = jax.lax.axis_index(seq_axis)
+
+        pos_ids = _global_position_ids(mask_local, cfg, seq_axis)
+        tok = jnp.take(p["tok_emb"]["embedding"], ids_local, axis=0)
+        pos = jnp.take(p["pos_emb"]["embedding"], pos_ids, axis=0)
+        x = _layernorm(tok + pos, p["ln_emb"], cfg.ln_eps).astype(cfg.dtype)
+
+        for i in range(cfg.n_layers):
+            x = _block(x, mask_local, p[f"block_{i}"], cfg, seq_axis)
+
+        # CLS pooling: global token 0 lives on shard 0; psum broadcasts
+        # it so the (replicated) head computes identically everywhere.
+        cls_local = jnp.where(ax_idx == 0, x[:, 0, :], 0.0)
+        cls = jax.lax.psum(cls_local, seq_axis)
+        cls = jnp.tanh(_dense(cls, p["head_dense"]))
+        return _dense(cls.astype(jnp.float32), p["head_out"])
+
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(None, seq_axis), P(None, seq_axis)),
+        out_specs=P(),
+        check_rep=False,
+    )
+    return jax.jit(mapped)

@@ -1,0 +1,86 @@
+"""Constructor calldata layout parity with the reference deployments."""
+
+import pytest
+
+from svoc_tpu.io.deploy import (
+    DeployConfig,
+    constructor_calldata,
+    parse_constructor_calldata,
+    simulator_from_calldata,
+)
+
+# Short-string felts for 'Akashi', 'Ozu', 'Higuchi', 'oracle_00'...
+# (test_contract.cairo:28-49 uses these as addresses).
+AKASHI = int.from_bytes(b"Akashi", "big")
+OZU = int.from_bytes(b"Ozu", "big")
+HIGUCHI = int.from_bytes(b"Higuchi", "big")
+ORACLES = [int.from_bytes(f"oracle_{i:02d}".encode(), "big") for i in range(7)]
+
+
+def reference_constrained_calldata(dimension: int):
+    """deploy_constrained_contract (test_contract.cairo:28-59):
+    3 admins, replacement on, majority 2, 2 failing, constrained,
+    spread 0, 7 oracles."""
+    return [
+        3, AKASHI, OZU, HIGUCHI,
+        1, 2, 2, 1, 0, dimension,
+        7, *ORACLES,
+    ]
+
+
+class TestCalldata:
+    def test_matches_reference_constrained_layout(self):
+        cfg = DeployConfig(
+            admins=[AKASHI, OZU, HIGUCHI],
+            oracles=ORACLES,
+            dimension=2,
+        )
+        assert constructor_calldata(cfg) == reference_constrained_calldata(2)
+
+    def test_unconstrained_spread_encodes_wsad(self):
+        """deploy_unconstrained_contract uses wsad()*10 (test_contract
+        .cairo:73): max_spread 10.0 -> felt 10_000_000."""
+        cfg = DeployConfig(
+            admins=[AKASHI, OZU, HIGUCHI],
+            oracles=ORACLES,
+            constrained=False,
+            unconstrained_max_spread=10.0,
+        )
+        calldata = constructor_calldata(cfg)
+        assert calldata[8] == 10_000_000
+
+    def test_roundtrip(self):
+        cfg = DeployConfig(
+            admins=[1, 2, 3],
+            oracles=[10, 11, 12, 13],
+            enable_oracle_replacement=False,
+            required_majority=3,
+            n_failing_oracles=1,
+            constrained=False,
+            unconstrained_max_spread=5.5,
+            dimension=6,
+        )
+        parsed = parse_constructor_calldata(constructor_calldata(cfg))
+        assert parsed == DeployConfig(
+            admins=[1, 2, 3],
+            oracles=[10, 11, 12, 13],
+            enable_oracle_replacement=False,
+            required_majority=3,
+            n_failing_oracles=1,
+            constrained=False,
+            unconstrained_max_spread=5.5,
+            dimension=6,
+        )
+
+    def test_trailing_garbage_rejected(self):
+        calldata = reference_constrained_calldata(2) + [99]
+        with pytest.raises(ValueError, match="consumed"):
+            parse_constructor_calldata(calldata)
+
+    def test_simulator_from_calldata_runs(self):
+        sim = simulator_from_calldata(reference_constrained_calldata(2))
+        assert sim.get_admin_list() == [AKASHI, OZU, HIGUCHI]
+        assert sim.get_oracle_list() == ORACLES
+        assert sim.get_predictions_dimension() == 2
+        sim.update_prediction(ORACLES[0], [0.4, 0.2])
+        assert not sim.consensus_active

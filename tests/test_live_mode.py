@@ -1,0 +1,97 @@
+"""auto_commit / auto_resume / live_mode composite loops."""
+
+import time
+
+from svoc_tpu.apps.commands import CommandConsole
+from svoc_tpu.apps.session import Session, SessionConfig
+from tests.test_apps import fake_vectorizer
+
+
+def make_fast_session():
+    from svoc_tpu.io.comment_store import CommentStore
+    from svoc_tpu.io.scraper import SyntheticSource
+
+    store = CommentStore()
+    store.save(SyntheticSource(batch=200)())
+    return Session(
+        config=SessionConfig(refresh_rate_s=0.05, scraper_rate_s=0.05),
+        store=store,
+        vectorizer=fake_vectorizer,
+    )
+
+
+def wait_until(pred, timeout_s=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestAutoFlags:
+    def test_auto_commit_and_resume_toggle(self):
+        c = CommandConsole(make_fast_session())
+        assert c.query("auto_commit on") == ["Auto-Commit: ENABLED"]
+        assert c.session.auto_commit is True
+        assert c.query("auto_resume on") == ["Auto-Resume: ENABLED"]
+        assert c.query("auto_commit off") == ["Auto-Commit: DISABLED"]
+        assert c.query("auto_commit") == ["Unexpected number of arguments."]
+
+    def test_auto_fetch_with_auto_commit_reaches_chain(self):
+        c = CommandConsole(make_fast_session())
+        c.query("auto_commit on")
+        c.query("auto_resume on")
+        c.query("auto_fetch on")
+        try:
+            assert wait_until(
+                lambda: c.session.adapter.cache.get("consensus_active")
+            ), "auto loop never committed + resumed"
+        finally:
+            c.query("auto_fetch off")
+            c.stop()
+
+    def test_live_mode_runs_full_pipeline(self):
+        from svoc_tpu.io.comment_store import CommentStore
+
+        # Live mode must work from a genuinely EMPTY store: the scraper
+        # is what fills it.
+        session = Session(
+            config=SessionConfig(refresh_rate_s=0.05, scraper_rate_s=0.05),
+            store=CommentStore(),
+            vectorizer=fake_vectorizer,
+        )
+        assert session.store.count() == 0
+        c = CommandConsole(session)
+        out = c.query("live_mode on")
+        assert any("Live mode: ENABLED" in line for line in out)
+        try:
+            assert wait_until(
+                lambda: session.adapter.call_consensus_active()
+            ), "live pipeline never drove the chain to consensus"
+        finally:
+            out = c.query("live_mode off")
+            assert any("Live mode: DISABLED" in line for line in out)
+            c.stop()
+        assert session.auto_fetch is False and session.auto_commit is False
+
+    def test_rapid_off_on_restarts_scraper(self):
+        """off→on with no delay must start a fresh ingest loop, not
+        report ENABLED while the old stopping thread dies."""
+        from svoc_tpu.io.comment_store import CommentStore
+
+        session = Session(
+            config=SessionConfig(refresh_rate_s=0.05, scraper_rate_s=0.05),
+            store=CommentStore(),
+            vectorizer=fake_vectorizer,
+        )
+        c = CommandConsole(session)
+        try:
+            c.query("scraper on")
+            c.query("scraper off")
+            out = c.query("scraper on")  # immediately — races wind-down
+            assert any("ENABLED (synthetic)" in line for line in out)
+            before = session.store.count()
+            assert wait_until(lambda: session.store.count() > before)
+        finally:
+            c.stop()

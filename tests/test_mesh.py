@@ -1,0 +1,29 @@
+"""Mesh helpers: factorizations, validation, hybrid single-slice path."""
+
+import pytest
+
+from svoc_tpu.parallel.mesh import MeshSpec, best_mesh, hybrid_mesh, make_mesh
+
+
+def test_make_mesh_validates_device_count():
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        make_mesh(MeshSpec(("oracle",), (16,)))
+
+
+def test_best_mesh_uses_all_devices():
+    m = best_mesh()
+    assert m.axis_names == ("oracle",)
+    assert m.devices.size == 8
+
+
+def test_hybrid_mesh_single_slice():
+    """CPU virtual devices have no slice_index → one slice, and the
+    ici spec need not cover every device."""
+    m = hybrid_mesh(MeshSpec(("data", "model"), (2, 2)))
+    assert m.axis_names == ("replica", "data", "model")
+    assert m.devices.shape == (1, 2, 2)
+
+
+def test_hybrid_mesh_validates_oversized_spec():
+    with pytest.raises(ValueError, match="needs 32 devices"):
+        hybrid_mesh(MeshSpec(("oracle",), (32,)))

@@ -1,0 +1,67 @@
+"""Flash attention kernel vs dense reference (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from svoc_tpu.ops.pallas_attention import flash_attention
+from svoc_tpu.parallel.ring_attention import dense_attention_reference
+
+
+def qkv(key, b=2, t=128, h=4, d=16, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, t, h, d), dtype),
+        jax.random.normal(kk, (b, t, h, d), dtype),
+        jax.random.normal(kv, (b, t, h, d), dtype),
+    )
+
+
+class TestFlashAttention:
+    def test_matches_dense(self):
+        q, k, v = qkv(jax.random.PRNGKey(0))
+        out = flash_attention(q, k, v, block_q=32, block_k=32)
+        ref = dense_attention_reference(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_matches_dense_with_padding(self):
+        q, k, v = qkv(jax.random.PRNGKey(1))
+        kmask = (
+            jax.random.uniform(jax.random.PRNGKey(2), k.shape[:2]) > 0.4
+        ).astype(jnp.int32)
+        kmask = kmask.at[:, 0].set(1)
+        out = flash_attention(q, k, v, kmask, block_q=32, block_k=32)
+        ref = dense_attention_reference(q, k, v, kmask)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_fully_masked_block_stable(self):
+        """A K block that is 100% padding must not produce NaNs."""
+        q, k, v = qkv(jax.random.PRNGKey(3), t=64)
+        kmask = jnp.zeros((2, 64), jnp.int32).at[:, :32].set(1)
+        out = flash_attention(q, k, v, kmask, block_q=32, block_k=32)
+        ref = dense_attention_reference(q, k, v, kmask)
+        assert np.isfinite(np.asarray(out)).all()
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_bf16(self):
+        q, k, v = qkv(jax.random.PRNGKey(4), dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, block_q=32, block_k=32)
+        assert out.dtype == jnp.bfloat16
+        ref = dense_attention_reference(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32),
+            np.asarray(ref, np.float32),
+            atol=3e-2,
+        )
+
+    def test_rejects_indivisible_seq(self):
+        q, k, v = qkv(jax.random.PRNGKey(5), t=100)
+        with pytest.raises(ValueError, match="not divisible"):
+            flash_attention(q, k, v, block_q=64, block_k=64)

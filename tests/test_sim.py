@@ -131,6 +131,22 @@ def test_montecarlo_matches_published_7_2(
     assert r["reliability_pct"] == pytest.approx(expected_reliability, abs=tol_r)
 
 
+@pytest.mark.parametrize(
+    "a,expected_success,tol_s",
+    [(10.0, 26.0, 6.0), (100.0, 78.33, 6.0)],
+)
+def test_montecarlo_matches_published_20_2(a, expected_success, tol_s):
+    """documentation/README.md:285-307: N=20 with 2 failing — wider
+    fleets make exact identification harder at low concentration
+    (26 % at a=10) and easier at high (78 % at a=100)."""
+    r = benchmark(
+        jax.random.PRNGKey(21), a, a, n_oracles=20, n_failing=2, k_trials=3000
+    )
+    assert r["identification_success_pct"] == pytest.approx(
+        expected_success, abs=tol_s
+    )
+
+
 def test_montecarlo_adversarial_75pct_stays_reliable():
     """documentation/README.md:318-319: N=20 with 15 failing (75%
     adversarial) keeps reliability ~90%."""
