@@ -215,10 +215,12 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
     jax.block_until_ready((vecs, essence))
 
     # Isolated stage latencies (reported alongside the overlapped rate).
+    # Transfer the batch once up front — the real pipeline device_puts on
+    # the producer thread, so per-rep H2D would overstate the forward.
     reps = latency_reps(platform)
+    dids0, dmask0 = jax.device_put((jnp.asarray(ids0), jnp.asarray(mask0)))
     fwd_ms = timed_latency_ms(
-        lambda: forward(pipe.params, jnp.asarray(ids0), jnp.asarray(mask0)),
-        reps=reps,
+        lambda: forward(pipe.params, dids0, dmask0), reps=reps
     )
     consensus_ms = timed_latency_ms(lambda: fleet_consensus(key, window), reps=reps)
 

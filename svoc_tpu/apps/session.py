@@ -88,10 +88,12 @@ def _fleet(key, window, n_oracles, n_failing, subset):
 def _preview_stats(values):
     """``predictions_to_eel_values`` math (``oracle_scheduler.py:106-134``):
     fleet mean, fleet median, and per-oracle normalized rank of deviation
-    from the mean (rank 0 = most deviant — suspected failing)."""
+    from the MEDIAN (``oracle_scheduler.py:109`` — the median, unlike the
+    mean, is not dragged toward adversarial outliers; rank 0 = most
+    deviant — suspected failing)."""
     mean = jnp.mean(values, axis=0)
     median = jnp.median(values, axis=0)
-    dev = jnp.linalg.norm(values - mean[None, :], axis=-1)
+    dev = jnp.linalg.norm(values - median[None, :], axis=-1)
     normalized, _ranks = rank_array(dev)
     return mean, median, normalized
 
@@ -122,7 +124,11 @@ class Session:
         #: commit ⇒ resume (help text web_interface.py:23).
         self.auto_resume: bool = False
         self.application_on: bool = True
-        self._key = jax.random.PRNGKey(self.config.seed)
+        #: Lazy: creating a PRNG key initializes the jax backend, which
+        #: can block indefinitely when the TPU plugin's chip is
+        #: unreachable — a session must come up (console, chain reads,
+        #: web UI) without touching the device; only fetch pays it.
+        self._key_value = None
 
     # -- sentiment stage ----------------------------------------------------
 
@@ -155,6 +161,21 @@ class Session:
             self._vectorizer = SentimentPipeline(label_indices=indices)
         return self._vectorizer
 
+    @property
+    def label_names(self) -> List[str]:
+        """Column names for the UI plots (``predictions_to_eel_values``
+        uses ``LABELS_KEYS``, ``oracle_scheduler.py:113-118``): the 6
+        tracked go_emotions labels at the reference dimension, the first
+        ``dimension`` head labels otherwise."""
+        from svoc_tpu.models.sentiment import GO_EMOTIONS_LABELS, TRACKED_LABELS
+
+        dim = self.config.dimension
+        if dim == len(TRACKED_LABELS):
+            return list(TRACKED_LABELS)
+        if dim <= len(GO_EMOTIONS_LABELS):
+            return list(GO_EMOTIONS_LABELS[:dim])
+        return [f"label_{i}" for i in range(dim)]
+
     # -- the fetch path (simulation_fetch, oracle_scheduler.py:155-161) -----
 
     def fetch(self) -> Dict:
@@ -176,7 +197,9 @@ class Session:
             window = jnp.asarray(
                 np.asarray(self.vectorizer(comments), dtype=np.float32)
             )
-            self._key, sub = jax.random.split(self._key)
+            if self._key_value is None:
+                self._key_value = jax.random.PRNGKey(self.config.seed)
+            self._key_value, sub = jax.random.split(self._key_value)
             values, honest = _fleet(
                 sub,
                 window,

@@ -45,6 +45,20 @@ _PAGE = """<!DOCTYPE html>
 <div>reliability first pass <div class="bar" id="rel1"><div style="width:0"></div></div>
      reliability second pass <div class="bar" id="rel2"><div style="width:0"></div></div></div>
 <div id="plots"></div>
+<button id="replace-btn">Oracle Replacement</button>
+<div id="replace-menu" style="display:none; border:1px solid #345; padding:.5rem; margin:.5rem 0">
+  <h3>propose replacement</h3>
+  as admin <select id="rp-admin"></select>
+  replace oracle <select id="rp-old"></select>
+  with address <input id="rp-new" placeholder="0x...">
+  <button id="rp-send">propose</button>
+  <button id="rp-clear">clear my proposition</button>
+  <h3>vote</h3>
+  as admin <select id="vt-admin"></select>
+  on proposition of admin <select id="vt-which"></select>
+  <button id="vt-yes">yes</button> <button id="vt-no">no</button>
+  <div id="rp-props"></div>
+</div>
 <div id="console"></div>
 <input id="cmd" placeholder="command ('help' to list)" autofocus>
 <script>
@@ -64,10 +78,20 @@ async function query(text) {
 document.getElementById('cmd').addEventListener('keydown', e => {
   if (e.key === 'Enter') { query(e.target.value); e.target.value = ''; }
 });
-function drawScatter(canvas, pts, colors, mean, median) {
+function drawScatter(canvas, pts, colors, mean, median, names) {
   const ctx = canvas.getContext('2d');
   ctx.clearRect(0, 0, canvas.width, canvas.height);
   const pad = 20, w = canvas.width - 2 * pad, h = canvas.height - 2 * pad;
+  // axis label names per pair (reference columnNames,
+  // oracle_scheduler.py:113-118 / simulation_graphics.js:8-80)
+  ctx.fillStyle = '#89a';
+  ctx.font = '11px monospace';
+  ctx.fillText(names[0], canvas.width / 2 - 4 * names[0].length, canvas.height - 4);
+  ctx.save();
+  ctx.translate(10, canvas.height / 2 + 4 * names[1].length);
+  ctx.rotate(-Math.PI / 2);
+  ctx.fillText(names[1], 0, 0);
+  ctx.restore();
   const xs = pts.map(p => p[0]).concat([mean[0], median[0]]);
   const ys = pts.map(p => p[1]).concat([mean[1], median[1]]);
   const x0 = Math.min(...xs), x1 = Math.max(...xs);
@@ -83,6 +107,28 @@ function drawScatter(canvas, pts, colors, mean, median) {
   ctx.fillStyle = '#fc3';
   ctx.fillRect(sx(median[0]) - 3, sy(median[1]) - 3, 6, 6);
 }
+function fillSelect(el, items) {
+  const prev = el.value;  // keep the operator's pick across refresh()
+  el.innerHTML = '';
+  items.forEach((label, i) => {
+    const o = document.createElement('option');
+    o.value = i; o.textContent = i + ': ' + label;
+    el.appendChild(o);
+  });
+  if (prev !== '' && Number(prev) < items.length) el.value = prev;
+}
+function updateReplacementMenu(s) {
+  // reference modal: admin/oracle selectors populated from chain
+  // state (oracle_management.js:23-62, index.html:10-71)
+  const admins = s.admin_list || [], oracles = s.oracle_list || [];
+  for (const id of ['rp-admin', 'vt-admin', 'vt-which'])
+    fillSelect(document.getElementById(id), admins);
+  fillSelect(document.getElementById('rp-old'), oracles);
+  const props = document.getElementById('rp-props');
+  props.textContent = (s.replacement_propositions || [])
+    .map((p, i) => 'admin ' + i + ': ' + (p === null ? 'None' : JSON.stringify(p)))
+    .join('\\n');
+}
 async function refresh() {
   const r = await fetch('/api/state');
   const s = await r.json();
@@ -93,10 +139,12 @@ async function refresh() {
     bar.firstElementChild.style.width = pct + '%';
     bar.classList.toggle('low', pct < 50);  // sepolia_graphics.js:53-69
   }
+  updateReplacementMenu(s);
   const plots = document.getElementById('plots');
   plots.innerHTML = '';
   if (!s.preview) return;
   const vals = s.preview.values, ranks = s.preview.normalized_ranks;
+  const labels = s.labels || [];
   const dim = vals[0].length;
   for (let c = 0; c + 1 < dim; c += 2) {  // one plot per label pair
     const canvas = document.createElement('canvas');
@@ -108,9 +156,27 @@ async function refresh() {
     drawScatter(canvas, pts,
       colors,
       [s.preview.mean[c], s.preview.mean[c + 1]],
-      [s.preview.median[c], s.preview.median[c + 1]]);
+      [s.preview.median[c], s.preview.median[c + 1]],
+      [labels[c] || ('dim ' + c), labels[c + 1] || ('dim ' + (c + 1))]);
   }
 }
+document.getElementById('replace-btn').addEventListener('click', () => {
+  const m = document.getElementById('replace-menu');
+  m.style.display = m.style.display === 'none' ? 'block' : 'none';
+});
+document.getElementById('rp-send').addEventListener('click', () => {
+  query('update_proposition ' + document.getElementById('rp-admin').value
+    + ' ' + document.getElementById('rp-old').value
+    + ' ' + document.getElementById('rp-new').value);
+});
+document.getElementById('rp-clear').addEventListener('click', () => {
+  query('update_proposition ' + document.getElementById('rp-admin').value + ' None');
+});
+for (const [id, ans] of [['vt-yes', 'yes'], ['vt-no', 'no']])
+  document.getElementById(id).addEventListener('click', () => {
+    query('vote_for_a_proposition ' + document.getElementById('vt-admin').value
+      + ' ' + document.getElementById('vt-which').value + ' ' + ans);
+  });
 refresh();
 </script></body></html>
 """
@@ -133,11 +199,24 @@ class _Handler(BaseHTTPRequestHandler):
             session = self.console.session
             state = dict(session.adapter.cache)
             preview = session.last_preview
+
+            def fmt(x):
+                """Addresses as the reference displays them
+                (hex for ints, contract.py to_hex)."""
+                return f"0x{x:x}" if isinstance(x, int) else str(x)
+
             payload = {
                 "reliability_first_pass": state.get("reliability_first_pass"),
                 "reliability_second_pass": state.get("reliability_second_pass"),
                 "consensus": state.get("consensus"),
                 "consensus_active": state.get("consensus_active"),
+                "labels": session.label_names,
+                "admin_list": [fmt(a) for a in state.get("admin_list") or []],
+                "oracle_list": [fmt(o) for o in state.get("oracle_list") or []],
+                "replacement_propositions": [
+                    None if p is None else [p[0], fmt(p[1])]
+                    for p in state.get("replacement_propositions") or []
+                ],
                 "preview": None
                 if preview is None
                 else {
@@ -155,6 +234,17 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/api/query":
             self._send(404, b"not found", "text/plain")
             return
+        # CSRF guard: a text/plain POST is a "simple request", so any
+        # page open in a local browser could otherwise drive the session
+        # (incl. chain transactions and 'exit').  Browsers always attach
+        # Origin to cross-origin POSTs — reject when it names another
+        # host; header-free clients (curl, tests) pass.
+        origin = self.headers.get("Origin")
+        if origin is not None:
+            host = self.headers.get("Host", "")
+            if origin.split("://", 1)[-1] != host:
+                self._send(403, b"cross-origin request rejected", "text/plain")
+                return
         length = int(self.headers.get("Content-Length", "0"))
         text = self.rfile.read(length).decode("utf-8", "replace")
         lines = self.console.query(text)
@@ -174,6 +264,15 @@ def serve(
     and returns ``(server, thread)`` (the test/embedding mode; the
     reference's ``eel.start(block=False)``, ``web_interface.py:61-67``)."""
     handler = type("BoundHandler", (_Handler,), {"console": console})
+    if host not in ("127.0.0.1", "localhost", "::1"):
+        import warnings
+
+        warnings.warn(
+            f"svoc web UI binding to non-loopback host {host!r}: the "
+            "query endpoint executes console commands (incl. chain "
+            "transactions) for anyone who can reach it",
+            stacklevel=2,
+        )
     server = ThreadingHTTPServer((host, port), handler)
     if block:  # pragma: no cover — interactive mode
         server.serve_forever()
@@ -212,6 +311,14 @@ def main(argv=None) -> int:  # pragma: no cover — interactive entry
         store=store,
     )
     console = CommandConsole(session, write=print)
+    # Startup resume+fetch (reference main.py:51-54).  fetch is the
+    # only stage that touches the device; a failure is reported by the
+    # console itself (CommandConsole.query catches and emits errors)
+    # and does not prevent the server from starting.  Pass
+    # --disable_startup_fetch for fully device-free startup.
+    console.query("resume")
+    if not args.disable_startup_fetch:
+        console.query("fetch")
     print(f"svoc web UI on http://{args.host}:{args.port}")
     serve(console, host=args.host, port=args.port, block=True)
     return 0

@@ -99,8 +99,19 @@ def hybrid_mesh(
         return make_mesh(
             MeshSpec(axis_names, (1,) + ici_spec.axis_sizes)
         )
+    # Multi-slice: ici_spec must cover every chip of a slice — the
+    # hybrid grid is a dense (n_slices, *ici) block, there is no
+    # "use the first k chips" degree of freedom as in make_mesh.
+    per_slice = len(jax.devices()) // n_slices
+    if ici_spec.n_devices != per_slice:
+        raise ValueError(
+            f"ici spec {ici_spec} covers {ici_spec.n_devices} chips but "
+            f"each of the {n_slices} slices has {per_slice}"
+        )
+    # create_hybrid_device_mesh requires mesh_shape and dcn_mesh_shape
+    # of equal length; the dcn axis is a leading 1 in the ici shape.
     grid = mesh_utils.create_hybrid_device_mesh(
-        ici_spec.axis_sizes,
+        (1,) + ici_spec.axis_sizes,
         dcn_mesh_shape=(n_slices,) + (1,) * len(ici_spec.axis_sizes),
     )
     return Mesh(grid.reshape((n_slices,) + ici_spec.axis_sizes), axis_names)

@@ -12,28 +12,67 @@ one flag and inspected in TensorBoard/XProf.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional
 
 
 @dataclass
 class Counter:
-    """A monotone event counter with rate reporting."""
+    """A monotone event counter with windowed rate reporting.
+
+    ``rate()`` covers only the trailing ``window_s`` seconds, so the
+    ``metrics`` command reports *recent* throughput — a lifetime
+    average would decay forever after any idle period.
+    ``lifetime_rate()`` keeps the old semantics explicitly.
+    """
 
     count: float = 0.0
+    window_s: float = 30.0
     started_at: float = field(default_factory=time.perf_counter)
+    _events: deque = field(default_factory=deque)  # (timestamp, count_after)
+    # add() runs on the auto_fetch daemon thread while rate() serves the
+    # web/console thread — guard the deque walk.
+    _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def add(self, n: float = 1.0) -> None:
-        self.count += n
+        now = time.perf_counter()
+        with self._lock:
+            self.count += n
+            self._events.append((now, self.count))
+            self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._events and self._events[0][0] < horizon:
+            self._events.popleft()
 
     def rate(self) -> float:
+        """Events/sec over the trailing window (0 when idle)."""
+        now = time.perf_counter()
+        with self._lock:
+            self._prune(now)
+            if not self._events:
+                return 0.0
+            t_oldest, c_oldest = self._events[0]
+            span = now - t_oldest
+            if span <= 0:
+                return 0.0
+            # Count since the window's first sample (whose own
+            # increment belongs to the time before it).
+            return (self.count - c_oldest) / span
+
+    def lifetime_rate(self) -> float:
         elapsed = time.perf_counter() - self.started_at
         return self.count / elapsed if elapsed > 0 else 0.0
 
     def reset(self) -> None:
-        self.count = 0.0
-        self.started_at = time.perf_counter()
+        with self._lock:
+            self.count = 0.0
+            self.started_at = time.perf_counter()
+            self._events.clear()
 
 
 @dataclass
@@ -85,7 +124,7 @@ class MetricsRegistry:
     def report(self) -> List[str]:
         lines = []
         for name, c in sorted(self.counters.items()):
-            lines.append(f"{name}: {c.count:,.0f} ({c.rate():,.1f}/s)")
+            lines.append(f"{name}: {c.count:,.0f} ({c.rate():,.1f}/s recent)")
         for name, t in sorted(self.timers.items()):
             lines.append(
                 f"{name}: n={t.n} mean={t.mean_s * 1e3:.2f}ms "

@@ -37,6 +37,50 @@ class TestSession:
         # Cursor advanced (circular window semantics).
         assert s.simulation_step == 50
 
+    def test_preview_ranks_match_reference_formula(self):
+        """``normalized_ranks`` parity with ``predictions_to_eel_values``
+        (``client/oracle_scheduler.py:106-111``): deviation is the L2
+        norm from the fleet MEDIAN (not the mean), then rank_array —
+        smallest deviation gets normalized rank 1, largest 0."""
+        from svoc_tpu.apps.session import _preview_stats
+
+        # Recorded fleet: 5 honest oracles near the simplex center plus
+        # 2 adversarial outliers whose deviation-from-median and
+        # deviation-from-mean ORDERINGS differ (the mean is dragged
+        # toward the outliers; oracle 2 sits exactly on the mean-side).
+        values = np.array(
+            [
+                [0.16, 0.17, 0.16, 0.17, 0.17, 0.17],
+                [0.17, 0.16, 0.17, 0.16, 0.17, 0.17],
+                [0.30, 0.30, 0.10, 0.10, 0.10, 0.10],
+                [0.16, 0.16, 0.17, 0.17, 0.17, 0.17],
+                [0.90, 0.02, 0.02, 0.02, 0.02, 0.02],
+                [0.02, 0.90, 0.02, 0.02, 0.02, 0.02],
+                [0.17, 0.17, 0.17, 0.16, 0.16, 0.17],
+            ],
+            dtype=np.float32,
+        )
+        mean, median, normalized = (np.asarray(x) for x in _preview_stats(values))
+
+        # Reference formula, straight numpy re-derivation.
+        ref_median = np.median(values, axis=0)
+        dev = np.array([np.linalg.norm(p - ref_median) for p in values])
+        order = np.argsort(dev)
+        ref_ranks = np.zeros(len(order), dtype=int)
+        for from_idx, to_idx in enumerate(order):
+            ref_ranks[to_idx] = order.size - from_idx - 1
+        np.testing.assert_allclose(
+            normalized, ref_ranks / (len(values) - 1), atol=1e-6
+        )
+        np.testing.assert_allclose(median, ref_median, atol=1e-6)
+        np.testing.assert_allclose(mean, values.mean(axis=0), atol=1e-6)
+
+        # The adversarial outliers must occupy the two most-deviant
+        # slots (normalized rank <= 0.2 colors red in the UI,
+        # simulation_graphics.js:97-99) — with MEAN-centered deviation
+        # oracle 2's rank would differ, which is the round-1 parity bug.
+        assert set(np.argsort(normalized)[:2]) == {4, 5}
+
     def test_fetch_on_empty_store_raises(self):
         s = Session(config=SessionConfig(), vectorizer=fake_vectorizer)
         with pytest.raises(RuntimeError, match="empty"):

@@ -9,8 +9,24 @@ def test_counter_rate():
     c.add(5)
     assert c.count == 15
     assert c.rate() > 0
+    assert c.lifetime_rate() > 0
     c.reset()
     assert c.count == 0
+    assert c.rate() == 0.0
+
+
+def test_counter_rate_is_windowed_not_lifetime():
+    """After an idle period the recent rate must drop to zero instead of
+    decaying forever as a lifetime average (round-1 advisor finding)."""
+    c = Counter(window_s=0.05)
+    c.add(1000)
+    import time
+
+    time.sleep(0.1)  # idle past the window
+    assert c.rate() == 0.0  # recent rate: no events in window
+    assert c.lifetime_rate() > 0  # lifetime average still positive
+    c.add(1)
+    assert c.rate() >= 0.0  # single fresh sample doesn't blow up
 
 
 def test_latency_timer():

@@ -61,3 +61,71 @@ class TestWebUI:
         base, _ = server
         with pytest.raises(urllib.error.HTTPError):
             get(base, "/nope")
+
+    def test_page_has_replacement_menu_and_labels(self, server):
+        """The oracle-replacement modal (reference
+        oracle_management.js:23-62) and the per-pair axis label names
+        (oracle_scheduler.py:113-118) must be in the served page."""
+        base, _ = server
+        page = get(base, "/").decode()
+        for element in (
+            "replace-menu", "rp-admin", "rp-old", "rp-new",
+            "vt-admin", "vt-which", "update_proposition",
+            "vote_for_a_proposition",
+        ):
+            assert element in page, f"missing {element}"
+        assert "names[0]" in page  # axis name rendering in drawScatter
+
+    def test_state_exposes_labels_and_chain_lists(self, server):
+        base, _ = server
+        post(base, "resume")
+        state = json.loads(get(base, "/api/state"))
+        assert state["labels"][:2] == ["optimism", "anger"]
+        assert len(state["admin_list"]) == 3
+        assert len(state["oracle_list"]) == 7
+        # Addresses rendered in hex like the reference's to_hex.
+        assert all(a.startswith("0x") for a in state["admin_list"])
+        assert len(state["replacement_propositions"]) == 3
+
+    def test_replacement_flow_via_query_endpoint(self, server):
+        """The modal's buttons issue console commands — drive the same
+        commands and verify the address swap lands in /api/state."""
+        base, _ = server
+        post(base, "resume")
+        state = json.loads(get(base, "/api/state"))
+        assert "0xbeef" not in state["oracle_list"]
+        post(base, "update_proposition 0 3 0xbeef")
+        post(base, "vote_for_a_proposition 1 0 yes")
+        post(base, "resume")
+        state = json.loads(get(base, "/api/state"))
+        assert state["oracle_list"][3] == "0xbeef"
+
+    def test_cross_origin_post_rejected(self, server):
+        """CSRF guard: a POST whose Origin names another host is
+        rejected; same-origin and header-free clients pass."""
+        base, _ = server
+        req = urllib.request.Request(
+            f"{base}/api/query",
+            data=b"dimension",
+            method="POST",
+            headers={"Origin": "http://evil.example"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc_info.value.code == 403
+
+        host = base.split("://", 1)[1]
+        req = urllib.request.Request(
+            f"{base}/api/query",
+            data=b"dimension",
+            method="POST",
+            headers={"Origin": f"http://{host}"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read()) == ["Dimension: 6"]
+
+    def test_non_loopback_bind_warns(self):
+        console = CommandConsole(make_session())
+        with pytest.warns(UserWarning, match="non-loopback"):
+            srv, _ = serve(console, host="0.0.0.0", port=0, block=False)
+        srv.shutdown()
