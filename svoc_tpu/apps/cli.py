@@ -43,7 +43,32 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comment store path (reference: data/comments.db)")
     p.add_argument("--seed-comments", type=int, default=200,
                    help="pre-seed an empty store with N synthetic comments")
+    p.add_argument("--contract-info", default=None,
+                   help="data/contract_info.json (rpc + deployed address) — "
+                        "with --accounts, commits go to Sepolia instead of "
+                        "the local simulator")
+    p.add_argument("--accounts", default=None,
+                   help="data/sepolia.json with admin/oracle keys "
+                        "(client/README.md:38-77 layout)")
     return p
+
+
+def build_adapter(args):
+    """The chain backend for parsed CLI args: Sepolia when both
+    ``--contract-info`` and ``--accounts`` are given (reference
+    ``retrieve_account_data`` + RPC path), else the local simulator
+    (``None`` → Session default)."""
+    if bool(args.contract_info) != bool(args.accounts):
+        raise SystemExit(
+            "--contract-info and --accounts must be given together"
+        )
+    if not args.contract_info:
+        return None
+    from svoc_tpu.io.chain import ChainAdapter, starknet_backend_from_files
+
+    return ChainAdapter(
+        starknet_backend_from_files(args.contract_info, args.accounts)
+    )
 
 
 def main(argv=None) -> int:
@@ -63,6 +88,7 @@ def main(argv=None) -> int:
             live_scraper=args.live_scraper,
         ),
         store=store,
+        adapter=build_adapter(args),
     )
     console = CommandConsole(session, write=print)
 

@@ -57,7 +57,7 @@ _PAGE = """<!DOCTYPE html>
   as admin <select id="vt-admin"></select>
   on proposition of admin <select id="vt-which"></select>
   <button id="vt-yes">yes</button> <button id="vt-no">no</button>
-  <div id="rp-props"></div>
+  <div id="rp-props" style="white-space: pre-line"></div>
 </div>
 <div id="console"></div>
 <input id="cmd" placeholder="command ('help' to list)" autofocus>
@@ -238,13 +238,20 @@ class _Handler(BaseHTTPRequestHandler):
         # page open in a local browser could otherwise drive the session
         # (incl. chain transactions and 'exit').  Browsers always attach
         # Origin to cross-origin POSTs — reject when it names another
-        # host; header-free clients (curl, tests) pass.
+        # host; header-free clients (curl, tests) pass.  The Host header
+        # is additionally validated against the bound address so DNS
+        # rebinding (evil.example resolving to 127.0.0.1 — Origin and
+        # Host then match each other) can't slip through.
+        host = self.headers.get("Host", "")
+        hostname = host.rsplit(":", 1)[0] if "]" not in host else host.split("]")[0] + "]"
+        allowed = {"127.0.0.1", "localhost", "[::1]", self.server.server_address[0]}
+        if hostname not in allowed:
+            self._send(403, b"unexpected Host header", "text/plain")
+            return
         origin = self.headers.get("Origin")
-        if origin is not None:
-            host = self.headers.get("Host", "")
-            if origin.split("://", 1)[-1] != host:
-                self._send(403, b"cross-origin request rejected", "text/plain")
-                return
+        if origin is not None and origin.split("://", 1)[-1] != host:
+            self._send(403, b"cross-origin request rejected", "text/plain")
+            return
         length = int(self.headers.get("Content-Length", "0"))
         text = self.rfile.read(length).decode("utf-8", "replace")
         lines = self.console.query(text)
@@ -299,6 +306,8 @@ def main(argv=None) -> int:  # pragma: no cover — interactive entry
     store = CommentStore(args.db)
     if store.count() == 0 and args.seed_comments:
         store.save(SyntheticSource(batch=args.seed_comments)())
+    from svoc_tpu.apps.cli import build_adapter
+
     session = Session(
         config=SessionConfig(
             n_oracles=args.n_oracles,
@@ -309,6 +318,7 @@ def main(argv=None) -> int:  # pragma: no cover — interactive entry
             live_scraper=args.live_scraper,
         ),
         store=store,
+        adapter=build_adapter(args),
     )
     console = CommandConsole(session, write=print)
     # Startup resume+fetch (reference main.py:51-54).  fetch is the

@@ -126,31 +126,40 @@ class LocalChainBackend:
             raise KeyError(f"unknown invoke function {function_name!r}")
 
 
-class StarknetBackend:  # pragma: no cover — needs starknet.py + network
-    """Sepolia JSON-RPC backend (``client/contract.py`` semantics)."""
+class StarknetBackend:
+    """Sepolia JSON-RPC backend (``client/contract.py`` semantics).
+
+    Reads go through one ABI-resolved contract on the node client;
+    writes re-resolve the contract with the *caller's* account as
+    provider and submit a signed ``invoke_v3`` with the reference's
+    fixed resource bounds (``client/contract.py:211-264``).
+    """
 
     def __init__(
         self,
         node_url: str,
         deployed_address: int,
         accounts: Dict[int, Any],
+        client: Any = None,
     ):
         try:
             from starknet_py.contract import Contract
             from starknet_py.net.client_models import ResourceBounds
             from starknet_py.net.full_node_client import FullNodeClient
-        except ImportError as e:
+        except ImportError as e:  # pragma: no cover — package present in CI mocks
             raise RuntimeError(
                 "StarknetBackend needs the 'starknet.py' package; use "
                 "LocalChainBackend for simulation"
             ) from e
         self._Contract = Contract
         self._bounds = ResourceBounds(*RESOURCE_BOUND_L1_GAS)
-        self.client = FullNodeClient(node_url=node_url)
+        self.client = client if client is not None else FullNodeClient(node_url=node_url)
         self.deployed_address = deployed_address
         self.accounts = accounts  # address -> starknet Account
         self._read_contract = asyncio.run(
-            Contract.from_address(provider=self.client, address=deployed_address)
+            Contract.from_address(
+                provider=self.client, address=deployed_address
+            )
         )
 
     def call(self, function_name: str) -> Any:
@@ -158,20 +167,19 @@ class StarknetBackend:  # pragma: no cover — needs starknet.py + network
             self._read_contract.functions[function_name].call()
         )[0]
 
-    def call_as(self, caller: int, function_name: str) -> Any:
-        contract = asyncio.run(
+    def _caller_contract(self, caller: int):
+        return asyncio.run(
             self._Contract.from_address(
                 provider=self.accounts[caller], address=self.deployed_address
             )
         )
+
+    def call_as(self, caller: int, function_name: str) -> Any:
+        contract = self._caller_contract(caller)
         return asyncio.run(contract.functions[function_name].call())[0]
 
     def invoke(self, caller: int, function_name: str, /, **kwargs) -> None:
-        contract = asyncio.run(
-            self._Contract.from_address(
-                provider=self.accounts[caller], address=self.deployed_address
-            )
-        )
+        contract = self._caller_contract(caller)
         asyncio.run(
             contract.functions[function_name].invoke_v3(
                 **kwargs, l1_resource_bounds=self._bounds
@@ -181,11 +189,72 @@ class StarknetBackend:  # pragma: no cover — needs starknet.py + network
 
 def load_account_data(path: str) -> Tuple[List[dict], List[dict]]:
     """Parse the ``data/sepolia.json`` layout (``client/contract.py:61-71``,
-    template at ``client/README.md:38-77``): 3 admin + 8 oracle entries of
-    ``{address, private_key, public_key}``."""
+    template at ``client/README.md:38-77``): parallel hex-string lists
+    ``admins_addresses``/``admins_private_keys`` and
+    ``oracles_addresses``/``oracles_private_keys`` (3 admins, 8 oracles
+    in the reference deployment)."""
     with open(path) as f:
         data = json.load(f)
-    return data["admins"], data["oracles"]
+    admins = [
+        {"address": a, "private_key": k}
+        for a, k in zip(
+            data["admins_addresses"], data["admins_private_keys"], strict=True
+        )
+    ]
+    oracles = [
+        {"address": a, "private_key": k}
+        for a, k in zip(
+            data["oracles_addresses"], data["oracles_private_keys"], strict=True
+        )
+    ]
+    return admins, oracles
+
+
+def load_contract_info(path: str) -> Tuple[str, int, int]:
+    """Parse ``data/contract_info.json`` (``client/README.md:22-30``):
+    ``(rpc_url, declared_address, deployed_address)``."""
+    with open(path) as f:
+        info = json.load(f)
+    return (
+        info["rpc"],
+        from_hex(info["declared_address"]),
+        from_hex(info["deployed_address"]),
+    )
+
+
+def build_starknet_accounts(
+    client: Any, admins: Sequence[dict], oracles: Sequence[dict]
+) -> Dict[int, Any]:
+    """``Account`` objects keyed by int address for every admin and
+    oracle entry (``client/contract.py:73-84``)."""
+    from starknet_py.net.account.account import Account
+    from starknet_py.net.models.chains import StarknetChainId
+    from starknet_py.net.signer.stark_curve_signer import KeyPair
+
+    accounts: Dict[int, Any] = {}
+    for entry in list(admins) + list(oracles):
+        accounts[from_hex(entry["address"])] = Account(
+            client=client,
+            address=entry["address"],
+            key_pair=KeyPair.from_private_key(entry["private_key"]),
+            chain=StarknetChainId.SEPOLIA,
+        )
+    return accounts
+
+
+def starknet_backend_from_files(
+    contract_info_path: str, accounts_path: str
+) -> "StarknetBackend":
+    """The full reference bootstrap (``retrieve_account_data``,
+    ``client/contract.py:61-90``): RPC client from ``contract_info.json``,
+    per-identity accounts from ``sepolia.json``, ABI-resolved contract."""
+    from starknet_py.net.full_node_client import FullNodeClient
+
+    rpc, _declared, deployed = load_contract_info(contract_info_path)
+    client = FullNodeClient(node_url=rpc)
+    admins, oracles = load_account_data(accounts_path)
+    accounts = build_starknet_accounts(client, admins, oracles)
+    return StarknetBackend(rpc, deployed, accounts, client=client)
 
 
 class ChainAdapter:

@@ -1,0 +1,330 @@
+"""StarknetBackend encoding against a mocked ``starknet_py``.
+
+The real Sepolia path (``client/contract.py`` semantics) can't reach a
+network in CI, but its *encoding* can be pinned: calldata felts
+(two's-complement wsad), the fixed V3 resource bounds, per-oracle signed
+tx order, and the account bootstrap from the ``sepolia.json`` layout
+(``client/README.md:38-77``).  A fake ``starknet_py`` records every
+call.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import types
+
+import pytest
+
+from svoc_tpu.ops.fixedpoint import FELT_PRIME, float_to_fwsad
+
+RESOURCE_BOUND = (259806, 153060543928007)  # client/contract.py:29
+
+
+# ---------------------------------------------------------------------------
+# fake starknet_py
+# ---------------------------------------------------------------------------
+
+
+class FakeFunction:
+    def __init__(self, log, provider, name, views):
+        self._log = log
+        self._provider = provider
+        self._name = name
+        self._views = views
+
+    async def call(self):
+        self._log.append(("call", self._provider, self._name))
+        return (self._views.get(self._name, []),)
+
+    async def invoke_v3(self, **kwargs):
+        self._log.append(("invoke_v3", self._provider, self._name, kwargs))
+
+
+class FakeFunctions:
+    def __init__(self, log, provider, views):
+        self._log = log
+        self._provider = provider
+        self._views = views
+
+    def __getitem__(self, name):
+        return FakeFunction(self._log, self._provider, name, self._views)
+
+
+class FakeContract:
+    #: shared recorders, reset per test via the fixture
+    log: list = []
+    views: dict = {}
+
+    def __init__(self, provider, address):
+        self.provider = provider
+        self.address = address
+        self.functions = FakeFunctions(self.log, provider, self.views)
+
+    @classmethod
+    async def from_address(cls, provider, address):
+        cls.log.append(("from_address", provider, address))
+        return cls(provider, address)
+
+
+class FakeResourceBounds:
+    def __init__(self, max_amount, max_price_per_unit):
+        self.max_amount = max_amount
+        self.max_price_per_unit = max_price_per_unit
+
+    def __eq__(self, other):
+        return (self.max_amount, self.max_price_per_unit) == (
+            other.max_amount,
+            other.max_price_per_unit,
+        )
+
+    def __repr__(self):
+        return f"FakeResourceBounds({self.max_amount}, {self.max_price_per_unit})"
+
+
+class FakeFullNodeClient:
+    def __init__(self, node_url):
+        self.node_url = node_url
+
+
+class FakeKeyPair:
+    def __init__(self, key):
+        self.key = key
+
+    @classmethod
+    def from_private_key(cls, key):
+        return cls(key)
+
+
+class FakeAccount:
+    def __init__(self, client, address, key_pair, chain):
+        self.client = client
+        self.address = address
+        self.key_pair = key_pair
+        self.chain = chain
+
+    def __repr__(self):
+        return f"FakeAccount({self.address})"
+
+
+class FakeChainId:
+    SEPOLIA = "SN_SEPOLIA"
+
+
+def _module(name, **attrs):
+    m = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(m, k, v)
+    return m
+
+
+@pytest.fixture()
+def fake_starknet(monkeypatch):
+    FakeContract.log = []
+    FakeContract.views = {}
+    mods = {
+        "starknet_py": _module("starknet_py"),
+        "starknet_py.contract": _module(
+            "starknet_py.contract", Contract=FakeContract
+        ),
+        "starknet_py.net": _module("starknet_py.net"),
+        "starknet_py.net.client_models": _module(
+            "starknet_py.net.client_models", ResourceBounds=FakeResourceBounds
+        ),
+        "starknet_py.net.full_node_client": _module(
+            "starknet_py.net.full_node_client", FullNodeClient=FakeFullNodeClient
+        ),
+        "starknet_py.net.account": _module("starknet_py.net.account"),
+        "starknet_py.net.account.account": _module(
+            "starknet_py.net.account.account", Account=FakeAccount
+        ),
+        "starknet_py.net.models": _module("starknet_py.net.models"),
+        "starknet_py.net.models.chains": _module(
+            "starknet_py.net.models.chains", StarknetChainId=FakeChainId
+        ),
+        "starknet_py.net.signer": _module("starknet_py.net.signer"),
+        "starknet_py.net.signer.stark_curve_signer": _module(
+            "starknet_py.net.signer.stark_curve_signer", KeyPair=FakeKeyPair
+        ),
+    }
+    for name, mod in mods.items():
+        monkeypatch.setitem(sys.modules, name, mod)
+    return FakeContract
+
+
+# ---------------------------------------------------------------------------
+# account / deployment file parsing
+# ---------------------------------------------------------------------------
+
+
+def _write_sepolia_json(path):
+    data = {
+        "admins_addresses": [f"0x{0xA0 + i:x}" for i in range(3)],
+        "admins_private_keys": [f"0x{100 + i:x}" for i in range(3)],
+        "oracles_addresses": [f"0x{0x10 + i:x}" for i in range(8)],
+        "oracles_private_keys": [f"0x{200 + i:x}" for i in range(8)],
+    }
+    path.write_text(json.dumps(data))
+
+
+def test_load_account_data_reference_layout(tmp_path):
+    from svoc_tpu.io.chain import load_account_data
+
+    p = tmp_path / "sepolia.json"
+    _write_sepolia_json(p)
+    admins, oracles = load_account_data(str(p))
+    assert len(admins) == 3 and len(oracles) == 8
+    assert admins[0] == {"address": "0xa0", "private_key": "0x64"}
+    assert oracles[7]["address"] == "0x17"
+
+
+def test_load_contract_info(tmp_path):
+    from svoc_tpu.io.chain import load_contract_info
+
+    p = tmp_path / "contract_info.json"
+    p.write_text(
+        json.dumps(
+            {
+                "rpc": "https://rpc.example/sepolia",
+                "declared_address": "0x123",
+                "deployed_address": "0x456",
+            }
+        )
+    )
+    rpc, declared, deployed = load_contract_info(str(p))
+    assert rpc == "https://rpc.example/sepolia"
+    assert (declared, deployed) == (0x123, 0x456)
+
+
+def test_build_accounts_keyed_by_int_address(fake_starknet):
+    from svoc_tpu.io.chain import build_starknet_accounts
+
+    client = FakeFullNodeClient("https://rpc.example")
+    admins = [{"address": "0xa0", "private_key": "0x1"}]
+    oracles = [{"address": "0x10", "private_key": "0x2"}]
+    accounts = build_starknet_accounts(client, admins, oracles)
+    assert set(accounts) == {0xA0, 0x10}
+    acct = accounts[0x10]
+    assert acct.client is client
+    assert acct.key_pair.key == "0x2"
+    assert acct.chain == FakeChainId.SEPOLIA
+
+
+# ---------------------------------------------------------------------------
+# backend call/invoke encoding
+# ---------------------------------------------------------------------------
+
+
+def make_backend(fake_starknet, accounts=None):
+    from svoc_tpu.io.chain import StarknetBackend
+
+    client = FakeFullNodeClient("https://rpc.example")
+    return StarknetBackend(
+        "https://rpc.example", 0xDE9, accounts or {}, client=client
+    )
+
+
+def test_reads_use_node_client_contract(fake_starknet):
+    backend = make_backend(fake_starknet)
+    # ABI resolution happened once against the node client.
+    kind, provider, address = fake_starknet.log[0]
+    assert kind == "from_address" and address == 0xDE9
+    assert isinstance(provider, FakeFullNodeClient)
+
+    fake_starknet.views["get_predictions_dimension"] = 6
+    assert backend.call("get_predictions_dimension") == 6
+    assert fake_starknet.log[-1][2] == "get_predictions_dimension"
+
+
+def test_invoke_signs_with_caller_account_and_v3_bounds(fake_starknet):
+    accounts = {0x10: FakeAccount(None, "0x10", FakeKeyPair("k"), "SN_SEPOLIA")}
+    backend = make_backend(fake_starknet, accounts)
+    backend.invoke(0x10, "update_prediction", prediction=[1, 2, 3])
+
+    kind, provider, name, kwargs = fake_starknet.log[-1]
+    assert (kind, name) == ("invoke_v3", "update_prediction")
+    assert provider is accounts[0x10]  # signed by the caller's account
+    assert kwargs["prediction"] == [1, 2, 3]
+    assert kwargs["l1_resource_bounds"] == FakeResourceBounds(*RESOURCE_BOUND)
+
+
+def test_adapter_update_all_predictions_order_and_felts(fake_starknet):
+    """The full commit path over the mocked chain: one tx per oracle in
+    oracle-list order (client/contract.py:200-208), negative wsad values
+    prime-wrapped (client/contract.py:48-53)."""
+    from svoc_tpu.io.chain import ChainAdapter
+
+    oracle_addrs = [0x10, 0x11, 0x12]
+    accounts = {
+        a: FakeAccount(None, hex(a), FakeKeyPair("k"), "SN_SEPOLIA")
+        for a in oracle_addrs
+    }
+    backend = make_backend(fake_starknet, accounts)
+    fake_starknet.views["get_oracle_list"] = oracle_addrs
+    adapter = ChainAdapter(backend)
+
+    predictions = [[0.25, -0.5], [1.0, 2.5], [-0.000001, 0.0]]
+    assert adapter.update_all_the_predictions(predictions) == 3
+
+    invokes = [e for e in fake_starknet.log if e[0] == "invoke_v3"]
+    assert [e[1] for e in invokes] == [accounts[a] for a in oracle_addrs]
+    sent = [e[3]["prediction"] for e in invokes]
+    assert sent[0] == [250000, FELT_PRIME - 500000]
+    assert sent[1] == [1000000, 2500000]
+    assert sent[2] == [FELT_PRIME - 1, 0]
+    assert sent[0][1] == float_to_fwsad(-0.5)
+
+
+def test_starknet_backend_from_files(fake_starknet, tmp_path):
+    from svoc_tpu.io.chain import starknet_backend_from_files
+
+    info = tmp_path / "contract_info.json"
+    info.write_text(
+        json.dumps(
+            {
+                "rpc": "https://rpc.example/sepolia",
+                "declared_address": "0x123",
+                "deployed_address": "0x456",
+            }
+        )
+    )
+    sepolia = tmp_path / "sepolia.json"
+    _write_sepolia_json(sepolia)
+
+    backend = starknet_backend_from_files(str(info), str(sepolia))
+    assert backend.deployed_address == 0x456
+    assert backend.client.node_url == "https://rpc.example/sepolia"
+    assert len(backend.accounts) == 11  # 3 admins + 8 oracles
+    assert 0xA0 in backend.accounts and 0x17 in backend.accounts
+
+
+def test_cli_adapter_wiring(fake_starknet, tmp_path):
+    """--contract-info/--accounts build a Sepolia-backed adapter; one
+    without the other is rejected; neither means local simulator."""
+    from svoc_tpu.apps.cli import build_adapter, build_parser
+    from svoc_tpu.io.chain import StarknetBackend
+
+    info = tmp_path / "contract_info.json"
+    info.write_text(
+        json.dumps(
+            {
+                "rpc": "https://rpc.example",
+                "declared_address": "0x1",
+                "deployed_address": "0x2",
+            }
+        )
+    )
+    sepolia = tmp_path / "sepolia.json"
+    _write_sepolia_json(sepolia)
+
+    parser = build_parser()
+    args = parser.parse_args(
+        ["--contract-info", str(info), "--accounts", str(sepolia)]
+    )
+    adapter = build_adapter(args)
+    assert isinstance(adapter.backend, StarknetBackend)
+
+    assert build_adapter(parser.parse_args([])) is None
+
+    with pytest.raises(SystemExit, match="together"):
+        build_adapter(parser.parse_args(["--contract-info", str(info)]))

@@ -124,6 +124,25 @@ class TestWebUI:
         with urllib.request.urlopen(req, timeout=10) as r:
             assert json.loads(r.read()) == ["Dimension: 6"]
 
+    def test_dns_rebinding_host_rejected(self, server):
+        """Origin == Host is not enough: a rebound domain sends a
+        matching pair naming the attacker's host — the Host header must
+        itself be loopback/the bound address."""
+        base, _ = server
+        port = base.rsplit(":", 1)[1]
+        req = urllib.request.Request(
+            f"{base}/api/query",
+            data=b"dimension",
+            method="POST",
+            headers={
+                "Origin": f"http://evil.example:{port}",
+                "Host": f"evil.example:{port}",
+            },
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc_info.value.code == 403
+
     def test_non_loopback_bind_warns(self):
         console = CommandConsole(make_session())
         with pytest.warns(UserWarning, match="non-loopback"):
