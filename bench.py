@@ -690,6 +690,73 @@ def bench_config5(seconds: float, small: bool, platform: str) -> dict:
     }
 
 
+def bench_config6(seconds: float, small: bool, platform: str) -> dict:
+    """Pallas fused consensus vs the XLA kernel at flagship fleet size:
+    compile time and steady-state latency for both paths, each measured
+    over half the timed window."""
+    import jax
+
+    from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step
+    from svoc_tpu.ops.pallas_consensus import PALLAS_MAX_ORACLES, fused_consensus
+
+    n_oracles = 128 if small else 1024
+    dim = 6
+    cfg = ConsensusConfig(n_failing=n_oracles // 4, constrained=True)
+    values = jax.random.uniform(
+        jax.random.PRNGKey(0), (n_oracles, dim), minval=0.01, maxval=0.99
+    )
+
+    def timed_window_ms(fn, window_s: float) -> float:
+        """Median blocking latency over a time window (≥3 samples)."""
+        import numpy as np
+
+        samples = []
+        t_end = time.perf_counter() + window_s
+        while time.perf_counter() < t_end or len(samples) < 3:
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            samples.append((time.perf_counter() - t0) * 1e3)
+        return float(np.median(samples))
+
+    xla_step = jax.jit(lambda v: consensus_step(v, cfg))
+    t0 = time.perf_counter()
+    jax.block_until_ready(xla_step(values))
+    xla_compile_s = time.perf_counter() - t0
+    xla_ms = timed_window_ms(lambda: xla_step(values), seconds / 2)
+
+    t0 = time.perf_counter()
+    out = fused_consensus(values, cfg)
+    jax.block_until_ready(out)
+    pallas_compile_s = time.perf_counter() - t0
+    pallas_ms = timed_window_ms(lambda: fused_consensus(values, cfg), seconds / 2)
+    pallas_active = n_oracles <= PALLAS_MAX_ORACLES
+    interpreted = jax.default_backend() != "tpu"
+
+    return {
+        "metric": (
+            f"config 6: fused Pallas consensus vs XLA kernel @ {n_oracles} "
+            "oracles (single launch, VMEM-resident)"
+        ),
+        "value": round(pallas_ms, 3),
+        "unit": "ms/consensus-update",
+        "vs_baseline": round((1e3 / pallas_ms) / REFERENCE_CONSENSUS_PER_SEC, 2)
+        if pallas_ms > 0
+        else None,
+        "detail": {
+            "pallas_latency_ms": round(pallas_ms, 3),
+            "xla_latency_ms": round(xla_ms, 3),
+            "pallas_vs_xla_speedup": round(xla_ms / pallas_ms, 3)
+            if pallas_ms > 0
+            else None,
+            "pallas_compile_s": round(pallas_compile_s, 2),
+            "xla_compile_s": round(xla_compile_s, 2),
+            "pallas_kernel_active": pallas_active,
+            "pallas_interpreted": interpreted,
+            "n_oracles": n_oracles,
+        },
+    }
+
+
 CONFIGS = {
     0: bench_flagship,
     1: bench_config1,
@@ -697,6 +764,7 @@ CONFIGS = {
     3: bench_config3,
     4: bench_config4,
     5: bench_config5,
+    6: bench_config6,
 }
 
 

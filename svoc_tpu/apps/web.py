@@ -185,6 +185,20 @@ refresh();
 class _Handler(BaseHTTPRequestHandler):
     console: CommandConsole  # set by serve()
 
+    def _host_ok(self) -> bool:
+        """DNS-rebinding guard for loopback serving: the Host header
+        must name the bound address (a rebound evil.example resolving
+        to 127.0.0.1 sends its own name).  Wildcard binds opted into
+        remote clients (serve() warned), so any Host is accepted."""
+        bound = self.server.server_address[0]
+        if bound in ("0.0.0.0", "::"):
+            return True
+        host = self.headers.get("Host", "")
+        hostname = (
+            host.split("]")[0] + "]" if host.startswith("[") else host.rsplit(":", 1)[0]
+        )
+        return hostname in {"127.0.0.1", "localhost", "[::1]", bound}
+
     def _send(self, code: int, body: bytes, ctype: str) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
@@ -193,6 +207,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 (stdlib API)
+        if not self._host_ok():
+            self._send(403, b"unexpected Host header", "text/plain")
+            return
         if self.path == "/":
             self._send(200, _PAGE.encode(), "text/html; charset=utf-8")
         elif self.path == "/api/state":
@@ -238,18 +255,16 @@ class _Handler(BaseHTTPRequestHandler):
         # page open in a local browser could otherwise drive the session
         # (incl. chain transactions and 'exit').  Browsers always attach
         # Origin to cross-origin POSTs — reject when it names another
-        # host; header-free clients (curl, tests) pass.  The Host header
-        # is additionally validated against the bound address so DNS
-        # rebinding (evil.example resolving to 127.0.0.1 — Origin and
-        # Host then match each other) can't slip through.
-        host = self.headers.get("Host", "")
-        hostname = host.rsplit(":", 1)[0] if "]" not in host else host.split("]")[0] + "]"
-        allowed = {"127.0.0.1", "localhost", "[::1]", self.server.server_address[0]}
-        if hostname not in allowed:
+        # host; header-free clients (curl, tests) pass.  _host_ok()
+        # additionally blocks DNS rebinding, where Origin and Host match
+        # each other but name the attacker's domain.
+        if not self._host_ok():
             self._send(403, b"unexpected Host header", "text/plain")
             return
         origin = self.headers.get("Origin")
-        if origin is not None and origin.split("://", 1)[-1] != host:
+        if origin is not None and origin.split("://", 1)[-1] != self.headers.get(
+            "Host", ""
+        ):
             self._send(403, b"cross-origin request rejected", "text/plain")
             return
         length = int(self.headers.get("Content-Length", "0"))

@@ -161,6 +161,11 @@ class StarknetBackend:
                 provider=self.client, address=deployed_address
             )
         )
+        #: ABI is immutable per (caller, address) — cache the resolved
+        #: contract per account so a commit cycle costs one RPC per tx,
+        #: not two (client/contract.py re-resolves every time; that is
+        #: a reference inefficiency, not semantics).
+        self._caller_contracts: Dict[int, Any] = {}
 
     def call(self, function_name: str) -> Any:
         return asyncio.run(
@@ -168,11 +173,15 @@ class StarknetBackend:
         )[0]
 
     def _caller_contract(self, caller: int):
-        return asyncio.run(
-            self._Contract.from_address(
-                provider=self.accounts[caller], address=self.deployed_address
+        contract = self._caller_contracts.get(caller)
+        if contract is None:
+            contract = asyncio.run(
+                self._Contract.from_address(
+                    provider=self.accounts[caller], address=self.deployed_address
+                )
             )
-        )
+            self._caller_contracts[caller] = contract
+        return contract
 
     def call_as(self, caller: int, function_name: str) -> Any:
         contract = self._caller_contract(caller)

@@ -31,6 +31,7 @@ tests); :func:`fused_consensus` picks automatically.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -40,30 +41,52 @@ from jax.experimental import pallas as pl
 from svoc_tpu.consensus.kernel import ConsensusConfig
 
 
+#: Column-block width for the rank computation.  Compiled kernel code
+#: touches at most [N, _RANK_BLOCK] tiles per loop body, so Mosaic
+#: compile time is linear in N instead of quadratic — the round-1
+#: version materialized the full [N, N] comparison matrix and took
+#: ~1 min to compile at N=128, capping the kernel below fleet scale.
+_RANK_BLOCK = 128
+
+
 def _stable_rank_2d(key_col: jnp.ndarray) -> jnp.ndarray:
     """Rank of each element of ``key_col [N, 1]`` in the Cairo order
     (ascending value, ties by descending index).  Returns ``[N, 1]`` f32
     (exact integers — N ≪ 2²⁴).
 
-    The row reduction of the [N, N] comparison matrix runs as an MXU
-    matmul against a ones vector: at N=1024 the kernel needs 13 of
-    these, and matmul keeps both compile time and runtime far below the
-    equivalent VPU multi-reductions."""
+    The [N, N] comparison matrix is never materialized: a fori_loop
+    walks [N, B] column blocks, reducing each block to partial counts
+    with an MXU matmul against ones (loop bodies compile once — code
+    size O(N·B), work O(N²), VMEM O(N·B)).  Matmul keeps both compile
+    time and runtime far below the equivalent VPU multi-reductions."""
     n = key_col.shape[0]
-    idx = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)  # row i
-    jdx = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)  # col j
-    ki = key_col  # [N, 1] broadcasts over columns
-    kj = key_col.reshape(1, n)
-    before = ((kj < ki) | ((kj == ki) & (jdx > idx))).astype(jnp.float32)
-    ones = jnp.ones((n, 1), jnp.float32)
-    # HIGHEST precision: the TPU MXU otherwise rounds inputs to bf16,
-    # corrupting both the integer counts and downstream selections.
-    ranks = jax.lax.dot_general(
-        before,
-        ones,
-        (((1,), (0,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32,
+    block = min(n, _RANK_BLOCK)
+    assert n % block == 0, f"fleet size {n} must be a multiple of {block}"
+    idx = jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)  # row index i
+    key_row = key_col.reshape(1, n)  # lane-major for block slicing
+    ones = jnp.ones((block, 1), jnp.float32)
+
+    def body(b, acc):
+        j0 = b * block
+        kj = jax.lax.dynamic_slice(key_row, (0, j0), (1, block))  # [1, B]
+        jdx = jax.lax.broadcasted_iota(jnp.int32, (n, block), 1) + j0
+        before = ((kj < key_col) | ((kj == key_col) & (jdx > idx))).astype(
+            jnp.float32
+        )  # [N, B]
+        # HIGHEST precision: the TPU MXU otherwise rounds inputs to
+        # bf16, corrupting both the integer counts and downstream
+        # selections.
+        part = jax.lax.dot_general(
+            before,
+            ones,
+            (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        return acc + part
+
+    ranks = jax.lax.fori_loop(
+        0, n // block, body, jnp.zeros((n, 1), jnp.float32)
     )
     return jnp.round(ranks)
 
@@ -170,14 +193,12 @@ class FusedConsensusOutput(NamedTuple):
     kurtosis: jnp.ndarray  # [M]
 
 
-#: Largest fleet the Pallas kernel compiles for.  The rank-counting
-#: kernel materializes [N, N] comparison tiles that Mosaic fully
-#: unrolls, so compile time grows ~quadratically (5 s at N=64, ~1 min
-#: at N=128, >10 min at N=1024).  The kernel's win is launch latency on
-#: small/medium fleets (the reference's N=7..64); above the cap
-#: :func:`fused_consensus` transparently runs the XLA graph, which is
-#: already ~1 ms at N=1024.
-PALLAS_MAX_ORACLES = 128
+#: Largest fleet the Pallas kernel compiles for, overridable via
+#: ``SVOC_PALLAS_MAX_ORACLES``.  With the block-looped rank computation
+#: compiled code size is O(N·_RANK_BLOCK), so the flagship N=1024 fleet
+#: compiles in bounded time; above the cap :func:`fused_consensus`
+#: transparently runs the XLA graph with identical semantics.
+PALLAS_MAX_ORACLES = int(os.environ.get("SVOC_PALLAS_MAX_ORACLES", "1024"))
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "interpret"))
@@ -193,8 +214,12 @@ def fused_consensus(
     n, dim = values.shape
     # The kernel implements only the cairo degenerate smooth median;
     # other smooth modes take the XLA path so semantics never depend on
-    # fleet size.
-    if n > PALLAS_MAX_ORACLES or cfg.smooth_mode != "cairo":
+    # fleet size.  Fleets above the rank block must tile it evenly.
+    if (
+        n > PALLAS_MAX_ORACLES
+        or (n > _RANK_BLOCK and n % _RANK_BLOCK != 0)
+        or cfg.smooth_mode != "cairo"
+    ):
         from svoc_tpu.consensus.kernel import consensus_step
 
         out = consensus_step(values.astype(jnp.float32), cfg)

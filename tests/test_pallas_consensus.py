@@ -21,7 +21,9 @@ CASES = [
     (7, 2, 2, False),
     (16, 4, 3, True),
     (64, 16, 6, True),
-    (256, 64, 6, True),  # > PALLAS_MAX_ORACLES: exercises the XLA fallback
+    (256, 64, 6, True),  # multi-block rank loop (2 blocks of 128)
+    (192, 16, 2, True),  # not a multiple of _RANK_BLOCK: XLA fallback
+    (1024, 256, 6, True),  # flagship fleet, 8-block rank loop
 ]
 
 
