@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""On-chip TPU probes: settle the kernel-compile questions empirically.
+
+Each probe runs in its own SUBPROCESS under a hard timeout, so a hung
+remote compiler (the known failure mode of the tunneled TPU backend)
+is contained and reported as ``{"ok": false, "timeout": true}`` instead
+of wedging the caller.  Results are printed as JSON lines and written to
+``TPU_PROBE.json`` at the repo root.
+
+Probes:
+
+1. ``backend``        — backend init + device kind (the canary).
+2. ``grid_copy``      — a trivial 2-D-grid ``pallas_call`` copy kernel:
+                        decides whether "gridded pallas_call hangs the
+                        axon compiler" (round-1 folklore) is real.
+3. ``consensus1024``  — gridless fused consensus @1024: compile time +
+                        latency vs the XLA kernel.
+4. ``flash512``       — flash attention, B=8 T=512 H=12 D=64, compile +
+                        latency vs the XLA dense path.
+5. ``encoder512``     — full encoder forward at seq 512 with and
+                        without SVOC_FLASH_ATTENTION.
+
+Usage: ``python tools/tpu_probe.py [--only NAME] [--timeout S]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROBES: dict = {}
+
+
+def probe(name):
+    def wrap(src):
+        PROBES[name] = src
+        return src
+
+    return wrap
+
+
+# Each probe is python source executed in a fresh interpreter; it must
+# print exactly one JSON object on its last stdout line.  The prelude
+# honors SVOC_PROBE_PLATFORM (e.g. "cpu") via jax.config — the
+# environment's sitecustomize pins the platform regardless of
+# JAX_PLATFORMS, so an env var alone cannot redirect a probe.
+
+PRELUDE = """
+import os as _os
+import jax as _jax
+if _os.environ.get("SVOC_PROBE_PLATFORM"):
+    _jax.config.update("jax_platforms", _os.environ["SVOC_PROBE_PLATFORM"])
+"""
+
+PROBES["backend"] = """
+import json, time, jax
+t0 = time.time()
+devs = jax.devices()
+print(json.dumps({"platform": devs[0].platform, "device_kind": devs[0].device_kind,
+                  "n_devices": len(devs), "init_s": round(time.time() - t0, 1)}))
+"""
+
+PROBES["grid_copy"] = """
+import json, time
+import jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+x = jnp.arange(4 * 256 * 128, dtype=jnp.float32).reshape(4, 256, 128)
+t0 = time.time()
+out = pl.pallas_call(
+    copy_kernel,
+    grid=(4, 2),
+    in_specs=[pl.BlockSpec((1, 128, 128), lambda i, j: (i, j, 0),
+                           memory_space=pltpu.VMEM)],
+    out_specs=pl.BlockSpec((1, 128, 128), lambda i, j: (i, j, 0),
+                           memory_space=pltpu.VMEM),
+    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+)(x)
+out.block_until_ready()
+ok = bool((out == x).all())
+print(json.dumps({"grid_compiles": True, "correct": ok,
+                  "compile_s": round(time.time() - t0, 1)}))
+"""
+
+PROBES["consensus1024"] = """
+import json, time
+import jax, jax.numpy as jnp
+from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step
+from svoc_tpu.ops.pallas_consensus import fused_consensus
+
+n, dim = 1024, 6
+cfg = ConsensusConfig(n_failing=n // 4, constrained=True)
+values = jax.random.uniform(jax.random.PRNGKey(0), (n, dim), minval=0.01, maxval=0.99)
+
+xla_step = jax.jit(lambda v: consensus_step(v, cfg))
+t0 = time.time(); jax.block_until_ready(xla_step(values)); xla_compile = time.time() - t0
+
+t0 = time.time(); jax.block_until_ready(fused_consensus(values, cfg))
+pallas_compile = time.time() - t0
+
+def lat(fn, reps=50):
+    jax.block_until_ready(fn())
+    t0 = time.time()
+    for _ in range(reps): jax.block_until_ready(fn())
+    return (time.time() - t0) / reps * 1e3
+
+xla_ms = lat(lambda: xla_step(values))
+pallas_ms = lat(lambda: fused_consensus(values, cfg))
+import numpy as np
+a = fused_consensus(values, cfg); b = xla_step(values)
+match = bool(np.allclose(np.asarray(a.essence), np.asarray(b.essence), atol=1e-5))
+print(json.dumps({"pallas_compile_s": round(pallas_compile, 1),
+                  "xla_compile_s": round(xla_compile, 1),
+                  "pallas_ms": round(pallas_ms, 3), "xla_ms": round(xla_ms, 3),
+                  "speedup": round(xla_ms / pallas_ms, 2), "essence_match": match}))
+"""
+
+PROBES["flash512"] = """
+import json, time, os
+os.environ["SVOC_FLASH_ATTENTION"] = "1"
+import jax, jax.numpy as jnp
+import numpy as np
+from svoc_tpu.ops.pallas_attention import flash_attention
+from svoc_tpu.parallel.ring_attention import dense_attention_reference
+
+b, t, h, d = 8, 512, 12, 64
+kq = jax.random.PRNGKey(0)
+q = jax.random.normal(kq, (b, t, h, d), jnp.float32)
+mask = jnp.ones((b, t), jnp.int32)
+
+t0 = time.time()
+out = flash_attention(q, q, q, mask)
+jax.block_until_ready(out)
+compile_s = time.time() - t0
+ref = dense_attention_reference(q, q, q, mask)
+match = bool(np.allclose(np.asarray(out), np.asarray(ref), atol=2e-3))
+
+def lat(fn, reps=30):
+    jax.block_until_ready(fn())
+    t0 = time.time()
+    for _ in range(reps): jax.block_until_ready(fn())
+    return (time.time() - t0) / reps * 1e3
+
+dense_jit = jax.jit(dense_attention_reference)
+flash_ms = lat(lambda: flash_attention(q, q, q, mask))
+dense_ms = lat(lambda: dense_jit(q, q, q, mask))
+print(json.dumps({"flash_compiles": True, "compile_s": round(compile_s, 1),
+                  "match_dense": match, "flash_ms": round(flash_ms, 3),
+                  "dense_ms": round(dense_ms, 3),
+                  "speedup": round(dense_ms / flash_ms, 2)}))
+"""
+
+PROBES["encoder512"] = """
+import json, time, os
+import jax, jax.numpy as jnp
+from svoc_tpu.models.configs import ROBERTA_GO_EMOTIONS
+from svoc_tpu.models.encoder import SentimentEncoder, init_params
+
+cfg = ROBERTA_GO_EMOTIONS
+model = SentimentEncoder(cfg)
+params = init_params(model, seed=0)
+b, t = 32, 512
+ids = jnp.ones((b, t), jnp.int32)
+mask = jnp.ones((b, t), jnp.int32)
+
+fwd = jax.jit(lambda p, i, m: model.apply(p, i, m))
+t0 = time.time(); jax.block_until_ready(fwd(params, ids, mask))
+compile_s = time.time() - t0
+
+def lat(fn, reps=20):
+    jax.block_until_ready(fn())
+    t0 = time.time()
+    for _ in range(reps): jax.block_until_ready(fn())
+    return (time.time() - t0) / reps * 1e3
+
+ms = lat(lambda: fwd(params, ids, mask))
+flash = os.environ.get("SVOC_FLASH_ATTENTION") == "1"
+print(json.dumps({"flash_enabled": flash, "compile_s": round(compile_s, 1),
+                  "forward_ms": round(ms, 3),
+                  "comments_per_sec": round(b / (ms / 1e3), 1)}))
+"""
+
+
+def run_probe(name: str, timeout_s: float, extra_env: dict | None = None) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{REPO}:{env.get('PYTHONPATH', '')}"
+    env.update(extra_env or {})
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PRELUDE + PROBES[name]],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {
+            "probe": name,
+            "ok": False,
+            "timeout": True,
+            "elapsed_s": round(time.time() - t0, 1),
+        }
+    result: dict = {
+        "probe": name,
+        "ok": proc.returncode == 0,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    if proc.returncode == 0:
+        try:
+            result.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+        except (ValueError, IndexError):
+            result["ok"] = False
+            result["stdout_tail"] = proc.stdout[-300:]
+    else:
+        result["stderr_tail"] = (proc.stderr or "").strip().splitlines()[-3:]
+    return result
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--only", choices=sorted(PROBES), default=None)
+    parser.add_argument("--timeout", type=float, default=420.0)
+    args = parser.parse_args(argv)
+
+    names = [args.only] if args.only else list(PROBES)
+    results = []
+    for name in names:
+        extra = {}
+        if name == "encoder512":
+            # run twice: dense, then flash-enabled
+            r1 = run_probe(name, args.timeout, {"SVOC_FLASH_ATTENTION": "0"})
+            r1["probe"] = "encoder512_dense"
+            print(json.dumps(r1), flush=True)
+            results.append(r1)
+            extra = {"SVOC_FLASH_ATTENTION": "1"}
+        r = run_probe(name, args.timeout, extra)
+        if name == "encoder512":
+            r["probe"] = "encoder512_flash"
+        print(json.dumps(r), flush=True)
+        results.append(r)
+        if name == "backend" and not r["ok"]:
+            print(json.dumps({"abort": "backend unreachable"}))
+            break
+
+    with open(os.path.join(REPO, "TPU_PROBE.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    return 0 if all(r.get("ok") for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
