@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Probe 3: decompose execution vs fetch on the axon tunnel.
+
+Dispatch N unique-input forwards back-to-back and fetch ONLY the last
+result.  If the device serializes execution, the final fetch waits for
+all N executions, so total/N approximates true per-step execution with
+the ~67 ms roundtrip amortized.  Compare N in {1, 8, 32} and a
+fetch-every-8 variant, plus chained steps (output feeds consensus) to
+mirror the flagship loop.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    result = {"backend": jax.default_backend()}
+
+    from svoc_tpu.models.configs import ROBERTA_GO_EMOTIONS
+    from svoc_tpu.models.sentiment import SentimentPipeline
+
+    B, S = 256, 128
+    pipe = SentimentPipeline(
+        cfg=ROBERTA_GO_EMOTIONS, seq_len=S, batch_size=B, tokenizer_name=None
+    )
+    fwd = pipe.forward_fn()
+    rng = np.random.default_rng(0)
+    n_uniq = 16
+    ids_pool = [
+        jax.device_put(jnp.asarray(rng.integers(10, 5000, (B, S)), jnp.int32))
+        for _ in range(n_uniq)
+    ]
+    mask = jax.device_put(jnp.ones((B, S), jnp.int32))
+    _ = float(jnp.sum(fwd(pipe.params, ids_pool[0], mask)))  # warm
+
+    j = [0]
+
+    def run_n_fetch_last(n):
+        out = None
+        for _ in range(n):
+            j[0] += 1
+            out = fwd(pipe.params, ids_pool[j[0] % n_uniq], mask)
+        return float(jnp.sum(out))
+
+    for n in (1, 8, 32):
+        run_n_fetch_last(n)  # warm the pattern
+        t0 = time.perf_counter()
+        run_n_fetch_last(n)
+        dt = time.perf_counter() - t0
+        result[f"dispatch{n}_fetch_last_s"] = round(dt, 3)
+        result[f"dispatch{n}_per_step_ms"] = round(dt / n * 1e3, 2)
+
+    flops = 256 * 128 * 12 * (2 * (4 * 768 * 768 + 2 * 768 * 3072) + 4 * 128 * 768)
+    per_step_s = result["dispatch32_per_step_ms"] / 1e3
+    result["fwd_matmul_tflop"] = round(flops / 1e12, 3)
+    result["amortized_implied_tflops"] = round(flops / per_step_s / 1e12, 1)
+    result["amortized_implied_mfu"] = round(
+        result["amortized_implied_tflops"] / 197.0, 3
+    )
+
+    line = json.dumps(result)
+    print(line, flush=True)
+    with open("DISPATCH_PROBE3.json", "w") as fh:
+        fh.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
