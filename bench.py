@@ -252,10 +252,26 @@ class AsyncResultFetcher:
         self._queue.put((step_idx, handle))
 
     def finish(self) -> list:
-        self._queue.put(None)
+        import queue
+
+        try:
+            # Bounded wait: if the worker is wedged inside a hung fetch
+            # the queue may stay full — don't block forever on the
+            # sentinel, and never return partial results as complete.
+            self._queue.put(None, timeout=600)
+        except queue.Full as e:
+            raise RuntimeError(
+                "checksum fetcher queue stuck full — a device fetch is "
+                "hanging; results are incomplete"
+            ) from e
         self._thread.join(timeout=600)
         if self.error is not None:
             raise self.error
+        if self._thread.is_alive():
+            raise RuntimeError(
+                "checksum fetcher did not drain within 600 s — a device "
+                "fetch is hanging; results are incomplete"
+            )
         return self.results
 
     def checksums(self) -> list:
@@ -348,6 +364,7 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
         seq_len=seq,
         batch_size=batch,
         tokenizer_name=None if small else "SamLowe/roberta-base-go_emotions",
+        params_dtype=None if small else "bfloat16",
     )
     forward = pipe.forward_fn()
 
@@ -878,6 +895,7 @@ def bench_config5(seconds: float, small: bool, platform: str) -> dict:
         seq_len=seq,
         batch_size=batch,
         tokenizer_name=None if small else "SamLowe/roberta-base-go_emotions",
+        params_dtype=None if small else "bfloat16",
     )
     forward = pipe.forward_fn()
 

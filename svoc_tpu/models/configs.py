@@ -36,6 +36,13 @@ class EncoderConfig:
     remat: bool = False
     #: "sigmoid" (multi-label, go_emotions) or "softmax" (SST-2).
     head: str = "sigmoid"
+    #: "dense" (fused XLA einsum chain) or "flash" (Pallas online-softmax
+    #: kernel, :mod:`svoc_tpu.ops.pallas_attention`).  Honest amortized
+    #: timings on v5e (FLASH_PROBE.json): flash wins from T=512
+    #: (1.16×) and dominates long context (49× at T=8192, where the
+    #: dense [B,H,T,T] HBM blowup bites); at the classifier's T=128
+    #: dense is ~8% faster, so it stays the default.
+    attention: str = "dense"
 
     @property
     def head_dim(self) -> int:

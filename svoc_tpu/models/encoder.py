@@ -43,11 +43,20 @@ class SelfAttention(nn.Module):
         k = nn.Dense(cfg.hidden, dtype=cfg.dtype, name="key")(x).reshape(b, t, h, d)
         v = nn.Dense(cfg.hidden, dtype=cfg.dtype, name="value")(x).reshape(b, t, h, d)
 
-        scale = jnp.asarray(1.0 / jnp.sqrt(d), cfg.dtype)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-        scores = scores.astype(jnp.float32) + bias  # f32 softmax
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, t, cfg.hidden)
+        if cfg.attention == "flash":
+            from svoc_tpu.ops.pallas_attention import flash_attention
+
+            # The additive bias encodes key padding (0 kept / -1e9
+            # masked, broadcast [B, 1, 1, T]) — recover the boolean
+            # per-key mask the kernel consumes.
+            kmask = (bias[:, 0, 0, :] > -1.0).astype(jnp.int32)
+            ctx = flash_attention(q, k, v, kmask).reshape(b, t, cfg.hidden)
+        else:
+            scale = jnp.asarray(1.0 / jnp.sqrt(d), cfg.dtype)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            scores = scores.astype(jnp.float32) + bias  # f32 softmax
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, t, cfg.hidden)
         return nn.Dense(cfg.hidden, dtype=cfg.dtype, name="out")(ctx)
 
 

@@ -74,6 +74,12 @@ class SentimentPipeline:
     label_indices: tuple = TRACKED_INDICES
     seed: int = 0
     params: Optional[dict] = None
+    #: Cast float32 params ONCE at construction (e.g. "bfloat16") so
+    #: inference matmuls read half-width weights from HBM instead of
+    #: casting per call.  None keeps the stored dtype (training /
+    #: conversion-parity use).  Measured +1.5% MFU on v5e
+    #: (PERF_EXPERIMENTS.json).
+    params_dtype: Optional[str] = None
 
     def __post_init__(self):
         if max(self.label_indices) >= self.cfg.n_labels:
@@ -85,6 +91,12 @@ class SentimentPipeline:
         self.model = SentimentEncoder(self.cfg)
         if self.params is None:
             self.params = init_params(self.model, seed=self.seed)
+        if self.params_dtype is not None:
+            dtype = jnp.dtype(self.params_dtype)
+            self.params = jax.tree_util.tree_map(
+                lambda a: a.astype(dtype) if a.dtype == jnp.float32 else a,
+                self.params,
+            )
         self.tokenizer = load_tokenizer(
             self.tokenizer_name,
             self.cfg.vocab_size,

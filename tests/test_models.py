@@ -118,3 +118,38 @@ def test_param_shardings_cover_tree():
     # at least the FFN kernels must actually be model-sharded
     n_sharded = sum(1 for s in flat_s if any(a == "model" for a in s.spec if a))
     assert n_sharded >= 2 * TINY_TEST.n_layers
+
+
+def test_flash_attention_encoder_matches_dense():
+    """attention="flash" must be logit-equivalent to the dense path
+    (same params tree — the attention impl is not a weight change)."""
+    import dataclasses
+
+    dense_cfg = dataclasses.replace(TINY_TEST, max_len=64)
+    flash_cfg = dataclasses.replace(dense_cfg, attention="flash")
+    dense = SentimentEncoder(dense_cfg)
+    flash = SentimentEncoder(flash_cfg)
+    params = init_params(dense, seed=3)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(2, 1000, (2, 64)), jnp.int32)
+    mask = jnp.asarray((rng.random((2, 64)) < 0.8).astype(np.int32))
+    mask = mask.at[:, 0].set(1)
+
+    out_dense = dense.apply(params, ids, mask)
+    out_flash = flash.apply(params, ids, mask)
+    np.testing.assert_allclose(
+        np.asarray(out_dense), np.asarray(out_flash), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_params_dtype_resident_cast():
+    pipe = SentimentPipeline(
+        cfg=TINY_TEST, seq_len=16, batch_size=2, tokenizer_name=None,
+        params_dtype="bfloat16",
+    )
+    leaves = jax.tree_util.tree_leaves(pipe.params)
+    assert all(l.dtype != jnp.float32 for l in leaves)
+    vecs = pipe(["some text", "other text"])
+    assert vecs.shape == (2, 6)
+    np.testing.assert_allclose(vecs.sum(axis=-1), 1.0, rtol=1e-2)

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Round-3 throughput experiments on the real chip (task: recover MFU).
+
+Variants timed with the honest amortized protocol (dispatch N, fetch
+last): batch size sweep, bf16-resident params, and a fleet/consensus
+stage breakdown at 1024 oracles.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def amortized_ms(step, n=16):
+    float(np.asarray(jnp.sum(step(0))))  # warm
+    t0 = time.perf_counter()
+    h = None
+    for i in range(n):
+        h = step(i + 1)
+    float(np.asarray(jnp.sum(h)))
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def main():
+    from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step
+    from svoc_tpu.models.configs import ROBERTA_GO_EMOTIONS
+    from svoc_tpu.models.sentiment import SentimentPipeline
+    from svoc_tpu.sim.oracle import gen_oracle_predictions
+
+    result = {}
+    S = 128
+    rng = np.random.default_rng(0)
+
+    FLOPS_PER_TOK = 12 * (2 * (4 * 768 * 768 + 2 * 768 * 3072) + 4 * S * 768)
+
+    for B in (256, 512, 1024):
+        pipe = SentimentPipeline(
+            cfg=ROBERTA_GO_EMOTIONS, seq_len=S, batch_size=B, tokenizer_name=None
+        )
+        fwd = pipe.forward_fn()
+        pool = [
+            jax.device_put(jnp.asarray(rng.integers(10, 5000, (B, S)), jnp.int32))
+            for _ in range(4)
+        ]
+        mask = jax.device_put(jnp.ones((B, S), jnp.int32))
+
+        ms = amortized_ms(lambda i: fwd(pipe.params, pool[i % 4], mask), n=12)
+        mfu = B * S * FLOPS_PER_TOK / (ms / 1e3) / 197e12
+        result[f"fwd_b{B}_f32params_ms"] = round(ms, 2)
+        result[f"fwd_b{B}_f32params_mfu"] = round(mfu, 4)
+
+        # bf16-resident params: one cast up front, matmuls read bf16
+        bf16_params = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+            pipe.params,
+        )
+        ms = amortized_ms(lambda i: fwd(bf16_params, pool[i % 4], mask), n=12)
+        mfu = B * S * FLOPS_PER_TOK / (ms / 1e3) / 197e12
+        result[f"fwd_b{B}_bf16params_ms"] = round(ms, 2)
+        result[f"fwd_b{B}_bf16params_mfu"] = round(mfu, 4)
+
+    # fleet + consensus breakdown at 1024 oracles, window 50x6
+    n_oracles = 1024
+    ccfg = ConsensusConfig(n_failing=n_oracles // 8, constrained=True)
+    window = jax.device_put(
+        jnp.asarray(rng.uniform(0.01, 0.99, (50, 6)), jnp.float32)
+    )
+    key = jax.random.PRNGKey(0)
+
+    fleet_only = jax.jit(
+        lambda k: gen_oracle_predictions(k, window, n_oracles, ccfg.n_failing, 10)[0]
+    )
+    values0 = fleet_only(key)
+    consensus_only = jax.jit(lambda v: consensus_step(v, ccfg).essence)
+
+    result["fleet_only_ms"] = round(
+        amortized_ms(lambda i: fleet_only(jax.random.fold_in(key, i)), n=16), 3
+    )
+    result["consensus_only_ms"] = round(
+        amortized_ms(lambda i: consensus_only(values0 + 1e-6 * i), n=16), 3
+    )
+
+    fused = jax.jit(
+        lambda k: consensus_step(
+            gen_oracle_predictions(k, window, n_oracles, ccfg.n_failing, 10)[0], ccfg
+        ).essence
+    )
+    result["fleet_consensus_fused_ms"] = round(
+        amortized_ms(lambda i: fused(jax.random.fold_in(key, i)), n=16), 3
+    )
+
+    line = json.dumps(result)
+    print(line, flush=True)
+    with open("PERF_EXPERIMENTS.json", "w") as fh:
+        fh.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
