@@ -17,6 +17,9 @@ bootstrap fleet -> two-pass consensus, overlapped via a prefetch queue.
 4. 1024-oracle pod sim with k failing/adversarial oracles
 5. Streaming scrape -> TPU inference -> on-chain consensus submit
    (end-to-end incl. the chain-submit stage via LocalChainBackend)
+6. Fused Pallas consensus kernel vs the XLA kernel @ flagship fleet size
+7. Data-parallel serving over all local devices (the v5e-8 ≥10k
+   comments/sec BASELINE path — mesh-sharded batch + oracle-sharded fleet)
 
 Baseline: the reference client classifies a 30-comment window every 5 s
 with 7 oracles on CPU torch (~6 comments/sec, one consensus update per
@@ -1080,6 +1083,148 @@ def bench_config6(seconds: float, small: bool, platform: str) -> dict:
     }
 
 
+def bench_config7(seconds: float, small: bool, platform: str) -> dict:
+    """Data-parallel serving over ALL local devices: batch sharded over a
+    ``data`` mesh axis through the forward, window replicated, fleet +
+    consensus oracle-sharded over the same axis — one jit per step
+    (:mod:`svoc_tpu.parallel.serving`).  On a v5e-8 this is the ≥10k
+    comments/sec BASELINE path; on one chip it degenerates to the
+    flagship shape (mesh size 1)."""
+    import jax
+    import jax.numpy as jnp
+
+    from svoc_tpu.consensus.kernel import ConsensusConfig
+    from svoc_tpu.io.pipeline import PrefetchPipeline
+    from svoc_tpu.io.scraper import SyntheticSource
+    from svoc_tpu.models.configs import ROBERTA_GO_EMOTIONS, TINY_TEST
+    from svoc_tpu.models.sentiment import SentimentPipeline
+    from svoc_tpu.parallel.serving import (
+        batch_sharding,
+        dp_serving_step_fn,
+        serving_mesh,
+    )
+
+    n_dev = len(jax.devices())
+    if small:
+        enc_cfg, per_dev_batch, seq, n_oracles = TINY_TEST, 32, 32, 16 * n_dev
+    else:
+        enc_cfg, per_dev_batch, seq, n_oracles = ROBERTA_GO_EMOTIONS, 256, 128, 1024
+    if n_oracles % n_dev:
+        n_oracles += n_dev - n_oracles % n_dev
+    batch = per_dev_batch * n_dev
+    window_size = min(50, batch)
+    ccfg = ConsensusConfig(n_failing=max(2, n_oracles // 8), constrained=True)
+
+    pipe = SentimentPipeline(
+        cfg=enc_cfg,
+        seq_len=seq,
+        batch_size=batch,
+        tokenizer_name=None if small else "SamLowe/roberta-base-go_emotions",
+        params_dtype=None if small else "bfloat16",
+    )
+    mesh = serving_mesh()
+    bshard = batch_sharding(mesh)
+    serve = dp_serving_step_fn(
+        mesh, enc_cfg, ccfg, n_oracles, window_size=window_size, subset_size=10
+    )
+    roundtrip = measure_roundtrip_ms()
+
+    source = SyntheticSource(batch=batch, seed=0)
+
+    def unique_batches():
+        while True:
+            yield source()
+
+    def put(b):
+        return (
+            jax.device_put(jnp.asarray(b[0]), bshard),
+            jax.device_put(jnp.asarray(b[1]), bshard),
+        )
+
+    ids0, mask0 = put(pipe.tokenizer(source(), seq))
+    ids1, mask1 = put(pipe.tokenizer(source(), seq))
+    key = jax.random.PRNGKey(0)
+    warm0 = device_fetch(serve(pipe.params, key, ids0, mask0)[0].essence)
+    warm1 = device_fetch(serve(pipe.params, key, ids1, mask1)[0].essence)
+    if warm0 == warm1:
+        raise AssertionError(
+            "distinct warmup batches produced identical serving checksums"
+        )
+    step_ms = timed_latency_ms(
+        lambda: serve(pipe.params, key, ids0, mask0)[0].essence,
+        reps=latency_reps(platform),
+    )
+    step_exec_ms = amortized_step_ms(
+        lambda i: serve(
+            pipe.params,
+            jax.random.fold_in(key, i),
+            ids0 if i % 2 else ids1,
+            mask0,
+        )[0].essence,
+        n=amortize_reps(platform),
+    )
+    sync_every = max(1, min(64, int(round(8 * roundtrip / max(step_exec_ms, 1e-3)))))
+
+    n_comments = 0
+    steps = 0
+    out = None
+    fetcher = AsyncResultFetcher(maxsize=2)
+    with PrefetchPipeline(
+        unique_batches(), pipe.tokenizer, seq_len=seq, depth=4, device_put=put
+    ) as stream:
+        t0 = time.perf_counter()
+        for ids, mask in stream:
+            key = jax.random.fold_in(key, steps)
+            out, honest = serve(pipe.params, key, ids, mask)
+            if steps % sync_every == 0:
+                fetcher.submit(steps, out.essence)
+            n_comments += batch
+            steps += 1
+            if time.perf_counter() - t0 >= seconds:
+                break
+        final_checksum = device_fetch(out.essence)
+        elapsed = time.perf_counter() - t0
+    fetcher.finish()
+    checksums = fetcher.checksums() + [(steps - 1, final_checksum)]
+    assert_checksums_distinct(checksums)
+
+    value = n_comments / elapsed
+    tokens_per_sec = value * seq
+    flops_per_token = encoder_matmul_flops_per_token(enc_cfg, seq)
+    peak = assumed_peak_flops(platform)
+    mfu = tokens_per_sec * flops_per_token / (peak * n_dev) if peak else None
+    return {
+        "metric": (
+            f"config 7: data-parallel serving over {n_dev} device(s) — "
+            f"sharded sentiment batch -> {n_oracles}-oracle fleet -> consensus"
+        ),
+        "value": round(value, 2),
+        "unit": "comments/sec",
+        "vs_baseline": round(value / REFERENCE_COMMENTS_PER_SEC, 2),
+        "detail": {
+            "timing_method": (
+                "unique batches per step; async host-fetch checksum every "
+                f"{sync_every} steps; clock stopped after final-step fetch"
+            ),
+            "device_roundtrip_ms": round(roundtrip, 3),
+            "n_mesh_devices": n_dev,
+            "per_device_batch": per_dev_batch,
+            "serving_step_ms": round(step_ms, 3),
+            "serving_step_exec_ms": round(step_exec_ms, 3),
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "mfu_estimate": round(mfu, 4) if mfu is not None else None,
+            "assumed_peak_tflops": peak * n_dev / 1e12 if peak else None,
+            "consensus_n_oracles": n_oracles,
+            "reliability2": device_fetch(out.reliability_second_pass),
+            "steps": steps,
+            "batch": batch,
+            "seq_len": seq,
+            "elapsed_s": round(elapsed, 2),
+            **checksum_stats(checksums),
+        },
+    }
+
+
 CONFIGS = {
     0: bench_flagship,
     1: bench_config1,
@@ -1088,6 +1233,7 @@ CONFIGS = {
     4: bench_config4,
     5: bench_config5,
     6: bench_config6,
+    7: bench_config7,
 }
 
 

@@ -18,10 +18,10 @@ scratch carry persists across the innermost k dimension; the output
 block is written on the last k step.  Padding is a per-key boolean mask.
 
 Round-3 note: the round-2 "axon remote compiler hangs on gridded
-pallas_call" guard was removed — ``TPU_PROBE.json`` showed the gridded
-kernel compiling in 1.9 s; the hang diagnosis was wrong (the probe's
-``block_until_ready`` timings were, like all round-2 timings, not
-waiting for execution at all).  Honest amortized timings live in
+pallas_call" guard was removed — the gridded kernel compiles in ~1.7 s
+on the tunneled backend (``FLASH_PROBE.json`` ``flash_compile_s``); the
+round-2 hang diagnosis was wrong (its ``block_until_ready`` timings
+never waited for execution).  Honest amortized timings live in
 ``FLASH_PROBE.json`` (``tools/flash_probe.py``).
 
 Non-TPU backends run in interpreter mode (tests); use
@@ -38,6 +38,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+
+def _largest_aligned_divisor(t: int, requested: int):
+    """Largest divisor of ``t`` that is ≤ ``requested`` and a multiple
+    of 8 (the TPU sublane), or None if ``t`` has no such divisor."""
+    for cand in range(min(requested, t), 7, -1):
+        if t % cand == 0 and cand % 8 == 0:
+            return cand
+    return None
 
 
 def _flash_kernel(
@@ -103,21 +112,18 @@ def flash_attention(
     ``[B, T, H, D]``.  T must divide by the block sizes (pad the batch
     to the model's fixed seq_len upstream, as the pipeline already
     does)."""
-    import math
-
     b, t, h, d = q.shape
     if kmask is None:
         kmask = jnp.ones((b, t), jnp.int32)
-    # Clamp to a divisor of T (gcd), not min() — T=384 with the default
-    # 256 must fall back to 128-wide blocks, not error out.  The blocks
-    # must stay sublane-aligned (multiples of 8) for the TPU tiling.
-    block_q = math.gcd(block_q, t)
-    block_k = math.gcd(block_k, t)
-    if block_q % 8 or block_k % 8:
+    # Clamp each block to the LARGEST 8-aligned divisor of T that fits
+    # the request — T=384 with the default 256 falls back to 128-wide
+    # blocks, and T=520 gets 104 (gcd would degenerate to 8-wide tiles).
+    block_q = _largest_aligned_divisor(t, block_q)
+    block_k = _largest_aligned_divisor(t, block_k)
+    if block_q is None or block_k is None:
         raise ValueError(
-            f"seq len {t} not divisible into 8-aligned blocks "
-            f"(got block_q={block_q}, block_k={block_k}) — pad T to a "
-            "multiple of 8"
+            f"seq len {t} not divisible into 8-aligned blocks — pad T "
+            "to a multiple of 8"
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

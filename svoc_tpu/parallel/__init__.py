@@ -11,6 +11,11 @@ from svoc_tpu.parallel.mesh import (  # noqa: F401
     best_mesh,
     make_mesh,
 )
+from svoc_tpu.parallel.serving import (  # noqa: F401
+    batch_sharding,
+    dp_serving_step_fn,
+    serving_mesh,
+)
 from svoc_tpu.parallel.sharded import (  # noqa: F401
     sharded_consensus_fn,
     sharded_fleet_step_fn,

@@ -40,6 +40,22 @@ from svoc_tpu.ops import sort as sort_ops
 from svoc_tpu.ops import stats
 
 
+def consensus_out_specs(axis: str) -> ConsensusOutput:
+    """PartitionSpecs of a shard_mapped consensus: per-oracle outputs
+    sharded over ``axis``, block outputs replicated."""
+    return ConsensusOutput(
+        essence=P(),
+        essence_first_pass=P(),
+        reliability_first_pass=P(),
+        reliability_second_pass=P(),
+        reliable=P(axis),
+        quadratic_risk=P(axis),
+        skewness=P(),
+        kurtosis=P(),
+        interval_valid=P(),
+    )
+
+
 def _consensus_body(cfg: ConsensusConfig, axis: str):
     """shard_map body: ``values_local [N/d, M]`` → sharded/replicated outs."""
 
@@ -140,17 +156,7 @@ def sharded_consensus_fn(
         body,
         mesh=mesh,
         in_specs=(P(axis, None),),
-        out_specs=ConsensusOutput(
-            essence=P(),
-            essence_first_pass=P(),
-            reliability_first_pass=P(),
-            reliability_second_pass=P(),
-            reliable=P(axis),
-            quadratic_risk=P(axis),
-            skewness=P(),
-            kurtosis=P(),
-            interval_valid=P(),
-        ),
+        out_specs=consensus_out_specs(axis),
         check_rep=False,
     )
     values_sharding = NamedSharding(mesh, P(axis, None))
@@ -198,6 +204,37 @@ def _fleet_body(
     return body
 
 
+def fleet_consensus_shard_map(
+    mesh: Mesh,
+    cfg: ConsensusConfig,
+    n_oracles: int,
+    subset_size: int = 10,
+    axis: str = "oracle",
+):
+    """UNJITTED shard_mapped ``(key, window) → (ConsensusOutput,
+    honest)`` — the composable fleet+consensus building block
+    (:func:`sharded_fleet_step_fn` jits it standalone;
+    :mod:`svoc_tpu.parallel.serving` fuses it after the data-parallel
+    forward)."""
+    n_dev = mesh.devices.size
+    if n_oracles % n_dev:
+        raise ValueError(f"n_oracles={n_oracles} not divisible by mesh size {n_dev}")
+    gen = _fleet_body(n_oracles, cfg.n_failing, subset_size, axis)
+    consensus = _consensus_body(cfg, axis)
+
+    def step(key, window):
+        values_local, honest_local = gen(key, window)
+        return consensus(values_local), honest_local
+
+    return shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(consensus_out_specs(axis), P(axis)),
+        check_rep=False,
+    )
+
+
 def sharded_fleet_step_fn(
     mesh: Mesh,
     cfg: ConsensusConfig,
@@ -212,34 +249,6 @@ def sharded_fleet_step_fn(
     the fleet materialized only as device-local shards — the 1024-oracle
     pod-sim configuration of BASELINE.json.
     """
-    n_dev = mesh.devices.size
-    if n_oracles % n_dev:
-        raise ValueError(f"n_oracles={n_oracles} not divisible by mesh size {n_dev}")
-    gen = _fleet_body(n_oracles, cfg.n_failing, subset_size, axis)
-    consensus = _consensus_body(cfg, axis)
-
-    def step(key, window):
-        values_local, honest_local = gen(key, window)
-        return consensus(values_local), honest_local
-
-    mapped = shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(P(), P()),
-        out_specs=(
-            ConsensusOutput(
-                essence=P(),
-                essence_first_pass=P(),
-                reliability_first_pass=P(),
-                reliability_second_pass=P(),
-                reliable=P(axis),
-                quadratic_risk=P(axis),
-                skewness=P(),
-                kurtosis=P(),
-                interval_valid=P(),
-            ),
-            P(axis),
-        ),
-        check_rep=False,
+    return jax.jit(
+        fleet_consensus_shard_map(mesh, cfg, n_oracles, subset_size, axis)
     )
-    return jax.jit(mapped)

@@ -1,0 +1,98 @@
+"""Data-parallel serving path (8-way CPU mesh, conftest-forced)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step
+from svoc_tpu.models.configs import TINY_TEST
+from svoc_tpu.models.encoder import SentimentEncoder, init_params
+from svoc_tpu.models.sentiment import scores_to_vectors
+from svoc_tpu.parallel.serving import (
+    batch_sharding,
+    dp_serving_step_fn,
+    serving_mesh,
+)
+from svoc_tpu.sim.oracle import gen_oracle_predictions
+
+LABEL_IDX = (0, 1, 2, 3, 4, 5)
+
+
+def _setup(n_oracles=16, batch=16, seq=16, window=8):
+    cfg = TINY_TEST
+    ccfg = ConsensusConfig(n_failing=4, constrained=True)
+    mesh = serving_mesh()
+    model = SentimentEncoder(cfg)
+    params = init_params(model, seed=0)
+    serve = dp_serving_step_fn(
+        mesh,
+        cfg,
+        ccfg,
+        n_oracles,
+        window_size=window,
+        subset_size=4,
+        label_indices=LABEL_IDX,
+    )
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(2, 1000, (batch, seq)), jnp.int32)
+    mask = jnp.ones((batch, seq), jnp.int32)
+    ids = jax.device_put(ids, batch_sharding(mesh))
+    mask = jax.device_put(mask, batch_sharding(mesh))
+    return cfg, ccfg, mesh, model, params, serve, ids, mask, window
+
+
+def test_dp_serving_runs_on_full_mesh():
+    cfg, ccfg, mesh, model, params, serve, ids, mask, window = _setup()
+    assert mesh.devices.size == 8  # conftest virtual mesh
+    out, honest = serve(params, jax.random.PRNGKey(0), ids, mask)
+    essence = np.asarray(out.essence)
+    assert essence.shape == (6,)
+    assert np.all(np.isfinite(essence))
+    assert np.asarray(honest).shape == (16,)
+    assert np.asarray(honest).sum() == 16 - ccfg.n_failing
+
+
+def test_dp_serving_matches_single_device_mesh():
+    """The 8-way data-parallel serving step must agree with the same
+    step on a 1-device mesh (unsharded forward, whole fleet local) —
+    the sharding must not change the math."""
+    cfg, ccfg, mesh, model, params, serve, ids, mask, window = _setup()
+    key = jax.random.PRNGKey(7)
+    out, honest = serve(params, key, ids, mask)
+
+    mesh1 = serving_mesh(devices=jax.devices()[:1])
+    serve1 = dp_serving_step_fn(
+        mesh1,
+        cfg,
+        ccfg,
+        16,
+        window_size=window,
+        subset_size=4,
+        label_indices=LABEL_IDX,
+    )
+    ids1 = jax.device_put(np.asarray(ids), batch_sharding(mesh1))
+    mask1 = jax.device_put(np.asarray(mask), batch_sharding(mesh1))
+    out1, honest1 = serve1(params, key, ids1, mask1)
+
+    np.testing.assert_allclose(
+        np.asarray(out.essence), np.asarray(out1.essence), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(out.reliability_second_pass),
+        float(out1.reliability_second_pass),
+        atol=1e-5,
+    )
+    np.testing.assert_array_equal(np.asarray(honest), np.asarray(honest1))
+    np.testing.assert_array_equal(
+        np.asarray(out.reliable), np.asarray(out1.reliable)
+    )
+
+
+def test_dp_serving_rejects_indivisible_oracles():
+    import pytest
+
+    mesh = serving_mesh()
+    with pytest.raises(ValueError, match="not divisible"):
+        dp_serving_step_fn(
+            mesh, TINY_TEST, ConsensusConfig(n_failing=1), n_oracles=9
+        )
