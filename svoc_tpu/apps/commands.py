@@ -23,6 +23,12 @@ import threading
 from typing import Callable, List, Optional
 
 from svoc_tpu.apps.session import Session
+from svoc_tpu.io.chain import ChainCommitError, to_hex
+
+
+def _addr_str(addr) -> str:
+    """Hex for felt ints, verbatim for symbolic sim addresses."""
+    return to_hex(addr) if isinstance(addr, int) else str(addr)
 
 HELP = """Commands:
     - help / clear / exit
@@ -190,8 +196,17 @@ class CommandConsole:
                     emit("Fetch before!")
                 else:
                     emit("Commit predictions...")
-                    n = self.session.commit()
-                    emit(f"Done ({n} transactions).")
+                    try:
+                        n = self.session.commit()
+                        emit(f"Done ({n} transactions).")
+                    except ChainCommitError as e:
+                        # Partial commits are ON CHAIN — say exactly how
+                        # far the loop got and what broke it.
+                        emit(
+                            f"Commit FAILED after {e.committed}/{e.total} "
+                            f"transactions at oracle "
+                            f"{_addr_str(e.failed_oracle)}: {e.cause}"
+                        )
             elif cmd == "consensus":
                 consensus = adapter.call_consensus()
                 emit("consensus :\n" + ",".join(f"{x:0.2f}" for x in consensus))
@@ -207,6 +222,7 @@ class CommandConsole:
                 )
             elif cmd == "resume":
                 state = adapter.resume()
+                self.session.bump_state()
                 emit(f"consensus_active: {state['consensus_active']}")
                 emit(
                     "consensus : "
@@ -360,6 +376,7 @@ class CommandConsole:
                         self.session.commit()
                         if self.session.auto_resume:
                             self.session.adapter.resume()
+                            self.session.bump_state()
                 except Exception as e:
                     # Surface the failure (once per distinct message) and
                     # count it, instead of silently spinning.

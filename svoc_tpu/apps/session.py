@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from svoc_tpu.consensus.state import OracleConsensusContract
-from svoc_tpu.io.chain import ChainAdapter, LocalChainBackend
+from svoc_tpu.io.chain import ChainAdapter, ChainCommitError, LocalChainBackend
 from svoc_tpu.io.comment_store import (
     PREDICTION_WINDOW,
     SQL_FETCH_LIMIT,
@@ -116,6 +116,11 @@ class Session:
         )
         self.predictions: Optional[np.ndarray] = None
         self.last_preview: Optional[Dict] = None
+        #: Bumped on every state change the UI renders (fetch, commit,
+        #: resume) — the web UI's poll loop redraws only when this
+        #: changes, so auto_fetch/auto_commit/auto_resume surface live
+        #: (the eel UI repaints on every push, simulation_graphics.js:85).
+        self.state_version: int = 0
         self.simulation_step: int = 0
         self.auto_fetch: bool = False
         #: fetch ⇒ commit (help text web_interface.py:22; unimplemented
@@ -218,15 +223,32 @@ class Session:
             "honest": np.asarray(honest),
             "n_comments": len(comments),
         }
+        self.bump_state()
         return self.last_preview
+
+    def bump_state(self) -> None:
+        """Mark renderable state as changed (web UI poll redraw)."""
+        self.state_version += 1
 
     # -- the commit path (contract.py:200-208) ------------------------------
 
     def commit(self) -> int:
-        """Send every oracle's prediction as its own signed tx."""
+        """Send every oracle's prediction as its own signed tx.
+
+        On a mid-loop failure the partial tx count is still recorded
+        (those transactions are on chain) before the
+        :class:`ChainCommitError` propagates to the command layer.
+        """
         if self.predictions is None:
             raise RuntimeError("fetch before commit")
         with metrics.timer("commit_latency").time():
-            n = self.adapter.update_all_the_predictions(self.predictions)
+            try:
+                n = self.adapter.update_all_the_predictions(self.predictions)
+            except ChainCommitError as e:
+                metrics.counter("chain_transactions").add(e.committed)
+                metrics.counter("chain_commit_failures").add(1)
+                self.bump_state()  # partial txs changed chain state
+                raise
         metrics.counter("chain_transactions").add(n)
+        self.bump_state()
         return n

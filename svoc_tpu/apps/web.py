@@ -129,9 +129,13 @@ function updateReplacementMenu(s) {
     .map((p, i) => 'admin ' + i + ': ' + (p === null ? 'None' : JSON.stringify(p)))
     .join('\\n');
 }
-async function refresh() {
-  const r = await fetch('/api/state');
-  const s = await r.json();
+let lastVersion = null;
+async function refresh(s) {
+  if (!s) {  // poll loop passes the state it already fetched
+    const r = await fetch('/api/state');
+    s = await r.json();
+  }
+  lastVersion = s.state_version;
   for (const [id, v] of [['rel1', s.reliability_first_pass],
                          ['rel2', s.reliability_second_pass]]) {
     const bar = document.getElementById(id);
@@ -178,6 +182,22 @@ for (const [id, ans] of [['vt-yes', 'yes'], ['vt-no', 'no']])
       + ' ' + document.getElementById('vt-which').value + ' ' + ans);
   });
 refresh();
+// Live refresh (reference eel parity: the UI repaints on every fetch
+// push, simulation_graphics.js:85): poll /api/state and redraw only
+// when the session's state_version changed — so with auto_fetch on the
+// plots stay live without typed commands, and an idle session costs one
+// tiny JSON GET per tick.
+let polling = false;
+setInterval(async () => {
+  if (polling) return;  // never stack slow polls
+  polling = true;
+  try {
+    const r = await fetch('/api/state');
+    const s = await r.json();
+    if (s.state_version !== lastVersion) await refresh(s);
+  } catch (e) { /* server restarting; retry next tick */ }
+  polling = false;
+}, 2000);
 </script></body></html>
 """
 
@@ -220,9 +240,13 @@ class _Handler(BaseHTTPRequestHandler):
             def fmt(x):
                 """Addresses as the reference displays them
                 (hex for ints, contract.py to_hex)."""
-                return f"0x{x:x}" if isinstance(x, int) else str(x)
+                from svoc_tpu.io.chain import to_hex
+
+                return to_hex(x) if isinstance(x, int) else str(x)
 
             payload = {
+                "state_version": session.state_version,
+                "auto_fetch": session.auto_fetch,
                 "reliability_first_pass": state.get("reliability_first_pass"),
                 "reliability_second_pass": state.get("reliability_second_pass"),
                 "consensus": state.get("consensus"),

@@ -45,6 +45,27 @@ from svoc_tpu.ops.fixedpoint import (
 RESOURCE_BOUND_L1_GAS = (259806, 153060543928007)
 
 
+class ChainCommitError(RuntimeError):
+    """A commit loop failed mid-way: earlier txs ARE on chain.
+
+    The reference's sequential per-oracle submit
+    (``client/contract.py:200-208``) has no rollback — a failure after
+    k transactions leaves k oracle predictions committed.  This error
+    carries that accounting so callers can surface it instead of
+    guessing from a traceback.
+    """
+
+    def __init__(self, committed: int, total: int, failed_oracle, cause):
+        self.committed = committed
+        self.total = total
+        self.failed_oracle = failed_oracle
+        self.cause = cause
+        super().__init__(
+            f"commit failed at oracle {failed_oracle!r} after "
+            f"{committed}/{total} transactions: {cause}"
+        )
+
+
 def to_hex(x: int) -> str:
     return f"0x{x:0x}"
 
@@ -194,6 +215,72 @@ class StarknetBackend:
                 **kwargs, l1_resource_bounds=self._bounds
             )
         )
+
+
+class DeployedContract:
+    """Result of :func:`declare_and_deploy` — what ``contract_info.json``
+    records (``client/data/contract_info.json:2-4``)."""
+
+    def __init__(self, class_hash: int, address: int):
+        self.class_hash = class_hash
+        self.address = address
+
+    def contract_info(self, rpc_url: str) -> Dict[str, str]:
+        """The ``contract_info.json`` payload for this deployment."""
+        return {
+            "rpc": rpc_url,
+            "declared_address": to_hex(self.class_hash),
+            "deployed_address": to_hex(self.address),
+        }
+
+
+def declare_and_deploy(
+    account: Any,
+    cfg: Any,
+    compiled_contract: str,
+    compiled_contract_casm: Optional[str] = None,
+    auto_estimate: bool = True,
+) -> DeployedContract:
+    """Declare the Sierra/CASM contract and deploy an instance with the
+    consensus configuration frozen in the constructor calldata — the
+    reference's manual Argent/starkli flow
+    (``contract/README.md:41-66``) as one call.
+
+    ``account`` is the paying ``starknet.py`` Account; ``cfg`` a
+    :class:`svoc_tpu.io.deploy.DeployConfig`.  Both transactions are
+    awaited to acceptance; the result carries the class hash and the
+    deployed address (what ``contract_info.json`` stores).
+    """
+    try:
+        from starknet_py.contract import Contract
+    except ImportError as e:  # pragma: no cover — package present in CI mocks
+        raise RuntimeError(
+            "declare_and_deploy needs the 'starknet.py' package; use "
+            "LocalChainBackend for simulation"
+        ) from e
+
+    from svoc_tpu.io.deploy import constructor_args
+
+    async def _run():
+        declare_result = await Contract.declare_v3(
+            account=account,
+            compiled_contract=compiled_contract,
+            compiled_contract_casm=compiled_contract_casm,
+            auto_estimate=auto_estimate,
+        )
+        await declare_result.wait_for_acceptance()
+        deploy_result = await declare_result.deploy_v3(
+            constructor_args=constructor_args(cfg),
+            auto_estimate=auto_estimate,
+        )
+        await deploy_result.wait_for_acceptance()
+        return declare_result, deploy_result
+
+    declare_result, deploy_result = asyncio.run(_run())
+    return DeployedContract(
+        class_hash=int(declare_result.class_hash),
+        address=int(deploy_result.deployed_contract.address),
+    )
 
 
 def load_account_data(path: str) -> Tuple[List[dict], List[dict]]:
@@ -367,11 +454,28 @@ class ChainAdapter:
 
     def update_all_the_predictions(self, predictions: Sequence) -> int:
         """One signed tx per oracle, in oracle-list order
-        (``client/contract.py:200-208``); returns tx count."""
+        (``client/contract.py:200-208``); returns tx count.
+
+        Each account signs sequentially (its nonce space advances one tx
+        at a time; the next oracle's tx is only submitted after the
+        previous returned).  A failure mid-loop raises
+        :class:`ChainCommitError` with the partial-commit count — the
+        earlier transactions are on chain and are NOT rolled back.
+        """
         oracles = self.call_oracle_list()
         n = 0
         for oracle, prediction in zip(oracles, predictions):
-            self.invoke_update_prediction(oracle, prediction)
+            try:
+                self.invoke_update_prediction(oracle, prediction)
+            except ChainCommitError:
+                raise
+            except Exception as e:
+                raise ChainCommitError(
+                    committed=n,
+                    total=min(len(oracles), len(predictions)),
+                    failed_oracle=oracle,
+                    cause=e,
+                ) from e
             n += 1
         return n
 

@@ -51,6 +51,23 @@ def constructor_calldata(cfg: DeployConfig) -> List[int]:
     ]
 
 
+def constructor_args(cfg: DeployConfig) -> dict:
+    """``cfg`` → ABI-typed constructor kwargs for ``starknet.py``'s
+    ``deploy_v3`` (the typed view of :func:`constructor_calldata` —
+    starknet.py serializes the Spans with their length prefixes, so the
+    wire calldata equals the felt list)."""
+    return {
+        "admins": [int(a) for a in cfg.admins],
+        "enable_oracle_replacement": bool(cfg.enable_oracle_replacement),
+        "required_majority": int(cfg.required_majority),
+        "n_failing_oracles": int(cfg.n_failing_oracles),
+        "constrained": bool(cfg.constrained),
+        "unconstrained_max_spread": float_to_fwsad(cfg.unconstrained_max_spread),
+        "dimension": int(cfg.dimension),
+        "oracles": [int(o) for o in cfg.oracles],
+    }
+
+
 def parse_constructor_calldata(calldata: Sequence[int]) -> DeployConfig:
     """Inverse of :func:`constructor_calldata` (validates lengths)."""
     data = [int(x) for x in calldata]

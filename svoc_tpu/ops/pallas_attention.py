@@ -116,7 +116,7 @@ def flash_attention(
     if kmask is None:
         kmask = jnp.ones((b, t), jnp.int32)
     # Clamp each block to the LARGEST 8-aligned divisor of T that fits
-    # the request — T=384 with the default 256 falls back to 128-wide
+    # the request — T=384 with the default 256 falls back to 192-wide
     # blocks, and T=520 gets 104 (gcd would degenerate to 8-wide tiles).
     block_q = _largest_aligned_divisor(t, block_q)
     block_k = _largest_aligned_divisor(t, block_k)

@@ -76,6 +76,12 @@ def dp_serving_step_fn(
     batch_shard = NamedSharding(mesh, P(axis, None))
 
     def serve(params, key, ids, mask):
+        if ids.shape[0] < window_size:
+            raise ValueError(
+                f"batch {ids.shape[0]} smaller than window_size "
+                f"{window_size} — the consensus window would be "
+                "silently truncated"
+            )
         logits = model.apply(params, ids, mask)  # batch stays data-sharded
         vecs = scores_to_vectors(logits, label_indices, multi_label)
         # Replicate the fleet's comment window: one [window, M] all-gather.

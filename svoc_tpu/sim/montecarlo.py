@@ -33,7 +33,7 @@ import jax.numpy as jnp
 
 from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step
 from svoc_tpu.ops.stats import rank_array
-from svoc_tpu.sim.generators import generate_beta_oracles
+from svoc_tpu.sim.generators import generate_beta_oracles, generate_gaussian_oracles
 
 
 def true_median(values: jnp.ndarray) -> jnp.ndarray:
@@ -130,6 +130,119 @@ def benchmark(
         "identification_success_pct": float(success_rate) * 100.0,
         "reliability_pct": (1.0 - 2.0 * float(mean_dist)) * 100.0,
     }
+
+
+def masked_mean(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Mean over unmasked rows — the unconstrained second-pass
+    estimator (``nd_average`` over the reliable set,
+    ``contract.cairo:406-420``)."""
+    w = mask[:, None].astype(values.dtype)
+    return jnp.sum(values * w, axis=0) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "n_oracles",
+        "n_failing",
+        "use_kernel",
+        "max_spread",
+        "failing_spread",
+    ),
+)
+def _unconstrained_trials(
+    keys,
+    mu,
+    sigma,
+    *,
+    n_oracles: int,
+    n_failing: int,
+    use_kernel: bool,
+    max_spread: float,
+    failing_spread: float,
+):
+    def trial(key):
+        values, honest = generate_gaussian_oracles(
+            key,
+            n_oracles,
+            n_failing,
+            mu,
+            sigma,
+            failing_spread=failing_spread,
+        )
+        if use_kernel:
+            out = consensus_step(
+                values,
+                ConsensusConfig(
+                    n_failing=n_failing,
+                    constrained=False,
+                    max_spread=max_spread,
+                ),
+            )
+            guess = out.reliable
+            rel2 = out.reliability_second_pass
+        else:
+            guess = identify_failing_oracles(values, n_failing)
+            rel2 = jnp.nan
+        success = jnp.all(guess == honest)
+        # Mean second pass (contract.cairo:406-420): the unconstrained
+        # estimator is the average of the oracles believed honest.
+        pred = masked_mean(values, guess)
+        truth = masked_mean(values, honest)
+        dist = jnp.linalg.norm(pred - truth)
+        return success, dist, rel2
+
+    success, dist, rel2 = jax.vmap(trial)(keys)
+    return (
+        jnp.mean(success.astype(jnp.float32)),
+        jnp.mean(dist),
+        jnp.mean(rel2),
+    )
+
+
+def benchmark_unconstrained(
+    key,
+    mu,
+    sigma,
+    n_oracles: int,
+    n_failing: int,
+    k_trials: int = 300,
+    max_spread: float = 10.0,
+    failing_spread: float = 10.0,
+    use_kernel: bool = False,
+) -> Dict[str, float]:
+    """Estimator-quality Monte-Carlo for the UNCONSTRAINED (Gaussian,
+    R^M) case — the ``gaussian_algorithm_demo.ipynb`` experiment the
+    reference never tabulated (its published tables are Beta-only).
+
+    Same trial structure as :func:`benchmark`, with the unconstrained
+    estimator semantics of ``contract.cairo:370-434``: detection by rank
+    of deviation, **mean** (not median) second pass, and reliability
+    normalized by ``max_spread`` — ``1 − E‖pred − truth‖ / max_spread``,
+    the Monte-Carlo analogue of the on-chain
+    ``1 − min(ms, √(mean qr)) / ms`` (``contract.cairo:365-368``).
+    With ``use_kernel=True`` detection runs through the actual two-pass
+    kernel and the mean on-chain second-pass reliability is reported.
+    """
+    keys = jax.random.split(key, k_trials)
+    success_rate, mean_dist, mean_rel2 = _unconstrained_trials(
+        keys,
+        jnp.asarray(mu, jnp.float32),
+        jnp.asarray(sigma, jnp.float32),
+        n_oracles=n_oracles,
+        n_failing=n_failing,
+        use_kernel=use_kernel,
+        max_spread=float(max_spread),
+        failing_spread=float(failing_spread),
+    )
+    out = {
+        "identification_success_pct": float(success_rate) * 100.0,
+        "reliability_pct": (1.0 - float(mean_dist) / max_spread) * 100.0,
+        "mean_estimator_error": float(mean_dist),
+    }
+    if use_kernel:
+        out["mean_onchain_reliability2_pct"] = float(mean_rel2) * 100.0
+    return out
 
 
 def launch_benchmark(

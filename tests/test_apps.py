@@ -205,3 +205,46 @@ class TestCli:
         assert rc == 0
         out = capsys.readouterr().out
         assert "Dimension: 6" in out
+
+
+class TestCommitFailureSurface:
+    def test_commit_command_surfaces_partial_failure(self):
+        """An RPC failure mid-commit must print the partial accounting
+        (k/N on chain, failing oracle, cause) instead of a traceback."""
+        from svoc_tpu.io.chain import ChainCommitError
+
+        s = make_session()
+        s.fetch()
+        failed = {"n": 0}
+        orig = s.adapter.invoke_update_prediction
+
+        def flaky(oracle, prediction):
+            if failed["n"] == 2:
+                raise ConnectionError("node dropped the request")
+            failed["n"] += 1
+            return orig(oracle, prediction)
+
+        s.adapter.invoke_update_prediction = flaky
+        out = []
+        console = CommandConsole(s, write=out.append)
+        console.query("commit")
+        text = "\n".join(out)
+        assert "Commit FAILED after 2/7 transactions" in text
+        assert "node dropped the request" in text
+
+    def test_session_records_partial_txs_in_metrics(self):
+        from svoc_tpu.io.chain import ChainCommitError
+        from svoc_tpu.utils.metrics import registry as metrics
+
+        s = make_session()
+        s.fetch()
+        s.adapter.invoke_update_prediction = lambda *a: (_ for _ in ()).throw(
+            ConnectionError("down")
+        )
+        before = metrics.counter("chain_transactions").count
+        fails_before = metrics.counter("chain_commit_failures").count
+        with pytest.raises(ChainCommitError) as exc:
+            s.commit()
+        assert exc.value.committed == 0
+        assert metrics.counter("chain_transactions").count == before
+        assert metrics.counter("chain_commit_failures").count == fails_before + 1

@@ -181,7 +181,11 @@ class TestUnconstrainedBasic:
 
         rel1 = c.get_first_pass_consensus_reliability(as_floats=True)
         rel2 = c.get_second_pass_consensus_reliability(as_floats=True)
-        assert 0.0 < rel1 < 1.0 and 0.0 < rel2 < 1.0
+        # The "first pass std : 0.533 / second pass std : 0.647" comment
+        # (test_contract.cairo:286-288) actually records the RELIABILITY
+        # getters printed right above it (:277-281) — pin them exactly.
+        assert rel1 == pytest.approx(0.533, abs=1e-3)
+        assert rel2 == pytest.approx(0.647, abs=1e-3)
 
         run_replacement_flow(c)
 

@@ -328,3 +328,206 @@ def test_cli_adapter_wiring(fake_starknet, tmp_path):
 
     with pytest.raises(SystemExit, match="together"):
         build_adapter(parser.parse_args(["--contract-info", str(info)]))
+
+
+# ---------------------------------------------------------------------------
+# declare / deploy (contract/README.md:41-66 flow)
+# ---------------------------------------------------------------------------
+
+
+class FakeDeployResult:
+    def __init__(self, log, address):
+        self._log = log
+        self.deployed_contract = types.SimpleNamespace(address=address)
+
+    async def wait_for_acceptance(self):
+        self._log.append(("wait_for_acceptance", "deploy"))
+        return self
+
+
+class FakeDeclareResult:
+    def __init__(self, log, class_hash):
+        self._log = log
+        self.class_hash = class_hash
+
+    async def wait_for_acceptance(self):
+        self._log.append(("wait_for_acceptance", "declare"))
+        return self
+
+    async def deploy_v3(self, constructor_args, auto_estimate):
+        self._log.append(("deploy_v3", constructor_args, auto_estimate))
+        return FakeDeployResult(self._log, address=0xDE9107)
+
+
+def test_declare_and_deploy_pins_tx_shape(fake_starknet):
+    """The declare->deploy flow: Sierra+CASM declared from the paying
+    account, constructor args in the ABI order of contract.cairo:236-245
+    with the wsad-felt max_spread, both txs awaited to acceptance."""
+    from svoc_tpu.io.chain import declare_and_deploy, to_hex
+    from svoc_tpu.io.deploy import DeployConfig, constructor_calldata
+
+    log = fake_starknet.log
+
+    async def declare_v3(account, compiled_contract, compiled_contract_casm,
+                         auto_estimate):
+        log.append(
+            ("declare_v3", account, compiled_contract, compiled_contract_casm,
+             auto_estimate)
+        )
+        return FakeDeclareResult(log, class_hash=0xC1A55)
+
+    fake_starknet.declare_v3 = declare_v3
+
+    cfg = DeployConfig(
+        admins=[1, 2, 3],
+        oracles=list(range(10, 17)),
+        enable_oracle_replacement=True,
+        required_majority=2,
+        n_failing_oracles=2,
+        constrained=False,
+        unconstrained_max_spread=10.0,
+        dimension=2,
+    )
+    account = object()
+    result = declare_and_deploy(account, cfg, "SIERRA_JSON", "CASM_JSON")
+
+    assert log[0] == ("declare_v3", account, "SIERRA_JSON", "CASM_JSON", True)
+    assert log[1] == ("wait_for_acceptance", "declare")
+    kind, args, auto = log[2][0], log[2][1], log[2][2]
+    assert kind == "deploy_v3" and auto is True
+    # ABI order + encoding (contract.cairo:236-245); max_spread crosses
+    # as a wsad felt (10.0 -> 10_000_000).
+    assert args == {
+        "admins": [1, 2, 3],
+        "enable_oracle_replacement": True,
+        "required_majority": 2,
+        "n_failing_oracles": 2,
+        "constrained": False,
+        "unconstrained_max_spread": 10_000_000,
+        "dimension": 2,
+        "oracles": [10, 11, 12, 13, 14, 15, 16],
+    }
+    assert log[3] == ("wait_for_acceptance", "deploy")
+
+    assert result.class_hash == 0xC1A55
+    assert result.address == 0xDE9107
+    info = result.contract_info("https://rpc.example")
+    assert info == {
+        "rpc": "https://rpc.example",
+        "declared_address": to_hex(0xC1A55),
+        "deployed_address": to_hex(0xDE9107),
+    }
+    # The typed args serialize to the same felts as the raw calldata
+    # documented in contract/README.md:41-66 (span length prefixes).
+    felts = constructor_calldata(cfg)
+    assert felts[0] == 3 and felts[4:10] == [1, 2, 2, 0, 10_000_000, 2]
+    assert felts[10] == 7
+
+
+# ---------------------------------------------------------------------------
+# failure paths + nonce ordering in the commit loop (round-3 hardening)
+# ---------------------------------------------------------------------------
+
+
+def _commit_fixture(fake_starknet, failing_rpc_at=None):
+    """Backend + adapter over 4 oracle accounts; optionally make the
+    fake RPC raise on the Nth invoke_v3 (0-based)."""
+    from svoc_tpu.io.chain import ChainAdapter
+
+    oracle_addrs = [0x10, 0x11, 0x12, 0x13]
+    accounts = {
+        a: FakeAccount(None, hex(a), FakeKeyPair("k"), "SN_SEPOLIA")
+        for a in oracle_addrs
+    }
+    backend = make_backend(fake_starknet, accounts)
+    fake_starknet.views["get_oracle_list"] = oracle_addrs
+
+    if failing_rpc_at is not None:
+        invokes = {"n": 0}
+        orig = FakeFunction.invoke_v3
+
+        async def flaky_invoke(self, **kwargs):
+            if self._name == "update_prediction":
+                if invokes["n"] == failing_rpc_at:
+                    invokes["n"] += 1
+                    raise ConnectionError("RPC node dropped the request")
+                invokes["n"] += 1
+            return await orig(self, **kwargs)
+
+        FakeFunction.invoke_v3 = flaky_invoke
+    return ChainAdapter(backend), oracle_addrs, accounts
+
+
+_ORIG_INVOKE = FakeFunction.invoke_v3
+
+
+def test_commit_loop_rpc_failure_partial_accounting(fake_starknet):
+    """An RPC failure on the 3rd oracle's tx must surface as
+    ChainCommitError with committed=2 — the first two txs ARE on chain
+    (client/contract.py:200-224 has no rollback)."""
+    from svoc_tpu.io.chain import ChainCommitError
+
+    adapter, oracle_addrs, _ = _commit_fixture(fake_starknet, failing_rpc_at=2)
+    predictions = [[0.1, 0.2]] * 4
+    try:
+        with pytest.raises(ChainCommitError) as exc:
+            adapter.update_all_the_predictions(predictions)
+        e = exc.value
+        assert e.committed == 2
+        assert e.total == 4
+        assert e.failed_oracle == oracle_addrs[2]
+        assert isinstance(e.cause, ConnectionError)
+        # the two successful txs went out in oracle-list order, signed
+        # by the right accounts
+        invokes = [x for x in fake_starknet.log if x[0] == "invoke_v3"]
+        assert [x[1].address for x in invokes] == ["0x10", "0x11"]
+    finally:
+        FakeFunction.invoke_v3 = _ORIG_INVOKE
+
+
+def test_commit_loop_success_after_transient_failure(fake_starknet):
+    """Retrying a failed commit resubmits from oracle 0 (idempotent on
+    the contract: update_prediction overwrites the oracle's value)."""
+    from svoc_tpu.io.chain import ChainCommitError
+
+    adapter, oracle_addrs, _ = _commit_fixture(fake_starknet, failing_rpc_at=1)
+    predictions = [[0.1, 0.2]] * 4
+    try:
+        with pytest.raises(ChainCommitError):
+            adapter.update_all_the_predictions(predictions)
+        # second attempt: the fake RPC has recovered
+        n = adapter.update_all_the_predictions(predictions)
+        assert n == 4
+        invokes = [x for x in fake_starknet.log if x[0] == "invoke_v3"]
+        # 1 successful from attempt 1 + 4 from attempt 2
+        assert [x[1].address for x in invokes] == [
+            "0x10", "0x10", "0x11", "0x12", "0x13",
+        ]
+    finally:
+        FakeFunction.invoke_v3 = _ORIG_INVOKE
+
+
+def test_commit_nonce_ordering_per_account(fake_starknet):
+    """Each account's txs must be submitted strictly sequentially (the
+    nonce space of a Starknet account admits no gaps): two commit
+    rounds produce monotonically increasing per-account nonces, and no
+    account's second tx is submitted before its first returned."""
+    adapter, oracle_addrs, accounts = _commit_fixture(fake_starknet)
+
+    nonces = {}
+    orig = FakeFunction.invoke_v3
+
+    async def nonce_invoke(self, **kwargs):
+        acct = self._provider
+        nonces.setdefault(acct.address, []).append(len(nonces.get(acct.address, [])))
+        return await orig(self, **kwargs)
+
+    FakeFunction.invoke_v3 = nonce_invoke
+    try:
+        predictions = [[0.1, 0.2]] * 4
+        assert adapter.update_all_the_predictions(predictions) == 4
+        assert adapter.update_all_the_predictions(predictions) == 4
+        # every account saw exactly nonces [0, 1], in order
+        assert nonces == {hex(a): [0, 1] for a in oracle_addrs}
+    finally:
+        FakeFunction.invoke_v3 = _ORIG_INVOKE
