@@ -163,7 +163,21 @@ class Session:
                     f"dimension {dim} exceeds the {len(GO_EMOTIONS_LABELS)}"
                     "-label head — pass an explicit vectorizer"
                 )
-            self._vectorizer = SentimentPipeline(label_indices=indices)
+            # Shard the vectorizer batch over all local devices when
+            # there are several — the app layer rides the same
+            # data-parallel path as svoc_tpu.parallel.serving.
+            data_mesh = None
+            n_dev = jax.device_count()
+            default_batch = 32
+            if n_dev > 1 and default_batch % n_dev == 0:
+                from svoc_tpu.parallel.serving import serving_mesh
+
+                data_mesh = serving_mesh()
+            self._vectorizer = SentimentPipeline(
+                label_indices=indices,
+                batch_size=default_batch,
+                data_mesh=data_mesh,
+            )
         return self._vectorizer
 
     @property

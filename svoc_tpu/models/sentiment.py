@@ -80,6 +80,12 @@ class SentimentPipeline:
     #: conversion-parity use).  Measured +1.5% MFU on v5e
     #: (PERF_EXPERIMENTS.json).
     params_dtype: Optional[str] = None
+    #: Optional 1-D device mesh: shard the token batch over its first
+    #: axis (data parallelism) with params replicated, so the app-layer
+    #: vectorizer scales to a v5e-8 the same way the serving path does
+    #: (:mod:`svoc_tpu.parallel.serving`).  The mesh size must divide
+    #: ``batch_size``.  None = single-device (default).
+    data_mesh: Optional[object] = None
 
     def __post_init__(self):
         if max(self.label_indices) >= self.cfg.n_labels:
@@ -120,12 +126,37 @@ class SentimentPipeline:
         multi = self.cfg.head == "sigmoid"
         idx = self.label_indices
 
-        @jax.jit
-        def forward(params, ids, mask):
+        def forward_fn_body(params, ids, mask):
             logits = self.model.apply(params, ids, mask)
             return scores_to_vectors(logits, idx, multi)
 
-        self._forward = forward
+        self._batch_sharding = None
+        if self.data_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh = self.data_mesh
+            if self.batch_size % mesh.devices.size:
+                raise ValueError(
+                    f"batch_size {self.batch_size} not divisible by the "
+                    f"{mesh.devices.size}-device data mesh"
+                )
+            self._batch_sharding = NamedSharding(
+                mesh, P(mesh.axis_names[0], None)
+            )
+            # Replicate params across the mesh ONCE — without this,
+            # every jitted call would re-broadcast the whole tree
+            # (~500 MB for RoBERTa-base f32) to all devices.
+            self.params = jax.device_put(self.params, NamedSharding(mesh, P()))
+            self._forward = jax.jit(
+                forward_fn_body,
+                in_shardings=(
+                    NamedSharding(mesh, P()),
+                    self._batch_sharding,
+                    self._batch_sharding,
+                ),
+            )
+        else:
+            self._forward = jax.jit(forward_fn_body)
 
     @property
     def dimension(self) -> int:
@@ -145,6 +176,9 @@ class SentimentPipeline:
             n_real = len(chunk)
             chunk += [""] * (b - n_real)  # fixed shapes — no recompiles
             ids, mask = self.tokenizer(chunk, self.seq_len)
+            if self._batch_sharding is not None:
+                ids = jax.device_put(jnp.asarray(ids), self._batch_sharding)
+                mask = jax.device_put(jnp.asarray(mask), self._batch_sharding)
             vecs = self._forward(self.params, ids, mask)
             out.append(np.asarray(vecs[:n_real], dtype=np.float64))
         return np.concatenate(out, axis=0) if out else np.zeros((0, self.dimension))

@@ -153,3 +153,29 @@ def test_params_dtype_resident_cast():
     vecs = pipe(["some text", "other text"])
     assert vecs.shape == (2, 6)
     np.testing.assert_allclose(vecs.sum(axis=-1), 1.0, rtol=1e-2)
+
+
+def test_pipeline_data_mesh_matches_single_device():
+    """A data-mesh-sharded pipeline must produce the same vectors as the
+    unsharded one (same seed → same params; DP is math-invariant)."""
+    from svoc_tpu.parallel.serving import serving_mesh
+
+    mesh = serving_mesh()
+    assert mesh.devices.size == 8  # conftest virtual mesh
+    kw = dict(cfg=TINY_TEST, seq_len=16, batch_size=8, tokenizer_name=None)
+    plain = SentimentPipeline(**kw)
+    sharded = SentimentPipeline(**kw, data_mesh=mesh)
+    texts = [f"comment number {i} about tpus" for i in range(11)]  # 2 chunks
+    np.testing.assert_allclose(plain(texts), sharded(texts), atol=1e-5)
+
+
+def test_pipeline_data_mesh_rejects_indivisible_batch():
+    import pytest
+
+    from svoc_tpu.parallel.serving import serving_mesh
+
+    with pytest.raises(ValueError, match="not divisible"):
+        SentimentPipeline(
+            cfg=TINY_TEST, seq_len=16, batch_size=9, tokenizer_name=None,
+            data_mesh=serving_mesh(),
+        )
