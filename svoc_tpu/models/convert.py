@@ -144,3 +144,79 @@ def load_hf_checkpoint(name_or_path: str, head: str = "sigmoid"):
     cfg = config_from_hf(model.config, head=head)
     params = convert_roberta_state_dict(model.state_dict(), cfg)
     return SentimentEncoder(cfg), params
+
+
+# --------------------------------------------------------------------------
+# Converted-checkpoint persistence (single dependency-free .npz)
+# --------------------------------------------------------------------------
+
+
+def save_params(path: str, params: Dict) -> str:
+    """Persist a flax params tree as one ``.npz`` (keys = /-joined tree
+    paths) so a conversion runs once and serving loads an artifact.
+    Returns the actual file path (``np.savez`` appends ``.npz`` when
+    the suffix is missing)."""
+    import jax
+
+    flat = {}
+    for key_path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = "/".join(
+            getattr(p, "key", getattr(p, "name", str(p))) for p in key_path
+        )
+        flat[key] = np.asarray(leaf)
+    if not path.endswith(".npz"):
+        path += ".npz"
+    np.savez(path, **flat)
+    return path
+
+
+def load_params(path: str) -> Dict:
+    """Inverse of :func:`save_params`."""
+    out: Dict[str, Any] = {}
+    with np.load(path) as data:
+        for key in data.files:
+            node = out
+            parts = key.split("/")
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = data[key]
+    return out
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m svoc_tpu.models.convert NAME -o params.npz`` —
+    convert a locally-cached HF RoBERTa classifier to a reusable flax
+    params artifact (pass it to ``SentimentPipeline(params=load_params(
+    path))``)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description=main.__doc__)
+    parser.add_argument("name_or_path", help="HF model name or local path")
+    parser.add_argument("-o", "--out", required=True, help="output .npz")
+    parser.add_argument(
+        "--head", choices=("sigmoid", "softmax"), default="sigmoid"
+    )
+    args = parser.parse_args(argv)
+
+    model, params = load_hf_checkpoint(args.name_or_path, head=args.head)
+    out_path = save_params(args.out, params)
+    n = sum(
+        int(np.prod(np.shape(leaf)))
+        for leaf in _tree_leaves_np(params)
+    )
+    print(
+        f"converted {args.name_or_path}: {n / 1e6:.1f}M params "
+        f"({model.cfg.n_layers}L/{model.cfg.hidden}H, "
+        f"{model.cfg.n_labels} labels) -> {out_path}"
+    )
+    return 0
+
+
+def _tree_leaves_np(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

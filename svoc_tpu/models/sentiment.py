@@ -176,9 +176,8 @@ class SentimentPipeline:
             n_real = len(chunk)
             chunk += [""] * (b - n_real)  # fixed shapes — no recompiles
             ids, mask = self.tokenizer(chunk, self.seq_len)
-            if self._batch_sharding is not None:
-                ids = jax.device_put(jnp.asarray(ids), self._batch_sharding)
-                mask = jax.device_put(jnp.asarray(mask), self._batch_sharding)
+            # No explicit device_put: the jitted forward's in_shardings
+            # place the raw numpy batch shard-wise in one transfer.
             vecs = self._forward(self.params, ids, mask)
             out.append(np.asarray(vecs[:n_real], dtype=np.float64))
         return np.concatenate(out, axis=0) if out else np.zeros((0, self.dimension))

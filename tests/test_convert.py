@@ -71,3 +71,32 @@ def test_config_mapping(tiny_hf_model):
     assert cfg.n_labels == 5
     assert cfg.max_len == 32
     assert cfg.pad_id == 1
+
+
+def test_params_npz_round_trip(tmp_path):
+    """save_params/load_params must round-trip a params tree exactly and
+    the loaded tree must drive the encoder to identical logits."""
+    import jax
+    import jax.numpy as jnp
+
+    from svoc_tpu.models.configs import TINY_TEST
+    from svoc_tpu.models.convert import load_params, save_params
+    from svoc_tpu.models.encoder import SentimentEncoder, init_params
+
+    model = SentimentEncoder(TINY_TEST)
+    params = init_params(model, seed=1)
+    p = tmp_path / "tiny.npz"
+    save_params(str(p), params)
+    loaded = load_params(str(p))
+
+    flat_a = jax.tree_util.tree_leaves_with_path(params)
+    flat_b = jax.tree_util.tree_leaves_with_path(loaded)
+    assert len(flat_a) == len(flat_b)
+
+    ids = jnp.ones((2, 16), jnp.int32)
+    mask = jnp.ones_like(ids)
+    np.testing.assert_allclose(
+        np.asarray(model.apply(params, ids, mask)),
+        np.asarray(model.apply(loaded, ids, mask)),
+        atol=1e-6,
+    )
