@@ -55,13 +55,15 @@ def _flash_kernel(
     v_ref,  # [1, bk, D]   streamed per k step
     mask_ref,  # [1, 1, bk]
     o_ref,  # [1, bq, D]   written on the last k step
-    m_scr,  # [bq, 1] running max
-    l_scr,  # [bq, 1] running denominator
-    acc_scr,  # [bq, D] running numerator
-    *,
+    *rest,  # [lse_ref [1, 1, bq] when with_lse] + 3 VMEM scratch refs
     scale: float,
     n_k: int,
+    with_lse: bool,
 ):
+    if with_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref, (m_scr, l_scr, acc_scr) = None, rest
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -93,12 +95,24 @@ def _flash_kernel(
 
     @pl.when(ki == n_k - 1)
     def _finish():
-        o_ref[0] = (
-            acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)
-        ).astype(o_ref.dtype)
+        l = jnp.maximum(l_scr[...], 1e-30)
+        # Fully-masked query rows (running max never rose above the
+        # NEG_INF sentinel): emit 0 output and -inf lse, NOT the
+        # softmax-of-all-NEG_INF uniform average — so ring hops whose
+        # rotating K/V block is padding contribute nothing when merged
+        # (and an all-padding row is exactly 0, not n_hops×mean(v)).
+        dead = m_scr[...] <= NEG_INF / 2  # [bq, 1]
+        o_ref[0] = jnp.where(dead, 0.0, acc_scr[...] / l).astype(o_ref.dtype)
+        if with_lse:
+            # log-sum-exp of the (masked) scores row: lets callers merge
+            # independently-normalized blocks (ring attention hops).
+            lse = jnp.where(dead, -jnp.inf, m_scr[...] + jnp.log(l))
+            lse_ref[0, 0] = lse[:, 0]
 
 
-@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "interpret", "return_lse")
+)
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -107,11 +121,22 @@ def flash_attention(
     block_q: int = 256,
     block_k: int = 256,
     interpret: bool | None = None,
-) -> jnp.ndarray:
+    return_lse: bool = False,
+) -> "jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]":
     """``q/k/v [B, T, H, D]``, ``kmask [B, T]`` (1 = real key) →
     ``[B, T, H, D]``.  T must divide by the block sizes (pad the batch
     to the model's fixed seq_len upstream, as the pipeline already
-    does)."""
+    does).
+
+    ``return_lse=True`` also returns the per-row log-sum-exp
+    ``[B, T, H]`` so independently-normalized outputs can be merged
+    exactly — the contraction ring attention uses for its
+    flash-inner/ring-outer composition
+    (:func:`svoc_tpu.parallel.ring_attention.ring_attention`).
+
+    Convention: a FULLY-masked query row yields 0 output and ``-inf``
+    lse (the dense softmax would yield the degenerate uniform average
+    of V) — required for exact ring merging of padding-only blocks."""
     b, t, h, d = q.shape
     if kmask is None:
         kmask = jnp.ones((b, t), jnp.int32)
@@ -138,9 +163,28 @@ def flash_attention(
 
     n_k = t // block_k
     kernel = functools.partial(
-        _flash_kernel, scale=1.0 / (d**0.5), n_k=n_k
+        _flash_kernel, scale=1.0 / (d**0.5), n_k=n_k, with_lse=return_lse
     )
-    out = pl.pallas_call(
+    out_specs = pl.BlockSpec(
+        (1, block_q, d),
+        lambda bh, qi, ki: (bh, qi, 0),
+        memory_space=pltpu.VMEM,
+    )
+    out_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype)
+    if return_lse:
+        out_specs = (
+            out_specs,
+            pl.BlockSpec(
+                (1, 1, block_q),
+                lambda bh, qi, ki: (bh, 0, qi),
+                memory_space=pltpu.VMEM,
+            ),
+        )
+        out_shape = (
+            out_shape,
+            jax.ShapeDtypeStruct((b * h, 1, t), jnp.float32),
+        )
+    result = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q, n_k),
         in_specs=[
@@ -165,12 +209,8 @@ def flash_attention(
                 memory_space=pltpu.VMEM,
             ),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, d),
-            lambda bh, qi, ki: (bh, qi, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -179,4 +219,9 @@ def flash_attention(
         interpret=interpret,
     )(qf, kf, vf, maskf)
 
-    return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))
+    if not return_lse:
+        return jnp.transpose(result.reshape(b, h, t, d), (0, 2, 1, 3))
+    out, lse = result
+    out = jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))
+    lse = jnp.transpose(lse.reshape(b, h, t), (0, 2, 1))  # [B, T, H]
+    return out, lse

@@ -52,12 +52,26 @@ def ring_attention(
     kmask: Optional[jnp.ndarray] = None,
     *,
     axis_name: str,
+    block_impl: str = "dense",
 ) -> jnp.ndarray:
     """Exact non-causal attention with K/V rotating over ``axis_name``.
 
     Call inside ``shard_map``: every argument is the device-local block
     ``q/k/v [B, T_local, H, D]``, ``kmask [B, T_local]`` (1 = real
     token).  Returns the local output block ``[B, T_local, H, D]``.
+
+    ``block_impl`` picks the per-hop attention over the resident Q block
+    and the rotating K/V block:
+
+    - ``"dense"`` — XLA einsum chain; materializes a
+      ``[B,H,T_local,T_local]`` score block per hop.  Right choice for
+      short local blocks.
+    - ``"flash"`` — the Pallas online-softmax kernel
+      (:func:`svoc_tpu.ops.pallas_attention.flash_attention`) with
+      ``return_lse``; hop outputs merge via log-sum-exp.  At long local
+      blocks this avoids the per-hop score materialization entirely
+      (honest probe: 49× vs dense at T=8192, ``FLASH_PROBE.json``) —
+      the ring-outer/flash-inner long-context composition.
     """
     if kmask is None:
         kmask = jnp.ones(k.shape[:2], dtype=jnp.int32)
@@ -65,12 +79,52 @@ def ring_attention(
     b, t_local, h, d = q.shape
     scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(d))
 
-    # Running stats: row max m, denominator l, numerator o.
-    m0 = jnp.full((b, h, t_local), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, t_local), jnp.float32)
-    o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    def run_ring(accumulate, carry0):
+        """The ring protocol: local block first, then n_dev−1 rotations
+        of K/V (+ padding mask) — no discarded final hop.  One driver
+        for every block_impl so the rotation can never diverge."""
+        carry = accumulate(k, v, kmask, carry0)
 
-    def accumulate(k_blk, v_blk, mask_blk, m, l, o):
+        def step(i, state):
+            k_blk, v_blk, mask_blk, carry = state
+            perm = [(s, (s + 1) % n_dev) for s in range(n_dev)]
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+            mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
+            return (k_blk, v_blk, mask_blk, accumulate(k_blk, v_blk, mask_blk, carry))
+
+        state = jax.lax.fori_loop(0, n_dev - 1, step, (k, v, kmask, carry))
+        return state[3]
+
+    if block_impl == "flash":
+        from svoc_tpu.ops.pallas_attention import flash_attention
+
+        def accumulate_flash(k_blk, v_blk, mask_blk, carry):
+            o, lse = carry
+            o_b, lse_b = flash_attention(
+                q, k_blk, v_blk, mask_blk, return_lse=True
+            )  # o_b [B,T,H,D], lse_b [B,T,H]; fully-masked rows: 0/-inf
+            lse_new = jnp.logaddexp(lse, lse_b)
+            # Guard the all--inf case (every key so far is padding):
+            # exp(-inf − -inf) would be NaN; the merged output is 0.
+            dead = jnp.isneginf(lse_new)
+            w_old = jnp.where(dead, 0.0, jnp.exp(lse - lse_new))[..., None]
+            w_new = jnp.where(dead, 0.0, jnp.exp(lse_b - lse_new))[..., None]
+            return o * w_old + o_b.astype(jnp.float32) * w_new, lse_new
+
+        o, _lse = run_ring(
+            accumulate_flash,
+            (
+                jnp.zeros((b, t_local, h, d), jnp.float32),
+                jnp.full((b, t_local, h), -jnp.inf, jnp.float32),
+            ),
+        )
+        return o.astype(q.dtype)
+    if block_impl != "dense":
+        raise ValueError(f"unknown block_impl {block_impl!r}")
+
+    def accumulate_dense(k_blk, v_blk, mask_blk, carry):
+        m, l, o = carry
         m_blk, p, pv = _block_attn(q, k_blk, v_blk, mask_blk, scale)
         m_new = jnp.maximum(m, m_blk)
         corr = jnp.exp(m - m_new)
@@ -82,37 +136,34 @@ def ring_attention(
         o = o * corr_o + pv.astype(jnp.float32) * corr_pv
         return m_new, l, o
 
-    # Local block first, then n_dev−1 rotations — no discarded final hop.
-    m, l, o = accumulate(k, v, kmask, m0, l0, o0)
-
-    def step(i, carry):
-        k_blk, v_blk, mask_blk, m, l, o = carry
-        # Rotate K/V (+ their padding mask) one hop around the ring.
-        perm = [(s, (s + 1) % n_dev) for s in range(n_dev)]
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
-        m, l, o = accumulate(k_blk, v_blk, mask_blk, m, l, o)
-        return (k_blk, v_blk, mask_blk, m, l, o)
-
-    k_blk, v_blk, mask_blk, m, l, o = jax.lax.fori_loop(
-        0, n_dev - 1, step, (k, v, kmask, m, l, o)
+    # Running stats: row max m, denominator l, numerator o.
+    m, l, o = run_ring(
+        accumulate_dense,
+        (
+            jnp.full((b, h, t_local), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, t_local), jnp.float32),
+            jnp.zeros((b, t_local, h, d), jnp.float32),
+        ),
     )
     l_t = jnp.transpose(l, (0, 2, 1))[..., None]  # [B,Tq,H,1]
     return (o / jnp.maximum(l_t, 1e-30)).astype(q.dtype)
 
 
 def ring_attention_fn(
-    mesh: Mesh, seq_axis: str = "seq"
+    mesh: Mesh, seq_axis: str = "seq", block_impl: str = "dense"
 ) -> Callable[..., jnp.ndarray]:
     """Jitted ``(q, k, v, kmask) → out`` with the sequence dimension
     sharded over ``seq_axis`` (batch/head dims replicated; compose with
-    data sharding by passing a multi-axis mesh and sharded inputs)."""
+    data sharding by passing a multi-axis mesh and sharded inputs).
+    ``block_impl="flash"`` uses the Pallas kernel per hop (long-context
+    composition — see :func:`ring_attention`)."""
     spec = P(None, seq_axis, None, None)
     mask_spec = P(None, seq_axis)
 
     def body(q, k, v, kmask):
-        return ring_attention(q, k, v, kmask, axis_name=seq_axis)
+        return ring_attention(
+            q, k, v, kmask, axis_name=seq_axis, block_impl=block_impl
+        )
 
     mapped = shard_map(
         body,

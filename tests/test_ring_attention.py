@@ -86,3 +86,43 @@ class TestRingAttention:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
         )
+
+
+def test_ring_flash_inner_matches_dense_reference(seq_mesh):
+    """The ring-outer/flash-inner composition (Pallas per-hop blocks,
+    log-sum-exp merge) must be exact vs the monolithic softmax, masks
+    included."""
+    key = jax.random.PRNGKey(4)
+    b, t, h, d = 2, 64, 2, 16  # 8 ring hops of 8-token blocks
+    q = jax.random.normal(key, (b, t, h, d), jnp.float32)
+    kmask = jnp.ones((b, t), jnp.int32).at[0, 40:].set(0)
+
+    flash_ring = ring_attention_fn(seq_mesh, block_impl="flash")(q, q, q, kmask)
+    ref = dense_attention_reference(q, q, q, kmask)
+    np.testing.assert_allclose(
+        np.asarray(flash_ring), np.asarray(ref), atol=2e-4
+    )
+
+    dense_ring = ring_attention_fn(seq_mesh, block_impl="dense")(q, q, q, kmask)
+    np.testing.assert_allclose(
+        np.asarray(flash_ring), np.asarray(dense_ring), atol=2e-4
+    )
+
+
+def test_ring_flash_all_padding_row_is_zero(seq_mesh):
+    """Regression (round-3 review): an all-padding batch row must come
+    out of the flash ring as exactly 0 — previously each hop's
+    degenerate uniform-average accumulated additively (n_dev× mean(V))
+    because the −1e30 lse sentinels absorbed in float32."""
+    key = jax.random.PRNGKey(11)
+    b, t, h, d = 2, 64, 2, 16
+    q = jax.random.normal(key, (b, t, h, d), jnp.float32)
+    kmask = jnp.ones((b, t), jnp.int32).at[1, :].set(0)  # row 1: padding
+
+    out = ring_attention_fn(seq_mesh, block_impl="flash")(q, q, q, kmask)
+    assert float(jnp.abs(out[1]).max()) == 0.0
+    # the real row is untouched by the convention
+    ref = dense_attention_reference(q, q, q, kmask)
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(ref[0]), atol=2e-4
+    )
