@@ -106,10 +106,15 @@ def ring_attention(
             )  # o_b [B,T,H,D], lse_b [B,T,H]; fully-masked rows: 0/-inf
             lse_new = jnp.logaddexp(lse, lse_b)
             # Guard the all--inf case (every key so far is padding):
-            # exp(-inf − -inf) would be NaN; the merged output is 0.
+            # exp(-inf − -inf) would be NaN.  Double-where so the
+            # untaken branch never materializes the NaN either — a bare
+            # outer where would still poison gradients through its
+            # cotangent if this path is ever differentiated.
             dead = jnp.isneginf(lse_new)
-            w_old = jnp.where(dead, 0.0, jnp.exp(lse - lse_new))[..., None]
-            w_new = jnp.where(dead, 0.0, jnp.exp(lse_b - lse_new))[..., None]
+            d_old = jnp.where(dead, 0.0, lse - lse_new)
+            d_new = jnp.where(dead, 0.0, lse_b - lse_new)
+            w_old = jnp.where(dead, 0.0, jnp.exp(d_old))[..., None]
+            w_new = jnp.where(dead, 0.0, jnp.exp(d_new))[..., None]
             return o * w_old + o_b.astype(jnp.float32) * w_new, lse_new
 
         o, _lse = run_ring(

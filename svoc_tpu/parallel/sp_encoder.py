@@ -71,7 +71,11 @@ def _block(x, bias_mask_local, params, cfg, axis):
     q = _dense(x, ap["query"]).reshape(b, t_local, h, d)
     k = _dense(x, ap["key"]).reshape(b, t_local, h, d)
     v = _dense(x, ap["value"]).reshape(b, t_local, h, d)
-    ctx = ring_attention(q, k, v, bias_mask_local, axis_name=axis)
+    # cfg.attention selects the per-hop block impl: "flash" runs the
+    # Pallas kernel inside every ring hop (long-context composition).
+    ctx = ring_attention(
+        q, k, v, bias_mask_local, axis_name=axis, block_impl=cfg.attention
+    )
     a = _dense(ctx.reshape(b, t_local, cfg.hidden), ap["out"])
 
     x = _layernorm(x + a, params["ln_attn"], cfg.ln_eps).astype(cfg.dtype)

@@ -67,3 +67,17 @@ class TestSequenceParallelEncoder:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), atol=2e-4
         )
+
+
+def test_sp_forward_flash_inner_matches_dense(setup):
+    """cfg.attention='flash' runs the Pallas kernel inside every ring
+    hop; logits must still match the dense single-device encoder,
+    padding included."""
+    cfg, model, params, _ = setup
+    flash_cfg = dataclasses.replace(cfg, attention="flash")
+    mesh = make_mesh(MeshSpec(("seq",), (8,)))
+    fwd = sequence_parallel_forward_fn(mesh, flash_cfg)
+    ids, mask = batch(cfg, jax.random.PRNGKey(2), b=3, t=64, lengths=[64, 30, 9])
+    ref = model.apply(params, ids, mask)
+    out = fwd(params, ids, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
