@@ -181,7 +181,8 @@ for (const [id, ans] of [['vt-yes', 'yes'], ['vt-no', 'no']])
     query('vote_for_a_proposition ' + document.getElementById('vt-admin').value
       + ' ' + document.getElementById('vt-which').value + ' ' + ans);
   });
-refresh();
+query('help');  // boot with the command list (main.js:45); its
+                // completion handler performs the initial refresh()
 // Live refresh (reference eel parity: the UI repaints on every fetch
 // push, simulation_graphics.js:85): poll /api/state and redraw only
 // when the session's state_version changed — so with auto_fetch on the

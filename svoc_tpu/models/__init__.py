@@ -7,6 +7,11 @@ from svoc_tpu.models.configs import (  # noqa: F401
     TINY_TEST,
     EncoderConfig,
 )
+from svoc_tpu.models.convert import (  # noqa: F401
+    load_hf_checkpoint,
+    load_params,
+    save_params,
+)
 from svoc_tpu.models.encoder import SentimentEncoder  # noqa: F401
 from svoc_tpu.models.sentiment import SentimentPipeline  # noqa: F401
 from svoc_tpu.models.tokenizer import HashingTokenizer, load_tokenizer  # noqa: F401

@@ -7,4 +7,9 @@ from svoc_tpu.sim.generators import (  # noqa: F401
     generate_kumaraswamy_oracles,
     kumaraswamy_mode,
 )
+from svoc_tpu.sim.montecarlo import (  # noqa: F401
+    benchmark,
+    benchmark_unconstrained,
+    launch_benchmark,
+)
 from svoc_tpu.sim.oracle import gen_oracle_predictions  # noqa: F401
