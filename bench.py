@@ -1257,8 +1257,63 @@ def main(argv=None) -> int:
         type=float,
         default=float(os.environ.get("SVOC_BENCH_SECONDS", "10")),
     )
+    parser.add_argument(
+        "--all",
+        action="store_true",
+        help=(
+            "run every config in its own subprocess (isolated compile "
+            "caches / failures), one JSON line each, and write the "
+            "collected results to BENCH_ALL.json"
+        ),
+    )
     args = parser.parse_args(argv)
     small = os.environ.get("SVOC_BENCH_SMALL") == "1"
+
+    if args.all:
+        # Per-config wall clock: a wedged backend must cost one config,
+        # not the sweep; results are flushed to disk after EVERY config.
+        per_config_timeout = float(
+            os.environ.get("SVOC_BENCH_ALL_TIMEOUT", "900")
+        )
+        results = []
+        for n in sorted(CONFIGS):
+            try:
+                proc = subprocess.run(
+                    [
+                        sys.executable,
+                        os.path.abspath(__file__),
+                        "--config",
+                        str(n),
+                        "--seconds",
+                        str(args.seconds),
+                    ],
+                    capture_output=True,
+                    text=True,
+                    timeout=per_config_timeout,
+                )
+                rc = proc.returncode
+                line = (proc.stdout or "").strip().splitlines()
+                stderr_tail = (proc.stderr or "").strip().splitlines()[-3:]
+            except subprocess.TimeoutExpired:
+                rc, line = 124, []
+                stderr_tail = [f"timed out after {per_config_timeout:.0f}s"]
+            try:
+                parsed = json.loads(line[-1]) if line else None
+            except ValueError:
+                parsed = None
+            if parsed is None:
+                parsed = {
+                    "metric": f"bench config {n}",
+                    "error": f"rc={rc}, no JSON line",
+                    "stderr_tail": stderr_tail,
+                }
+            parsed["config"] = n
+            parsed["rc"] = rc
+            print(json.dumps(parsed), flush=True)
+            results.append(parsed)
+            with open("BENCH_ALL.json", "w") as f:
+                json.dump(results, f, indent=1)
+        return 0 if all(r["rc"] == 0 for r in results) else 1
 
     platform, fallback_reason = resolve_backend()
     _pin_platform(platform)

@@ -144,3 +144,28 @@ def quantize(x, scale: float = 1e6):
     if isinstance(x, np.ndarray) or np.isscalar(x):
         return np.trunc(np.asarray(x) * scale) / scale
     return jnp.trunc(x * scale) / scale
+
+
+def to_cairo_fixture(vectors) -> str:
+    """Float prediction vectors → Cairo test-fixture source text.
+
+    The reference generates its contract-test vectors by printing
+    ``array![...].span(),`` lines of wsad ints from the notebooks
+    (provenance comments at ``test_contract.cairo:148-149``; the
+    ``to_wsad`` cells of ``beta_kumaraswamy_algorithm_demo copy.ipynb``)
+    — this is that generator as a library function, so new Cairo
+    fixtures can be produced from any fleet this framework simulates::
+
+        print(to_cairo_fixture(np.asarray(out_values)))
+
+    Negative components render as prime-wrapped felts the way the chain
+    encoding sends them (``encode_vector``).
+    """
+    arr = np.asarray(vectors, dtype=np.float64)
+    if arr.size == 0:
+        return ""
+    rows = []
+    for vec in np.atleast_2d(arr):
+        felts = ", ".join(str(f) for f in encode_vector(vec))
+        rows.append(f"array![{felts}].span(),")
+    return "\n".join(rows)

@@ -72,3 +72,18 @@ def test_quantize_matches_to_wsad():
     q = fp.quantize(xs)
     for x, qx in zip(xs, q):
         assert qx == pytest.approx(fp.from_wsad(fp.to_wsad(float(x))), abs=1e-12)
+
+
+def test_to_cairo_fixture_reproduces_recorded_vectors():
+    """The fixture generator must emit the exact source lines recorded
+    in the reference contract test (test_contract.cairo:253-261 — the
+    Gaussian fixture's first rows), incl. prime-wrapped negatives."""
+    from svoc_tpu.ops.fixedpoint import FELT_PRIME, to_cairo_fixture
+
+    out = to_cairo_fixture([[20.202804, 16.401132], [25.630344, 13.501687]])
+    assert out.splitlines() == [
+        "array![20202804, 16401132].span(),",
+        "array![25630344, 13501687].span(),",
+    ]
+    neg = to_cairo_fixture([[-1.5]])
+    assert neg == f"array![{FELT_PRIME - 1_500_000}].span(),"
