@@ -11,6 +11,10 @@ from svoc_tpu.parallel.mesh import (  # noqa: F401
     best_mesh,
     make_mesh,
 )
+from svoc_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_forward_fn,
+    stack_block_params,
+)
 from svoc_tpu.parallel.serving import (  # noqa: F401
     batch_sharding,
     dp_serving_step_fn,

@@ -1,0 +1,108 @@
+"""Functional encoder math shared by the sharded forwards.
+
+``parallel/sp_encoder.py`` (sequence parallel) and
+``parallel/pipeline.py`` (pipeline parallel) re-run the
+:class:`svoc_tpu.models.encoder.SentimentEncoder` math on raw param
+trees inside ``shard_map`` (flax modules don't trace through collective
+axes).  This module is the single home for that math so the three
+implementations cannot drift, with the SAME dtype semantics as the flax
+modules: matmuls in ``cfg.dtype`` (kernels cast — the MXU path),
+layernorm/softmax accumulation in float32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from svoc_tpu.models.configs import EncoderConfig
+
+
+def dense(x, p, dtype):
+    """``nn.Dense(dtype=dtype)`` semantics: inputs, kernel and bias all
+    cast to ``dtype`` before the matmul."""
+    return (
+        jnp.einsum(
+            "...i,io->...o", x.astype(dtype), p["kernel"].astype(dtype)
+        )
+        + p["bias"].astype(dtype)
+    )
+
+
+def layernorm(x, p, eps):
+    """``nn.LayerNorm(dtype=float32)`` semantics (f32 accumulation)."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mean) ** 2, axis=-1, keepdims=True)
+    return (x32 - mean) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def embed_tokens(ids, pos_ids, rest, cfg: EncoderConfig):
+    """Token + position embedding + embedding layernorm
+    (``encoder.py:82-95``); ``pos_ids`` supplied by the caller (local
+    cumsum for the pipeline, cross-shard prefix sum for sp).
+
+    Bit-parity note: ``nn.Embed(dtype=cfg.dtype)`` gathers from a
+    dtype-cast table, so the rows are cast BEFORE the add — at bf16 the
+    rounding order is observable."""
+    tok = jnp.take(rest["tok_emb"]["embedding"], ids, axis=0).astype(cfg.dtype)
+    pos = jnp.take(rest["pos_emb"]["embedding"], pos_ids, axis=0).astype(
+        cfg.dtype
+    )
+    return layernorm(tok + pos, rest["ln_emb"], cfg.ln_eps).astype(cfg.dtype)
+
+
+def local_position_ids(mask, cfg: EncoderConfig):
+    """RoBERTa position ids within one (unsharded) sequence block
+    (``encoder.py:87``)."""
+    return jnp.cumsum(mask, axis=-1) * mask + cfg.pad_id
+
+
+def cls_head(cls_vec, rest, cfg: EncoderConfig):
+    """First-token classification head (``encoder.py:105-107``)."""
+    cls = jnp.tanh(dense(cls_vec, rest["head_dense"], cfg.dtype))
+    return dense(cls.astype(jnp.float32), rest["head_out"], jnp.float32)
+
+
+def local_attention(q, k, v, kmask, cfg: EncoderConfig):
+    """Full-sequence attention over device-local blocks, honoring
+    ``cfg.attention`` exactly like the flax encoder (``encoder.py:
+    46-60``): dense einsum chain or the Pallas flash kernel.
+
+    The dense branch mirrors ``SelfAttention`` op for op (scale
+    multiply in ``cfg.dtype`` BEFORE the f32 cast, additive −1e9 key
+    bias, probs cast back to ``cfg.dtype``) so bf16 configs are
+    logit-exact with the flax module."""
+    if cfg.attention == "flash":
+        from svoc_tpu.ops.pallas_attention import flash_attention
+
+        return flash_attention(q, k, v, kmask)
+    d = q.shape[-1]
+    scale = jnp.asarray(1.0 / jnp.sqrt(jnp.float32(d)), cfg.dtype)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    bias = jnp.where(kmask[:, None, None, :] > 0, 0.0, -1e9).astype(
+        jnp.float32
+    )
+    probs = jax.nn.softmax(scores.astype(jnp.float32) + bias, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v)
+
+
+def encoder_block(x, kmask, bp, cfg: EncoderConfig, attention_fn=None):
+    """One :class:`EncoderBlock` (``encoder.py:54-70``) from a raw
+    params dict.  ``attention_fn(q, k, v, kmask) → ctx`` defaults to
+    :func:`local_attention`; sp passes the ring."""
+    b, t, _ = x.shape
+    h, d = cfg.n_heads, cfg.head_dim
+    ap = bp["attention"]
+    q = dense(x, ap["query"], cfg.dtype).reshape(b, t, h, d)
+    k = dense(x, ap["key"], cfg.dtype).reshape(b, t, h, d)
+    v = dense(x, ap["value"], cfg.dtype).reshape(b, t, h, d)
+    if attention_fn is None:
+        ctx = local_attention(q, k, v, kmask, cfg)
+    else:
+        ctx = attention_fn(q, k, v, kmask)
+    a = dense(ctx.reshape(b, t, cfg.hidden), ap["out"], cfg.dtype)
+    x = layernorm(x + a, bp["ln_attn"], cfg.ln_eps).astype(cfg.dtype)
+    f = jax.nn.gelu(dense(x, bp["ffn_in"], cfg.dtype), approximate=False)
+    f = dense(f, bp["ffn_out"], cfg.dtype)
+    return layernorm(x + f, bp["ln_ffn"], cfg.ln_eps).astype(cfg.dtype)

@@ -30,20 +30,13 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from svoc_tpu.models.configs import EncoderConfig
+from svoc_tpu.parallel.encoder_math import (
+    cls_head,
+    embed_tokens,
+    encoder_block,
+)
 from svoc_tpu.parallel.ring_attention import ring_attention
 from svoc_tpu.parallel.sharded import shard_map
-
-
-def _dense(x, p):
-    return jnp.einsum("...i,io->...o", x, p["kernel"]) + p["bias"]
-
-
-def _layernorm(x, p, eps):
-    x32 = x.astype(jnp.float32)
-    mean = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.mean((x32 - mean) ** 2, axis=-1, keepdims=True)
-    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
-    return y * p["scale"] + p["bias"]
 
 
 def _global_position_ids(mask_local, cfg, axis):
@@ -63,26 +56,17 @@ def _global_position_ids(mask_local, cfg, axis):
 
 
 def _block(x, bias_mask_local, params, cfg, axis):
-    """One EncoderBlock (``encoder.py:54-70``) on sequence shards."""
-    h, d = cfg.n_heads, cfg.head_dim
-    b, t_local, _ = x.shape
+    """One EncoderBlock (``encoder.py:54-70``) on sequence shards —
+    the shared :func:`encoder_block` math with the ring as the
+    attention impl (``cfg.attention`` selects the per-hop block impl:
+    "flash" runs the Pallas kernel inside every ring hop)."""
 
-    ap = params["attention"]
-    q = _dense(x, ap["query"]).reshape(b, t_local, h, d)
-    k = _dense(x, ap["key"]).reshape(b, t_local, h, d)
-    v = _dense(x, ap["value"]).reshape(b, t_local, h, d)
-    # cfg.attention selects the per-hop block impl: "flash" runs the
-    # Pallas kernel inside every ring hop (long-context composition).
-    ctx = ring_attention(
-        q, k, v, bias_mask_local, axis_name=axis, block_impl=cfg.attention
-    )
-    a = _dense(ctx.reshape(b, t_local, cfg.hidden), ap["out"])
+    def ring(q, k, v, kmask):
+        return ring_attention(
+            q, k, v, kmask, axis_name=axis, block_impl=cfg.attention
+        )
 
-    x = _layernorm(x + a, params["ln_attn"], cfg.ln_eps).astype(cfg.dtype)
-    f = _dense(x, params["ffn_in"])
-    f = jax.nn.gelu(f, approximate=False)
-    f = _dense(f, params["ffn_out"])
-    return _layernorm(x + f, params["ln_ffn"], cfg.ln_eps).astype(cfg.dtype)
+    return encoder_block(x, bias_mask_local, params, cfg, attention_fn=ring)
 
 
 def sequence_parallel_forward_fn(
@@ -97,9 +81,7 @@ def sequence_parallel_forward_fn(
         ax_idx = jax.lax.axis_index(seq_axis)
 
         pos_ids = _global_position_ids(mask_local, cfg, seq_axis)
-        tok = jnp.take(p["tok_emb"]["embedding"], ids_local, axis=0)
-        pos = jnp.take(p["pos_emb"]["embedding"], pos_ids, axis=0)
-        x = _layernorm(tok + pos, p["ln_emb"], cfg.ln_eps).astype(cfg.dtype)
+        x = embed_tokens(ids_local, pos_ids, p, cfg)
 
         for i in range(cfg.n_layers):
             x = _block(x, mask_local, p[f"block_{i}"], cfg, seq_axis)
@@ -108,8 +90,7 @@ def sequence_parallel_forward_fn(
         # it so the (replicated) head computes identically everywhere.
         cls_local = jnp.where(ax_idx == 0, x[:, 0, :], 0.0)
         cls = jax.lax.psum(cls_local, seq_axis)
-        cls = jnp.tanh(_dense(cls, p["head_dense"]))
-        return _dense(cls.astype(jnp.float32), p["head_out"])
+        return cls_head(cls.astype(cfg.dtype), p, cfg)
 
     mapped = shard_map(
         body,

@@ -34,7 +34,7 @@ def test_entry_traces():
 
 def test_dryrun_multichip_subprocess_fresh_env():
     """The real thing: fresh interpreter, hostile JAX_PLATFORMS, hard
-    timeout far below the driver's.  Must print all five section marks."""
+    timeout far below the driver's.  Must print all six section marks."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "tpu,cpu"  # hostile: would hang if probed first
@@ -57,6 +57,7 @@ def test_dryrun_multichip_subprocess_fresh_env():
         "ring-attention",
         "sequence-parallel-forward",
         "dp-serving-end-to-end",
+        "pipeline-parallel-forward",
     ]
 
 
