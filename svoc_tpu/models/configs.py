@@ -41,7 +41,9 @@ class EncoderConfig:
     #: timings on v5e (FLASH_PROBE.json): flash wins from T=512
     #: (1.16×) and dominates long context (49× at T=8192, where the
     #: dense [B,H,T,T] HBM blowup bites); at the classifier's T=128
-    #: dense is ~8% faster, so it stays the default.
+    #: dense is ~8% faster, so it stays the default.  Flash is
+    #: INFERENCE-ONLY (no backward pass) — the trainer rejects it; the
+    #: params tree is impl-independent, so train dense / serve flash.
     attention: str = "dense"
 
     @property

@@ -46,6 +46,16 @@ def _loss_fn(model: SentimentEncoder, params, batch: Batch) -> jnp.ndarray:
 
 def _step_body(model: SentimentEncoder, tx: optax.GradientTransformation):
     """The unjitted update: shared by the plain and sharded factories."""
+    if model.cfg.attention == "flash":
+        # The Pallas flash kernel is forward-only (no custom_vjp);
+        # jax.grad through it fails deep inside tracing.  Fail here —
+        # the shared altitude, so BOTH factories reject it — with the
+        # fix: train dense, serve flash (same params tree).
+        raise ValueError(
+            "attention='flash' is inference-only (the Pallas kernel "
+            "defines no backward pass) — fine-tune with "
+            "attention='dense' and switch the config for serving"
+        )
 
     def step_fn(state: TrainState, batch: Batch) -> Tuple[TrainState, Dict]:
         loss, grads = jax.value_and_grad(lambda p: _loss_fn(model, p, batch))(

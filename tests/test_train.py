@@ -68,3 +68,22 @@ def test_sharded_train_step_matches_unsharded():
     leaves_b = jax.tree_util.tree_leaves(ref_state.params)
     for a, b in zip(leaves_a, leaves_b):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_trainer_rejects_flash_attention():
+    """The flash kernel is forward-only; BOTH trainer factories must
+    fail with an actionable message instead of a deep tracing error."""
+    import dataclasses
+
+    import pytest
+
+    cfg = dataclasses.replace(TINY_TEST, attention="flash")
+    model = SentimentEncoder(cfg)
+    params = init_params(model, seed=0)
+    with pytest.raises(ValueError, match="inference-only"):
+        make_train_step(model, optax.adamw(1e-4))
+    mesh = make_mesh(MeshSpec(("data", "model"), (4, 2)))
+    with pytest.raises(ValueError, match="inference-only"):
+        make_sharded_train_step(
+            model, optax.adamw(1e-4), mesh, params_template=params
+        )
