@@ -41,11 +41,12 @@ from jax.experimental import pallas as pl
 from svoc_tpu.consensus.kernel import ConsensusConfig
 
 
-#: Column-block width for the rank computation.  Compiled kernel code
-#: touches at most [N, _RANK_BLOCK] tiles per loop body, so Mosaic
-#: compile time is linear in N instead of quadratic — the round-1
-#: version materialized the full [N, N] comparison matrix and took
-#: ~1 min to compile at N=128, capping the kernel below fleet scale.
+#: Column-block width for the rank computation.  Each unrolled body
+#: touches an [N, _RANK_BLOCK] tile, so VMEM working set stays O(N·B)
+#: — the round-1 version materialized the full [N, N] comparison matrix
+#: and took ~1 min to compile at N=128, capping the kernel below fleet
+#: scale.  The unroll emits N/B bodies per rank call, so compiled code
+#: size is O(N²/B) per call site; :data:`PALLAS_MAX_ORACLES` caps N.
 _RANK_BLOCK = 128
 
 
@@ -54,11 +55,13 @@ def _stable_rank_2d(key_col: jnp.ndarray) -> jnp.ndarray:
     (ascending value, ties by descending index).  Returns ``[N, 1]`` f32
     (exact integers — N ≪ 2²⁴).
 
-    The [N, N] comparison matrix is never materialized: a fori_loop
-    walks [N, B] column blocks, reducing each block to partial counts
-    with an MXU matmul against ones (loop bodies compile once — code
-    size O(N·B), work O(N²), VMEM O(N·B)).  Matmul keeps both compile
-    time and runtime far below the equivalent VPU multi-reductions."""
+    The [N, N] comparison matrix is never materialized: a statically
+    unrolled loop walks [N, B] column blocks, reducing each block to
+    partial counts with an MXU matmul against ones (work O(N²), VMEM
+    O(N·B)).  The unroll is static Python slicing because Mosaic cannot
+    lower ``dynamic_slice`` on *values* (only on refs) — N/B bodies
+    (8 at the flagship N=1024) keep compile time bounded.  Matmul keeps
+    runtime far below the equivalent VPU multi-reductions."""
     n = key_col.shape[0]
     block = min(n, _RANK_BLOCK)
     assert n % block == 0, f"fleet size {n} must be a multiple of {block}"
@@ -66,9 +69,10 @@ def _stable_rank_2d(key_col: jnp.ndarray) -> jnp.ndarray:
     key_row = key_col.reshape(1, n)  # lane-major for block slicing
     ones = jnp.ones((block, 1), jnp.float32)
 
-    def body(b, acc):
+    acc = jnp.zeros((n, 1), jnp.float32)
+    for b in range(n // block):
         j0 = b * block
-        kj = jax.lax.dynamic_slice(key_row, (0, j0), (1, block))  # [1, B]
+        kj = key_row[:, j0 : j0 + block]  # [1, B], static slice
         jdx = jax.lax.broadcasted_iota(jnp.int32, (n, block), 1) + j0
         before = ((kj < key_col) | ((kj == key_col) & (jdx > idx))).astype(
             jnp.float32
@@ -76,19 +80,14 @@ def _stable_rank_2d(key_col: jnp.ndarray) -> jnp.ndarray:
         # HIGHEST precision: the TPU MXU otherwise rounds inputs to
         # bf16, corrupting both the integer counts and downstream
         # selections.
-        part = jax.lax.dot_general(
+        acc = acc + jax.lax.dot_general(
             before,
             ones,
             (((1,), (0,)), ((), ())),
             precision=jax.lax.Precision.HIGHEST,
             preferred_element_type=jnp.float32,
         )
-        return acc + part
-
-    ranks = jax.lax.fori_loop(
-        0, n // block, body, jnp.zeros((n, 1), jnp.float32)
-    )
-    return jnp.round(ranks)
+    return jnp.round(acc)
 
 
 def _value_at_rank(col, ranks, r: int):
@@ -194,9 +193,11 @@ class FusedConsensusOutput(NamedTuple):
 
 
 #: Largest fleet the Pallas kernel compiles for, overridable via
-#: ``SVOC_PALLAS_MAX_ORACLES``.  With the block-looped rank computation
-#: compiled code size is O(N·_RANK_BLOCK), so the flagship N=1024 fleet
-#: compiles in bounded time; above the cap :func:`fused_consensus`
+#: ``SVOC_PALLAS_MAX_ORACLES``.  The statically unrolled rank
+#: computation emits N/_RANK_BLOCK bodies per rank call (8 at the
+#: flagship N=1024), and the kernel makes ~2·M+1 rank calls — compiled
+#: code grows quadratically in N, so raising the cap raises Mosaic
+#: compile time accordingly; above the cap :func:`fused_consensus`
 #: transparently runs the XLA graph with identical semantics.
 PALLAS_MAX_ORACLES = int(os.environ.get("SVOC_PALLAS_MAX_ORACLES", "1024"))
 
