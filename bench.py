@@ -20,6 +20,9 @@ bootstrap fleet -> two-pass consensus, overlapped via a prefetch queue.
 6. Fused Pallas consensus kernel vs the XLA kernel @ flagship fleet size
 7. Data-parallel serving over all local devices (the v5e-8 ≥10k
    comments/sec BASELINE path — mesh-sharded batch + oracle-sharded fleet)
+8. Sequence-packed flagship: several comments per fixed row
+   (block-diagonal attention, per-segment CLS gather) — same device
+   work per step as the flagship, ~packing-factor more comments/sec
 
 Baseline: the reference client classifies a 30-comment window every 5 s
 with 7 oracles on CPU torch (~6 comments/sec, one consensus update per
@@ -371,12 +374,26 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
     )
     forward = pipe.forward_fn()
 
+    # Consensus implementation for the fused fleet+consensus step:
+    # "xla" (default) or "pallas" (the fused VMEM-resident kernel,
+    # ops/pallas_consensus.py).  The default follows the recorded
+    # --config 6 on-chip measurement (VERDICT r2 item 5 decision rule);
+    # override with SVOC_CONSENSUS_IMPL to A/B the two.
+    consensus_impl = os.environ.get("SVOC_CONSENSUS_IMPL", "xla")
+    if consensus_impl not in ("xla", "pallas"):
+        raise ValueError(f"SVOC_CONSENSUS_IMPL={consensus_impl!r} not in xla|pallas")
+
     @jax.jit
     def fleet_consensus(key, window):
         values, honest = gen_oracle_predictions(
             key, window, n_oracles, ccfg.n_failing, subset_size=10
         )
-        out = consensus_step(values, ccfg)
+        if consensus_impl == "pallas":
+            from svoc_tpu.ops.pallas_consensus import fused_consensus
+
+            out = fused_consensus(values, ccfg)
+        else:
+            out = consensus_step(values, ccfg)
         return out.essence, out.reliability_second_pass, honest
 
     roundtrip = measure_roundtrip_ms()
@@ -494,6 +511,7 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
             "consensus_update_latency_ms": round(consensus_ms, 3),
             "consensus_update_exec_ms": round(consensus_exec_ms, 3),
             "consensus_n_oracles": n_oracles,
+            "consensus_impl": consensus_impl,
             "mfu_estimate": round(mfu, 4) if mfu is not None else None,
             "assumed_peak_tflops": peak / 1e12 if peak else None,
             "steps": steps,
@@ -1231,6 +1249,181 @@ def bench_config7(seconds: float, small: bool, platform: str) -> dict:
     }
 
 
+def bench_config8(seconds: float, small: bool, platform: str) -> dict:
+    """Sequence-PACKED flagship: several comments per fixed seq-128 row
+    (block-diagonal attention, per-segment CLS gather —
+    :mod:`svoc_tpu.models.packing`), same fleet+consensus tail and the
+    same host-fetch timing protocol as the flagship.  Device work per
+    step equals the flagship's (same rows × seq), so comments/sec
+    scales by the measured packing factor (~3× on HN-shaped comments).
+    """
+    import collections
+
+    import jax
+    import jax.numpy as jnp
+
+    from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step
+    from svoc_tpu.io.pipeline import PrefetchPipeline
+    from svoc_tpu.io.scraper import SyntheticSource
+    from svoc_tpu.models.configs import ROBERTA_GO_EMOTIONS, TINY_TEST
+    from svoc_tpu.models.packing import pack_tokens, strip_padding
+    from svoc_tpu.models.sentiment import SentimentPipeline
+    from svoc_tpu.sim.oracle import gen_oracle_predictions
+
+    if small:
+        enc_cfg, rows, seq, n_oracles, max_seg = TINY_TEST, 32, 32, 64, 4
+    else:
+        enc_cfg, rows, seq, n_oracles, max_seg = ROBERTA_GO_EMOTIONS, 256, 128, 1024, 8
+
+    window_size = min(50, rows)
+    ccfg = ConsensusConfig(n_failing=max(2, n_oracles // 8), constrained=True)
+
+    pipe = SentimentPipeline(
+        cfg=enc_cfg,
+        seq_len=seq,
+        batch_size=rows,
+        tokenizer_name=None if small else "SamLowe/roberta-base-go_emotions",
+        params_dtype=None if small else "bfloat16",
+    )
+    forward = pipe.packed_forward_fn()
+    pad_id = pipe.tokenizer.pad_id
+    dim = pipe.dimension
+
+    @jax.jit
+    def fleet_consensus(key, vecs, valid):
+        # First `window_size` VALID segments, fixed-shape: stable argsort
+        # puts valid segments first in packer (= input) order.
+        flat = vecs.reshape(-1, dim)
+        order = jnp.argsort(jnp.logical_not(valid.reshape(-1)), stable=True)
+        window = flat[order[:window_size]]
+        values, honest = gen_oracle_predictions(
+            key, window, n_oracles, ccfg.n_failing, subset_size=10
+        )
+        out = consensus_step(values, ccfg)
+        return out.essence, out.reliability_second_pass, honest
+
+    roundtrip = measure_roundtrip_ms()
+    source = SyntheticSource(batch=rows, seed=0)
+
+    def packed_batches():
+        """Tokenize → strip → pack into FIXED [rows, seq] batches; the
+        comment buffer always holds enough lists to fill every row."""
+        buf = collections.deque()
+        need = rows * max_seg  # worst-case comments to fill all rows
+        while True:
+            while len(buf) < need:
+                ids, mask = pipe.tokenizer(source(), seq)
+                buf.extend(strip_padding(ids, mask))
+            batch, n = pack_tokens(list(buf), seq, max_seg, pad_id, rows=rows)
+            for _ in range(n):
+                buf.popleft()
+            yield batch, n
+
+    def put(item):
+        batch, n = item
+        dev = tuple(
+            jnp.asarray(a)
+            for a in (batch.ids, batch.pos, batch.seg, batch.cls_pos)
+        )
+        return dev, jnp.asarray(batch.seg_valid > 0), n
+
+    # Warmup on two distinct packed batches; prove input sensitivity.
+    gen = packed_batches()
+    (dev0, valid0, n0) = put(next(gen))
+    (dev1, valid1, n1) = put(next(gen))
+    key = jax.random.PRNGKey(0)
+    warm0 = device_fetch(fleet_consensus(key, forward(pipe.params, *dev0), valid0)[0])
+    warm1 = device_fetch(fleet_consensus(key, forward(pipe.params, *dev1), valid1)[0])
+    if warm0 == warm1:
+        raise AssertionError(
+            "distinct packed batches produced identical consensus "
+            f"checksums ({warm0}) — pipeline is not input-sensitive"
+        )
+
+    reps = latency_reps(platform)
+    fwd_ms = timed_latency_ms(lambda: forward(pipe.params, *dev0), reps=reps)
+    fwd_exec_ms = amortized_step_ms(
+        lambda i: forward(pipe.params, *(dev0 if i % 2 else dev1)),
+        n=amortize_reps(platform),
+    )
+    vecs0 = forward(pipe.params, *dev0)
+    consensus_exec_ms = amortized_step_ms(
+        lambda i: fleet_consensus(jax.random.fold_in(key, i), vecs0, valid0)[0],
+        n=amortize_reps(platform),
+    )
+    step_exec_ms = fwd_exec_ms + consensus_exec_ms
+    sync_every = max(1, min(64, int(round(8 * roundtrip / max(step_exec_ms, 1e-3)))))
+
+    n_comments = 0
+    steps = 0
+    fetcher = AsyncResultFetcher(maxsize=2)
+    rel2 = None
+    with PrefetchPipeline(
+        packed_batches(), tokenizer=None, seq_len=seq, depth=4, device_put=put
+    ) as stream:
+        t0 = time.perf_counter()
+        for dev, valid, n_batch in stream:
+            vecs = forward(pipe.params, *dev)
+            key = jax.random.fold_in(key, steps)
+            essence, rel2, _ = fleet_consensus(key, vecs, valid)
+            if steps % sync_every == 0:
+                fetcher.submit(steps, essence)
+            n_comments += n_batch
+            steps += 1
+            if time.perf_counter() - t0 >= seconds:
+                break
+        final_checksum = device_fetch(essence)
+        elapsed = time.perf_counter() - t0
+    fetcher.finish()
+    checksums = fetcher.checksums()
+    if (steps - 1) % sync_every != 0:
+        checksums.append((steps - 1, final_checksum))
+    assert_checksums_distinct(checksums)
+
+    value = n_comments / elapsed
+    packing_factor = n_comments / (steps * rows)
+    row_tokens_per_sec = steps * rows * seq / elapsed
+    flops_per_token = encoder_matmul_flops_per_token(enc_cfg, seq)
+    peak = assumed_peak_flops(platform)
+    mfu = row_tokens_per_sec * flops_per_token / peak if peak else None
+
+    return {
+        "metric": (
+            "config 8: sequence-PACKED end-to-end throughput — packed "
+            f"sentiment ({'tiny-f32' if small else 'roberta-base-bf16'}, "
+            f"{max_seg}-seg rows @ seq {seq}) -> {n_oracles}-oracle fleet "
+            "-> two-pass consensus"
+        ),
+        "value": round(value, 2),
+        "unit": "comments/sec",
+        "vs_baseline": round(value / REFERENCE_COMMENTS_PER_SEC, 2),
+        "detail": {
+            "timing_method": (
+                "unique packed batches per step; async host-fetch checksum "
+                f"every {sync_every} steps; clock stopped after final-step "
+                "fetch"
+            ),
+            "device_roundtrip_ms": round(roundtrip, 3),
+            "packing_factor": round(packing_factor, 3),
+            "comments_per_step_mean": round(n_comments / max(steps, 1), 1),
+            "row_tokens_per_sec": round(row_tokens_per_sec, 1),
+            "packed_forward_ms": round(fwd_ms, 3),
+            "packed_forward_exec_ms": round(fwd_exec_ms, 3),
+            "consensus_update_exec_ms": round(consensus_exec_ms, 3),
+            "consensus_n_oracles": n_oracles,
+            "mfu_estimate": round(mfu, 4) if mfu is not None else None,
+            "assumed_peak_tflops": peak / 1e12 if peak else None,
+            "steps": steps,
+            "rows": rows,
+            "max_segments": max_seg,
+            "seq_len": seq,
+            "consensus_reliability2": device_fetch(rel2),
+            "elapsed_s": round(elapsed, 2),
+            **checksum_stats(checksums),
+        },
+    }
+
+
 CONFIGS = {
     0: bench_flagship,
     1: bench_config1,
@@ -1240,6 +1433,7 @@ CONFIGS = {
     5: bench_config5,
     6: bench_config6,
     7: bench_config7,
+    8: bench_config8,
 }
 
 

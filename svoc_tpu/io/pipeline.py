@@ -30,6 +30,9 @@ class PrefetchPipeline:
         tail); exhaustion ends the pipeline.
       tokenizer: ``(texts, seq_len) → (ids, mask)`` (any tokenizer from
         :mod:`svoc_tpu.models.tokenizer` / :mod:`svoc_tpu.runtime`).
+        ``None`` = raw mode: the source already yields device-ready
+        items (e.g. pre-packed batches) that pass straight to
+        ``device_put``.
       seq_len: fixed sequence length (static device shapes).
       depth: producer queue depth (2 = classic double buffering).
     """
@@ -37,7 +40,7 @@ class PrefetchPipeline:
     def __init__(
         self,
         source: Iterable[Sequence[str]],
-        tokenizer: Callable,
+        tokenizer: Optional[Callable],
         seq_len: int,
         depth: int = 2,
         device_put: Optional[Callable] = None,
@@ -57,7 +60,10 @@ class PrefetchPipeline:
             for texts in self._source:
                 if self._stop.is_set():
                     break
-                batch = self._tokenizer(list(texts), self._seq_len)
+                if self._tokenizer is None:  # raw mode — item is ready
+                    batch = texts
+                else:
+                    batch = self._tokenizer(list(texts), self._seq_len)
                 if self._device_put is not None:
                     batch = self._device_put(batch)
                 while not self._stop.is_set():

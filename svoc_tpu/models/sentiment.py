@@ -166,6 +166,75 @@ class SentimentPipeline:
         """The raw jitted ``(params, ids, mask) → [B, M]`` device fn."""
         return self._forward
 
+    def packed_forward_fn(self):
+        """Jitted packed forward: ``(params, ids, pos, seg, cls_pos) →
+        [R, S, M]`` vectors (invalid segments produce garbage rows the
+        caller masks via ``seg_valid``).  Shape-polymorphic in the
+        segment count — S comes from the input arrays, so one callable
+        serves every ``max_segments``.  Shares ``self.params`` — the
+        packed module's parameter tree is identical
+        (:mod:`svoc_tpu.models.packing`)."""
+        from svoc_tpu.models.packing import PackedSentimentEncoder
+
+        packed_model = PackedSentimentEncoder(self.cfg)
+        multi = self.cfg.head == "sigmoid"
+        idx = self.label_indices
+
+        def body(params, ids, pos, seg, cls_pos):
+            logits = packed_model.apply(params, ids, pos, seg, cls_pos)
+            r, s, l = logits.shape
+            vecs = scores_to_vectors(logits.reshape(r * s, l), idx, multi)
+            return vecs.reshape(r, s, len(idx))
+
+        if self.data_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self.data_mesh, P())
+            rows = self._batch_sharding
+            return jax.jit(body, in_shardings=(rep, rows, rows, rows, rows))
+        return jax.jit(body)
+
+    def call_packed(
+        self, texts: Sequence[str], max_segments: int = 8
+    ) -> np.ndarray:
+        """Packed equivalent of ``__call__``: same ``[len(texts), M]``
+        result, ~packing-factor fewer forward rows.  Row count is padded
+        to ``batch_size`` multiples so jit shapes stay fixed."""
+        from svoc_tpu.models.packing import pack_tokens, strip_padding
+
+        if not len(texts):
+            return np.zeros((0, self.dimension))
+        ids, mask = self.tokenizer(list(texts), self.seq_len)
+        token_lists = strip_padding(ids, mask)
+        batch, n = pack_tokens(
+            token_lists, self.seq_len, max_segments, self.tokenizer.pad_id
+        )
+        assert n == len(texts), f"packer consumed {n}/{len(texts)} without a row cap"
+        forward = self._packed_forward()
+        out = np.zeros((len(texts), self.dimension), dtype=np.float64)
+        rows = batch.ids.shape[0]
+        b = self.batch_size
+        for i in range(0, rows, b):
+            sl = slice(i, i + b)
+            chunk = [batch.ids[sl], batch.pos[sl], batch.seg[sl], batch.cls_pos[sl]]
+            n_real = chunk[0].shape[0]
+            if n_real < b:  # pad rows — fixed shapes, no recompiles
+                chunk = [
+                    np.concatenate(
+                        [a, np.repeat(a[-1:], b - n_real, axis=0)], axis=0
+                    )
+                    for a in chunk
+                ]
+            vecs = np.asarray(forward(self.params, *chunk), dtype=np.float64)
+            valid = batch.seg_valid[sl] > 0
+            out[batch.owner[sl][valid]] = vecs[:n_real][valid]
+        return out
+
+    def _packed_forward(self):
+        if not hasattr(self, "_packed_cache"):
+            self._packed_cache = self.packed_forward_fn()
+        return self._packed_cache
+
     def __call__(self, texts: Sequence[str]) -> np.ndarray:
         """``sentiment_analysis`` equivalent: pad to full batches, run
         the jitted forward per chunk, return ``[len(texts), M]``."""

@@ -1,0 +1,176 @@
+"""Sequence packing: packer invariants + packed-vs-unpacked parity.
+
+The packed encoder must reproduce the unpacked per-comment logits to
+float tolerance (same position ids, same per-segment softmax support —
+``svoc_tpu/models/packing.py`` docstring), and the host packer must
+cover every input exactly once with in-bounds gather indices.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from svoc_tpu.models.configs import TINY_TEST, EncoderConfig
+from svoc_tpu.models.encoder import SentimentEncoder, init_params
+from svoc_tpu.models.packing import (
+    PackedSentimentEncoder,
+    pack_tokens,
+    strip_padding,
+)
+from svoc_tpu.models.sentiment import SentimentPipeline
+
+
+SEQ = 32
+
+
+def _texts(n=12, seed=0):
+    rng = np.random.default_rng(seed)
+    vocab = "alpha beta gamma delta epsilon zeta eta theta iota kappa".split()
+    return [
+        " ".join(rng.choice(vocab, size=int(rng.integers(2, 12))))
+        for _ in range(n)
+    ]
+
+
+@pytest.fixture(scope="module")
+def pipe():
+    return SentimentPipeline(
+        cfg=TINY_TEST, seq_len=SEQ, batch_size=4, tokenizer_name=None
+    )
+
+
+# -- packer invariants ------------------------------------------------------
+
+
+def test_pack_covers_every_input_once(pipe):
+    ids, mask = pipe.tokenizer(_texts(20), SEQ)
+    lists = strip_padding(ids, mask)
+    batch, n = pack_tokens(lists, SEQ, max_segments=4, pad_id=pipe.tokenizer.pad_id)
+    assert n == 20
+    owners = batch.owner[batch.seg_valid > 0]
+    assert sorted(owners.tolist()) == list(range(20))
+    # every cls_pos points at the segment's first token
+    for r in range(batch.ids.shape[0]):
+        for s in range(batch.cls_pos.shape[1]):
+            if batch.seg_valid[r, s]:
+                p = batch.cls_pos[r, s]
+                assert batch.seg[r, p] == s + 1
+                assert p == 0 or batch.seg[r, p - 1] != s + 1
+
+
+def test_pack_factor_beats_one_row_per_comment(pipe):
+    ids, mask = pipe.tokenizer(_texts(30), SEQ)
+    lists = strip_padding(ids, mask)
+    batch, _ = pack_tokens(lists, SEQ, max_segments=8, pad_id=pipe.tokenizer.pad_id)
+    assert batch.ids.shape[0] < 30  # strictly fewer rows than comments
+    assert batch.n_segments == 30
+
+
+def test_pack_respects_row_budget_and_resumes(pipe):
+    ids, mask = pipe.tokenizer(_texts(30), SEQ)
+    lists = strip_padding(ids, mask)
+    first, n1 = pack_tokens(
+        lists, SEQ, max_segments=2, pad_id=pipe.tokenizer.pad_id, rows=3
+    )
+    assert first.ids.shape[0] == 3 and 0 < n1 < 30
+    rest, n2 = pack_tokens(
+        lists[n1:], SEQ, max_segments=2, pad_id=pipe.tokenizer.pad_id
+    )
+    assert n1 + n2 == 30
+    # resumed owners are relative to the sliced list
+    owners = rest.owner[rest.seg_valid > 0]
+    assert sorted(owners.tolist()) == list(range(30 - n1))
+
+
+def test_pack_truncates_overlong(pipe):
+    long = [list(range(2, SEQ + 40))]  # way past seq_len
+    batch, n = pack_tokens(long, SEQ, max_segments=4, pad_id=1)
+    assert n == 1
+    assert (batch.seg[0] == 1).sum() == SEQ
+
+
+def test_positions_restart_per_segment(pipe):
+    lists = [[5, 6, 7], [8, 9]]
+    batch, _ = pack_tokens(lists, SEQ, max_segments=4, pad_id=1)
+    # both segments in one row; positions restart at pad_id + 1 = 2
+    assert batch.pos[0, :5].tolist() == [2, 3, 4, 2, 3]
+
+
+# -- numerical parity -------------------------------------------------------
+
+
+def test_packed_logits_match_unpacked(pipe):
+    texts = _texts(10, seed=3)
+    ids, mask = pipe.tokenizer(texts, SEQ)
+    lists = strip_padding(ids, mask)
+    batch, _ = pack_tokens(lists, SEQ, max_segments=4, pad_id=pipe.tokenizer.pad_id)
+
+    model = SentimentEncoder(TINY_TEST)
+    packed_model = PackedSentimentEncoder(TINY_TEST)
+    ref = model.apply(pipe.params, jnp.asarray(ids), jnp.asarray(mask))
+    got = packed_model.apply(
+        pipe.params,
+        jnp.asarray(batch.ids),
+        jnp.asarray(batch.pos),
+        jnp.asarray(batch.seg),
+        jnp.asarray(batch.cls_pos),
+    )
+    valid = batch.seg_valid > 0
+    np.testing.assert_allclose(
+        np.asarray(got)[valid][np.argsort(batch.owner[valid])],
+        np.asarray(ref),
+        rtol=2e-4,
+        atol=2e-5,
+    )
+
+
+def test_packed_param_tree_is_identical(pipe):
+    packed_model = PackedSentimentEncoder(TINY_TEST)
+    batch, _ = pack_tokens([[5, 6], [7]], SEQ, max_segments=2, pad_id=1)
+    packed_params = packed_model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(batch.ids),
+        jnp.asarray(batch.pos),
+        jnp.asarray(batch.seg),
+        jnp.asarray(batch.cls_pos),
+    )
+    ref_tree = jax.tree_util.tree_structure(pipe.params)
+    assert jax.tree_util.tree_structure(packed_params) == ref_tree
+    ref_shapes = jax.tree_util.tree_map(lambda a: a.shape, pipe.params)
+    got_shapes = jax.tree_util.tree_map(lambda a: a.shape, packed_params)
+    assert ref_shapes == got_shapes
+
+
+def test_packed_rejects_flash():
+    cfg = EncoderConfig(
+        vocab_size=64, hidden=16, n_layers=1, n_heads=2, intermediate=32,
+        max_len=32, dtype=jnp.float32, attention="flash",
+    )
+    packed_model = PackedSentimentEncoder(cfg)
+    batch, _ = pack_tokens([[5, 6]], 16, max_segments=2, pad_id=1)
+    with pytest.raises(ValueError, match="dense"):
+        packed_model.init(
+            jax.random.PRNGKey(0),
+            jnp.asarray(batch.ids),
+            jnp.asarray(batch.pos),
+            jnp.asarray(batch.seg),
+            jnp.asarray(batch.cls_pos),
+        )
+
+
+# -- pipeline round trip ----------------------------------------------------
+
+
+def test_call_packed_matches_call(pipe):
+    texts = _texts(11, seed=7)
+    ref = pipe(texts)
+    got = pipe.call_packed(texts)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_call_packed_empty(pipe):
+    out = pipe.call_packed([])
+    assert out.shape == (0, pipe.dimension)
