@@ -23,6 +23,9 @@ bootstrap fleet -> two-pass consensus, overlapped via a prefetch queue.
 8. Sequence-packed flagship: several comments per fixed row
    (block-diagonal attention, per-segment CLS gather) — same device
    work per step as the flagship, ~packing-factor more comments/sec
+9. Sequence-packed data-parallel serving: config 7 x config 8 — the
+   packing factor compounds with the device count (the framework's
+   highest-throughput serving configuration)
 
 Baseline: the reference client classifies a 30-comment window every 5 s
 with 7 oracles on CPU torch (~6 comments/sec, one consensus update per
@@ -1249,6 +1252,52 @@ def bench_config7(seconds: float, small: bool, platform: str) -> dict:
     }
 
 
+def packed_comment_stream(pipe, source, rows: int, seq: int, max_seg: int):
+    """Generator of ``(PackedBatch, n_comments)`` with fixed ``[rows,
+    seq]`` shapes: the comment buffer always holds enough token lists
+    (``rows * max_seg`` worst case) to fill every row, so no packed
+    batch is ever partially empty (the packed serving window contract —
+    ``svoc_tpu/parallel/serving.py:packed_serving_step_fn``).  Shared by
+    configs 8 and 9."""
+    import collections
+
+    from svoc_tpu.models.packing import pack_tokens, strip_padding
+
+    pad_id = pipe.tokenizer.pad_id
+    buf = collections.deque()
+    need = rows * max_seg
+    while True:
+        while len(buf) < need:
+            ids, mask = pipe.tokenizer(source(), seq)
+            buf.extend(strip_padding(ids, mask))
+        batch, n = pack_tokens(list(buf), seq, max_seg, pad_id, rows=rows)
+        for _ in range(n):
+            buf.popleft()
+        yield batch, n
+
+
+def packed_put_fn(row_shard=None):
+    """Device-transfer stage for packed batches: ``(PackedBatch, n) →
+    ((ids, pos, seg, cls_pos), valid, n)`` — single-device ``jnp``
+    transfer by default, ``device_put`` onto ``row_shard`` when given
+    (the data-parallel mesh path)."""
+    import jax
+    import jax.numpy as jnp
+
+    def put(item):
+        batch, n = item
+        arrs = (batch.ids, batch.pos, batch.seg, batch.cls_pos)
+        if row_shard is None:
+            dev = tuple(jnp.asarray(a) for a in arrs)
+            valid = jnp.asarray(batch.seg_valid > 0)
+        else:
+            dev = tuple(jax.device_put(jnp.asarray(a), row_shard) for a in arrs)
+            valid = jax.device_put(jnp.asarray(batch.seg_valid > 0), row_shard)
+        return dev, valid, n
+
+    return put
+
+
 def bench_config8(seconds: float, small: bool, platform: str) -> dict:
     """Sequence-PACKED flagship: several comments per fixed seq-128 row
     (block-diagonal attention, per-segment CLS gather —
@@ -1257,8 +1306,6 @@ def bench_config8(seconds: float, small: bool, platform: str) -> dict:
     step equals the flagship's (same rows × seq), so comments/sec
     scales by the measured packing factor (~3× on HN-shaped comments).
     """
-    import collections
-
     import jax
     import jax.numpy as jnp
 
@@ -1266,7 +1313,6 @@ def bench_config8(seconds: float, small: bool, platform: str) -> dict:
     from svoc_tpu.io.pipeline import PrefetchPipeline
     from svoc_tpu.io.scraper import SyntheticSource
     from svoc_tpu.models.configs import ROBERTA_GO_EMOTIONS, TINY_TEST
-    from svoc_tpu.models.packing import pack_tokens, strip_padding
     from svoc_tpu.models.sentiment import SentimentPipeline
     from svoc_tpu.sim.oracle import gen_oracle_predictions
 
@@ -1286,7 +1332,6 @@ def bench_config8(seconds: float, small: bool, platform: str) -> dict:
         params_dtype=None if small else "bfloat16",
     )
     forward = pipe.packed_forward_fn()
-    pad_id = pipe.tokenizer.pad_id
     dim = pipe.dimension
 
     @jax.jit
@@ -1306,26 +1351,9 @@ def bench_config8(seconds: float, small: bool, platform: str) -> dict:
     source = SyntheticSource(batch=rows, seed=0)
 
     def packed_batches():
-        """Tokenize → strip → pack into FIXED [rows, seq] batches; the
-        comment buffer always holds enough lists to fill every row."""
-        buf = collections.deque()
-        need = rows * max_seg  # worst-case comments to fill all rows
-        while True:
-            while len(buf) < need:
-                ids, mask = pipe.tokenizer(source(), seq)
-                buf.extend(strip_padding(ids, mask))
-            batch, n = pack_tokens(list(buf), seq, max_seg, pad_id, rows=rows)
-            for _ in range(n):
-                buf.popleft()
-            yield batch, n
+        return packed_comment_stream(pipe, source, rows, seq, max_seg)
 
-    def put(item):
-        batch, n = item
-        dev = tuple(
-            jnp.asarray(a)
-            for a in (batch.ids, batch.pos, batch.seg, batch.cls_pos)
-        )
-        return dev, jnp.asarray(batch.seg_valid > 0), n
+    put = packed_put_fn()
 
     # Warmup on two distinct packed batches; prove input sensitivity.
     gen = packed_batches()
@@ -1424,6 +1452,151 @@ def bench_config8(seconds: float, small: bool, platform: str) -> dict:
     }
 
 
+def bench_config9(seconds: float, small: bool, platform: str) -> dict:
+    """Sequence-packed DATA-PARALLEL serving: config 7's mesh path with
+    config 8's packed rows (:func:`svoc_tpu.parallel.serving.
+    packed_serving_step_fn`) — per-step throughput compounds the
+    packing factor (~3×) with the device count.  On a v5e-8 this is
+    the highest-throughput serving configuration in the framework."""
+    import jax
+
+    from svoc_tpu.consensus.kernel import ConsensusConfig
+    from svoc_tpu.io.pipeline import PrefetchPipeline
+    from svoc_tpu.io.scraper import SyntheticSource
+    from svoc_tpu.models.configs import ROBERTA_GO_EMOTIONS, TINY_TEST
+    from svoc_tpu.models.sentiment import SentimentPipeline
+    from svoc_tpu.parallel.serving import (
+        batch_sharding,
+        packed_serving_step_fn,
+        serving_mesh,
+    )
+
+    n_dev = len(jax.devices())
+    if small:
+        enc_cfg, per_dev_rows, seq, n_oracles, max_seg = TINY_TEST, 16, 32, 16 * n_dev, 4
+    else:
+        enc_cfg, per_dev_rows, seq, n_oracles, max_seg = (
+            ROBERTA_GO_EMOTIONS, 256, 128, 1024, 8,
+        )
+    if n_oracles % n_dev:
+        n_oracles += n_dev - n_oracles % n_dev
+    rows = per_dev_rows * n_dev
+    window_size = min(50, rows)
+    ccfg = ConsensusConfig(n_failing=max(2, n_oracles // 8), constrained=True)
+
+    pipe = SentimentPipeline(
+        cfg=enc_cfg,
+        seq_len=seq,
+        batch_size=rows,
+        tokenizer_name=None if small else "SamLowe/roberta-base-go_emotions",
+        params_dtype=None if small else "bfloat16",
+    )
+    mesh = serving_mesh()
+    row_shard = batch_sharding(mesh)
+    serve = packed_serving_step_fn(
+        mesh, enc_cfg, ccfg, n_oracles, window_size=window_size, subset_size=10
+    )
+    roundtrip = measure_roundtrip_ms()
+    source = SyntheticSource(batch=rows, seed=0)
+
+    def packed_batches():
+        return packed_comment_stream(pipe, source, rows, seq, max_seg)
+
+    put = packed_put_fn(row_shard)
+
+    gen = packed_batches()
+    dev0, valid0, n0 = put(next(gen))
+    dev1, valid1, n1 = put(next(gen))
+    key = jax.random.PRNGKey(0)
+    warm0 = device_fetch(serve(pipe.params, key, *dev0, valid0)[0].essence)
+    warm1 = device_fetch(serve(pipe.params, key, *dev1, valid1)[0].essence)
+    if warm0 == warm1:
+        raise AssertionError(
+            "distinct packed batches produced identical serving checksums"
+        )
+    step_ms = timed_latency_ms(
+        lambda: serve(pipe.params, key, *dev0, valid0)[0].essence,
+        reps=latency_reps(platform),
+    )
+    step_exec_ms = amortized_step_ms(
+        lambda i: serve(
+            pipe.params,
+            jax.random.fold_in(key, i),
+            *(dev0 if i % 2 else dev1),
+            valid0 if i % 2 else valid1,
+        )[0].essence,
+        n=amortize_reps(platform),
+    )
+    sync_every = max(1, min(64, int(round(8 * roundtrip / max(step_exec_ms, 1e-3)))))
+
+    n_comments = 0
+    steps = 0
+    out = None
+    fetcher = AsyncResultFetcher(maxsize=2)
+    with PrefetchPipeline(
+        packed_batches(), tokenizer=None, seq_len=seq, depth=4, device_put=put
+    ) as stream:
+        t0 = time.perf_counter()
+        for dev, valid, n_batch in stream:
+            key = jax.random.fold_in(key, steps)
+            out, honest = serve(pipe.params, key, *dev, valid)
+            if steps % sync_every == 0:
+                fetcher.submit(steps, out.essence)
+            n_comments += n_batch
+            steps += 1
+            if time.perf_counter() - t0 >= seconds:
+                break
+        final_checksum = device_fetch(out.essence)
+        elapsed = time.perf_counter() - t0
+    fetcher.finish()
+    checksums = fetcher.checksums()
+    if (steps - 1) % sync_every != 0:
+        checksums.append((steps - 1, final_checksum))
+    assert_checksums_distinct(checksums)
+
+    value = n_comments / elapsed
+    packing_factor = n_comments / (steps * rows)
+    row_tokens_per_sec = steps * rows * seq / elapsed
+    flops_per_token = encoder_matmul_flops_per_token(enc_cfg, seq)
+    peak = assumed_peak_flops(platform)
+    mfu = row_tokens_per_sec * flops_per_token / (peak * n_dev) if peak else None
+
+    return {
+        "metric": (
+            f"config 9: sequence-packed data-parallel serving over {n_dev} "
+            f"device(s) — {max_seg}-seg packed rows -> {n_oracles}-oracle "
+            "fleet -> consensus"
+        ),
+        "value": round(value, 2),
+        "unit": "comments/sec",
+        "vs_baseline": round(value / REFERENCE_COMMENTS_PER_SEC, 2),
+        "detail": {
+            "timing_method": (
+                "unique packed batches per step; async host-fetch checksum "
+                f"every {sync_every} steps; clock stopped after final-step "
+                "fetch"
+            ),
+            "device_roundtrip_ms": round(roundtrip, 3),
+            "n_mesh_devices": n_dev,
+            "per_device_rows": per_dev_rows,
+            "packing_factor": round(packing_factor, 3),
+            "serving_step_ms": round(step_ms, 3),
+            "serving_step_exec_ms": round(step_exec_ms, 3),
+            "row_tokens_per_sec": round(row_tokens_per_sec, 1),
+            "mfu_estimate": round(mfu, 4) if mfu is not None else None,
+            "assumed_peak_tflops": peak * n_dev / 1e12 if peak else None,
+            "consensus_n_oracles": n_oracles,
+            "reliability2": device_fetch(out.reliability_second_pass),
+            "steps": steps,
+            "rows": rows,
+            "max_segments": max_seg,
+            "seq_len": seq,
+            "elapsed_s": round(elapsed, 2),
+            **checksum_stats(checksums),
+        },
+    }
+
+
 CONFIGS = {
     0: bench_flagship,
     1: bench_config1,
@@ -1434,6 +1607,7 @@ CONFIGS = {
     6: bench_config6,
     7: bench_config7,
     8: bench_config8,
+    9: bench_config9,
 }
 
 

@@ -96,3 +96,55 @@ def test_dp_serving_rejects_indivisible_oracles():
         dp_serving_step_fn(
             mesh, TINY_TEST, ConsensusConfig(n_failing=1), n_oracles=9
         )
+
+
+def test_packed_serving_matches_unpacked():
+    """Packed data-parallel serving must produce the SAME consensus as
+    the unpacked dp path on the same texts: the packer preserves input
+    order, so the first window_size valid segments = the unpacked
+    window."""
+    from svoc_tpu.models.packing import pack_tokens, strip_padding
+    from svoc_tpu.models.tokenizer import load_tokenizer
+    from svoc_tpu.parallel.serving import packed_serving_step_fn
+
+    cfg = TINY_TEST
+    ccfg = ConsensusConfig(n_failing=4, constrained=True)
+    mesh = serving_mesh()
+    window, seq, n_oracles = 8, 16, 16
+    model = SentimentEncoder(cfg)
+    params = init_params(model, seed=0)
+    tok = load_tokenizer(None, cfg.vocab_size, pad_id=cfg.pad_id, max_len=seq)
+    texts = [f"short comment number {i} about consensus" for i in range(16)]
+    ids, mask = tok(texts, seq)
+
+    serve = dp_serving_step_fn(
+        mesh, cfg, ccfg, n_oracles, window_size=window, subset_size=4,
+        label_indices=LABEL_IDX,
+    )
+    key = jax.random.PRNGKey(3)
+    d_ids = jax.device_put(jnp.asarray(ids), batch_sharding(mesh))
+    d_mask = jax.device_put(jnp.asarray(mask), batch_sharding(mesh))
+    ref_out, ref_honest = serve(params, key, d_ids, d_mask)
+
+    lists = strip_padding(ids, mask)
+    batch, n = pack_tokens(lists, seq, max_segments=2, pad_id=cfg.pad_id, rows=8)
+    assert n == 16  # every comment packed into the 8 rows
+    pserve = packed_serving_step_fn(
+        mesh, cfg, ccfg, n_oracles, window_size=window, subset_size=4,
+        label_indices=LABEL_IDX,
+    )
+    row = batch_sharding(mesh)
+    args = [
+        jax.device_put(jnp.asarray(a), row)
+        for a in (batch.ids, batch.pos, batch.seg, batch.cls_pos)
+    ]
+    valid = jax.device_put(jnp.asarray(batch.seg_valid > 0), row)
+    out, honest = pserve(params, key, *args, valid)
+
+    np.testing.assert_allclose(
+        np.asarray(out.essence), np.asarray(ref_out.essence), atol=2e-4
+    )
+    np.testing.assert_array_equal(np.asarray(honest), np.asarray(ref_honest))
+    np.testing.assert_array_equal(
+        np.asarray(out.reliable), np.asarray(ref_out.reliable)
+    )
