@@ -60,6 +60,11 @@ class SessionConfig:
     required_majority: int = 2
     n_admins: int = 3
     seed: int = 0
+    #: Sequence-packed inference for the default vectorizer — several
+    #: comments per fixed device row (:mod:`svoc_tpu.models.packing`),
+    #: ~3× fewer forward rows on HN-shaped comments with identical
+    #: results to float tolerance.  The TPU-first default.
+    packed_inference: bool = True
     #: Deployment info (``data/contract_info.json`` fields).
     declared_address: Optional[str] = None
     deployed_address: Optional[str] = None
@@ -177,6 +182,7 @@ class Session:
                 label_indices=indices,
                 batch_size=default_batch,
                 data_mesh=data_mesh,
+                packed=self.config.packed_inference,
             )
         return self._vectorizer
 

@@ -86,8 +86,21 @@ class SentimentPipeline:
     #: (:mod:`svoc_tpu.parallel.serving`).  The mesh size must divide
     #: ``batch_size``.  None = single-device (default).
     data_mesh: Optional[object] = None
+    #: Route ``__call__`` through the sequence-packed forward
+    #: (:mod:`svoc_tpu.models.packing`): several comments per fixed row,
+    #: ~3× fewer device rows on HN-shaped text, identical results to
+    #: float tolerance.  Requires ``cfg.attention == "dense"``.
+    packed: bool = False
+    #: Segments per packed row (only read when ``packed``).
+    max_segments: int = 8
 
     def __post_init__(self):
+        if self.packed and self.cfg.attention != "dense":
+            raise ValueError(
+                "packed inference needs cfg.attention == 'dense' — the "
+                "flash kernel's per-key mask cannot express block-diagonal "
+                f"segments (got {self.cfg.attention!r})"
+            )
         if max(self.label_indices) >= self.cfg.n_labels:
             raise ValueError(
                 f"label_indices {self.label_indices} out of range for a "
@@ -238,6 +251,8 @@ class SentimentPipeline:
     def __call__(self, texts: Sequence[str]) -> np.ndarray:
         """``sentiment_analysis`` equivalent: pad to full batches, run
         the jitted forward per chunk, return ``[len(texts), M]``."""
+        if self.packed:
+            return self.call_packed(texts, self.max_segments)
         out = []
         b = self.batch_size
         for i in range(0, len(texts), b):

@@ -174,3 +174,27 @@ def test_call_packed_matches_call(pipe):
 def test_call_packed_empty(pipe):
     out = pipe.call_packed([])
     assert out.shape == (0, pipe.dimension)
+
+
+def test_pipeline_packed_flag_routes_call():
+    p = SentimentPipeline(
+        cfg=TINY_TEST, seq_len=SEQ, batch_size=4, tokenizer_name=None, packed=True
+    )
+    ref = SentimentPipeline(
+        cfg=TINY_TEST, seq_len=SEQ, batch_size=4, tokenizer_name=None
+    )
+    texts = _texts(9, seed=11)
+    np.testing.assert_allclose(p(texts), ref(texts), rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_packed_rejects_flash():
+    from dataclasses import replace
+
+    with pytest.raises(ValueError, match="dense"):
+        SentimentPipeline(
+            cfg=replace(TINY_TEST, attention="flash"),
+            seq_len=SEQ,
+            batch_size=4,
+            tokenizer_name=None,
+            packed=True,
+        )
