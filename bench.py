@@ -1261,7 +1261,7 @@ def packed_comment_stream(pipe, source, rows: int, seq: int, max_seg: int):
     configs 8 and 9."""
     import collections
 
-    from svoc_tpu.models.packing import pack_tokens, strip_padding
+    from svoc_tpu.models.packing import pack_tokens_auto, strip_padding
 
     pad_id = pipe.tokenizer.pad_id
     buf = collections.deque()
@@ -1270,7 +1270,7 @@ def packed_comment_stream(pipe, source, rows: int, seq: int, max_seg: int):
         while len(buf) < need:
             ids, mask = pipe.tokenizer(source(), seq)
             buf.extend(strip_padding(ids, mask))
-        batch, n = pack_tokens(list(buf), seq, max_seg, pad_id, rows=rows)
+        batch, n = pack_tokens_auto(list(buf), seq, max_seg, pad_id, rows=rows)
         for _ in range(n):
             buf.popleft()
         yield batch, n

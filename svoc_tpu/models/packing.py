@@ -66,9 +66,11 @@ class PackedBatch(NamedTuple):
         return int(self.seg_valid.sum())
 
 
-def strip_padding(ids: np.ndarray, mask: np.ndarray) -> List[List[int]]:
-    """Fixed-shape tokenizer output → per-text unpadded id lists."""
-    return [list(row[m > 0]) for row, m in zip(ids, mask)]
+def strip_padding(ids: np.ndarray, mask: np.ndarray) -> List[np.ndarray]:
+    """Fixed-shape tokenizer output → per-text unpadded id arrays
+    (int32, no Python-int conversion — the native packer concatenates
+    them without a per-element copy)."""
+    return [row[m > 0] for row, m in zip(ids, mask)]
 
 
 def pack_tokens(
@@ -91,6 +93,8 @@ def pack_tokens(
     """
     if max_segments < 1:
         raise ValueError(f"max_segments must be >= 1, got {max_segments}")
+    if rows is not None and rows < 1:
+        raise ValueError(f"rows must be >= 1, got {rows}")
     row_ids: List[List[int]] = []
     row_segs: List[List[Tuple[int, int]]] = []  # per row: (owner, start)
     cur_ids: List[int] = []
@@ -137,6 +141,31 @@ def pack_tokens(
             seg_valid[i, j] = 1
             owner[i, j] = owner_idx
     return PackedBatch(ids, pos, seg, cls_pos, seg_valid, owner), n_consumed
+
+
+def pack_tokens_auto(
+    token_lists: Sequence[Sequence[int]],
+    seq_len: int,
+    max_segments: int,
+    pad_id: int,
+    rows: int | None = None,
+) -> Tuple[PackedBatch, int]:
+    """:func:`pack_tokens` via the native C++ packer when it builds
+    (``svoc_tpu/runtime/packer.cpp`` — GIL-free, the host hot stage of
+    packed serving), bit-identical Python fallback otherwise
+    (equality-tested in ``tests/test_runtime.py``)."""
+    try:
+        from svoc_tpu.runtime import native_pack_tokens_raw
+
+        raw = native_pack_tokens_raw(
+            token_lists, seq_len, max_segments, pad_id, rows
+        )
+    except ImportError:  # pragma: no cover — runtime package stripped
+        raw = None
+    if raw is None:
+        return pack_tokens(token_lists, seq_len, max_segments, pad_id, rows)
+    ids, pos, seg, cls_pos, seg_valid, owner, n = raw
+    return PackedBatch(ids, pos, seg, cls_pos, seg_valid, owner), n
 
 
 class PackedSentimentEncoder(nn.Module):

@@ -213,13 +213,13 @@ class SentimentPipeline:
         """Packed equivalent of ``__call__``: same ``[len(texts), M]``
         result, ~packing-factor fewer forward rows.  Row count is padded
         to ``batch_size`` multiples so jit shapes stay fixed."""
-        from svoc_tpu.models.packing import pack_tokens, strip_padding
+        from svoc_tpu.models.packing import pack_tokens_auto, strip_padding
 
         if not len(texts):
             return np.zeros((0, self.dimension))
         ids, mask = self.tokenizer(list(texts), self.seq_len)
         token_lists = strip_padding(ids, mask)
-        batch, n = pack_tokens(
+        batch, n = pack_tokens_auto(
             token_lists, self.seq_len, max_segments, self.tokenizer.pad_id
         )
         assert n == len(texts), f"packer consumed {n}/{len(texts)} without a row cap"

@@ -14,10 +14,12 @@ from svoc_tpu.runtime.native import (
     NativeHashingTokenizer,
     load_native_library,
     native_available,
+    native_pack_tokens_raw,
 )
 
 __all__ = [
     "NativeHashingTokenizer",
     "load_native_library",
     "native_available",
+    "native_pack_tokens_raw",
 ]

@@ -26,7 +26,7 @@ import numpy as np
 _SRC_DIR = Path(__file__).resolve().parent
 _BUILD_DIR = _SRC_DIR / "_build"
 _LIB_PATH = _BUILD_DIR / "libsvoc_runtime.so"
-_SOURCES = ["tokenizer.cpp"]
+_SOURCES = ["tokenizer.cpp", "packer.cpp"]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -77,14 +77,102 @@ def load_native_library() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_int32),  # mask out
             ]
             lib.svoc_tokenize_batch.restype = None
+            lib.svoc_pack_tokens.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),  # flat tokens
+                ctypes.POINTER(ctypes.c_int64),  # offsets [n+1]
+                ctypes.c_int,  # n_lists
+                ctypes.c_int,  # seq_len
+                ctypes.c_int,  # max_segments
+                ctypes.c_int32,  # pad_id
+                ctypes.c_int,  # rows_cap
+                ctypes.POINTER(ctypes.c_int32),  # ids out
+                ctypes.POINTER(ctypes.c_int32),  # pos out
+                ctypes.POINTER(ctypes.c_int32),  # seg out
+                ctypes.POINTER(ctypes.c_int32),  # cls_pos out
+                ctypes.POINTER(ctypes.c_int32),  # seg_valid out
+                ctypes.POINTER(ctypes.c_int32),  # owner out
+                ctypes.POINTER(ctypes.c_int32),  # out counts [2]
+            ]
+            lib.svoc_pack_tokens.restype = None
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale prebuilt .so (mtime newer than the
+            # sources, e.g. shipped by tar/docker with preserved times)
+            # missing a newer symbol — fall back to Python rather than
+            # crash every consumer.
             _lib = None
         return _lib
 
 
 def native_available() -> bool:
     return load_native_library() is not None
+
+
+def _int32_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def native_pack_tokens_raw(
+    token_lists: Sequence[Sequence[int]],
+    seq_len: int,
+    max_segments: int,
+    pad_id: int,
+    rows: Optional[int] = None,
+) -> Optional[tuple]:
+    """C++ greedy next-fit packer (``packer.cpp``), GIL-free during the
+    pack.  Returns raw numpy arrays ``(ids, pos, seg, cls_pos,
+    seg_valid, owner, n_consumed)`` with semantics identical to
+    :func:`svoc_tpu.models.packing.pack_tokens` (which wraps them into a
+    ``PackedBatch``), or ``None`` when the native library is
+    unavailable."""
+    lib = load_native_library()
+    if lib is None:
+        return None
+    if max_segments < 1:
+        raise ValueError(f"max_segments must be >= 1, got {max_segments}")
+    if rows is not None and rows < 1:
+        raise ValueError(f"rows must be >= 1, got {rows}")
+    n = len(token_lists)
+    arrs = [np.asarray(t, dtype=np.int32) for t in token_lists]
+    lengths = np.fromiter((a.size for a in arrs), dtype=np.int64, count=n)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lengths, out=offsets[1:])
+    flat = (
+        np.ascontiguousarray(np.concatenate(arrs), dtype=np.int32)
+        if n and offsets[-1]
+        else np.zeros(0, dtype=np.int32)
+    )
+
+    rows_cap = rows if rows is not None else max(1, n)
+    t, s = seq_len, max_segments
+    ids = np.full((rows_cap, t), pad_id, dtype=np.int32)
+    pos = np.full((rows_cap, t), pad_id, dtype=np.int32)
+    seg = np.zeros((rows_cap, t), dtype=np.int32)
+    cls_pos = np.zeros((rows_cap, s), dtype=np.int32)
+    seg_valid = np.zeros((rows_cap, s), dtype=np.int32)
+    owner = np.full((rows_cap, s), -1, dtype=np.int32)
+    counts = np.zeros(2, dtype=np.int32)
+    lib.svoc_pack_tokens(
+        _int32_ptr(flat),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n,
+        t,
+        s,
+        pad_id,
+        rows_cap,
+        _int32_ptr(ids),
+        _int32_ptr(pos),
+        _int32_ptr(seg),
+        _int32_ptr(cls_pos),
+        _int32_ptr(seg_valid),
+        _int32_ptr(owner),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rows is None:
+        used = max(1, int(counts[0]))
+        ids, pos, seg = ids[:used], pos[:used], seg[:used]
+        cls_pos, seg_valid, owner = cls_pos[:used], seg_valid[:used], owner[:used]
+    return ids, pos, seg, cls_pos, seg_valid, owner, int(counts[1])
 
 
 class NativeHashingTokenizer:

@@ -58,6 +58,7 @@ def test_dryrun_multichip_subprocess_fresh_env():
         "sequence-parallel-forward",
         "dp-serving-end-to-end",
         "pipeline-parallel-forward",
+        "packed-forward-dp",
     ]
 
 

@@ -80,3 +80,56 @@ class TestLoadTokenizerPrefersNative:
 
         tok = load_tokenizer(None, 50265, pad_id=1, max_len=64)
         assert isinstance(tok, NativeHashingTokenizer)
+
+
+def test_native_packer_matches_python_exactly():
+    """The C++ packer must be BIT-identical to the Python reference on
+    every output array, across row caps, empty lists, overlong lists,
+    and segment-cap flushes."""
+    import numpy as np
+    import pytest
+
+    from svoc_tpu.runtime import native_available, native_pack_tokens_raw
+    from svoc_tpu.models.packing import PackedBatch, pack_tokens
+
+    if not native_available():
+        pytest.skip("native runtime unavailable")
+
+    rng = np.random.default_rng(0)
+    cases = []
+    for trial in range(20):
+        n = int(rng.integers(1, 40))
+        lists = [
+            list(rng.integers(4, 1000, size=int(rng.integers(0, 40))))
+            for _ in range(n)
+        ]
+        seq = int(rng.integers(8, 33))
+        max_seg = int(rng.integers(1, 6))
+        rows = None if trial % 3 else int(rng.integers(1, 8))
+        cases.append((lists, seq, max_seg, rows))
+    cases.append(([[]], 8, 2, None))  # degenerate empty list
+    cases.append(([list(range(4, 100))], 16, 2, None))  # overlong
+
+    for lists, seq, max_seg, rows in cases:
+        ref, ref_n = pack_tokens(lists, seq, max_seg, pad_id=1, rows=rows)
+        raw = native_pack_tokens_raw(lists, seq, max_seg, pad_id=1, rows=rows)
+        got = PackedBatch(*raw[:6])
+        assert raw[6] == ref_n, (lists, seq, max_seg, rows)
+        for name in PackedBatch._fields:
+            np.testing.assert_array_equal(
+                getattr(got, name), getattr(ref, name),
+                err_msg=f"{name} mismatch @ seq={seq} max_seg={max_seg} rows={rows}",
+            )
+
+
+def test_packers_reject_zero_rows():
+    import pytest
+
+    from svoc_tpu.models.packing import pack_tokens
+    from svoc_tpu.runtime import native_available, native_pack_tokens_raw
+
+    with pytest.raises(ValueError, match="rows"):
+        pack_tokens([[5, 6]], 8, 2, pad_id=1, rows=0)
+    if native_available():
+        with pytest.raises(ValueError, match="rows"):
+            native_pack_tokens_raw([[5, 6]], 8, 2, pad_id=1, rows=0)
