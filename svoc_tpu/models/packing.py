@@ -143,6 +143,20 @@ def pack_tokens(
     return PackedBatch(ids, pos, seg, cls_pos, seg_valid, owner), n_consumed
 
 
+def pack_labels(batch: PackedBatch, labels: np.ndarray) -> np.ndarray:
+    """Scatter per-comment ``labels [N, ...]`` into the packed layout
+    ``[R, S, ...]`` via the owner map (zeros where no segment) — the
+    label side of a packed fine-tuning batch
+    (:func:`svoc_tpu.train.trainer.make_packed_train_step`)."""
+    labels = np.asarray(labels)
+    if len(labels) == 0:  # all-padding batch (empty streaming tail)
+        return np.zeros(batch.owner.shape + labels.shape[1:], labels.dtype)
+    safe = np.where(batch.owner >= 0, batch.owner, 0)
+    out = labels[safe]
+    out[batch.seg_valid == 0] = 0
+    return out
+
+
 def pack_tokens_auto(
     token_lists: Sequence[Sequence[int]],
     seq_len: int,

@@ -54,8 +54,48 @@ def _compile() -> bool:
         return False
 
 
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Declare the C signatures; raises AttributeError when the library
+    is missing a symbol (a stale prebuilt .so)."""
+    lib.svoc_tokenize_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p),  # texts
+        ctypes.c_int,  # n_texts
+        ctypes.c_int,  # seq_len
+        ctypes.c_int64,  # vocab_size
+        ctypes.c_int32,  # pad_id
+        ctypes.c_int32,  # bos_id
+        ctypes.c_int32,  # eos_id
+        ctypes.POINTER(ctypes.c_int32),  # ids out
+        ctypes.POINTER(ctypes.c_int32),  # mask out
+    ]
+    lib.svoc_tokenize_batch.restype = None
+    lib.svoc_pack_tokens.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),  # flat tokens
+        ctypes.POINTER(ctypes.c_int64),  # offsets [n+1]
+        ctypes.c_int,  # n_lists
+        ctypes.c_int,  # seq_len
+        ctypes.c_int,  # max_segments
+        ctypes.c_int32,  # pad_id
+        ctypes.c_int,  # rows_cap
+        ctypes.POINTER(ctypes.c_int32),  # ids out
+        ctypes.POINTER(ctypes.c_int32),  # pos out
+        ctypes.POINTER(ctypes.c_int32),  # seg out
+        ctypes.POINTER(ctypes.c_int32),  # cls_pos out
+        ctypes.POINTER(ctypes.c_int32),  # seg_valid out
+        ctypes.POINTER(ctypes.c_int32),  # owner out
+        ctypes.POINTER(ctypes.c_int32),  # out counts [2]
+    ]
+    lib.svoc_pack_tokens.restype = None
+    return lib
+
+
 def load_native_library() -> Optional[ctypes.CDLL]:
-    """Compile-on-demand + load; ``None`` when unavailable."""
+    """Compile-on-demand + load; ``None`` when unavailable.
+
+    A library that loads but is missing a symbol (stale prebuilt .so
+    whose mtime outruns the sources — e.g. shipped by tar/docker with
+    preserved times) is deleted and rebuilt ONCE, so one stale artifact
+    cannot silently disable the whole native runtime."""
     global _lib, _load_attempted
     with _lock:
         if _lib is not None or _load_attempted:
@@ -64,42 +104,29 @@ def load_native_library() -> Optional[ctypes.CDLL]:
         if not _compile():
             return None
         try:
-            lib = ctypes.CDLL(str(_LIB_PATH))
-            lib.svoc_tokenize_batch.argtypes = [
-                ctypes.POINTER(ctypes.c_char_p),  # texts
-                ctypes.c_int,  # n_texts
-                ctypes.c_int,  # seq_len
-                ctypes.c_int64,  # vocab_size
-                ctypes.c_int32,  # pad_id
-                ctypes.c_int32,  # bos_id
-                ctypes.c_int32,  # eos_id
-                ctypes.POINTER(ctypes.c_int32),  # ids out
-                ctypes.POINTER(ctypes.c_int32),  # mask out
-            ]
-            lib.svoc_tokenize_batch.restype = None
-            lib.svoc_pack_tokens.argtypes = [
-                ctypes.POINTER(ctypes.c_int32),  # flat tokens
-                ctypes.POINTER(ctypes.c_int64),  # offsets [n+1]
-                ctypes.c_int,  # n_lists
-                ctypes.c_int,  # seq_len
-                ctypes.c_int,  # max_segments
-                ctypes.c_int32,  # pad_id
-                ctypes.c_int,  # rows_cap
-                ctypes.POINTER(ctypes.c_int32),  # ids out
-                ctypes.POINTER(ctypes.c_int32),  # pos out
-                ctypes.POINTER(ctypes.c_int32),  # seg out
-                ctypes.POINTER(ctypes.c_int32),  # cls_pos out
-                ctypes.POINTER(ctypes.c_int32),  # seg_valid out
-                ctypes.POINTER(ctypes.c_int32),  # owner out
-                ctypes.POINTER(ctypes.c_int32),  # out counts [2]
-            ]
-            lib.svoc_pack_tokens.restype = None
-            _lib = lib
-        except (OSError, AttributeError):
-            # AttributeError: a stale prebuilt .so (mtime newer than the
-            # sources, e.g. shipped by tar/docker with preserved times)
-            # missing a newer symbol — fall back to Python rather than
-            # crash every consumer.
+            _lib = _bind(ctypes.CDLL(str(_LIB_PATH)))
+        except AttributeError:
+            # Stale artifact missing a symbol: rebuild from sources and
+            # load under an ALIAS path — glibc dlopen dedupes loaded
+            # objects by pathname, so reloading _LIB_PATH would return
+            # the stale handle.  The alias is unlinked immediately (the
+            # mapping survives); fresh processes load the rebuilt
+            # _LIB_PATH directly.
+            import shutil
+
+            _lib = None
+            try:
+                _LIB_PATH.unlink()
+                if _compile():
+                    alias = _BUILD_DIR / f"libsvoc_runtime.{os.getpid()}.so"
+                    shutil.copy2(_LIB_PATH, alias)
+                    try:
+                        _lib = _bind(ctypes.CDLL(str(alias)))
+                    finally:
+                        alias.unlink(missing_ok=True)
+            except (OSError, AttributeError):
+                _lib = None
+        except OSError:
             _lib = None
         return _lib
 
@@ -169,9 +196,15 @@ def native_pack_tokens_raw(
         counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     if rows is None:
+        # copy() the trims: a bare slice is a view keeping the whole
+        # worst-case [n, T] allocation alive for the batch's lifetime.
         used = max(1, int(counts[0]))
-        ids, pos, seg = ids[:used], pos[:used], seg[:used]
-        cls_pos, seg_valid, owner = cls_pos[:used], seg_valid[:used], owner[:used]
+        ids, pos, seg = ids[:used].copy(), pos[:used].copy(), seg[:used].copy()
+        cls_pos, seg_valid, owner = (
+            cls_pos[:used].copy(),
+            seg_valid[:used].copy(),
+            owner[:used].copy(),
+        )
     return ids, pos, seg, cls_pos, seg_valid, owner, int(counts[1])
 
 

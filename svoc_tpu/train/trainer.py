@@ -34,6 +34,18 @@ class Batch(NamedTuple):
     labels: jnp.ndarray  # [B, n_labels] float (multi-hot) or [B] int
 
 
+class PackedTrainBatch(NamedTuple):
+    """Sequence-packed fine-tuning batch (:mod:`svoc_tpu.models.packing`
+    shapes; ``labels`` via :func:`svoc_tpu.models.packing.pack_labels`)."""
+
+    ids: jnp.ndarray  # [R, T] int32
+    pos: jnp.ndarray  # [R, T] int32
+    seg: jnp.ndarray  # [R, T] int32
+    cls_pos: jnp.ndarray  # [R, S] int32
+    seg_valid: jnp.ndarray  # [R, S] int32
+    labels: jnp.ndarray  # [R, S, n_labels] float (multi-hot) or [R, S] int
+
+
 def _loss_fn(model: SentimentEncoder, params, batch: Batch) -> jnp.ndarray:
     logits = model.apply(params, batch.ids, batch.mask)
     if model.cfg.head == "sigmoid":  # multi-label BCE (go_emotions)
@@ -44,21 +56,44 @@ def _loss_fn(model: SentimentEncoder, params, batch: Batch) -> jnp.ndarray:
     )
 
 
-def _step_body(model: SentimentEncoder, tx: optax.GradientTransformation):
-    """The unjitted update: shared by the plain and sharded factories."""
-    if model.cfg.attention == "flash":
+def _packed_loss_fn(packed_model, params, batch: PackedTrainBatch) -> jnp.ndarray:
+    """Per-segment loss over VALID segments only, normalized by their
+    count — identical to the unpacked batch-mean over the same comments
+    (equivalence-tested in ``tests/test_train.py``)."""
+    logits = packed_model.apply(
+        params, batch.ids, batch.pos, batch.seg, batch.cls_pos
+    )  # [R, S, L]
+    if packed_model.cfg.head == "sigmoid":
+        per_seg = jnp.sum(
+            optax.sigmoid_binary_cross_entropy(logits, batch.labels), axis=-1
+        )
+    else:
+        per_seg = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch.labels
+        )
+    w = batch.seg_valid.astype(jnp.float32)
+    return jnp.sum(per_seg * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def _reject_flash(cfg) -> None:
+    if cfg.attention == "flash":
         # The Pallas flash kernel is forward-only (no custom_vjp);
-        # jax.grad through it fails deep inside tracing.  Fail here —
-        # the shared altitude, so BOTH factories reject it — with the
-        # fix: train dense, serve flash (same params tree).
+        # jax.grad through it fails deep inside tracing.  Fail at the
+        # factory — the shared altitude, so EVERY train factory rejects
+        # it — with the fix: train dense, serve flash (same params tree).
         raise ValueError(
             "attention='flash' is inference-only (the Pallas kernel "
             "defines no backward pass) — fine-tune with "
             "attention='dense' and switch the config for serving"
         )
 
-    def step_fn(state: TrainState, batch: Batch) -> Tuple[TrainState, Dict]:
-        loss, grads = jax.value_and_grad(lambda p: _loss_fn(model, p, batch))(
+
+def _update_step(tx, loss_fn):
+    """Generic ``(state, batch) → (state, metrics)`` update around a
+    ``loss_fn(params, batch)``."""
+
+    def step_fn(state: TrainState, batch) -> Tuple[TrainState, Dict]:
+        loss, grads = jax.value_and_grad(lambda p: loss_fn(p, batch))(
             state.params
         )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
@@ -69,6 +104,28 @@ def _step_body(model: SentimentEncoder, tx: optax.GradientTransformation):
         )
 
     return step_fn
+
+
+def _step_body(model: SentimentEncoder, tx: optax.GradientTransformation):
+    """The unjitted update: shared by the plain and sharded factories."""
+    _reject_flash(model.cfg)
+    return _update_step(tx, lambda p, b: _loss_fn(model, p, b))
+
+
+def _packed_step_body(cfg, tx: optax.GradientTransformation):
+    """Unjitted packed update (packed twin of :func:`_step_body`)."""
+    from svoc_tpu.models.packing import PackedSentimentEncoder
+
+    _reject_flash(cfg)
+    packed_model = PackedSentimentEncoder(cfg)
+    return _update_step(tx, lambda p, b: _packed_loss_fn(packed_model, p, b))
+
+
+def make_packed_train_step(cfg, tx: optax.GradientTransformation):
+    """Single-device packed fine-tune step: several comments per row,
+    same parameter tree as the unpacked model, loss averaged over valid
+    segments (= the unpacked batch-mean over the same comments)."""
+    return jax.jit(_packed_step_body(cfg, tx))
 
 
 def make_train_step(model: SentimentEncoder, tx: optax.GradientTransformation):
@@ -99,43 +156,87 @@ def make_sharded_train_step(
     - ``batch_sharding`` — NamedSharding for incoming batches.
     """
     p_shard = param_shardings(params_template, mesh, model_axis=model_axis)
-
     scalar = NamedSharding(mesh, P())
     batch_sharding = Batch(
         ids=NamedSharding(mesh, P(data_axis, None)),
         mask=NamedSharding(mesh, P(data_axis, None)),
         labels=NamedSharding(mesh, P(data_axis)),
     )
-
-    def _opt_state_shardings():
-        """Optimizer moments mirror the param tree as subtrees (adam's
-        ``mu``/``nu``), so match opt-state leaves to param shardings by
-        tree-path *suffix*; anything else (step counts…) replicates.
-        ``eval_shape`` keeps this allocation-free."""
-        by_path = {}
-        for path, s in jax.tree_util.tree_flatten_with_path(p_shard)[0]:
-            by_path[tuple(str(k) for k in path)] = s
-
-        def for_leaf(path, leaf):
-            keys = tuple(str(k) for k in path)
-            for start in range(len(keys)):
-                hit = by_path.get(keys[start:])
-                if hit is not None:
-                    return hit
-            return scalar
-
-        opt_shapes = jax.eval_shape(tx.init, params_template)
-        flat, treedef = jax.tree_util.tree_flatten_with_path(opt_shapes)
-        return jax.tree_util.tree_unflatten(
-            treedef, [for_leaf(p, l) for p, l in flat]
-        )
-
     state_shardings = TrainState(
-        step=scalar, params=p_shard, opt_state=_opt_state_shardings()
+        step=scalar,
+        params=p_shard,
+        opt_state=_opt_state_shardings(p_shard, scalar, tx, params_template),
     )
 
     train_step = jax.jit(
         _step_body(model, tx),
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings=(state_shardings, scalar),
+    )
+
+    def shard_state(state: TrainState) -> TrainState:
+        return jax.device_put(state, state_shardings)
+
+    return train_step, shard_state, batch_sharding
+
+
+def _opt_state_shardings(p_shard, scalar, tx, params_template):
+    """Optimizer moments mirror the param tree as subtrees (adam's
+    ``mu``/``nu``), so match opt-state leaves to param shardings by
+    tree-path *suffix*; anything else (step counts…) replicates.
+    ``eval_shape`` keeps this allocation-free."""
+    by_path = {}
+    for path, s in jax.tree_util.tree_flatten_with_path(p_shard)[0]:
+        by_path[tuple(str(k) for k in path)] = s
+
+    def for_leaf(path, leaf):
+        keys = tuple(str(k) for k in path)
+        for start in range(len(keys)):
+            hit = by_path.get(keys[start:])
+            if hit is not None:
+                return hit
+        return scalar
+
+    opt_shapes = jax.eval_shape(tx.init, params_template)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(opt_shapes)
+    return jax.tree_util.tree_unflatten(
+        treedef, [for_leaf(p, l) for p, l in flat]
+    )
+
+
+def make_sharded_packed_train_step(
+    cfg,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    params_template: Any,
+    data_axis: str = "data",
+    model_axis: str = "model",
+):
+    """GSPMD packed fine-tune step (packed twin of
+    :func:`make_sharded_train_step`): rows shard over ``data_axis``,
+    params follow the Megatron layout over ``model_axis`` — the packed
+    module's parameter tree is identical, so the same
+    :func:`param_shardings` apply."""
+    p_shard = param_shardings(params_template, mesh, model_axis=model_axis)
+    scalar = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P(data_axis, None))
+    batch_sharding = PackedTrainBatch(
+        ids=row,
+        pos=row,
+        seg=row,
+        cls_pos=row,
+        seg_valid=row,
+        labels=NamedSharding(mesh, P(data_axis)),
+    )
+    state_shardings = TrainState(
+        step=scalar,
+        params=p_shard,
+        opt_state=_opt_state_shardings(p_shard, scalar, tx, params_template),
+    )
+
+    train_step = jax.jit(
+        _packed_step_body(cfg, tx),
         in_shardings=(state_shardings, batch_sharding),
         out_shardings=(state_shardings, scalar),
     )

@@ -87,3 +87,147 @@ def test_trainer_rejects_flash_attention():
         make_sharded_train_step(
             model, optax.adamw(1e-4), mesh, params_template=params
         )
+
+
+def _packed_pair(n_texts=12, seq=24, seed=5):
+    """Matching (unpacked Batch, PackedTrainBatch) over the same texts
+    and labels."""
+    from svoc_tpu.models.packing import pack_labels, pack_tokens, strip_padding
+    from svoc_tpu.models.tokenizer import HashingTokenizer
+    from svoc_tpu.train.trainer import Batch, PackedTrainBatch
+
+    cfg = TINY_TEST
+    tok = HashingTokenizer(cfg.vocab_size, pad_id=cfg.pad_id, max_len=seq)
+    rng = np.random.default_rng(seed)
+    texts = [
+        " ".join(rng.choice(["aa", "bb", "cc", "dd"], size=int(rng.integers(2, 8))))
+        for _ in range(n_texts)
+    ]
+    ids, mask = tok(texts, seq)
+    labels = (rng.random((n_texts, cfg.n_labels)) < 0.3).astype(np.float32)
+    batch = Batch(
+        ids=jnp.asarray(ids), mask=jnp.asarray(mask), labels=jnp.asarray(labels)
+    )
+    pk, n = pack_tokens(strip_padding(ids, mask), seq, 4, pad_id=cfg.pad_id)
+    assert n == n_texts
+    packed = PackedTrainBatch(
+        ids=jnp.asarray(pk.ids),
+        pos=jnp.asarray(pk.pos),
+        seg=jnp.asarray(pk.seg),
+        cls_pos=jnp.asarray(pk.cls_pos),
+        seg_valid=jnp.asarray(pk.seg_valid),
+        labels=jnp.asarray(pack_labels(pk, labels)),
+    )
+    return cfg, batch, packed
+
+
+def test_packed_train_step_matches_unpacked():
+    """A packed update must equal an unpacked update on the same
+    comments+labels: the masked segment-mean loss IS the batch mean.
+
+    Gradients are compared directly, and the optimizer step uses SGD
+    (linear in the gradient) — one-step Adam equality is ill-
+    conditioned: coordinates whose true gradient is ~0 get float-noise
+    signs that Adam amplifies to ±lr."""
+    from svoc_tpu.models.packing import PackedSentimentEncoder
+    from svoc_tpu.train.trainer import (
+        _loss_fn,
+        _packed_loss_fn,
+        make_packed_train_step,
+    )
+
+    cfg, batch, packed = _packed_pair()
+    model = SentimentEncoder(cfg)
+    params = init_params(model, seed=0)
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: _loss_fn(model, p, batch)
+    )(params)
+    loss, grads = jax.value_and_grad(
+        lambda p: _packed_loss_fn(PackedSentimentEncoder(cfg), p, packed)
+    )(params)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(grads), jax.tree_util.tree_leaves(ref_grads)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+        )
+
+    tx = optax.sgd(0.1)
+    ref_state, _ = make_train_step(model, tx)(init_state(model, params, tx), batch)
+    state, _ = make_packed_train_step(cfg, tx)(
+        init_state(model, params, tx), packed
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(ref_state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_sharded_packed_train_step_matches_unsharded():
+    from svoc_tpu.train.trainer import (
+        make_packed_train_step,
+        make_sharded_packed_train_step,
+    )
+
+    cfg, batch, packed = _packed_pair(n_texts=16)
+    # pad rows to the 8-device mesh (repeat last row, zero validity)
+    rows = packed.ids.shape[0]
+    pad_to = -(-rows // 8) * 8
+    if pad_to != rows:
+        k = pad_to - rows
+
+        def padrow(a, zero=False):
+            tail = jnp.repeat(a[-1:], k, axis=0)
+            if zero:
+                tail = jnp.zeros_like(tail)
+            return jnp.concatenate([a, tail], axis=0)
+
+        from svoc_tpu.train.trainer import PackedTrainBatch
+
+        packed = PackedTrainBatch(
+            ids=padrow(packed.ids),
+            pos=padrow(packed.pos),
+            seg=padrow(packed.seg, zero=True),
+            cls_pos=padrow(packed.cls_pos, zero=True),
+            seg_valid=padrow(packed.seg_valid, zero=True),
+            labels=padrow(packed.labels, zero=True),
+        )
+    model = SentimentEncoder(cfg)
+    params = init_params(model, seed=0)
+    # SGD: linear in the gradient, so sharded-reduction float noise
+    # stays at float scale instead of being amplified to ±lr by Adam.
+    tx = optax.sgd(0.1)
+
+    ref_state, ref_metrics = make_packed_train_step(cfg, tx)(
+        init_state(model, params, tx), packed
+    )
+    mesh = make_mesh(MeshSpec(("data", "model"), (4, 2)))
+    step, shard_state, bshard = make_sharded_packed_train_step(
+        cfg, tx, mesh, params_template=params
+    )
+    sbatch = jax.device_put(packed, bshard)
+    state, metrics = step(shard_state(init_state(model, params, tx)), sbatch)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(ref_metrics["loss"]), rtol=2e-4
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(ref_state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_packed_trainer_rejects_flash():
+    import dataclasses
+
+    import pytest
+
+    from svoc_tpu.train.trainer import make_packed_train_step
+
+    with pytest.raises(ValueError, match="inference-only"):
+        make_packed_train_step(
+            dataclasses.replace(TINY_TEST, attention="flash"), optax.adamw(1e-4)
+        )
