@@ -41,9 +41,10 @@ class EncoderConfig:
     #: timings on v5e (FLASH_PROBE.json): flash wins from T=512
     #: (1.16×) and dominates long context (49× at T=8192, where the
     #: dense [B,H,T,T] HBM blowup bites); at the classifier's T=128
-    #: dense is ~8% faster, so it stays the default.  Flash is
-    #: INFERENCE-ONLY (no backward pass) — the trainer rejects it; the
-    #: params tree is impl-independent, so train dense / serve flash.
+    #: dense is ~8% faster, so it stays the default.  Flash trains too
+    #: (FlashAttention-2 custom VJP, gradient-parity-tested vs dense);
+    #: only the ring/lse composition and packed batches require dense.
+    #: The params tree is impl-independent — train/serve with either.
     attention: str = "dense"
 
     @property

@@ -1,4 +1,4 @@
-"""Flash attention forward as a Pallas TPU kernel.
+"""Flash attention (forward + FlashAttention-2 backward) as Pallas TPU kernels.
 
 The encoder's attention (:class:`svoc_tpu.models.encoder.SelfAttention`)
 materializes [B, H, T, T] score tensors in HBM; this kernel never does —
@@ -23,6 +23,14 @@ on the tunneled backend (``FLASH_PROBE.json`` ``flash_compile_s``); the
 round-2 hang diagnosis was wrong (its ``block_until_ready`` timings
 never waited for execution).  Honest amortized timings live in
 ``FLASH_PROBE.json`` (``tools/flash_probe.py``).
+
+The default (``return_lse=False``) path is DIFFERENTIABLE: a
+``jax.custom_vjp`` implements the FlashAttention-2 backward — ``delta =
+rowsum(dO·O)`` in XLA, then two kernels recomputing the softmax from
+the saved per-row log-sum-exp (dq walks k blocks; dk/dv walks q
+blocks), so the backward is also O(block²) memory.  Gradients match
+the dense reference to float epsilon (``tests/test_pallas_attention.py``).
+The ``return_lse=True`` path (ring composition) stays inference-only.
 
 Non-TPU backends run in interpreter mode (tests); use
 :func:`flash_attention` which picks automatically.
@@ -110,6 +118,269 @@ def _flash_kernel(
             lse_ref[0, 0] = lse[:, 0]
 
 
+# --------------------------------------------------------------------------
+# Backward pass (FlashAttention-2 style): delta = rowsum(dO·O) in XLA,
+# then two kernels — dq (grid walks k blocks per q block) and dk/dv
+# (grid walks q blocks per k block).  p is recomputed from the saved
+# per-row log-sum-exp, so nothing [T, T]-shaped ever hits HBM.
+# --------------------------------------------------------------------------
+
+
+def _p_block(q_blk, k_blk, kmask, lse_row, *, scale):
+    """Recomputed softmax block ``p [bq, bk]`` from saved lse.
+
+    ``lse = -inf`` marks a fully-masked query row (forward emits 0);
+    ``exp(s - (-inf))`` would be ``inf``, so those rows are zeroed —
+    matching the forward convention that dead rows are constant 0 (zero
+    gradient)."""
+    s = scale * jax.lax.dot_general(
+        q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [bq, bk]
+    p = jnp.exp(s - lse_row[:, None])
+    p = jnp.where(kmask[None, :] > 0, p, 0.0)
+    return jnp.where(jnp.isfinite(lse_row)[:, None], p, 0.0)
+
+
+def _flash_dq_kernel(
+    q_ref,  # [1, bq, D]  resident across k steps
+    k_ref,  # [1, bk, D]  streamed
+    v_ref,  # [1, bk, D]  streamed
+    mask_ref,  # [1, 1, bk]
+    do_ref,  # [1, bq, D]
+    lse_ref,  # [1, 1, bq]
+    delta_ref,  # [1, 1, bq]
+    dq_ref,  # [1, bq, D]  written on the last k step
+    acc_scr,  # VMEM [bq, D]
+    *,
+    scale: float,
+    n_k: int,
+):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr[...])
+
+    q = q_ref[0].astype(jnp.float32)
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse_row = lse_ref[0, 0]
+    delta_row = delta_ref[0, 0]
+
+    p = _p_block(q, k_blk, mask_ref[0, 0], lse_row, scale=scale)
+    dp = jax.lax.dot_general(
+        do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [bq, bk]
+    ds = p * (dp - delta_row[:, None])
+    acc_scr[...] += jax.lax.dot_general(
+        ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        dq_ref[0] = (acc_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(
+    k_ref,  # [1, bk, D]  resident across q steps
+    v_ref,  # [1, bk, D]
+    mask_ref,  # [1, 1, bk]
+    q_ref,  # [1, bq, D]  streamed
+    do_ref,  # [1, bq, D]  streamed
+    lse_ref,  # [1, 1, bq]
+    delta_ref,  # [1, 1, bq]
+    dk_ref,  # [1, bk, D]  written on the last q step
+    dv_ref,  # [1, bk, D]
+    dk_scr,  # VMEM [bk, D]
+    dv_scr,  # VMEM [bk, D]
+    *,
+    scale: float,
+    n_q: int,
+):
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr[...])
+        dv_scr[...] = jnp.zeros_like(dv_scr[...])
+
+    q = q_ref[0].astype(jnp.float32)
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse_row = lse_ref[0, 0]
+    delta_row = delta_ref[0, 0]
+
+    p = _p_block(q, k_blk, mask_ref[0, 0], lse_row, scale=scale)  # [bq, bk]
+    dv_scr[...] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [bk, D]
+    dp = jax.lax.dot_general(
+        do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta_row[:, None])  # [bq, bk]
+    dk_scr[...] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [bk, D]
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_core(qf, kf, vf, maskf, *, block_q, block_k, d, interpret, with_lse):
+    """The forward pallas_call over pre-flattened ``[B·H, T, D]``."""
+    bh, t, _ = qf.shape
+    n_k = t // block_k
+    kernel = functools.partial(
+        _flash_kernel, scale=1.0 / (d**0.5), n_k=n_k, with_lse=with_lse
+    )
+    out_specs = pl.BlockSpec(
+        (1, block_q, d), lambda b, qi, ki: (b, qi, 0), memory_space=pltpu.VMEM
+    )
+    out_shape = jax.ShapeDtypeStruct((bh, t, d), qf.dtype)
+    if with_lse:
+        out_specs = (
+            out_specs,
+            pl.BlockSpec(
+                (1, 1, block_q),
+                lambda b, qi, ki: (b, 0, qi),
+                memory_space=pltpu.VMEM,
+            ),
+        )
+        out_shape = (out_shape, jax.ShapeDtypeStruct((bh, 1, t), jnp.float32))
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, t // block_q, n_k),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_q, d), lambda b, qi, ki: (b, qi, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda b, qi, ki: (b, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, block_k, d), lambda b, qi, ki: (b, ki, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k), lambda b, qi, ki: (b, 0, ki),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, maskf)
+
+
+def _flash_grads(qf, kf, vf, maskf, dof, lsef, deltaf, *, block_q, block_k, d, interpret):
+    """Backward pallas_calls over pre-flattened arrays → (dqf, dkf, dvf)."""
+    bh, t, _ = qf.shape
+    scale = 1.0 / (d**0.5)
+    n_q, n_k = t // block_q, t // block_k
+
+    q_at_qi = pl.BlockSpec(
+        (1, block_q, d), lambda b, qi, ki: (b, qi, 0), memory_space=pltpu.VMEM
+    )
+    k_at_ki = pl.BlockSpec(
+        (1, block_k, d), lambda b, qi, ki: (b, ki, 0), memory_space=pltpu.VMEM
+    )
+    mask_at_ki = pl.BlockSpec(
+        (1, 1, block_k), lambda b, qi, ki: (b, 0, ki), memory_space=pltpu.VMEM
+    )
+    row_at_qi = pl.BlockSpec(
+        (1, 1, block_q), lambda b, qi, ki: (b, 0, qi), memory_space=pltpu.VMEM
+    )
+    dqf = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, n_k=n_k),
+        grid=(bh, n_q, n_k),
+        in_specs=[q_at_qi, k_at_ki, k_at_ki, mask_at_ki, q_at_qi, row_at_qi, row_at_qi],
+        out_specs=q_at_qi,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, maskf, dof, lsef, deltaf)
+
+    # dk/dv grid: k blocks outer, q blocks inner (scratch carries over qi).
+    k_outer = pl.BlockSpec(
+        (1, block_k, d), lambda b, ki, qi: (b, ki, 0), memory_space=pltpu.VMEM
+    )
+    mask_outer = pl.BlockSpec(
+        (1, 1, block_k), lambda b, ki, qi: (b, 0, ki), memory_space=pltpu.VMEM
+    )
+    q_inner = pl.BlockSpec(
+        (1, block_q, d), lambda b, ki, qi: (b, qi, 0), memory_space=pltpu.VMEM
+    )
+    row_inner = pl.BlockSpec(
+        (1, 1, block_q), lambda b, ki, qi: (b, 0, qi), memory_space=pltpu.VMEM
+    )
+    dkf, dvf = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale, n_q=n_q),
+        grid=(bh, n_k, n_q),
+        in_specs=[k_outer, k_outer, mask_outer, q_inner, q_inner, row_inner, row_inner],
+        out_specs=(k_outer, k_outer),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), kf.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), vf.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kf, vf, maskf, qf, dof, lsef, deltaf)
+    return dqf, dkf, dvf
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_diff(qf, kf, vf, maskf, block_q, block_k, d, interpret):
+    """Differentiable flattened flash attention (custom VJP)."""
+    return _flash_core(
+        qf, kf, vf, maskf,
+        block_q=block_q, block_k=block_k, d=d,
+        interpret=interpret, with_lse=False,
+    )
+
+
+def _flash_diff_fwd(qf, kf, vf, maskf, block_q, block_k, d, interpret):
+    out, lse = _flash_core(
+        qf, kf, vf, maskf,
+        block_q=block_q, block_k=block_k, d=d,
+        interpret=interpret, with_lse=True,
+    )
+    return out, (qf, kf, vf, maskf, out, lse)
+
+
+def _flash_diff_bwd(block_q, block_k, d, interpret, res, dout):
+    import numpy as np
+
+    qf, kf, vf, maskf, out, lse = res
+    # delta = rowsum(dO · O) per query row — cheap elementwise in XLA.
+    delta = jnp.sum(
+        dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )[:, None, :]  # [B·H, 1, T]
+    dqf, dkf, dvf = _flash_grads(
+        qf, kf, vf, maskf, dout, lse, delta,
+        block_q=block_q, block_k=block_k, d=d, interpret=interpret,
+    )
+    # kmask is integer-valued: its tangent space is float0.
+    dmask = np.zeros(maskf.shape, jax.dtypes.float0)
+    return dqf, dkf, dvf, dmask
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
 @functools.partial(
     jax.jit, static_argnames=("block_q", "block_k", "interpret", "return_lse")
 )
@@ -161,67 +432,17 @@ def flash_attention(
     # trailing dims TPU-tileable ((1, bk) blocks are rejected by Mosaic).
     maskf = jnp.repeat(kmask, h, axis=0)[:, None, :]
 
-    n_k = t // block_k
-    kernel = functools.partial(
-        _flash_kernel, scale=1.0 / (d**0.5), n_k=n_k, with_lse=return_lse
-    )
-    out_specs = pl.BlockSpec(
-        (1, block_q, d),
-        lambda bh, qi, ki: (bh, qi, 0),
-        memory_space=pltpu.VMEM,
-    )
-    out_shape = jax.ShapeDtypeStruct((b * h, t, d), q.dtype)
-    if return_lse:
-        out_specs = (
-            out_specs,
-            pl.BlockSpec(
-                (1, 1, block_q),
-                lambda bh, qi, ki: (bh, 0, qi),
-                memory_space=pltpu.VMEM,
-            ),
-        )
-        out_shape = (
-            out_shape,
-            jax.ShapeDtypeStruct((b * h, 1, t), jnp.float32),
-        )
-    result = pl.pallas_call(
-        kernel,
-        grid=(b * h, t // block_q, n_k),
-        in_specs=[
-            pl.BlockSpec(
-                (1, block_q, d),
-                lambda bh, qi, ki: (bh, qi, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, block_k, d),
-                lambda bh, qi, ki: (bh, ki, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, block_k, d),
-                lambda bh, qi, ki: (bh, ki, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k),
-                lambda bh, qi, ki: (bh, 0, ki),
-                memory_space=pltpu.VMEM,
-            ),
-        ],
-        out_specs=out_specs,
-        out_shape=out_shape,
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
-        interpret=interpret,
-    )(qf, kf, vf, maskf)
-
     if not return_lse:
-        return jnp.transpose(result.reshape(b, h, t, d), (0, 2, 1, 3))
-    out, lse = result
+        # Differentiable path (custom VJP — FlashAttention-2 backward):
+        # the fwd rule re-runs the kernel with lse saved as a residual.
+        out = _flash_diff(qf, kf, vf, maskf, block_q, block_k, d, interpret)
+        return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))
+    # lse path (ring composition) — inference-only.
+    out, lse = _flash_core(
+        qf, kf, vf, maskf,
+        block_q=block_q, block_k=block_k, d=d,
+        interpret=interpret, with_lse=True,
+    )
     out = jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))
     lse = jnp.transpose(lse.reshape(b, h, t), (0, 2, 1))  # [B, T, H]
     return out, lse

@@ -75,16 +75,15 @@ def _packed_loss_fn(packed_model, params, batch: PackedTrainBatch) -> jnp.ndarra
     return jnp.sum(per_seg * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
-def _reject_flash(cfg) -> None:
-    if cfg.attention == "flash":
-        # The Pallas flash kernel is forward-only (no custom_vjp);
-        # jax.grad through it fails deep inside tracing.  Fail at the
-        # factory — the shared altitude, so EVERY train factory rejects
-        # it — with the fix: train dense, serve flash (same params tree).
+def _reject_non_dense_packed(cfg) -> None:
+    if cfg.attention != "dense":
+        # Early, factory-level version of PackedSentimentEncoder's own
+        # trace-time check: packed batches need block-diagonal masking
+        # the flash kernel's per-key mask cannot express.
         raise ValueError(
-            "attention='flash' is inference-only (the Pallas kernel "
-            "defines no backward pass) — fine-tune with "
-            "attention='dense' and switch the config for serving"
+            "packed fine-tuning needs cfg.attention == 'dense' — the "
+            "flash kernel's per-key mask cannot express block-diagonal "
+            f"segments (got {cfg.attention!r})"
         )
 
 
@@ -107,8 +106,11 @@ def _update_step(tx, loss_fn):
 
 
 def _step_body(model: SentimentEncoder, tx: optax.GradientTransformation):
-    """The unjitted update: shared by the plain and sharded factories."""
-    _reject_flash(model.cfg)
+    """The unjitted update: shared by the plain and sharded factories.
+
+    ``attention='flash'`` trains too — the Pallas kernel defines a
+    FlashAttention-2 custom VJP (``svoc_tpu/ops/pallas_attention.py``),
+    gradient-parity-tested against dense in ``tests/test_train.py``."""
     return _update_step(tx, lambda p, b: _loss_fn(model, p, b))
 
 
@@ -116,7 +118,7 @@ def _packed_step_body(cfg, tx: optax.GradientTransformation):
     """Unjitted packed update (packed twin of :func:`_step_body`)."""
     from svoc_tpu.models.packing import PackedSentimentEncoder
 
-    _reject_flash(cfg)
+    _reject_non_dense_packed(cfg)
     packed_model = PackedSentimentEncoder(cfg)
     return _update_step(tx, lambda p, b: _packed_loss_fn(packed_model, p, b))
 
@@ -154,30 +156,27 @@ def make_sharded_train_step(
       shardings (params tensor-parallel, batch data-parallel),
     - ``shard_state(state)`` — device_put a host state onto the mesh,
     - ``batch_sharding`` — NamedSharding for incoming batches.
+
+    Requires ``attention='dense'``: ``pallas_call`` has no SPMD
+    partitioning rule, so the flash VJP under GSPMD shardings is
+    unvalidated (the probe hangs on the virtual mesh) — single-device
+    flash training (:func:`make_train_step`) is the supported path.
     """
-    p_shard = param_shardings(params_template, mesh, model_axis=model_axis)
-    scalar = NamedSharding(mesh, P())
+    if model.cfg.attention == "flash":
+        raise ValueError(
+            "sharded training needs attention='dense' — pallas_call has "
+            "no SPMD partitioning rule for the flash VJP; train flash "
+            "single-device (make_train_step) or use dense here"
+        )
     batch_sharding = Batch(
         ids=NamedSharding(mesh, P(data_axis, None)),
         mask=NamedSharding(mesh, P(data_axis, None)),
         labels=NamedSharding(mesh, P(data_axis)),
     )
-    state_shardings = TrainState(
-        step=scalar,
-        params=p_shard,
-        opt_state=_opt_state_shardings(p_shard, scalar, tx, params_template),
+    return _sharded_factory(
+        _step_body(model, tx), batch_sharding, tx, mesh,
+        params_template=params_template, model_axis=model_axis,
     )
-
-    train_step = jax.jit(
-        _step_body(model, tx),
-        in_shardings=(state_shardings, batch_sharding),
-        out_shardings=(state_shardings, scalar),
-    )
-
-    def shard_state(state: TrainState) -> TrainState:
-        return jax.device_put(state, state_shardings)
-
-    return train_step, shard_state, batch_sharding
 
 
 def _opt_state_shardings(p_shard, scalar, tx, params_template):
@@ -204,6 +203,37 @@ def _opt_state_shardings(p_shard, scalar, tx, params_template):
     )
 
 
+def _sharded_factory(
+    step_body,
+    batch_sharding,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    params_template: Any,
+    model_axis: str = "model",
+):
+    """Shared GSPMD wiring: jit ``step_body`` with tensor-parallel
+    params, suffix-matched optimizer-state shardings, and the given
+    batch shardings."""
+    p_shard = param_shardings(params_template, mesh, model_axis=model_axis)
+    scalar = NamedSharding(mesh, P())
+    state_shardings = TrainState(
+        step=scalar,
+        params=p_shard,
+        opt_state=_opt_state_shardings(p_shard, scalar, tx, params_template),
+    )
+    train_step = jax.jit(
+        step_body,
+        in_shardings=(state_shardings, batch_sharding),
+        out_shardings=(state_shardings, scalar),
+    )
+
+    def shard_state(state: TrainState) -> TrainState:
+        return jax.device_put(state, state_shardings)
+
+    return train_step, shard_state, batch_sharding
+
+
 def make_sharded_packed_train_step(
     cfg,
     tx: optax.GradientTransformation,
@@ -218,8 +248,6 @@ def make_sharded_packed_train_step(
     params follow the Megatron layout over ``model_axis`` — the packed
     module's parameter tree is identical, so the same
     :func:`param_shardings` apply."""
-    p_shard = param_shardings(params_template, mesh, model_axis=model_axis)
-    scalar = NamedSharding(mesh, P())
     row = NamedSharding(mesh, P(data_axis, None))
     batch_sharding = PackedTrainBatch(
         ids=row,
@@ -229,19 +257,7 @@ def make_sharded_packed_train_step(
         seg_valid=row,
         labels=NamedSharding(mesh, P(data_axis)),
     )
-    state_shardings = TrainState(
-        step=scalar,
-        params=p_shard,
-        opt_state=_opt_state_shardings(p_shard, scalar, tx, params_template),
+    return _sharded_factory(
+        _packed_step_body(cfg, tx), batch_sharding, tx, mesh,
+        params_template=params_template, model_axis=model_axis,
     )
-
-    train_step = jax.jit(
-        _packed_step_body(cfg, tx),
-        in_shardings=(state_shardings, batch_sharding),
-        out_shardings=(state_shardings, scalar),
-    )
-
-    def shard_state(state: TrainState) -> TrainState:
-        return jax.device_put(state, state_shardings)
-
-    return train_step, shard_state, batch_sharding

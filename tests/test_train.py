@@ -70,22 +70,41 @@ def test_sharded_train_step_matches_unsharded():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
-def test_trainer_rejects_flash_attention():
-    """The flash kernel is forward-only; BOTH trainer factories must
-    fail with an actionable message instead of a deep tracing error."""
+def test_flash_train_step_matches_dense():
+    """attention='flash' now trains (FlashAttention-2 custom VJP):
+    gradients through the flash encoder must match the dense encoder's
+    to float tolerance on the same batch."""
     import dataclasses
 
-    import pytest
+    from svoc_tpu.train.trainer import _loss_fn
 
-    cfg = dataclasses.replace(TINY_TEST, attention="flash")
-    model = SentimentEncoder(cfg)
-    params = init_params(model, seed=0)
-    with pytest.raises(ValueError, match="inference-only"):
-        make_train_step(model, optax.adamw(1e-4))
-    mesh = make_mesh(MeshSpec(("data", "model"), (4, 2)))
-    with pytest.raises(ValueError, match="inference-only"):
-        make_sharded_train_step(
-            model, optax.adamw(1e-4), mesh, params_template=params
+    dense_cfg = dataclasses.replace(TINY_TEST, max_len=32)
+    flash_cfg = dataclasses.replace(dense_cfg, attention="flash")
+    dense_model = SentimentEncoder(dense_cfg)
+    flash_model = SentimentEncoder(flash_cfg)
+    params = init_params(dense_model, seed=0)
+
+    rng = np.random.default_rng(2)
+    b, t = 4, 16
+    ids = jnp.asarray(rng.integers(4, 1000, (b, t)), jnp.int32)
+    mask = jnp.asarray((np.arange(t)[None, :] < rng.integers(6, t + 1, (b, 1))), jnp.int32)
+    labels = jnp.asarray((rng.random((b, dense_cfg.n_labels)) < 0.3), jnp.float32)
+    from svoc_tpu.train.trainer import Batch
+
+    batch = Batch(ids=ids, mask=mask, labels=labels)
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: _loss_fn(dense_model, p, batch)
+    )(params)
+    loss, grads = jax.value_and_grad(
+        lambda p: _loss_fn(flash_model, p, batch)
+    )(params)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for a, b_ in zip(
+        jax.tree_util.tree_leaves(grads), jax.tree_util.tree_leaves(ref_grads)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=2e-3, atol=2e-5
         )
 
 
@@ -227,7 +246,25 @@ def test_packed_trainer_rejects_flash():
 
     from svoc_tpu.train.trainer import make_packed_train_step
 
-    with pytest.raises(ValueError, match="inference-only"):
+    with pytest.raises(ValueError, match="dense"):
         make_packed_train_step(
             dataclasses.replace(TINY_TEST, attention="flash"), optax.adamw(1e-4)
+        )
+
+
+def test_sharded_trainer_rejects_flash():
+    """pallas_call has no SPMD partitioning rule — the sharded factory
+    must reject flash with an actionable message (single-device flash
+    training is the supported path)."""
+    import dataclasses
+
+    import pytest
+
+    cfg = dataclasses.replace(TINY_TEST, attention="flash")
+    model = SentimentEncoder(cfg)
+    params = init_params(model, seed=0)
+    mesh = make_mesh(MeshSpec(("data", "model"), (4, 2)))
+    with pytest.raises(ValueError, match="single-device"):
+        make_sharded_train_step(
+            model, optax.adamw(1e-4), mesh, params_template=params
         )
