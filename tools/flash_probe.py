@@ -59,6 +59,38 @@ def main():
         entry["dense_ms"] = round(amortized_ms(lambda i: dense(qs[i % 4]), n=12), 3)
         entry["flash_ms"] = round(amortized_ms(lambda i: flash(qs[i % 4]), n=12), 3)
         entry["speedup"] = round(entry["dense_ms"] / entry["flash_ms"], 3)
+
+        # Backward (FlashAttention-2 custom VJP vs autodiff-of-dense):
+        # grad of sum(out) wrt q/k/v, dq summed as the fetch handle.
+        dense_grad = jax.jit(
+            jax.grad(lambda q: jnp.sum(
+                dense_attention_reference(q, q, q, mask).astype(jnp.float32)
+            ))
+        )
+        flash_grad = jax.jit(
+            jax.grad(lambda q: jnp.sum(
+                flash_attention(
+                    q, q, q, mask, block_q=256, block_k=256
+                ).astype(jnp.float32)
+            ))
+        )
+        t0 = time.perf_counter()
+        g_f = flash_grad(qs[0])
+        float(np.asarray(jnp.sum(g_f)))
+        entry["flash_bwd_compile_s"] = round(time.perf_counter() - t0, 2)
+        g_d = dense_grad(qs[0])
+        entry["bwd_max_abs_diff"] = float(
+            jnp.max(jnp.abs(g_f.astype(jnp.float32) - g_d.astype(jnp.float32)))
+        )
+        entry["dense_bwd_ms"] = round(
+            amortized_ms(lambda i: dense_grad(qs[i % 4]), n=12), 3
+        )
+        entry["flash_bwd_ms"] = round(
+            amortized_ms(lambda i: flash_grad(qs[i % 4]), n=12), 3
+        )
+        entry["bwd_speedup"] = round(
+            entry["dense_bwd_ms"] / entry["flash_bwd_ms"], 3
+        )
         results.append(entry)
         print(json.dumps(entry), flush=True)
 
