@@ -21,6 +21,7 @@ padding masks travel around the ring with their K/V blocks.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import jax
@@ -30,6 +31,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from svoc_tpu.parallel.sharded import shard_map
 
 NEG_INF = -1e30
+
+
+def _ring_protocol(axis_name, n_dev, rotating, carry, update):
+    """THE ring rotation driver — the single place the permutation
+    lives, so forward, forward-with-stats, and the two-pass backward can
+    never diverge.  ``update(rotating, carry) → (rotating, carry)`` is
+    applied to the local blocks first, then after each of the
+    ``n_dev − 1`` rotations of every array in ``rotating`` (a pytree;
+    the backward rotates its dk/dv accumulators alongside the K/V
+    blocks by returning them updated from ``update``)."""
+    rotating, carry = update(rotating, carry)
+
+    def step(i, state):
+        rot, c = state
+        rot = ring_rotate(rot, axis_name, n_dev)
+        return update(rot, c)
+
+    return jax.lax.fori_loop(0, n_dev - 1, step, (rotating, carry))
+
+
+def ring_rotate(tree, axis_name, n_dev):
+    """One forward rotation (shard s → s+1) of every array in ``tree``."""
+    perm = [(s, (s + 1) % n_dev) for s in range(n_dev)]
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.ppermute(a, axis_name, perm), tree
+    )
 
 
 def _block_attn(q, k, v, kmask, scale):
@@ -80,21 +107,16 @@ def ring_attention(
     scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(d))
 
     def run_ring(accumulate, carry0):
-        """The ring protocol: local block first, then n_dev−1 rotations
-        of K/V (+ padding mask) — no discarded final hop.  One driver
-        for every block_impl so the rotation can never diverge."""
-        carry = accumulate(k, v, kmask, carry0)
-
-        def step(i, state):
-            k_blk, v_blk, mask_blk, carry = state
-            perm = [(s, (s + 1) % n_dev) for s in range(n_dev)]
-            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-            mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
-            return (k_blk, v_blk, mask_blk, accumulate(k_blk, v_blk, mask_blk, carry))
-
-        state = jax.lax.fori_loop(0, n_dev - 1, step, (k, v, kmask, carry))
-        return state[3]
+        """Forward-style ring over ``(k, v, kmask)``: rotating state is
+        read-only, only the carry accumulates."""
+        _, carry = _ring_protocol(
+            axis_name,
+            n_dev,
+            (k, v, kmask),
+            carry0,
+            lambda rot, c: (rot, accumulate(*rot, c)),
+        )
+        return carry
 
     if block_impl == "flash":
         from svoc_tpu.ops.pallas_attention import flash_attention
@@ -127,31 +149,121 @@ def ring_attention(
         return o.astype(q.dtype)
     if block_impl != "dense":
         raise ValueError(f"unknown block_impl {block_impl!r}")
+    # Dense inner: the differentiable implementation (custom two-pass
+    # ring VJP — reverse-mode through the rotation loop itself would
+    # transpose every ppermute and blow up compile).
+    return _ring_dense_diff(q, k, v, kmask, axis_name)
 
-    def accumulate_dense(k_blk, v_blk, mask_blk, carry):
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _ring_dense_diff(q, k, v, kmask, axis_name):
+    """Differentiable dense-inner ring attention (two-pass backward)."""
+    out, _lse = _ring_dense_fwd_stats(q, k, v, kmask, axis_name)
+    return out
+
+
+def _ring_dense_fwd_stats(q, k, v, kmask, axis_name):
+    """Forward with per-row log-sum-exp kept: one ring pass reducing
+    (m, l, o); ``lse = m + log l``, −inf where every key is padding."""
+    n_dev = jax.lax.psum(1, axis_name)
+    b, t_local, h, d = q.shape
+    scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(d))
+
+    def update(rot, carry):
+        k_blk, v_blk, mask_blk = rot
         m, l, o = carry
         m_blk, p, pv = _block_attn(q, k_blk, v_blk, mask_blk, scale)
         m_new = jnp.maximum(m, m_blk)
         corr = jnp.exp(m - m_new)
         corr_blk = jnp.exp(m_blk - m_new)
         l = l * corr + jnp.sum(p, axis=-1) * corr_blk
-        # corr is [B,H,Tq] — broadcast onto the [B,Tq,H,D] accumulator.
         corr_o = jnp.transpose(corr, (0, 2, 1))[..., None]
         corr_pv = jnp.transpose(corr_blk, (0, 2, 1))[..., None]
         o = o * corr_o + pv.astype(jnp.float32) * corr_pv
-        return m_new, l, o
+        return rot, (m_new, l, o)
 
-    # Running stats: row max m, denominator l, numerator o.
-    m, l, o = run_ring(
-        accumulate_dense,
-        (
-            jnp.full((b, h, t_local), NEG_INF, jnp.float32),
-            jnp.zeros((b, h, t_local), jnp.float32),
-            jnp.zeros((b, t_local, h, d), jnp.float32),
-        ),
+    carry0 = (
+        jnp.full((b, h, t_local), NEG_INF, jnp.float32),
+        jnp.zeros((b, h, t_local), jnp.float32),
+        jnp.zeros((b, t_local, h, d), jnp.float32),
     )
-    l_t = jnp.transpose(l, (0, 2, 1))[..., None]  # [B,Tq,H,1]
-    return (o / jnp.maximum(l_t, 1e-30)).astype(q.dtype)
+    _, (m, l, o) = _ring_protocol(
+        axis_name, n_dev, (k, v, kmask), carry0, update
+    )
+    l_t = jnp.transpose(l, (0, 2, 1))[..., None]
+    out = (o / jnp.maximum(l_t, 1e-30)).astype(q.dtype)
+    dead = m <= NEG_INF / 2  # no real key anywhere in the ring
+    lse = jnp.where(dead, -jnp.inf, m + jnp.log(jnp.maximum(l, 1e-30)))
+    return out, lse
+
+
+def _ring_dense_diff_fwd(q, k, v, kmask, axis_name):
+    out, lse = _ring_dense_fwd_stats(q, k, v, kmask, axis_name)
+    return out, (q, k, v, kmask, out, lse)
+
+
+def _ring_dense_diff_bwd(axis_name, res, dout):
+    """Second ring pass: dk/dv accumulators TRAVEL with their rotating
+    K/V block (same permutation as the forward), so after the n_dev−1
+    processing hops one final rotation delivers them home."""
+    import numpy as np
+
+    q, k, v, kmask, out, lse = res
+    n_dev = jax.lax.psum(1, axis_name)
+    b, t_local, h, d = q.shape
+    scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(d))
+    dout_f = dout.astype(jnp.float32)
+    # delta = rowsum(dO · O) per query row, aligned [B, H, Tq].
+    delta = jnp.transpose(
+        jnp.sum(dout_f * out.astype(jnp.float32), axis=-1), (0, 2, 1)
+    )
+    qf = q.astype(jnp.float32)
+    finite = jnp.isfinite(lse)[..., None]  # [B, H, Tq, 1]
+
+    def contrib(k_blk, v_blk, mask_blk):
+        s = (
+            jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+            * scale
+        )
+        s = jnp.where(mask_blk[:, None, None, :] > 0, s, NEG_INF)
+        p = jnp.where(finite, jnp.exp(s - lse[..., None]), 0.0)
+        p = jnp.where(mask_blk[:, None, None, :] > 0, p, 0.0)  # exact zero
+        dp = jnp.einsum("bqhd,bkhd->bhqk", dout_f, v_blk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None])
+        dq_c = jnp.einsum("bhqk,bkhd->bqhd", ds, k_blk.astype(jnp.float32)) * scale
+        dk_c = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * scale
+        dv_c = jnp.einsum("bhqk,bqhd->bkhd", p, dout_f)
+        return dq_c, dk_c, dv_c
+
+    def update(rot, dq):
+        # The dk/dv accumulators live in `rot` so they rotate WITH their
+        # K/V block; each hop adds this device's contribution to them.
+        k_blk, v_blk, mask_blk, dk_acc, dv_acc = rot
+        dq_c, dk_c, dv_c = contrib(k_blk, v_blk, mask_blk)
+        rot = (k_blk, v_blk, mask_blk, dk_acc + dk_c, dv_acc + dv_c)
+        return rot, dq + dq_c
+
+    zeros_kd = jnp.zeros(k.shape, jnp.float32)
+    rot, dq = _ring_protocol(
+        axis_name,
+        n_dev,
+        (k, v, kmask, zeros_kd, zeros_kd),
+        jnp.zeros(q.shape, jnp.float32),
+        update,
+    )
+    _, _, _, dk_acc, dv_acc = rot
+    # Blocks sit one hop short of home after n_dev−1 rotations.
+    dk_home, dv_home = ring_rotate((dk_acc, dv_acc), axis_name, n_dev)
+    dmask = np.zeros(kmask.shape, jax.dtypes.float0)
+    return (
+        dq.astype(q.dtype),
+        dk_home.astype(k.dtype),
+        dv_home.astype(v.dtype),
+        dmask,
+    )
+
+
+_ring_dense_diff.defvjp(_ring_dense_diff_fwd, _ring_dense_diff_bwd)
 
 
 def ring_attention_fn(

@@ -7,7 +7,12 @@ tensor-parallel over a device mesh.
 """
 
 from svoc_tpu.train.trainer import (  # noqa: F401
+    Batch,
+    PackedTrainBatch,
     TrainState,
+    make_packed_train_step,
+    make_sharded_packed_train_step,
     make_sharded_train_step,
+    make_sp_train_step,
     make_train_step,
 )

@@ -46,14 +46,18 @@ class PackedTrainBatch(NamedTuple):
     labels: jnp.ndarray  # [R, S, n_labels] float (multi-hot) or [R, S] int
 
 
+def _per_example_loss(head: str, logits, labels) -> jnp.ndarray:
+    """Per-example loss, shared by every train-step flavor: multi-label
+    BCE summed over labels (sigmoid head, go_emotions) or integer
+    softmax CE.  Shape = ``logits.shape[:-1]``."""
+    if head == "sigmoid":
+        return jnp.sum(optax.sigmoid_binary_cross_entropy(logits, labels), axis=-1)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
 def _loss_fn(model: SentimentEncoder, params, batch: Batch) -> jnp.ndarray:
     logits = model.apply(params, batch.ids, batch.mask)
-    if model.cfg.head == "sigmoid":  # multi-label BCE (go_emotions)
-        losses = optax.sigmoid_binary_cross_entropy(logits, batch.labels)
-        return jnp.mean(jnp.sum(losses, axis=-1))
-    return jnp.mean(
-        optax.softmax_cross_entropy_with_integer_labels(logits, batch.labels)
-    )
+    return jnp.mean(_per_example_loss(model.cfg.head, logits, batch.labels))
 
 
 def _packed_loss_fn(packed_model, params, batch: PackedTrainBatch) -> jnp.ndarray:
@@ -63,14 +67,7 @@ def _packed_loss_fn(packed_model, params, batch: PackedTrainBatch) -> jnp.ndarra
     logits = packed_model.apply(
         params, batch.ids, batch.pos, batch.seg, batch.cls_pos
     )  # [R, S, L]
-    if packed_model.cfg.head == "sigmoid":
-        per_seg = jnp.sum(
-            optax.sigmoid_binary_cross_entropy(logits, batch.labels), axis=-1
-        )
-    else:
-        per_seg = optax.softmax_cross_entropy_with_integer_labels(
-            logits, batch.labels
-        )
+    per_seg = _per_example_loss(packed_model.cfg.head, logits, batch.labels)
     w = batch.seg_valid.astype(jnp.float32)
     return jnp.sum(per_seg * w) / jnp.maximum(jnp.sum(w), 1.0)
 
@@ -130,6 +127,35 @@ def make_packed_train_step(cfg, tx: optax.GradientTransformation):
     return jax.jit(_packed_step_body(cfg, tx))
 
 
+def make_sp_train_step(cfg, tx: optax.GradientTransformation, mesh, seq_axis="seq"):
+    """LONG-CONTEXT fine-tune step: the sequence-parallel encoder
+    forward (ring attention over ``seq_axis`` — T sharded, params
+    replicated) differentiated end to end.  Ring attention's backward
+    is a custom two-pass ring VJP (``svoc_tpu/parallel/ring_attention
+    .py``), so reverse mode never transposes the rotation loop;
+    gradients match the dense encoder to float tolerance
+    (``tests/test_train.py``).  Sequences longer than one device's
+    memory train by adding devices to ``seq_axis``."""
+    from svoc_tpu.parallel.sp_encoder import sequence_parallel_forward_fn
+
+    if cfg.attention != "dense":
+        # The SP encoder's ring passes block_impl=cfg.attention through;
+        # only the dense inner has the custom ring VJP — the flash-inner
+        # composition would reverse-differentiate the rotation loop.
+        raise ValueError(
+            "sequence-parallel training needs attention='dense' — the "
+            "ring VJP covers the dense inner only (the flash-inner "
+            f"composition is inference-only; got {cfg.attention!r})"
+        )
+    sp_fwd = sequence_parallel_forward_fn(mesh, cfg, seq_axis=seq_axis)
+
+    def loss_fn(params, batch: Batch) -> jnp.ndarray:
+        logits = sp_fwd(params, batch.ids, batch.mask)
+        return jnp.mean(_per_example_loss(cfg.head, logits, batch.labels))
+
+    return jax.jit(_update_step(tx, loss_fn))
+
+
 def make_train_step(model: SentimentEncoder, tx: optax.GradientTransformation):
     """Single-device/jit-only training step (no explicit shardings)."""
     return jax.jit(_step_body(model, tx))
@@ -157,17 +183,10 @@ def make_sharded_train_step(
     - ``shard_state(state)`` — device_put a host state onto the mesh,
     - ``batch_sharding`` — NamedSharding for incoming batches.
 
-    Requires ``attention='dense'``: ``pallas_call`` has no SPMD
-    partitioning rule, so the flash VJP under GSPMD shardings is
-    unvalidated (the probe hangs on the virtual mesh) — single-device
-    flash training (:func:`make_train_step`) is the supported path.
+    ``attention='flash'`` shards too: the flash VJP under GSPMD
+    data×model shardings matches the unsharded step to float epsilon on
+    the virtual mesh (``tests/test_train.py``).
     """
-    if model.cfg.attention == "flash":
-        raise ValueError(
-            "sharded training needs attention='dense' — pallas_call has "
-            "no SPMD partitioning rule for the flash VJP; train flash "
-            "single-device (make_train_step) or use dense here"
-        )
     batch_sharding = Batch(
         ids=NamedSharding(mesh, P(data_axis, None)),
         mask=NamedSharding(mesh, P(data_axis, None)),

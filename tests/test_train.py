@@ -252,19 +252,81 @@ def test_packed_trainer_rejects_flash():
         )
 
 
-def test_sharded_trainer_rejects_flash():
-    """pallas_call has no SPMD partitioning rule — the sharded factory
-    must reject flash with an actionable message (single-device flash
-    training is the supported path)."""
+def test_sharded_flash_train_step_matches_unsharded():
+    """attention='flash' trains SHARDED too: the flash VJP under GSPMD
+    data x model shardings must match the unsharded step (the round-3
+    'GSPMD flash hangs' diagnosis was a dead-TPU backend-init hang, not
+    a real limitation)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY_TEST, max_len=32, attention="flash")
+    model = SentimentEncoder(cfg)
+    params = init_params(model, seed=0)
+    tx = optax.sgd(0.1)
+    rng = np.random.default_rng(0)
+    b, t = 8, 16
+    batch = Batch(
+        ids=jnp.asarray(rng.integers(4, 1000, (b, t)), jnp.int32),
+        mask=jnp.ones((b, t), jnp.int32),
+        labels=jnp.asarray((rng.random((b, cfg.n_labels)) < 0.3), jnp.float32),
+    )
+    ref_state, _ = make_train_step(model, tx)(init_state(model, params, tx), batch)
+    mesh = make_mesh(MeshSpec(("data", "model"), (4, 2)))
+    step, shard_state, bshard = make_sharded_train_step(
+        model, tx, mesh, params_template=params
+    )
+    state, _ = step(
+        shard_state(init_state(model, params, tx)), jax.device_put(batch, bshard)
+    )
+    for a, b_ in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(ref_state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-5)
+
+
+def test_sp_train_step_matches_dense():
+    """Long-context sequence-parallel fine-tuning: one SP train step
+    (ring-attention custom VJP over the 8-way seq mesh) must match the
+    plain single-device step on the same batch."""
+    from svoc_tpu.train.trainer import make_sp_train_step
+
+    cfg = TINY_TEST
+    model = SentimentEncoder(cfg)
+    params = init_params(model, seed=0)
+    tx = optax.sgd(0.1)
+    rng = np.random.default_rng(1)
+    b, t = 2, 64  # T sharded 8 ways
+    batch = Batch(
+        ids=jnp.asarray(rng.integers(4, cfg.vocab_size, (b, t)), jnp.int32),
+        mask=jnp.ones((b, t), jnp.int32),
+        labels=jnp.asarray((rng.random((b, cfg.n_labels)) < 0.3), jnp.float32),
+    )
+    ref_state, ref_metrics = make_train_step(model, tx)(
+        init_state(model, params, tx), batch
+    )
+    mesh = make_mesh(MeshSpec(("seq",), (8,)))
+    step = make_sp_train_step(cfg, tx, mesh)
+    state, metrics = step(init_state(model, params, tx), batch)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(ref_metrics["loss"]), rtol=1e-4
+    )
+    for a, b_ in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(ref_state.params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=5e-5)
+
+
+def test_sp_trainer_rejects_flash():
     import dataclasses
 
     import pytest
 
-    cfg = dataclasses.replace(TINY_TEST, attention="flash")
-    model = SentimentEncoder(cfg)
-    params = init_params(model, seed=0)
-    mesh = make_mesh(MeshSpec(("data", "model"), (4, 2)))
-    with pytest.raises(ValueError, match="single-device"):
-        make_sharded_train_step(
-            model, optax.adamw(1e-4), mesh, params_template=params
+    from svoc_tpu.train.trainer import make_sp_train_step
+
+    mesh = make_mesh(MeshSpec(("seq",), (8,)))
+    with pytest.raises(ValueError, match="dense"):
+        make_sp_train_step(
+            dataclasses.replace(TINY_TEST, attention="flash"), optax.sgd(0.1), mesh
         )
