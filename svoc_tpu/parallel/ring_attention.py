@@ -104,7 +104,6 @@ def ring_attention(
         kmask = jnp.ones(k.shape[:2], dtype=jnp.int32)
     n_dev = jax.lax.psum(1, axis_name)
     b, t_local, h, d = q.shape
-    scale = jnp.float32(1.0) / jnp.sqrt(jnp.float32(d))
 
     def run_ring(accumulate, carry0):
         """Forward-style ring over ``(k, v, kmask)``: rotating state is
@@ -193,6 +192,12 @@ def _ring_dense_fwd_stats(q, k, v, kmask, axis_name):
     l_t = jnp.transpose(l, (0, 2, 1))[..., None]
     out = (o / jnp.maximum(l_t, 1e-30)).astype(q.dtype)
     dead = m <= NEG_INF / 2  # no real key anywhere in the ring
+    # Dead rows (every key padding) return EXACTLY 0, the same
+    # convention as the flash path — and the one that makes the
+    # two-pass VJP's zero gradient for them exact (the dense softmax's
+    # degenerate uniform average would depend on v with dv = 0 here).
+    dead_rows = jnp.transpose(dead, (0, 2, 1))[..., None]  # [B,Tq,H,1]
+    out = jnp.where(dead_rows, jnp.zeros_like(out), out)
     lse = jnp.where(dead, -jnp.inf, m + jnp.log(jnp.maximum(l, 1e-30)))
     return out, lse
 

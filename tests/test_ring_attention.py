@@ -154,3 +154,42 @@ def test_ring_attention_backward_matches_dense():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b_), atol=1e-5, err_msg=f"d{name}"
         )
+
+
+def test_ring_dense_all_padding_row_zero_forward_and_grad(seq_mesh):
+    """Regression (round-3 review): an all-padding batch row through the
+    DENSE ring must return exactly 0 forward (the flash-path convention)
+    with exactly-zero dq/dk/dv for it — previously the forward emitted
+    the degenerate uniform average of V while the VJP returned zeros,
+    an inconsistent gradient.  The live row must stay dense-exact both
+    ways."""
+    rng = np.random.default_rng(7)
+    b, t, h, d = 2, 64, 2, 8
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+        for _ in range(3)
+    )
+    kmask = jnp.ones((b, t), jnp.int32).at[1, :].set(0)  # row 1 all pad
+    cot = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+    ring = ring_attention_fn(seq_mesh)
+
+    out = ring(q, k, v, kmask)
+    assert float(jnp.abs(out[1]).max()) == 0.0
+    ref = dense_attention_reference(q, k, v, kmask)
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(ref[0]), atol=2e-5, rtol=2e-5
+    )
+
+    gf = jax.grad(
+        lambda *a: jnp.sum(ring(*a, kmask) * cot), argnums=(0, 1, 2)
+    )(q, k, v)
+    gd = jax.grad(
+        lambda *a: jnp.sum(dense_attention_reference(*a, kmask) * cot),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for name, a, b_ in zip("qkv", gf, gd):
+        a, b_ = np.asarray(a), np.asarray(b_)
+        assert float(np.abs(a[1]).max()) == 0.0, f"d{name} dead row"
+        np.testing.assert_allclose(
+            a[0], b_[0], atol=1e-5, err_msg=f"d{name} live row"
+        )
