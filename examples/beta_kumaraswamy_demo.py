@@ -106,7 +106,19 @@ def main():
         "--n-oracles", type=int, default=7, help="fleet size (tables use 7 and 20)"
     )
     p.add_argument("--n-failing", type=int, default=2)
+    p.add_argument(
+        "--platform",
+        default="cpu",
+        choices=("cpu", "tpu", "default"),
+        help=(
+            "JAX platform; 'cpu' (default) pins the CPU backend BEFORE "
+            "first device use so the demo never hangs on a wedged "
+            "accelerator plugin; 'default' keeps the environment's choice"
+        ),
+    )
     args = p.parse_args()
+    if args.platform != "default":
+        jax.config.update("jax_platforms", args.platform)
     key = jax.random.PRNGKey(args.seed)
     k1, k2, k3 = jax.random.split(key, 3)
 

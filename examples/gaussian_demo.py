@@ -80,7 +80,19 @@ def main():
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--n-oracles", type=int, default=7)
     p.add_argument("--n-failing", type=int, default=2)
+    p.add_argument(
+        "--platform",
+        default="cpu",
+        choices=("cpu", "tpu", "default"),
+        help=(
+            "JAX platform; 'cpu' (default) pins the CPU backend BEFORE "
+            "first device use so the demo never hangs on a wedged "
+            "accelerator plugin; 'default' keeps the environment's choice"
+        ),
+    )
     args = p.parse_args()
+    if args.platform != "default":
+        jax.config.update("jax_platforms", args.platform)
     k1, k2 = jax.random.split(jax.random.PRNGKey(args.seed))
 
     print("== 1. single-fleet walkthrough (on-chain unconstrained rule) ==")

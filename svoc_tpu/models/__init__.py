@@ -15,3 +15,17 @@ from svoc_tpu.models.convert import (  # noqa: F401
 from svoc_tpu.models.encoder import SentimentEncoder  # noqa: F401
 from svoc_tpu.models.sentiment import SentimentPipeline  # noqa: F401
 from svoc_tpu.models.tokenizer import HashingTokenizer, load_tokenizer  # noqa: F401
+
+_QUANT_EXPORTS = ("quantize_params", "quantized_forward", "quantized_packed_forward")
+
+
+def __getattr__(name):
+    """Lazy re-export of the int8 serving API — ``svoc_tpu.models.quant``
+    pulls in :mod:`svoc_tpu.parallel.encoder_math`, and importing the
+    parallel package eagerly from here would create a models↔parallel
+    import cycle (parallel's modules import models submodules back)."""
+    if name in _QUANT_EXPORTS:
+        from svoc_tpu.models import quant
+
+        return getattr(quant, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

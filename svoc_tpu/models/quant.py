@@ -1,0 +1,166 @@
+"""W8A8 dynamic post-training quantization for the sentiment encoder.
+
+A TPU-first serving capability with no counterpart in the reference
+(its classifier runs f32 torch on CPU, ``client/oracle_scheduler.py:
+23-40``): the v5e MXU executes int8×int8→int32 at TWICE the bf16 rate
+(394 vs 197 TOPS), and at the classifier's seq 128 the encoder's FLOPs
+are ~97 % Dense matmuls — so quantizing just the six block matmuls
+(query/key/value/out/ffn_in/ffn_out) doubles the roofline while
+embeddings, layernorms, softmax, residuals and the classification head
+stay in bf16/f32.
+
+Scheme — symmetric, zero-point-free, no calibration pass:
+
+- **weights**: per-output-channel int8, ``scale[o] = amax(|W[:, o]|)/127``,
+  folded once at load time (:func:`quantize_params`);
+- **activations**: per-row (per-token) dynamic int8, scales computed on
+  device inside the jitted forward — outlier tokens only widen their own
+  row's grid;
+- **accumulation**: int32 via ``lax.dot_general(..,
+  preferred_element_type=int32)`` (the MXU int8 path); dequantization is
+  a rank-1 rescale fused into the bias add.
+
+The quantized forward IS the functional encoder math
+(:mod:`svoc_tpu.parallel.encoder_math`): ``encoder_block`` runs with
+``dense_fn=qdense`` and nothing else changes, so block wiring, softmax
+and layernorm semantics stay pinned to the flax module's in exactly one
+place.  Both the unpacked ``(ids, mask)`` contract and the
+sequence-packed one (:mod:`svoc_tpu.models.packing`) are provided — the
+packing factor and the int8 rate multiply.
+
+Composition: the quantized tree is replicated for data-parallel serving
+exactly like the float tree (it is ~4× smaller in HBM).  Tensor
+parallelism is intentionally NOT wired here: int8 serving targets the
+throughput path where DP over the batch is the right sharding for a
+model this size.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from svoc_tpu.models.configs import EncoderConfig
+from svoc_tpu.parallel.encoder_math import (
+    cls_head,
+    embed_tokens,
+    encoder_block,
+    local_position_ids,
+)
+
+#: Kernels quantized inside each encoder block (the MXU-heavy matmuls).
+_BLOCK_DENSES = ("query", "key", "value", "out", "ffn_in", "ffn_out")
+
+
+def quantize_dense(p: Dict) -> Dict:
+    """``{kernel [I,O], bias [O]}`` → ``{w_int8, w_scale, bias}`` with
+    per-output-channel symmetric scales."""
+    w = jnp.asarray(p["kernel"], jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(w), axis=0), 1e-8) / 127.0
+    w_int8 = jnp.clip(jnp.round(w / scale[None, :]), -127, 127).astype(jnp.int8)
+    return {
+        "w_int8": w_int8,
+        "w_scale": scale,
+        "bias": jnp.asarray(p["bias"], jnp.float32),
+    }
+
+
+def quantize_params(params: Dict, cfg: EncoderConfig) -> Dict:
+    """Float param tree → quantized tree: every block Dense becomes an
+    int8 triple, every other leaf is kept verbatim (embeddings, norms,
+    head).  Structure mirrors the flax tree so the shared encoder math
+    indexes it identically."""
+    tree = dict(params["params"])
+    for i in range(cfg.n_layers):
+        bp = dict(tree[f"block_{i}"])
+        ap = dict(bp["attention"])
+        for name in _BLOCK_DENSES:
+            holder = ap if name in ap else bp
+            holder[name] = quantize_dense(holder[name])
+        bp["attention"] = ap
+        tree[f"block_{i}"] = bp
+    return {"params": tree}
+
+
+def quantized_size_bytes(qparams: Dict) -> int:
+    """Total HBM footprint of the quantized tree (int8 kernels + f32
+    rest) — ~4× below the f32 tree, ~2× below bf16-resident."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(qparams)
+    )
+
+
+def qdense(x: jnp.ndarray, qp: Dict, dtype) -> jnp.ndarray:
+    """Dynamically quantized replacement for ``encoder_math.dense``
+    (same ``(x, params, dtype)`` signature, so ``encoder_block`` takes
+    it as ``dense_fn``).
+
+    Per-row activation scales are computed in f32 on device; the matmul
+    runs int8×int8→int32 on the MXU; dequant + bias fold into one
+    elementwise epilogue XLA fuses.
+    """
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True), 1e-8) / 127.0
+    xq = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq,
+        qp["w_int8"],
+        (((xq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    y = acc.astype(jnp.float32) * (s * qp["w_scale"]) + qp["bias"]
+    return y.astype(dtype)
+
+
+def _bias_attention(bias, cfg: EncoderConfig):
+    """``attention_fn`` with a precomputed additive f32 bias (the packed
+    block-diagonal case) — the same softmax chain as
+    ``encoder_math.local_attention``'s dense branch."""
+
+    def attn(q, k, v, _kmask):
+        d = q.shape[-1]
+        scale = jnp.asarray(1.0 / jnp.sqrt(jnp.float32(d)), cfg.dtype)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        probs = jax.nn.softmax(scores.astype(jnp.float32) + bias, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v)
+
+    return attn
+
+
+def quantized_forward(
+    qparams: Dict, ids: jnp.ndarray, mask: jnp.ndarray, cfg: EncoderConfig
+) -> jnp.ndarray:
+    """Unpacked ``(ids, mask) → logits`` with int8 block matmuls —
+    drop-in for ``SentimentEncoder.apply`` on a quantized tree."""
+    rest = qparams["params"]
+    x = embed_tokens(ids, local_position_ids(mask, cfg), rest, cfg)
+    for i in range(cfg.n_layers):
+        x = encoder_block(x, mask, rest[f"block_{i}"], cfg, dense_fn=qdense)
+    return cls_head(x[:, 0, :], rest, cfg)
+
+
+def quantized_packed_forward(
+    qparams: Dict,
+    ids: jnp.ndarray,
+    pos_ids: jnp.ndarray,
+    seg: jnp.ndarray,
+    cls_pos: jnp.ndarray,
+    cfg: EncoderConfig,
+) -> jnp.ndarray:
+    """Sequence-packed twin (``PackedSentimentEncoder`` contract:
+    block-diagonal attention, per-segment CLS gather) with int8
+    matmuls — the packing factor and the int8 MXU rate multiply."""
+    rest = qparams["params"]
+    x = embed_tokens(ids, pos_ids, rest, cfg)
+    same = (seg[:, :, None] == seg[:, None, :]) & (seg[:, :, None] > 0)
+    bias = jnp.where(same[:, None, :, :], 0.0, -1e9).astype(jnp.float32)
+    attn = _bias_attention(bias, cfg)
+    for i in range(cfg.n_layers):
+        x = encoder_block(
+            x, None, rest[f"block_{i}"], cfg, attention_fn=attn, dense_fn=qdense
+        )
+    cls = jnp.take_along_axis(x, cls_pos[:, :, None], axis=1)  # [R, S, D]
+    return cls_head(cls, rest, cfg)

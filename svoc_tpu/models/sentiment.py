@@ -93,6 +93,12 @@ class SentimentPipeline:
     packed: bool = False
     #: Segments per packed row (only read when ``packed``).
     max_segments: int = 8
+    #: ``"int8"`` swaps the block matmuls for W8A8 dynamic-PTQ kernels
+    #: (:mod:`svoc_tpu.models.quant`) — 2× the bf16 MXU rate on v5e,
+    #: ~4× smaller HBM tree; composes with ``packed`` and ``data_mesh``.
+    #: None (default) keeps the float forward.  Serving-only: the
+    #: quantized tree is not trainable and not checkpoint-compatible.
+    quant: Optional[str] = None
 
     def __post_init__(self):
         if self.packed and self.cfg.attention != "dense":
@@ -136,12 +142,33 @@ class SentimentPipeline:
                 pad_id=self.cfg.pad_id,
                 max_len=self.seq_len,
             )
+        if self.quant not in (None, "int8"):
+            raise ValueError(f"quant must be None or 'int8', got {self.quant!r}")
+        if self.quant and self.cfg.attention != "dense":
+            raise ValueError(
+                "int8 serving uses the dense attention path — set "
+                f"cfg.attention == 'dense' (got {self.cfg.attention!r})"
+            )
         multi = self.cfg.head == "sigmoid"
         idx = self.label_indices
 
-        def forward_fn_body(params, ids, mask):
-            logits = self.model.apply(params, ids, mask)
-            return scores_to_vectors(logits, idx, multi)
+        if self.quant == "int8":
+            from svoc_tpu.models.quant import quantize_params, quantized_forward
+
+            # The float tree is dropped after folding — the pipeline
+            # holds only the int8 kernels (+ f32 rest) from here on.
+            self.params = quantize_params(self.params, self.cfg)
+            cfg = self.cfg
+
+            def forward_fn_body(params, ids, mask):
+                logits = quantized_forward(params, ids, mask, cfg)
+                return scores_to_vectors(logits, idx, multi)
+
+        else:
+
+            def forward_fn_body(params, ids, mask):
+                logits = self.model.apply(params, ids, mask)
+                return scores_to_vectors(logits, idx, multi)
 
         self._batch_sharding = None
         if self.data_mesh is not None:
@@ -187,14 +214,24 @@ class SentimentPipeline:
         serves every ``max_segments``.  Shares ``self.params`` — the
         packed module's parameter tree is identical
         (:mod:`svoc_tpu.models.packing`)."""
-        from svoc_tpu.models.packing import PackedSentimentEncoder
-
-        packed_model = PackedSentimentEncoder(self.cfg)
         multi = self.cfg.head == "sigmoid"
         idx = self.label_indices
 
+        if self.quant == "int8":
+            from svoc_tpu.models.quant import quantized_packed_forward
+
+            cfg = self.cfg
+
+            def apply_fn(params, ids, pos, seg, cls_pos):
+                return quantized_packed_forward(params, ids, pos, seg, cls_pos, cfg)
+
+        else:
+            from svoc_tpu.models.packing import PackedSentimentEncoder
+
+            apply_fn = PackedSentimentEncoder(self.cfg).apply
+
         def body(params, ids, pos, seg, cls_pos):
-            logits = packed_model.apply(params, ids, pos, seg, cls_pos)
+            logits = apply_fn(params, ids, pos, seg, cls_pos)
             r, s, l = logits.shape
             vecs = scores_to_vectors(logits.reshape(r * s, l), idx, multi)
             return vecs.reshape(r, s, len(idx))

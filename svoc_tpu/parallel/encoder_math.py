@@ -87,22 +87,27 @@ def local_attention(q, k, v, kmask, cfg: EncoderConfig):
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(cfg.dtype), v)
 
 
-def encoder_block(x, kmask, bp, cfg: EncoderConfig, attention_fn=None):
+def encoder_block(x, kmask, bp, cfg: EncoderConfig, attention_fn=None, *, dense_fn=None):
     """One :class:`EncoderBlock` (``encoder.py:54-70``) from a raw
     params dict.  ``attention_fn(q, k, v, kmask) → ctx`` defaults to
-    :func:`local_attention`; sp passes the ring."""
+    :func:`local_attention`; sp passes the ring.  ``dense_fn(x, p,
+    dtype)`` defaults to :func:`dense`; the int8 path
+    (:mod:`svoc_tpu.models.quant`) passes its quantized matmul so the
+    block wiring is defined exactly once."""
+    if dense_fn is None:
+        dense_fn = dense
     b, t, _ = x.shape
     h, d = cfg.n_heads, cfg.head_dim
     ap = bp["attention"]
-    q = dense(x, ap["query"], cfg.dtype).reshape(b, t, h, d)
-    k = dense(x, ap["key"], cfg.dtype).reshape(b, t, h, d)
-    v = dense(x, ap["value"], cfg.dtype).reshape(b, t, h, d)
+    q = dense_fn(x, ap["query"], cfg.dtype).reshape(b, t, h, d)
+    k = dense_fn(x, ap["key"], cfg.dtype).reshape(b, t, h, d)
+    v = dense_fn(x, ap["value"], cfg.dtype).reshape(b, t, h, d)
     if attention_fn is None:
         ctx = local_attention(q, k, v, kmask, cfg)
     else:
         ctx = attention_fn(q, k, v, kmask)
-    a = dense(ctx.reshape(b, t, cfg.hidden), ap["out"], cfg.dtype)
+    a = dense_fn(ctx.reshape(b, t, cfg.hidden), ap["out"], cfg.dtype)
     x = layernorm(x + a, bp["ln_attn"], cfg.ln_eps).astype(cfg.dtype)
-    f = jax.nn.gelu(dense(x, bp["ffn_in"], cfg.dtype), approximate=False)
-    f = dense(f, bp["ffn_out"], cfg.dtype)
+    f = jax.nn.gelu(dense_fn(x, bp["ffn_in"], cfg.dtype), approximate=False)
+    f = dense_fn(f, bp["ffn_out"], cfg.dtype)
     return layernorm(x + f, bp["ln_ffn"], cfg.ln_eps).astype(cfg.dtype)

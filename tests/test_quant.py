@@ -1,0 +1,158 @@
+"""W8A8 dynamic-PTQ serving path (svoc_tpu/models/quant.py).
+
+Quantization is lossy by construction, so parity bounds here are
+looser than the float-path bit-parity tests: what must hold is that
+the PRODUCT output — sum-normalized tracked sentiment vectors — stays
+close to the float forward's, and that the packed/unpacked quantized
+paths agree with each other.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from svoc_tpu.models.configs import TINY_TEST
+from svoc_tpu.models.encoder import SentimentEncoder, init_params
+from svoc_tpu.models.quant import (
+    qdense,
+    quantize_dense,
+    quantize_params,
+    quantized_forward,
+    quantized_size_bytes,
+)
+from svoc_tpu.models.sentiment import SentimentPipeline
+from svoc_tpu.parallel.encoder_math import dense
+
+CFG = TINY_TEST
+TEXTS = [
+    "the rollout went great, everyone is thrilled",
+    "this outage is infuriating and support is silent",
+    "mildly annoyed by the new UI but it works",
+    "nervous about the migration tomorrow",
+    "deeply sorry about the data loss",
+    "what an exciting launch day!",
+]
+
+
+def _params():
+    return init_params(SentimentEncoder(CFG), seed=3)
+
+
+class TestQDense:
+    def test_matches_float_dense_within_quant_error(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(4, 16, 64)), jnp.float32)
+        p = {
+            "kernel": jnp.asarray(rng.normal(size=(64, 32)), jnp.float32),
+            "bias": jnp.asarray(rng.normal(size=(32,)), jnp.float32),
+        }
+        ref = np.asarray(dense(x, p, jnp.float32))
+        out = np.asarray(qdense(x, quantize_dense(p), jnp.float32))
+        # Two int8 grids (row activations x channel weights): relative
+        # error ~1% of the row-scale x channel-scale envelope.
+        denom = np.maximum(np.abs(ref).max(), 1.0)
+        assert np.abs(out - ref).max() / denom < 0.02
+
+    def test_preserves_exact_zero_rows(self):
+        p = {
+            "kernel": jnp.ones((8, 4), jnp.float32),
+            "bias": jnp.zeros((4,), jnp.float32),
+        }
+        out = np.asarray(qdense(jnp.zeros((2, 8)), quantize_dense(p), jnp.float32))
+        np.testing.assert_array_equal(out, 0.0)
+
+
+class TestQuantizedTree:
+    def test_kernels_int8_rest_verbatim(self):
+        params = _params()
+        q = quantize_params(params, CFG)
+        b0 = q["params"]["block_0"]
+        for name in ("query", "key", "value", "out"):
+            assert b0["attention"][name]["w_int8"].dtype == jnp.int8
+        for name in ("ffn_in", "ffn_out"):
+            assert b0[name]["w_int8"].dtype == jnp.int8
+        # embeddings / norms / head untouched (identical leaves)
+        np.testing.assert_array_equal(
+            np.asarray(q["params"]["tok_emb"]["embedding"]),
+            np.asarray(params["params"]["tok_emb"]["embedding"]),
+        )
+        assert "kernel" in q["params"]["head_dense"]
+
+    def test_smaller_than_float_tree(self):
+        params = _params()
+        float_bytes = sum(
+            l.size * l.dtype.itemsize
+            for l in jax.tree_util.tree_leaves(params)
+        )
+        assert quantized_size_bytes(quantize_params(params, CFG)) < float_bytes
+
+
+class TestQuantizedForward:
+    def test_logits_track_float_forward(self):
+        params = _params()
+        rng = np.random.default_rng(1)
+        ids = jnp.asarray(
+            rng.integers(2, CFG.vocab_size, size=(4, 32)), jnp.int32
+        )
+        mask = jnp.ones_like(ids).at[1, 20:].set(0).at[3, 8:].set(0)
+        ids = jnp.where(mask > 0, ids, CFG.pad_id)
+        ref = np.asarray(SentimentEncoder(CFG).apply(params, ids, mask))
+        out = np.asarray(
+            quantized_forward(quantize_params(params, CFG), ids, mask, CFG)
+        )
+        assert out.shape == ref.shape
+        assert np.abs(out - ref).max() < 0.15 * max(1.0, np.abs(ref).max())
+        # ranking of labels survives quantization per row
+        agree = np.mean(np.argmax(out, -1) == np.argmax(ref, -1))
+        assert agree >= 0.75
+
+
+class TestPipelineIntegration:
+    def test_int8_vectors_close_to_float(self):
+        fp = SentimentPipeline(
+            cfg=CFG, seq_len=32, batch_size=4, tokenizer_name=None, seed=5
+        )
+        qp = SentimentPipeline(
+            cfg=CFG,
+            seq_len=32,
+            batch_size=4,
+            tokenizer_name=None,
+            seed=5,
+            quant="int8",
+        )
+        ref = fp(TEXTS)
+        out = qp(TEXTS)
+        assert out.shape == ref.shape == (len(TEXTS), 6)
+        np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+        assert np.abs(out - ref).max() < 0.05
+
+    def test_packed_int8_matches_unpacked_int8(self):
+        qp = SentimentPipeline(
+            cfg=CFG, seq_len=32, batch_size=4, tokenizer_name=None, seed=5,
+            quant="int8",
+        )
+        unpacked = qp(TEXTS)
+        packed = qp.call_packed(TEXTS, max_segments=4)
+        # Same int8 kernels, same per-segment math: differences come only
+        # from row-level activation scales (different packing of rows).
+        np.testing.assert_allclose(packed, unpacked, atol=0.05)
+
+    def test_quant_requires_dense_attention(self):
+        import dataclasses
+
+        with pytest.raises(ValueError, match="dense"):
+            SentimentPipeline(
+                cfg=dataclasses.replace(CFG, attention="flash"),
+                seq_len=32,
+                batch_size=4,
+                tokenizer_name=None,
+                quant="int8",
+            )
+
+    def test_unknown_quant_rejected(self):
+        with pytest.raises(ValueError, match="int8"):
+            SentimentPipeline(
+                cfg=CFG, seq_len=32, batch_size=4, tokenizer_name=None,
+                quant="int4",
+            )
