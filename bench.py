@@ -30,6 +30,9 @@ bootstrap fleet -> two-pass consensus, overlapped via a prefetch queue.
     forward (``svoc_tpu/models/quant.py``) — block matmuls on the MXU
     int8 path (2x the bf16 rate on v5e); MFU normalized to the int8
     peak so the >1.0 hard-fail stays physical
+11. INT8 packed data-parallel serving: config 9 x config 10 — packing
+    x int8 rate x device count, the framework's highest-throughput
+    serving configuration
 
 Baseline: the reference client classifies a 30-comment window every 5 s
 with 7 oracles on CPU torch (~6 comments/sec, one consensus update per
@@ -144,6 +147,20 @@ def assumed_peak_flops(platform: str):
     if platform == "cpu":
         return None  # MFU vs an unknown host peak is meaningless
     return 197e12  # TPU v5e bf16 peak per chip
+
+
+def quant_peak_and_meta(peak, quant):
+    """int8 configs run on the MXU int8 path (2× the bf16 rate on
+    v5e): normalize MFU against THAT peak so ``main``'s >1.0 hard-fail
+    stays physical, and stamp the detail dict accordingly.  The single
+    home for the ratio — configs 10 and 11 must never drift."""
+    if quant not in (None, "int8"):
+        raise ValueError(f"quant must be None or 'int8', got {quant!r}")
+    if not quant:
+        return peak, {}
+    if peak:
+        peak *= 2
+    return peak, {"quantization": "W8A8 dynamic PTQ; MFU vs int8 (2x bf16) peak"}
 
 
 def device_fetch(x) -> float:
@@ -1436,12 +1453,7 @@ def _bench_packed_flagship(
     packing_factor = n_comments / (steps * rows)
     row_tokens_per_sec = steps * rows * seq / elapsed
     flops_per_token = encoder_matmul_flops_per_token(enc_cfg, seq)
-    peak = assumed_peak_flops(platform)
-    # int8 runs on the MXU's int8 path (2x the bf16 rate on v5e) — MFU
-    # is normalized against THAT peak so >1.0 stays physically
-    # impossible and the main() hard-fail stays meaningful.
-    if peak and quant == "int8":
-        peak *= 2
+    peak, quant_meta = quant_peak_and_meta(assumed_peak_flops(platform), quant)
     mfu = row_tokens_per_sec * flops_per_token / peak if peak else None
 
     cfg_label = "config 10: INT8 (W8A8 dynamic PTQ)" if quant else "config 8:"
@@ -1473,11 +1485,7 @@ def _bench_packed_flagship(
             "consensus_n_oracles": n_oracles,
             "mfu_estimate": round(mfu, 4) if mfu is not None else None,
             "assumed_peak_tflops": peak / 1e12 if peak else None,
-            **(
-                {"quantization": "W8A8 dynamic PTQ; MFU vs int8 (2x bf16) peak"}
-                if quant
-                else {}
-            ),
+            **quant_meta,
             "steps": steps,
             "rows": rows,
             "max_segments": max_seg,
@@ -1493,8 +1501,22 @@ def bench_config9(seconds: float, small: bool, platform: str) -> dict:
     """Sequence-packed DATA-PARALLEL serving: config 7's mesh path with
     config 8's packed rows (:func:`svoc_tpu.parallel.serving.
     packed_serving_step_fn`) — per-step throughput compounds the
-    packing factor (~3×) with the device count.  On a v5e-8 this is
-    the highest-throughput serving configuration in the framework."""
+    packing factor (~3×) with the device count."""
+    return _bench_packed_dp_serving(seconds, small, platform, quant=None)
+
+
+def bench_config11(seconds: float, small: bool, platform: str) -> dict:
+    """INT8 packed data-parallel serving: config 9 with the W8A8
+    dynamic-PTQ forward — packing × int8 MXU rate × device count, the
+    framework's highest-throughput serving configuration.
+    ``mfu_estimate`` is normalized against the INT8 peak (2× bf16) so
+    ``main``'s >1.0 hard-fail stays physical."""
+    return _bench_packed_dp_serving(seconds, small, platform, quant="int8")
+
+
+def _bench_packed_dp_serving(
+    seconds: float, small: bool, platform: str, quant=None
+) -> dict:
     import jax
 
     from svoc_tpu.consensus.kernel import ConsensusConfig
@@ -1526,12 +1548,16 @@ def bench_config9(seconds: float, small: bool, platform: str) -> dict:
         seq_len=seq,
         batch_size=rows,
         tokenizer_name=None if small else "SamLowe/roberta-base-go_emotions",
-        params_dtype=None if small else "bfloat16",
+        # int8 folds its own kernels (pipe.params becomes the quantized
+        # tree); bf16-resident params otherwise.
+        params_dtype=None if (small or quant) else "bfloat16",
+        quant=quant,
     )
     mesh = serving_mesh()
     row_shard = batch_sharding(mesh)
     serve = packed_serving_step_fn(
-        mesh, enc_cfg, ccfg, n_oracles, window_size=window_size, subset_size=10
+        mesh, enc_cfg, ccfg, n_oracles, window_size=window_size, subset_size=10,
+        quant=quant,
     )
     roundtrip = measure_roundtrip_ms()
     source = SyntheticSource(batch=rows, seed=0)
@@ -1595,12 +1621,17 @@ def bench_config9(seconds: float, small: bool, platform: str) -> dict:
     packing_factor = n_comments / (steps * rows)
     row_tokens_per_sec = steps * rows * seq / elapsed
     flops_per_token = encoder_matmul_flops_per_token(enc_cfg, seq)
-    peak = assumed_peak_flops(platform)
+    peak, quant_meta = quant_peak_and_meta(assumed_peak_flops(platform), quant)
     mfu = row_tokens_per_sec * flops_per_token / (peak * n_dev) if peak else None
 
+    cfg_label = (
+        "config 11: INT8 (W8A8) sequence-packed data-parallel serving"
+        if quant
+        else "config 9: sequence-packed data-parallel serving"
+    )
     return {
         "metric": (
-            f"config 9: sequence-packed data-parallel serving over {n_dev} "
+            f"{cfg_label} over {n_dev} "
             f"device(s) — {max_seg}-seg packed rows -> {n_oracles}-oracle "
             "fleet -> consensus"
         ),
@@ -1622,6 +1653,7 @@ def bench_config9(seconds: float, small: bool, platform: str) -> dict:
             "row_tokens_per_sec": round(row_tokens_per_sec, 1),
             "mfu_estimate": round(mfu, 4) if mfu is not None else None,
             "assumed_peak_tflops": peak * n_dev / 1e12 if peak else None,
+            **quant_meta,
             "consensus_n_oracles": n_oracles,
             "reliability2": device_fetch(out.reliability_second_pass),
             "steps": steps,
@@ -1646,6 +1678,7 @@ CONFIGS = {
     8: bench_config8,
     9: bench_config9,
     10: bench_config10,
+    11: bench_config11,
 }
 
 

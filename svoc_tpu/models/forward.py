@@ -1,0 +1,58 @@
+"""Forward-function resolution shared by the pipeline and serving steps.
+
+One place owns the (quant × packed) dispatch and its validation so
+:class:`svoc_tpu.models.sentiment.SentimentPipeline` and the serving
+step factories (:mod:`svoc_tpu.parallel.serving`) can never drift on
+which forward implements a configuration.  Imports stay lazy per
+branch: resolving a float forward never touches the int8 module (which
+pulls in the parallel package's encoder math).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from svoc_tpu.models.configs import EncoderConfig
+
+
+def validate_quant(cfg: EncoderConfig, quant: Optional[str]) -> None:
+    """The quant-option contract, raised identically by every entry."""
+    if quant not in (None, "int8"):
+        raise ValueError(f"quant must be None or 'int8', got {quant!r}")
+    if quant == "int8" and cfg.attention != "dense":
+        raise ValueError(
+            "int8 serving uses the dense attention path — set "
+            f"cfg.attention == 'dense' (got {cfg.attention!r})"
+        )
+
+
+def resolve_forward(
+    cfg: EncoderConfig, quant: Optional[str] = None, packed: bool = False
+):
+    """The encoder forward for a serving/pipeline configuration.
+
+    Returns ``(params, ids, mask) → logits`` (unpacked) or ``(params,
+    ids, pos, seg, cls_pos) → logits`` (packed); the flax module's
+    ``apply`` for float configs, the W8A8 math
+    (:mod:`svoc_tpu.models.quant`) for ``quant="int8"`` — whose
+    ``params`` is then the QUANTIZED tree (:func:`~svoc_tpu.models.
+    quant.quantize_params`).
+    """
+    validate_quant(cfg, quant)
+    if packed:
+        if quant == "int8":
+            from svoc_tpu.models.quant import quantized_packed_forward
+
+            return lambda p, ids, pos, seg, cls_pos: quantized_packed_forward(
+                p, ids, pos, seg, cls_pos, cfg
+            )
+        from svoc_tpu.models.packing import PackedSentimentEncoder
+
+        return PackedSentimentEncoder(cfg).apply
+    if quant == "int8":
+        from svoc_tpu.models.quant import quantized_forward
+
+        return lambda p, ids, mask: quantized_forward(p, ids, mask, cfg)
+    from svoc_tpu.models.encoder import SentimentEncoder
+
+    return SentimentEncoder(cfg).apply

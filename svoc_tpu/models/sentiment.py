@@ -142,33 +142,23 @@ class SentimentPipeline:
                 pad_id=self.cfg.pad_id,
                 max_len=self.seq_len,
             )
-        if self.quant not in (None, "int8"):
-            raise ValueError(f"quant must be None or 'int8', got {self.quant!r}")
-        if self.quant and self.cfg.attention != "dense":
-            raise ValueError(
-                "int8 serving uses the dense attention path — set "
-                f"cfg.attention == 'dense' (got {self.cfg.attention!r})"
-            )
+        from svoc_tpu.models.forward import resolve_forward, validate_quant
+
+        validate_quant(self.cfg, self.quant)
         multi = self.cfg.head == "sigmoid"
         idx = self.label_indices
 
         if self.quant == "int8":
-            from svoc_tpu.models.quant import quantize_params, quantized_forward
+            from svoc_tpu.models.quant import quantize_params
 
             # The float tree is dropped after folding — the pipeline
             # holds only the int8 kernels (+ f32 rest) from here on.
             self.params = quantize_params(self.params, self.cfg)
-            cfg = self.cfg
+        apply_fn = resolve_forward(self.cfg, self.quant)
 
-            def forward_fn_body(params, ids, mask):
-                logits = quantized_forward(params, ids, mask, cfg)
-                return scores_to_vectors(logits, idx, multi)
-
-        else:
-
-            def forward_fn_body(params, ids, mask):
-                logits = self.model.apply(params, ids, mask)
-                return scores_to_vectors(logits, idx, multi)
+        def forward_fn_body(params, ids, mask):
+            logits = apply_fn(params, ids, mask)
+            return scores_to_vectors(logits, idx, multi)
 
         self._batch_sharding = None
         if self.data_mesh is not None:
@@ -214,21 +204,11 @@ class SentimentPipeline:
         serves every ``max_segments``.  Shares ``self.params`` — the
         packed module's parameter tree is identical
         (:mod:`svoc_tpu.models.packing`)."""
+        from svoc_tpu.models.forward import resolve_forward
+
         multi = self.cfg.head == "sigmoid"
         idx = self.label_indices
-
-        if self.quant == "int8":
-            from svoc_tpu.models.quant import quantized_packed_forward
-
-            cfg = self.cfg
-
-            def apply_fn(params, ids, pos, seg, cls_pos):
-                return quantized_packed_forward(params, ids, pos, seg, cls_pos, cfg)
-
-        else:
-            from svoc_tpu.models.packing import PackedSentimentEncoder
-
-            apply_fn = PackedSentimentEncoder(self.cfg).apply
+        apply_fn = resolve_forward(self.cfg, self.quant, packed=True)
 
         def body(params, ids, pos, seg, cls_pos):
             logits = apply_fn(params, ids, pos, seg, cls_pos)

@@ -36,7 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from svoc_tpu.consensus.kernel import ConsensusConfig
 from svoc_tpu.models.configs import EncoderConfig
-from svoc_tpu.models.encoder import SentimentEncoder
+from svoc_tpu.models.forward import resolve_forward
 from svoc_tpu.models.sentiment import TRACKED_INDICES, scores_to_vectors
 from svoc_tpu.parallel.sharded import fleet_consensus_shard_map
 
@@ -51,6 +51,7 @@ def dp_serving_step_fn(
     subset_size: int = 10,
     label_indices: tuple = TRACKED_INDICES,
     axis: str = "data",
+    quant: Optional[str] = None,
 ):
     """Jitted ``(params, key, ids, mask) → (ConsensusOutput, honest)``.
 
@@ -60,6 +61,11 @@ def dp_serving_step_fn(
     size.  Returns the same ConsensusOutput tree as
     :func:`svoc_tpu.parallel.sharded.sharded_fleet_step_fn` (per-oracle
     leaves sharded over ``axis``).
+
+    ``quant="int8"`` serves the W8A8 dynamic-PTQ forward
+    (:mod:`svoc_tpu.models.quant`): pass the QUANTIZED tree as
+    ``params`` — it replicates over the mesh like the float tree (and
+    is ~4× smaller in HBM).
     """
     if max(label_indices) >= enc_cfg.n_labels:
         raise ValueError(
@@ -68,7 +74,7 @@ def dp_serving_step_fn(
             "silently clamp; pass indices matching the model"
         )
 
-    model = SentimentEncoder(enc_cfg)
+    apply_fn = resolve_forward(enc_cfg, quant)
     multi_label = enc_cfg.head == "sigmoid"
     fleet = fleet_consensus_shard_map(mesh, ccfg, n_oracles, subset_size, axis)
 
@@ -82,7 +88,7 @@ def dp_serving_step_fn(
                 f"{window_size} — the consensus window would be "
                 "silently truncated"
             )
-        logits = model.apply(params, ids, mask)  # batch stays data-sharded
+        logits = apply_fn(params, ids, mask)  # batch stays data-sharded
         vecs = scores_to_vectors(logits, label_indices, multi_label)
         # Replicate the fleet's comment window: one [window, M] all-gather.
         window = jax.lax.with_sharding_constraint(
@@ -106,11 +112,15 @@ def packed_serving_step_fn(
     subset_size: int = 10,
     label_indices: tuple = TRACKED_INDICES,
     axis: str = "data",
+    quant: Optional[str] = None,
 ):
     """Sequence-PACKED data-parallel serving: the config-7 path with the
     packed forward (:mod:`svoc_tpu.models.packing`) — rows carry several
     comments each, so per-mesh throughput compounds the packing factor
-    (~3×) with the device count.
+    (~3×) with the device count.  ``quant="int8"`` additionally swaps in
+    the W8A8 forward (pass the quantized tree as ``params``): packing ×
+    int8 × device count is the framework's highest-throughput serving
+    configuration.
 
     Jitted ``(params, key, ids, pos, seg, cls_pos, valid) →
     (ConsensusOutput, honest)``; the four packed arrays are ``[R, T]``/
@@ -132,9 +142,7 @@ def packed_serving_step_fn(
             f"label_indices {label_indices} out of range for a "
             f"{enc_cfg.n_labels}-label head"
         )
-    from svoc_tpu.models.packing import PackedSentimentEncoder
-
-    model = PackedSentimentEncoder(enc_cfg)
+    apply_fn = resolve_forward(enc_cfg, quant, packed=True)
     multi_label = enc_cfg.head == "sigmoid"
     dim = len(label_indices)
     fleet = fleet_consensus_shard_map(mesh, ccfg, n_oracles, subset_size, axis)
@@ -150,7 +158,7 @@ def packed_serving_step_fn(
                 f"window_size {window_size} — the consensus window would "
                 "be silently truncated"
             )
-        logits = model.apply(params, ids, pos, seg, cls_pos)  # [R, S, L]
+        logits = apply_fn(params, ids, pos, seg, cls_pos)  # [R, S, L]
         r, s, l = logits.shape
         vecs = scores_to_vectors(
             logits.reshape(r * s, l), label_indices, multi_label

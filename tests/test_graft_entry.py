@@ -59,6 +59,7 @@ def test_dryrun_multichip_subprocess_fresh_env():
         "dp-serving-end-to-end",
         "pipeline-parallel-forward",
         "packed-forward-dp",
+        "int8-packed-serving-dp",
     ]
 
 

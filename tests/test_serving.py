@@ -148,3 +148,96 @@ def test_packed_serving_matches_unpacked():
     np.testing.assert_array_equal(
         np.asarray(out.reliable), np.asarray(ref_out.reliable)
     )
+
+
+def test_int8_dp_serving_matches_single_device_int8():
+    """quant='int8' serving on the 8-way mesh must agree exactly with
+    the same int8 step on a 1-device mesh — data sharding cannot change
+    the quantized math (activation scales are per-row, so the split is
+    invisible)."""
+    from svoc_tpu.models.quant import quantize_params
+
+    cfg, ccfg, mesh, model, params, _serve, ids, mask, window = _setup()
+    qparams = quantize_params(params, cfg)
+    key = jax.random.PRNGKey(9)
+
+    serve8 = dp_serving_step_fn(
+        mesh, cfg, ccfg, 16, window_size=window, subset_size=4,
+        label_indices=LABEL_IDX, quant="int8",
+    )
+    out8, honest8 = serve8(qparams, key, ids, mask)
+
+    mesh1 = serving_mesh(devices=jax.devices()[:1])
+    serve1 = dp_serving_step_fn(
+        mesh1, cfg, ccfg, 16, window_size=window, subset_size=4,
+        label_indices=LABEL_IDX, quant="int8",
+    )
+    ids1 = jax.device_put(np.asarray(ids), batch_sharding(mesh1))
+    mask1 = jax.device_put(np.asarray(mask), batch_sharding(mesh1))
+    out1, honest1 = serve1(qparams, key, ids1, mask1)
+
+    np.testing.assert_allclose(
+        np.asarray(out8.essence), np.asarray(out1.essence), atol=1e-5
+    )
+    np.testing.assert_array_equal(np.asarray(honest8), np.asarray(honest1))
+    np.testing.assert_array_equal(
+        np.asarray(out8.reliable), np.asarray(out1.reliable)
+    )
+
+
+def test_int8_packed_serving_runs_and_tracks_float():
+    """packed × int8 × data-parallel (the highest-throughput serving
+    config): same consensus pipeline as the float packed path, within
+    quantization tolerance of it on the same texts."""
+    from svoc_tpu.models.packing import pack_tokens, strip_padding
+    from svoc_tpu.models.quant import quantize_params
+    from svoc_tpu.models.tokenizer import load_tokenizer
+    from svoc_tpu.parallel.serving import packed_serving_step_fn
+
+    cfg = TINY_TEST
+    ccfg = ConsensusConfig(n_failing=4, constrained=True)
+    mesh = serving_mesh()
+    window, seq, n_oracles = 8, 16, 16
+    params = init_params(SentimentEncoder(cfg), seed=0)
+    qparams = quantize_params(params, cfg)
+    tok = load_tokenizer(None, cfg.vocab_size, pad_id=cfg.pad_id, max_len=seq)
+    texts = [f"short comment number {i} about consensus" for i in range(16)]
+    ids, mask = tok(texts, seq)
+    lists = strip_padding(ids, mask)
+    batch, n = pack_tokens(lists, seq, max_segments=2, pad_id=cfg.pad_id, rows=8)
+    assert n == 16
+    row = batch_sharding(mesh)
+    args = [
+        jax.device_put(jnp.asarray(a), row)
+        for a in (batch.ids, batch.pos, batch.seg, batch.cls_pos)
+    ]
+    valid = jax.device_put(jnp.asarray(batch.seg_valid > 0), row)
+    key = jax.random.PRNGKey(3)
+
+    fserve = packed_serving_step_fn(
+        mesh, cfg, ccfg, n_oracles, window_size=window, subset_size=4,
+        label_indices=LABEL_IDX,
+    )
+    fout, fhonest = fserve(params, key, *args, valid)
+    qserve = packed_serving_step_fn(
+        mesh, cfg, ccfg, n_oracles, window_size=window, subset_size=4,
+        label_indices=LABEL_IDX, quant="int8",
+    )
+    qout, qhonest = qserve(qparams, key, *args, valid)
+
+    # Same honest-mask draw (same key), essence within quant tolerance.
+    np.testing.assert_array_equal(np.asarray(qhonest), np.asarray(fhonest))
+    np.testing.assert_allclose(
+        np.asarray(qout.essence), np.asarray(fout.essence), atol=0.05
+    )
+    assert np.all(np.isfinite(np.asarray(qout.essence)))
+
+
+def test_serving_rejects_unknown_quant():
+    import pytest
+
+    with pytest.raises(ValueError, match="int8"):
+        dp_serving_step_fn(
+            serving_mesh(), TINY_TEST, ConsensusConfig(n_failing=1),
+            n_oracles=16, quant="fp8",
+        )
