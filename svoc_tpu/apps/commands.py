@@ -121,6 +121,17 @@ class CommandConsole:
     # -- dispatcher (web_interface.py:133-303) ------------------------------
 
     def query(self, text: str) -> List[str]:
+        """Dispatch one command.  Serialized on ``session.lock``: the
+        web UI's ThreadingHTTPServer handlers, the stdin console, and
+        the auto_fetch loop share one session, and the reference's
+        implicit serialization (a single eel event loop over
+        ``globalState``) must survive the move to real threads —
+        without this a vote command could interleave with an
+        auto-commit's contract mutation."""
+        with self.session.lock:
+            return self._query_locked(text)
+
+    def _query_locked(self, text: str) -> List[str]:
         out: List[str] = []
 
         def emit(line: str) -> None:
@@ -371,12 +382,17 @@ class CommandConsole:
                 and self.session.application_on
             ):
                 try:
-                    self.session.fetch()
-                    if self.session.auto_commit:
-                        self.session.commit()
-                        if self.session.auto_resume:
-                            self.session.adapter.resume()
-                            self.session.bump_state()
+                    # One lock hold per iteration: fetch/commit re-enter
+                    # it, and the resume + state bump must not interleave
+                    # with a locked command's contract mutation (the lock
+                    # is the serialization contract — session.py).
+                    with self.session.lock:
+                        self.session.fetch()
+                        if self.session.auto_commit:
+                            self.session.commit()
+                            if self.session.auto_resume:
+                                self.session.adapter.resume()
+                                self.session.bump_state()
                 except Exception as e:
                     # Surface the failure (once per distinct message) and
                     # count it, instead of silently spinning.

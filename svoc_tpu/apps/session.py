@@ -20,6 +20,7 @@ Defaults mirror ``client/common.py:7-31``: 7 oracles, 2 failing, window
 from __future__ import annotations
 
 import dataclasses
+import threading
 from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -139,6 +140,15 @@ class Session:
         #: unreachable — a session must come up (console, chain reads,
         #: web UI) without touching the device; only fetch pays it.
         self._key_value = None
+        #: Serializes session mutation.  The reference is single-threaded
+        #: (one eel event loop over ``globalState``); here the auto_fetch
+        #: loop, the stdin console, and the web UI's ThreadingHTTPServer
+        #: handlers all touch one session — without this, concurrent
+        #: fetches could split the same PRNG key (duplicate fleets) and
+        #: command dispatch could interleave with contract-sim vote
+        #: mutations.  Reentrant so a command holding it can call
+        #: fetch/commit.
+        self.lock = threading.RLock()
 
     # -- sentiment stage ----------------------------------------------------
 
@@ -210,7 +220,7 @@ class Session:
         deviation ranks, honest ground truth) and caches ``predictions``
         for ``commit``.
         """
-        with metrics.timer("fetch_latency").time():
+        with self.lock, metrics.timer("fetch_latency").time():
             comments, _dates, self.simulation_step = self.store.read_window(
                 self.simulation_step, self.config.window, self.config.fetch_limit
             )
@@ -233,18 +243,18 @@ class Session:
                 self.config.bootstrap_subset,
             )
             mean, median, ranks = _preview_stats(values)
-        metrics.counter("comments_processed").add(len(comments))
-        self.predictions = np.asarray(values, dtype=np.float64)
-        self.last_preview = {
-            "values": self.predictions,
-            "mean": np.asarray(mean),
-            "median": np.asarray(median),
-            "normalized_ranks": np.asarray(ranks),
-            "honest": np.asarray(honest),
-            "n_comments": len(comments),
-        }
-        self.bump_state()
-        return self.last_preview
+            metrics.counter("comments_processed").add(len(comments))
+            self.predictions = np.asarray(values, dtype=np.float64)
+            self.last_preview = {
+                "values": self.predictions,
+                "mean": np.asarray(mean),
+                "median": np.asarray(median),
+                "normalized_ranks": np.asarray(ranks),
+                "honest": np.asarray(honest),
+                "n_comments": len(comments),
+            }
+            self.bump_state()
+            return self.last_preview
 
     def bump_state(self) -> None:
         """Mark renderable state as changed (web UI poll redraw)."""
@@ -259,16 +269,17 @@ class Session:
         (those transactions are on chain) before the
         :class:`ChainCommitError` propagates to the command layer.
         """
-        if self.predictions is None:
-            raise RuntimeError("fetch before commit")
-        with metrics.timer("commit_latency").time():
-            try:
-                n = self.adapter.update_all_the_predictions(self.predictions)
-            except ChainCommitError as e:
-                metrics.counter("chain_transactions").add(e.committed)
-                metrics.counter("chain_commit_failures").add(1)
-                self.bump_state()  # partial txs changed chain state
-                raise
-        metrics.counter("chain_transactions").add(n)
-        self.bump_state()
-        return n
+        with self.lock:
+            if self.predictions is None:
+                raise RuntimeError("fetch before commit")
+            with metrics.timer("commit_latency").time():
+                try:
+                    n = self.adapter.update_all_the_predictions(self.predictions)
+                except ChainCommitError as e:
+                    metrics.counter("chain_transactions").add(e.committed)
+                    metrics.counter("chain_commit_failures").add(1)
+                    self.bump_state()  # partial txs changed chain state
+                    raise
+            metrics.counter("chain_transactions").add(n)
+            self.bump_state()
+            return n

@@ -235,8 +235,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, _PAGE.encode(), "text/html; charset=utf-8")
         elif self.path == "/api/state":
             session = self.console.session
-            state = dict(session.adapter.cache)
-            preview = session.last_preview
+            # Snapshot under the session lock: a locked 'resume' command
+            # rehydrates adapter.cache key-by-key on another handler
+            # thread, and iterating it unguarded can raise "dictionary
+            # changed size during iteration" (and read torn state).
+            with session.lock:
+                state = dict(session.adapter.cache)
+                preview = session.last_preview
+                state_version = session.state_version
 
             def fmt(x):
                 """Addresses as the reference displays them
@@ -246,7 +252,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return to_hex(x) if isinstance(x, int) else str(x)
 
             payload = {
-                "state_version": session.state_version,
+                "state_version": state_version,
                 "auto_fetch": session.auto_fetch,
                 "reliability_first_pass": state.get("reliability_first_pass"),
                 "reliability_second_pass": state.get("reliability_second_pass"),
