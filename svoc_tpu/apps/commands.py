@@ -87,6 +87,12 @@ class CommandConsole:
         self._auto_fetch_thread: Optional[threading.Thread] = None
         self._scraper_stop: Optional[threading.Event] = None
         self._scraper_thread: Optional[threading.Thread] = None
+        #: Serializes background-loop lifecycle (auto_fetch generation
+        #: token, scraper thread handles): query() is deliberately
+        #: unserialized, so racing 'auto_fetch on' / 'scraper on'
+        #: commands would otherwise both pass the check-then-act and
+        #: leave two loops running.
+        self._bg_lock = threading.Lock()
 
     # -- address/index parsing (web_interface.py:71-107) -------------------
 
@@ -121,17 +127,18 @@ class CommandConsole:
     # -- dispatcher (web_interface.py:133-303) ------------------------------
 
     def query(self, text: str) -> List[str]:
-        """Dispatch one command.  Serialized on ``session.lock``: the
-        web UI's ThreadingHTTPServer handlers, the stdin console, and
-        the auto_fetch loop share one session, and the reference's
-        implicit serialization (a single eel event loop over
-        ``globalState``) must survive the move to real threads —
-        without this a vote command could interleave with an
-        auto-commit's contract mutation."""
-        with self.session.lock:
-            return self._query_locked(text)
+        """Dispatch one command.
 
-    def _query_locked(self, text: str) -> List[str]:
+        Deliberately NOT serialized here: the web UI's
+        ThreadingHTTPServer handlers, the stdin console, and the
+        auto_fetch loop share one session, and holding a dispatch-wide
+        lock would freeze all of them behind a slow chain RPC or the
+        first fetch's model build.  Safety is layered below instead —
+        session field mutation under ``session.lock``, whole-fleet
+        commits under the commit lock, each chain read/tx atomic under
+        the adapter lock (tx-granular interleaving beyond that matches
+        the real chain), and the vectorizer build double-checked
+        (``Session`` docstring)."""
         out: List[str] = []
 
         def emit(line: str) -> None:
@@ -370,8 +377,11 @@ class CommandConsole:
 
         Each start bumps a generation token; a superseded loop exits at
         its next check even if off→on toggles race its wind-down, so
-        exactly one loop serves the current enable."""
-        gen = self._auto_fetch_gen = getattr(self, "_auto_fetch_gen", 0) + 1
+        exactly one loop serves the current enable.  The bump+start is
+        atomic under ``_bg_lock`` — racing starts would otherwise both
+        read the same token and neither loop would ever yield."""
+        with self._bg_lock:
+            gen = self._auto_fetch_gen = getattr(self, "_auto_fetch_gen", 0) + 1
 
         def loop():
             import time
@@ -382,17 +392,17 @@ class CommandConsole:
                 and self.session.application_on
             ):
                 try:
-                    # One lock hold per iteration: fetch/commit re-enter
-                    # it, and the resume + state bump must not interleave
-                    # with a locked command's contract mutation (the lock
-                    # is the serialization contract — session.py).
-                    with self.session.lock:
-                        self.session.fetch()
-                        if self.session.auto_commit:
-                            self.session.commit()
-                            if self.session.auto_resume:
-                                self.session.adapter.resume()
-                                self.session.bump_state()
+                    # No outer lock hold: fetch/commit/bump_state lock
+                    # internally and the adapter serializes per
+                    # operation — a slow or hung chain RPC in this loop
+                    # must never freeze the console / web UI behind the
+                    # session lock.
+                    self.session.fetch()
+                    if self.session.auto_commit:
+                        self.session.commit()
+                        if self.session.auto_resume:
+                            self.session.adapter.resume()
+                            self.session.bump_state()
                 except Exception as e:
                     # Surface the failure (once per distinct message) and
                     # count it, instead of silently spinning.
@@ -412,7 +422,13 @@ class CommandConsole:
     def _start_scraper(self) -> str:
         """Start the ingest loop; returns the source actually used
         ("hn-live" when Selenium is available and requested, else the
-        offline synthetic generator)."""
+        offline synthetic generator).  Atomic under ``_bg_lock`` —
+        racing 'scraper on' commands would otherwise both pass the
+        is-alive check and orphan one loop's stop event."""
+        with self._bg_lock:
+            return self._start_scraper_locked()
+
+    def _start_scraper_locked(self) -> str:
         if self._scraper_thread and self._scraper_thread.is_alive():
             if self._scraper_stop is not None and self._scraper_stop.is_set():
                 # A just-stopped thread is winding down — wait it out so
@@ -452,8 +468,9 @@ class CommandConsole:
         return source_name
 
     def _stop_scraper(self) -> None:
-        if self._scraper_stop is not None:
-            self._scraper_stop.set()
+        with self._bg_lock:
+            if self._scraper_stop is not None:
+                self._scraper_stop.set()
 
     def stop(self) -> None:
         self.session.auto_fetch = False

@@ -140,15 +140,29 @@ class Session:
         #: unreachable — a session must come up (console, chain reads,
         #: web UI) without touching the device; only fetch pays it.
         self._key_value = None
-        #: Serializes session mutation.  The reference is single-threaded
-        #: (one eel event loop over ``globalState``); here the auto_fetch
-        #: loop, the stdin console, and the web UI's ThreadingHTTPServer
-        #: handlers all touch one session — without this, concurrent
-        #: fetches could split the same PRNG key (duplicate fleets) and
-        #: command dispatch could interleave with contract-sim vote
-        #: mutations.  Reentrant so a command holding it can call
-        #: fetch/commit.
+        #: Concurrency model (the reference is single-threaded — one eel
+        #: event loop over ``globalState``; here the auto_fetch loop,
+        #: the stdin console, and the web UI's ThreadingHTTPServer
+        #: handlers share one session), layered so no lock is ever held
+        #: across unbounded chain I/O or model building:
+        #:
+        #: - ``lock`` (reentrant) — session field mutation: fetch's
+        #:   cursor/PRNG-split/preview, state_version bumps, commit's
+        #:   predictions snapshot.  Held only around in-memory /
+        #:   on-device work.
+        #: - ``_commit_lock`` — whole-fleet commit atomicity: two
+        #:   concurrent commits must not interleave per-oracle txs (a
+        #:   mixed fleet no fetch produced would reach consensus).
+        #: - the adapter's own lock — per-operation atomicity of chain
+        #:   reads/txs against the contract simulator and read cache
+        #:   (tx-granular interleaving beyond that matches the real
+        #:   chain).
+        #: - ``_vectorizer_lock`` — single construction of the lazy
+        #:   sentiment pipeline (tens of seconds of transformer init;
+        #:   double-checked so only first callers pay it).
         self.lock = threading.RLock()
+        self._commit_lock = threading.Lock()
+        self._vectorizer_lock = threading.Lock()
 
     # -- sentiment stage ----------------------------------------------------
 
@@ -160,8 +174,16 @@ class Session:
         subset is sized to ``config.dimension`` (the 6 tracked
         go_emotions labels when it is 6, the first ``dimension`` labels
         of the 28-label head otherwise) so fetch output always matches
-        the contract's dimension."""
-        if self._vectorizer is None:
+        the contract's dimension.
+
+        Double-checked locking on its own lock (NOT the session lock):
+        racing first fetches must not both pay the build, and callers
+        of other session state must not wait behind it."""
+        if self._vectorizer is not None:
+            return self._vectorizer
+        with self._vectorizer_lock:
+            if self._vectorizer is not None:  # lost the build race
+                return self._vectorizer
             from svoc_tpu.models.sentiment import (
                 GO_EMOTIONS_LABELS,
                 TRACKED_INDICES,
@@ -220,45 +242,61 @@ class Session:
         deviation ranks, honest ground truth) and caches ``predictions``
         for ``commit``.
         """
-        with self.lock, metrics.timer("fetch_latency").time():
-            comments, _dates, self.simulation_step = self.store.read_window(
-                self.simulation_step, self.config.window, self.config.fetch_limit
-            )
+        # The session lock is held only around cursor advance and the
+        # (bounded, on-device) fleet/preview stage — NOT around the
+        # sentiment forward: the first vectorize call pays pipeline
+        # construction AND the lazy XLA compile (tens of seconds), and
+        # neither may freeze other commands / the web UI poll.  Racing
+        # fetches therefore classify concurrently, each on the distinct
+        # window its atomic cursor advance claimed.
+        with metrics.timer("fetch_latency").time():
+            with self.lock:
+                comments, _dates, self.simulation_step = self.store.read_window(
+                    self.simulation_step, self.config.window, self.config.fetch_limit
+                )
             if not comments:
                 raise RuntimeError(
                     "comment store is empty — run the scraper (or seed the "
                     "store) before fetching"
                 )
+            # Resolved only now: an empty store must fail in
+            # milliseconds, not after a transformer build.
+            vectorize = self.vectorizer
             window = jnp.asarray(
-                np.asarray(self.vectorizer(comments), dtype=np.float32)
+                np.asarray(vectorize(comments), dtype=np.float32)
             )
-            if self._key_value is None:
-                self._key_value = jax.random.PRNGKey(self.config.seed)
-            self._key_value, sub = jax.random.split(self._key_value)
-            values, honest = _fleet(
-                sub,
-                window,
-                self.config.n_oracles,
-                self.config.n_failing,
-                self.config.bootstrap_subset,
-            )
-            mean, median, ranks = _preview_stats(values)
-            metrics.counter("comments_processed").add(len(comments))
-            self.predictions = np.asarray(values, dtype=np.float64)
-            self.last_preview = {
-                "values": self.predictions,
-                "mean": np.asarray(mean),
-                "median": np.asarray(median),
-                "normalized_ranks": np.asarray(ranks),
-                "honest": np.asarray(honest),
-                "n_comments": len(comments),
-            }
-            self.bump_state()
-            return self.last_preview
+            with self.lock:
+                if self._key_value is None:
+                    self._key_value = jax.random.PRNGKey(self.config.seed)
+                self._key_value, sub = jax.random.split(self._key_value)
+                values, honest = _fleet(
+                    sub,
+                    window,
+                    self.config.n_oracles,
+                    self.config.n_failing,
+                    self.config.bootstrap_subset,
+                )
+                mean, median, ranks = _preview_stats(values)
+                metrics.counter("comments_processed").add(len(comments))
+                self.predictions = np.asarray(values, dtype=np.float64)
+                preview = {
+                    "values": self.predictions,
+                    "mean": np.asarray(mean),
+                    "median": np.asarray(median),
+                    "normalized_ranks": np.asarray(ranks),
+                    "honest": np.asarray(honest),
+                    "n_comments": len(comments),
+                }
+                self.last_preview = preview
+                self.bump_state()
+        return preview
 
     def bump_state(self) -> None:
-        """Mark renderable state as changed (web UI poll redraw)."""
-        self.state_version += 1
+        """Mark renderable state as changed (web UI poll redraw).
+        Self-locking: the increment is a read-modify-write racing the
+        auto_fetch thread against command dispatch."""
+        with self.lock:
+            self.state_version += 1
 
     # -- the commit path (contract.py:200-208) ------------------------------
 
@@ -269,17 +307,24 @@ class Session:
         (those transactions are on chain) before the
         :class:`ChainCommitError` propagates to the command layer.
         """
+        # Snapshot under the session lock, then submit under the COMMIT
+        # lock only: a Sepolia RPC can stall indefinitely and must not
+        # freeze the console / web UI behind the session lock, but two
+        # concurrent commits must also not interleave their per-oracle
+        # txs (a mixed fleet no fetch produced would reach consensus) —
+        # whole-fleet atomicity lives on ``_commit_lock``.
         with self.lock:
             if self.predictions is None:
                 raise RuntimeError("fetch before commit")
-            with metrics.timer("commit_latency").time():
-                try:
-                    n = self.adapter.update_all_the_predictions(self.predictions)
-                except ChainCommitError as e:
-                    metrics.counter("chain_transactions").add(e.committed)
-                    metrics.counter("chain_commit_failures").add(1)
-                    self.bump_state()  # partial txs changed chain state
-                    raise
-            metrics.counter("chain_transactions").add(n)
-            self.bump_state()
-            return n
+            predictions = self.predictions
+        with self._commit_lock, metrics.timer("commit_latency").time():
+            try:
+                n = self.adapter.update_all_the_predictions(predictions)
+            except ChainCommitError as e:
+                metrics.counter("chain_transactions").add(e.committed)
+                metrics.counter("chain_commit_failures").add(1)
+                self.bump_state()  # partial txs changed chain state
+                raise
+        metrics.counter("chain_transactions").add(n)
+        self.bump_state()
+        return n

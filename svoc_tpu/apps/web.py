@@ -235,14 +235,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, _PAGE.encode(), "text/html; charset=utf-8")
         elif self.path == "/api/state":
             session = self.console.session
-            # Snapshot under the session lock: a locked 'resume' command
-            # rehydrates adapter.cache key-by-key on another handler
-            # thread, and iterating it unguarded can raise "dictionary
-            # changed size during iteration" (and read torn state).
+            # Consistent snapshots: the adapter lock guards the cache
+            # against a concurrent 'resume' rehydrating it key-by-key
+            # ("dictionary changed size during iteration"); the session
+            # lock pairs preview with its state_version.  ORDER MATTERS:
+            # the version is read BEFORE the cache, so data can only be
+            # fresher than its label — a stale-cache/new-version pairing
+            # would make the browser's version comparison skip the next
+            # poll's fresh redraw.
             with session.lock:
-                state = dict(session.adapter.cache)
                 preview = session.last_preview
                 state_version = session.state_version
+            state = session.adapter.cache_snapshot()
 
             def fmt(x):
                 """Addresses as the reference displays them

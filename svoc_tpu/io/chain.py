@@ -28,8 +28,10 @@ hex like the reference's ``to_hex`` where string forms are exposed.
 from __future__ import annotations
 
 import asyncio
+import functools
 import json
 import os
+import threading
 from typing import Any, Dict, List, Optional, Protocol, Sequence, Tuple
 
 import numpy as np
@@ -353,32 +355,64 @@ def starknet_backend_from_files(
     return StarknetBackend(rpc, deployed, accounts, client=client)
 
 
+def _atomic(fn):
+    """Serialize one adapter operation (backend call/invoke + its cache
+    write) on the adapter lock.  Deliberately NOT applied to the
+    composite loops (``update_all_the_predictions``, ``resume``): their
+    inner ops each lock individually, so a long chain commit never
+    monopolizes the adapter — interleaving at transaction granularity
+    is exactly what the real chain permits anyway."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
+
+
 class ChainAdapter:
-    """The typed chain API (``call_*`` / ``invoke_*`` parity)."""
+    """The typed chain API (``call_*`` / ``invoke_*`` parity).
+
+    Thread-safe at operation granularity: each read or signed tx is
+    atomic under the adapter lock (protecting the in-memory contract
+    simulator's state machine and the read cache), while composites
+    interleave at tx granularity like the real chain."""
 
     def __init__(self, backend: ChainBackend):
         self.backend = backend
         #: Last-read cache, the ``globalState.remote_*`` equivalent
         #: (``client/common.py:43-55``) — rehydrated by ``resume``.
         self.cache: Dict[str, Any] = {}
+        self._lock = threading.RLock()
+
+    def cache_snapshot(self) -> Dict[str, Any]:
+        """Consistent copy of the read cache for UI rendering — safe
+        against a concurrent ``resume`` rehydrating it key by key."""
+        with self._lock:
+            return dict(self.cache)
 
     # -- reads (client/contract.py:131-190) --------------------------------
 
+    @_atomic
     def call_consensus(self) -> List[float]:
         v = [fwsad_to_float(x) for x in self.backend.call("get_consensus_value")]
         self.cache["consensus"] = v
         return v
 
+    @_atomic
     def call_skewness(self) -> List[float]:
         v = [fwsad_to_float(x) for x in self.backend.call("get_skewness")]
         self.cache["skewness"] = v
         return v
 
+    @_atomic
     def call_kurtosis(self) -> List[float]:
         v = [fwsad_to_float(x) for x in self.backend.call("get_kurtosis")]
         self.cache["kurtosis"] = v
         return v
 
+    @_atomic
     def call_first_pass_consensus_reliability(self) -> float:
         v = fwsad_to_float(
             self.backend.call("get_first_pass_consensus_reliability")
@@ -386,6 +420,7 @@ class ChainAdapter:
         self.cache["reliability_first_pass"] = v
         return v
 
+    @_atomic
     def call_second_pass_consensus_reliability(self) -> float:
         v = fwsad_to_float(
             self.backend.call("get_second_pass_consensus_reliability")
@@ -393,31 +428,37 @@ class ChainAdapter:
         self.cache["reliability_second_pass"] = v
         return v
 
+    @_atomic
     def call_consensus_active(self) -> bool:
         v = bool(self.backend.call("consensus_active"))
         self.cache["consensus_active"] = v
         return v
 
+    @_atomic
     def call_admin_list(self) -> List:
         v = self.backend.call("get_admin_list")
         self.cache["admin_list"] = v
         return v
 
+    @_atomic
     def call_oracle_list(self) -> List:
         v = self.backend.call("get_oracle_list")
         self.cache["oracle_list"] = v
         return v
 
+    @_atomic
     def call_dimension(self) -> int:
         v = int(self.backend.call("get_predictions_dimension"))
         self.cache["dimension"] = v
         return v
 
+    @_atomic
     def call_replacement_propositions(self) -> List:
         v = self.backend.call("get_replacement_propositions")
         self.cache["replacement_propositions"] = v
         return v
 
+    @_atomic
     def call_oracle_value_list(self, caller) -> List:
         """Admin-only raw dump, decoded: ``(address, [floats], enabled,
         reliable)`` per oracle (``client/contract.py:188-190``)."""
@@ -446,6 +487,7 @@ class ChainAdapter:
 
     # -- writes (client/contract.py:200-264) -------------------------------
 
+    @_atomic
     def invoke_update_prediction(self, oracle_address, prediction) -> None:
         felts = [float_to_fwsad(float(x)) for x in np.asarray(prediction).ravel()]
         self.backend.invoke(
@@ -479,6 +521,7 @@ class ChainAdapter:
             n += 1
         return n
 
+    @_atomic
     def invoke_update_proposition(
         self,
         admin_address,
@@ -499,6 +542,7 @@ class ChainAdapter:
             admin_address, "update_proposition", proposition=proposition
         )
 
+    @_atomic
     def invoke_vote_for_a_proposition(
         self, admin_address, which_admin: int, support: bool
     ) -> None:
@@ -527,4 +571,4 @@ class ChainAdapter:
             # Contract deployed with replacement disabled; anything else
             # (RPC failures, codec bugs) propagates like the other reads.
             self.cache["replacement_propositions"] = None
-        return dict(self.cache)
+        return self.cache_snapshot()

@@ -84,6 +84,23 @@ def quantize_params(params: Dict, cfg: EncoderConfig) -> Dict:
     return {"params": tree}
 
 
+def is_quantized_tree(params: Dict) -> bool:
+    """Whether ``params`` is already a :func:`quantize_params` output
+    (any node carrying an ``w_int8`` kernel).  Lets serving load a
+    persisted folded tree (``models.convert.save_params`` round-trips
+    int8 leaves through ``.npz`` dtype-exactly) instead of re-folding
+    at every boot."""
+
+    def walk(node) -> bool:
+        if isinstance(node, dict):
+            if "w_int8" in node:
+                return True
+            return any(walk(v) for v in node.values())
+        return False
+
+    return walk(params)
+
+
 def quantized_size_bytes(qparams: Dict) -> int:
     """Total HBM footprint of the quantized tree (int8 kernels + f32
     rest) — ~4× below the f32 tree, ~2× below bf16-resident."""

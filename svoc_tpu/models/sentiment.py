@@ -97,10 +97,16 @@ class SentimentPipeline:
     #: (:mod:`svoc_tpu.models.quant`) — 2× the bf16 MXU rate on v5e,
     #: ~4× smaller HBM tree; composes with ``packed`` and ``data_mesh``.
     #: None (default) keeps the float forward.  Serving-only: the
-    #: quantized tree is not trainable and not checkpoint-compatible.
+    #: quantized tree is not trainable; it persists via
+    #: ``models.convert.save_params``/``load_params`` (a pre-folded tree
+    #: passed as ``params`` is used as-is).
     quant: Optional[str] = None
 
     def __post_init__(self):
+        from svoc_tpu.models.forward import resolve_forward, validate_quant
+
+        # ALL config validation up front — before the tree cast and the
+        # tokenizer load, so a misconfiguration fails in microseconds.
         if self.packed and self.cfg.attention != "dense":
             raise ValueError(
                 "packed inference needs cfg.attention == 'dense' — the "
@@ -112,6 +118,14 @@ class SentimentPipeline:
                 f"label_indices {self.label_indices} out of range for a "
                 f"{self.cfg.n_labels}-label head — pass label_indices "
                 f"matching the model (e.g. (0, 1) for SST-2)"
+            )
+        validate_quant(self.cfg, self.quant)
+        if self.quant and self.params_dtype is not None:
+            raise ValueError(
+                "params_dtype is not meaningful under quant='int8' — "
+                "the fold defines its own dtypes (int8 kernels, f32 "
+                "scales/rest); casting a quantized tree would change "
+                "its numerics"
             )
         self.model = SentimentEncoder(self.cfg)
         if self.params is None:
@@ -142,18 +156,18 @@ class SentimentPipeline:
                 pad_id=self.cfg.pad_id,
                 max_len=self.seq_len,
             )
-        from svoc_tpu.models.forward import resolve_forward, validate_quant
-
-        validate_quant(self.cfg, self.quant)
         multi = self.cfg.head == "sigmoid"
         idx = self.label_indices
 
         if self.quant == "int8":
-            from svoc_tpu.models.quant import quantize_params
+            from svoc_tpu.models.quant import is_quantized_tree, quantize_params
 
             # The float tree is dropped after folding — the pipeline
-            # holds only the int8 kernels (+ f32 rest) from here on.
-            self.params = quantize_params(self.params, self.cfg)
+            # holds only the int8 kernels (+ f32 rest) from here on.  A
+            # pre-folded tree (e.g. load_params of a persisted fold) is
+            # used as-is.
+            if not is_quantized_tree(self.params):
+                self.params = quantize_params(self.params, self.cfg)
         apply_fn = resolve_forward(self.cfg, self.quant)
 
         def forward_fn_body(params, ids, mask):

@@ -77,27 +77,37 @@ class Counter:
 
 @dataclass
 class LatencyTimer:
-    """Running latency stats (count / mean / max, EMA of recent)."""
+    """Running latency stats (count / mean / max, EMA of recent).
+
+    Thread-safe like :class:`Counter`: fetch/commit timers are observed
+    concurrently from the auto_fetch loop, the console, and web
+    handlers — unsynchronized read-modify-writes would lose samples and
+    desynchronize ``total_s`` from ``n``."""
 
     n: int = 0
     total_s: float = 0.0
     max_s: float = 0.0
     ema_s: Optional[float] = None
     ema_alpha: float = 0.1
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def observe(self, seconds: float) -> None:
-        self.n += 1
-        self.total_s += seconds
-        self.max_s = max(self.max_s, seconds)
-        self.ema_s = (
-            seconds
-            if self.ema_s is None
-            else self.ema_alpha * seconds + (1 - self.ema_alpha) * self.ema_s
-        )
+        with self._lock:
+            self.n += 1
+            self.total_s += seconds
+            self.max_s = max(self.max_s, seconds)
+            self.ema_s = (
+                seconds
+                if self.ema_s is None
+                else self.ema_alpha * seconds + (1 - self.ema_alpha) * self.ema_s
+            )
 
     @property
     def mean_s(self) -> float:
-        return self.total_s / self.n if self.n else 0.0
+        with self._lock:
+            return self.total_s / self.n if self.n else 0.0
 
     @contextlib.contextmanager
     def time(self) -> Iterator[None]:

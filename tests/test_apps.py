@@ -287,6 +287,7 @@ class TestConcurrency:
             t.start()
         for t in threads:
             t.join(timeout=120)
+            assert not t.is_alive(), "worker deadlocked"
         assert not errors
         # Every oracle committed at least once under contention, and the
         # contract went through the activation gate exactly as in the
@@ -312,6 +313,7 @@ class TestConcurrency:
             t.start()
         for t in threads:
             t.join(timeout=120)
+            assert not t.is_alive(), "worker deadlocked"
         assert len(results) == 4
         for i in range(len(results)):
             for j in range(i + 1, len(results)):
@@ -319,3 +321,86 @@ class TestConcurrency:
                     "two fetches produced identical fleets — PRNG key "
                     "split raced"
                 )
+
+    def test_concurrent_commits_do_not_interleave_transactions(self):
+        """Whole-fleet commit atomicity: two racing commits must land as
+        two contiguous 7-tx blocks, never a mixed fleet (which would
+        reach consensus even though no fetch produced it)."""
+        import threading
+        import time
+
+        from svoc_tpu.io.chain import ChainAdapter, LocalChainBackend
+        from svoc_tpu.apps.session import _default_contract
+
+        cfg = SessionConfig()
+        inner = LocalChainBackend(_default_contract(cfg))
+        tx_log = []
+
+        class RecordingBackend:
+            def call(self, *a):
+                return inner.call(*a)
+
+            def call_as(self, *a):
+                return inner.call_as(*a)
+
+            def invoke(self, caller, fn, /, **kwargs):
+                time.sleep(0.005)  # widen the race window
+                tx_log.append((threading.get_ident(), fn))
+                return inner.invoke(caller, fn, **kwargs)
+
+        store = CommentStore()
+        store.save(SyntheticSource(batch=200)())
+        session = Session(
+            config=cfg, store=store, vectorizer=fake_vectorizer,
+            adapter=ChainAdapter(RecordingBackend()),
+        )
+        session.fetch()
+
+        threads = [
+            threading.Thread(target=session.commit) for _ in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "commit deadlocked"
+        assert len(tx_log) == 2 * cfg.n_oracles
+        # Contiguity: the thread id must change exactly once.
+        owners = [tid for tid, _ in tx_log]
+        assert sum(
+            1 for a, b in zip(owners, owners[1:]) if a != b
+        ) == 1, f"interleaved commits: {owners}"
+
+    def test_racing_first_fetches_build_vectorizer_once(self, monkeypatch):
+        import threading
+
+        builds = []
+
+        class CountingPipeline:
+            def __init__(self, **kwargs):
+                import time
+
+                builds.append(1)
+                time.sleep(0.2)  # widen the race window
+
+            def __call__(self, texts):
+                rng = np.random.default_rng(42)
+                v = rng.uniform(0.05, 0.95, size=(len(texts), 6))
+                return v / v.sum(axis=1, keepdims=True)
+
+        import svoc_tpu.models.sentiment as sentiment_mod
+
+        monkeypatch.setattr(sentiment_mod, "SentimentPipeline", CountingPipeline)
+        store = CommentStore()
+        store.save(SyntheticSource(batch=200)())
+        session = Session(config=SessionConfig(), store=store)
+
+        threads = [
+            threading.Thread(target=session.fetch) for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive()
+        assert sum(builds) == 1, f"vectorizer built {sum(builds)} times"

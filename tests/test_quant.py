@@ -156,3 +156,43 @@ class TestPipelineIntegration:
                 cfg=CFG, seq_len=32, batch_size=4, tokenizer_name=None,
                 quant="int4",
             )
+
+
+class TestPersistence:
+    def test_quantized_tree_roundtrips_npz_and_serves(self, tmp_path):
+        """save_params/load_params must preserve the folded tree
+        dtype-exactly (int8 kernels included) and a pipeline handed the
+        loaded tree must serve without re-folding, matching the
+        fresh-fold pipeline bit-for-bit."""
+        from svoc_tpu.models.convert import load_params, save_params
+        from svoc_tpu.models.quant import is_quantized_tree, quantize_params
+
+        params = _params()
+        q = quantize_params(params, CFG)
+        path = save_params(str(tmp_path / "int8_tree"), q)
+        loaded = load_params(path)
+        assert is_quantized_tree(loaded)
+        assert not is_quantized_tree(params)
+        b0 = loaded["params"]["block_0"]["attention"]["query"]
+        assert b0["w_int8"].dtype == np.int8
+        np.testing.assert_array_equal(
+            b0["w_int8"],
+            np.asarray(q["params"]["block_0"]["attention"]["query"]["w_int8"]),
+        )
+
+        fresh = SentimentPipeline(
+            cfg=CFG, seq_len=32, batch_size=4, tokenizer_name=None, seed=3,
+            params=params, quant="int8",
+        )
+        from_disk = SentimentPipeline(
+            cfg=CFG, seq_len=32, batch_size=4, tokenizer_name=None, seed=3,
+            params=loaded, quant="int8",
+        )
+        np.testing.assert_array_equal(fresh(TEXTS), from_disk(TEXTS))
+
+    def test_quant_rejects_params_dtype(self):
+        with pytest.raises(ValueError, match="params_dtype"):
+            SentimentPipeline(
+                cfg=CFG, seq_len=32, batch_size=4, tokenizer_name=None,
+                quant="int8", params_dtype="bfloat16",
+            )
