@@ -334,7 +334,10 @@ class CommandConsole:
                     return out
                 if on_off_to_bool(args[0]):
                     source_name = self._start_scraper()
-                    emit(f"Scraper: ENABLED ({source_name})")
+                    if source_name is None:
+                        emit("Scraper: not started (superseded or stopped)")
+                    else:
+                        emit(f"Scraper: ENABLED ({source_name})")
                 else:
                     self._stop_scraper()
                     emit("Scraper: DISABLED")
@@ -352,7 +355,7 @@ class CommandConsole:
                     emit("Unexpected number of arguments.")
                     return out
                 if on_off_to_bool(args[0]):
-                    source_name = self._start_scraper()
+                    source_name = self._start_scraper() or "unchanged"
                     self.session.auto_commit = True
                     self.session.auto_fetch = True
                     self._start_auto_fetch()
@@ -419,23 +422,33 @@ class CommandConsole:
         self._auto_fetch_thread = threading.Thread(target=loop, daemon=True)
         self._auto_fetch_thread.start()
 
-    def _start_scraper(self) -> str:
+    def _start_scraper(self) -> Optional[str]:
         """Start the ingest loop; returns the source actually used
         ("hn-live" when Selenium is available and requested, else the
-        offline synthetic generator).  Atomic under ``_bg_lock`` —
-        racing 'scraper on' commands would otherwise both pass the
-        is-alive check and orphan one loop's stop event."""
-        with self._bg_lock:
-            return self._start_scraper_locked()
+        offline synthetic generator), or ``None`` when nothing was
+        started (claim superseded by a newer command, or stopped before
+        the commit phase).
 
-    def _start_scraper_locked(self) -> str:
-        if self._scraper_thread and self._scraper_thread.is_alive():
-            if self._scraper_stop is not None and self._scraper_stop.is_set():
-                # A just-stopped thread is winding down — wait it out so
-                # the restart actually starts a fresh loop.
-                self._scraper_thread.join(timeout=5)
-            else:
-                return "already running"
+        Claim → build → commit: the slot is claimed by a fresh stop
+        event under ``_bg_lock`` (racing 'scraper on' commands would
+        otherwise both pass the is-alive check and orphan one loop's
+        stop event), but the SOURCE BUILD runs unlocked — a Selenium
+        browser launch takes seconds (or hangs), and 'scraper off' /
+        'exit' must never block behind it.  The commit phase starts the
+        thread only if this claim is still the current one."""
+        with self._bg_lock:
+            winding_down = None
+            if self._scraper_thread and self._scraper_thread.is_alive():
+                if self._scraper_stop is not None and self._scraper_stop.is_set():
+                    winding_down = self._scraper_thread
+                else:
+                    return "already running"
+            stop = self._scraper_stop = threading.Event()
+        if winding_down is not None:
+            # A just-stopped loop is winding down — wait it out (outside
+            # the lock) so the restart actually starts a fresh loop.
+            winding_down.join(timeout=5)
+
         from svoc_tpu.io.scraper import (
             SeleniumHNSource,
             SyntheticSource,
@@ -451,9 +464,6 @@ class CommandConsole:
         if source is None:
             source = SyntheticSource()
 
-        self._scraper_stop = threading.Event()
-        stop = self._scraper_stop
-
         def loop():
             run_scraper(
                 self.session.store,
@@ -463,8 +473,25 @@ class CommandConsole:
                 sleep=lambda s: stop.wait(s),
             )
 
-        self._scraper_thread = threading.Thread(target=loop, daemon=True)
-        self._scraper_thread.start()
+        def discard() -> None:
+            # The claim lost — release the built source (a Selenium
+            # source holds a live headless Firefox that GC never quits).
+            close = getattr(source, "close", None)
+            if close:
+                close()
+
+        with self._bg_lock:
+            if self._scraper_stop is not stop:
+                discard()
+                return None  # superseded by a newer scraper command
+            if stop.is_set():
+                # 'scraper off' landed between claim and commit — honor
+                # it rather than starting a loop that exits immediately.
+                discard()
+                self._scraper_stop = None
+                return None
+            self._scraper_thread = threading.Thread(target=loop, daemon=True)
+            self._scraper_thread.start()
         return source_name
 
     def _stop_scraper(self) -> None:

@@ -163,6 +163,13 @@ class Session:
         self.lock = threading.RLock()
         self._commit_lock = threading.Lock()
         self._vectorizer_lock = threading.Lock()
+        #: Fetch publish ordering: each fetch claims a monotonically
+        #: increasing token with its window cursor; a slower fetch of an
+        #: EARLIER window must not overwrite predictions/preview from a
+        #: later one (the UI and auto_commit would regress to stale
+        #: data).
+        self._fetch_claim = 0
+        self._fetch_published = 0
 
     # -- sentiment stage ----------------------------------------------------
 
@@ -242,18 +249,21 @@ class Session:
         deviation ranks, honest ground truth) and caches ``predictions``
         for ``commit``.
         """
-        # The session lock is held only around cursor advance and the
-        # (bounded, on-device) fleet/preview stage — NOT around the
-        # sentiment forward: the first vectorize call pays pipeline
-        # construction AND the lazy XLA compile (tens of seconds), and
-        # neither may freeze other commands / the web UI poll.  Racing
-        # fetches therefore classify concurrently, each on the distinct
-        # window its atomic cursor advance claimed.
+        # The session lock is held only around bounded in-memory work
+        # (cursor advance + claim, PRNG split, publish) — NOT around
+        # the sentiment forward or the fleet/preview compute, whose
+        # first calls pay pipeline construction and XLA compiles (tens
+        # of seconds) and must never freeze other commands / the web UI
+        # poll.  Racing fetches classify concurrently, each on the
+        # distinct window its atomic cursor advance claimed; the claim
+        # token keeps publishes in window order.
         with metrics.timer("fetch_latency").time():
             with self.lock:
                 comments, _dates, self.simulation_step = self.store.read_window(
                     self.simulation_step, self.config.window, self.config.fetch_limit
                 )
+                self._fetch_claim += 1
+                claim = self._fetch_claim
             if not comments:
                 raise RuntimeError(
                     "comment store is empty — run the scraper (or seed the "
@@ -269,26 +279,32 @@ class Session:
                 if self._key_value is None:
                     self._key_value = jax.random.PRNGKey(self.config.seed)
                 self._key_value, sub = jax.random.split(self._key_value)
-                values, honest = _fleet(
-                    sub,
-                    window,
-                    self.config.n_oracles,
-                    self.config.n_failing,
-                    self.config.bootstrap_subset,
-                )
-                mean, median, ranks = _preview_stats(values)
-                metrics.counter("comments_processed").add(len(comments))
-                self.predictions = np.asarray(values, dtype=np.float64)
-                preview = {
-                    "values": self.predictions,
-                    "mean": np.asarray(mean),
-                    "median": np.asarray(median),
-                    "normalized_ranks": np.asarray(ranks),
-                    "honest": np.asarray(honest),
-                    "n_comments": len(comments),
-                }
-                self.last_preview = preview
-                self.bump_state()
+            values, honest = _fleet(
+                sub,
+                window,
+                self.config.n_oracles,
+                self.config.n_failing,
+                self.config.bootstrap_subset,
+            )
+            mean, median, ranks = _preview_stats(values)
+            metrics.counter("comments_processed").add(len(comments))
+            predictions = np.asarray(values, dtype=np.float64)
+            preview = {
+                "values": predictions,
+                "mean": np.asarray(mean),
+                "median": np.asarray(median),
+                "normalized_ranks": np.asarray(ranks),
+                "honest": np.asarray(honest),
+                "n_comments": len(comments),
+            }
+            with self.lock:
+                # Publish only if no LATER claim already did — a slow
+                # fetch of an older window must not regress the state.
+                if claim > self._fetch_published:
+                    self._fetch_published = claim
+                    self.predictions = predictions
+                    self.last_preview = preview
+                    self.bump_state()
         return preview
 
     def bump_state(self) -> None:

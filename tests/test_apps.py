@@ -404,3 +404,42 @@ class TestConcurrency:
             t.join(timeout=120)
             assert not t.is_alive()
         assert sum(builds) == 1, f"vectorizer built {sum(builds)} times"
+
+    def test_slow_earlier_fetch_does_not_overwrite_later_publish(self):
+        """Publish ordering: a fetch that claimed an EARLIER window but
+        finishes later must not regress predictions/preview/state
+        published by a later-window fetch."""
+        import threading
+        import time
+
+        release_first = threading.Event()
+        call_count = []
+
+        def gated_vectorizer(texts):
+            i = len(call_count)
+            call_count.append(1)
+            if i == 0:  # first (earlier-window) fetch stalls mid-flight
+                release_first.wait(30)
+            rng = np.random.default_rng(100 + i)
+            v = rng.uniform(0.05, 0.95, size=(len(texts), 6))
+            return v / v.sum(axis=1, keepdims=True)
+
+        store = CommentStore()
+        store.save(SyntheticSource(batch=200)())
+        session = Session(
+            config=SessionConfig(), store=store, vectorizer=gated_vectorizer
+        )
+        slow = threading.Thread(target=session.fetch)
+        slow.start()
+        while not call_count:  # slow fetch has claimed window 1
+            time.sleep(0.01)
+        later = session.fetch()  # claims window 2, publishes
+        version_after_later = session.state_version
+        release_first.set()
+        slow.join(timeout=60)
+        assert not slow.is_alive()
+        # The later window's fleet remains the published state, and no
+        # extra version bump advertised the stale overwrite.
+        np.testing.assert_array_equal(session.predictions, later["values"])
+        assert session.last_preview["values"] is later["values"]
+        assert session.state_version == version_after_later
