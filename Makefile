@@ -1,0 +1,53 @@
+# Build/run glue — the reference's client/Makefile targets
+# (import_contracts / run / run_with_scraper / run_scraper,
+# client/Makefile:1-13) mapped onto this framework, plus the
+# framework-native targets (tests, bench, native runtime).
+
+PY ?= python
+
+.PHONY: run run_with_scraper run_scraper web test test_fast bench native clean
+
+# The stdin console client (reference: `make run` -> python3 main.py).
+run:
+	$(PY) -m svoc_tpu.apps.cli
+
+# Console + background ingest loop (reference: `make run_with_scraper`).
+run_with_scraper:
+	$(PY) -m svoc_tpu.apps.cli --scraper
+
+# Ingest loop alone (reference: `make run_scraper` -> scraper.py);
+# SVOC_SCRAPER_RATE seconds between scrapes (reference default 600).
+run_scraper:
+	$(PY) -c "import os; \
+	from svoc_tpu.io.comment_store import CommentStore; \
+	from svoc_tpu.io.scraper import SyntheticSource, run_scraper; \
+	run_scraper(CommentStore('comments.db'), SyntheticSource(), \
+	rate_s=float(os.environ.get('SVOC_SCRAPER_RATE', '600')))"
+
+# The web UI (reference: eel window; here a stdlib server on :8100).
+web:
+	$(PY) -m svoc_tpu.apps.web
+
+# Hermetic suite on the 8-device virtual CPU mesh.
+test:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/ -q
+
+# Quick smoke subset (consensus math + apps; no transformer builds).
+test_fast:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_fixedpoint.py tests/test_sort.py \
+	tests/test_consensus_kernel.py tests/test_state.py tests/test_apps.py -q
+
+# One-line JSON throughput benchmark (flagship; --config N for others).
+bench:
+	$(PY) bench.py
+
+# Build/verify the native C++ runtime pieces (they also build lazily
+# on first import).
+native:
+	$(PY) -c "from svoc_tpu.runtime.native import native_available; \
+	assert native_available(), 'native build failed'; print('native runtime OK')"
+
+clean:
+	rm -rf build dist *.egg-info svoc_tpu/runtime/*.so __pycache__
