@@ -18,10 +18,11 @@ run_with_scraper:
 # Ingest loop alone (reference: `make run_scraper` -> scraper.py);
 # SVOC_SCRAPER_RATE seconds between scrapes (reference default 600).
 run_scraper:
+	mkdir -p data
 	$(PY) -c "import os; \
 	from svoc_tpu.io.comment_store import CommentStore; \
 	from svoc_tpu.io.scraper import SyntheticSource, run_scraper; \
-	run_scraper(CommentStore('comments.db'), SyntheticSource(), \
+	run_scraper(CommentStore('data/comments.db'), SyntheticSource(), \
 	rate_s=float(os.environ.get('SVOC_SCRAPER_RATE', '600')))"
 
 # The web UI (reference: eel window; here a stdlib server on :8100).
@@ -50,4 +51,5 @@ native:
 	assert native_available(), 'native build failed'; print('native runtime OK')"
 
 clean:
-	rm -rf build dist *.egg-info svoc_tpu/runtime/*.so __pycache__
+	rm -rf build dist *.egg-info svoc_tpu/runtime/_build svoc_tpu/runtime/*.so
+	find . -name __pycache__ -type d -not -path './.git/*' -exec rm -rf {} +
