@@ -1,0 +1,218 @@
+"""Property-based tests (hypothesis) for the numeric foundations.
+
+The example-based suites pin Cairo fixtures and reference recordings;
+these cover the INVARIANTS across arbitrary inputs — codec round trips,
+sort/rank permutation laws, packing bijections, consensus mask
+cardinality — where a counterexample means a real parity bug, not a
+tolerance issue.  Deadlines are disabled: jit compilation on first
+example would trip hypothesis's per-example timer.
+"""
+
+import math
+from fractions import Fraction
+
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import HealthCheck, assume, given, settings
+from hypothesis import strategies as st
+
+from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step
+from svoc_tpu.models.packing import pack_tokens
+from svoc_tpu.ops.fixedpoint import (
+    FELT_PRIME,
+    WSAD,
+    div_trunc,
+    felt_to_wsad,
+    float_to_fwsad,
+    fwsad_to_float,
+    to_wsad,
+    wsad_mul,
+    wsad_to_felt,
+)
+from svoc_tpu.ops.sort import indexed_sort_host, reliability_mask
+from svoc_tpu.ops.stats import rank_array
+
+COMMON = settings(
+    deadline=None,
+    max_examples=60,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+
+# wsad ints that survive the i128 range with room for mul's rescale.
+wsad_ints = st.integers(min_value=-(10**15), max_value=10**15)
+floats_unit = st.floats(
+    min_value=-1e6, max_value=1e6, allow_nan=False, allow_infinity=False
+)
+
+
+class TestFixedpointProperties:
+    @COMMON
+    @given(floats_unit)
+    def test_float_felt_roundtrip_within_grid(self, x):
+        """float → felt252 → float loses at most one wsad step (the
+        codec TRUNCATES like the reference's ``int(x*1e6)``,
+        ``client/contract.py:48-49`` — not round-to-nearest)."""
+        back = fwsad_to_float(float_to_fwsad(x))
+        assert abs(back - x) < 1.0 / WSAD + 1e-9
+        assert abs(back) <= abs(x) + 1e-12  # truncation: toward zero
+
+    @COMMON
+    @given(wsad_ints)
+    def test_felt_wrap_is_involutive(self, w):
+        felt = wsad_to_felt(w)
+        assert 0 <= felt < FELT_PRIME
+        assert felt_to_wsad(felt) == w
+
+    @COMMON
+    @given(floats_unit)
+    def test_to_wsad_truncates_toward_zero(self, x):
+        w = to_wsad(x)
+        assert abs(w) <= abs(x) * WSAD + 1e-6  # never rounds away from zero
+        assert abs(w / WSAD - x) < 1.0 / WSAD + 1e-9
+
+    @COMMON
+    @given(wsad_ints, wsad_ints)
+    def test_wsad_mul_matches_independent_rational_oracle(self, a, b):
+        """signed_decimal.cairo:110-112 semantics via an INDEPENDENT
+        oracle: exact rational (a·b + WSAD/2) / WSAD truncated toward
+        zero with Fraction/math.trunc — shares no code with the
+        implementation's div_trunc."""
+        expected = math.trunc(Fraction(a * b + WSAD // 2, WSAD))
+        assert wsad_mul(a, b) == expected
+
+    def test_wsad_mul_signed_pinned_cases(self):
+        """Hand-derived signed cases (wsad scale 1e6): the +HALF bias is
+        added BEFORE the truncating division, so negative products round
+        toward zero asymmetrically."""
+        # 1.5 * 2.0 = 3.0
+        assert wsad_mul(1_500_000, 2_000_000) == 3_000_000
+        # (-3) * 0.5: a·b = -1.5e12; +HALF → -1_499_999_500_000;
+        # truncating division by 1e6 gives -1_499_999 — i.e. -1.499999,
+        # one ulp toward zero from the exact -1.5 (the bias is ADDED,
+        # not sign-symmetric; Cairo's i128 div truncates toward zero)
+        assert wsad_mul(-3_000_000, 500_000) == -1_499_999
+        # one ulp * 1.0: (1_000_000 + 500_000)/1e6 truncates to 1 —
+        # the +HALF bias rounds the positive half-ulp UP
+        assert wsad_mul(1, 1_000_000) == 1
+        # minus one ulp * 1.0 → (-1_000_000 + 500_000)/1e6 truncates
+        # to 0 — the same bias rounds the negative half-ulp up too
+        assert wsad_mul(-1, 1_000_000) == 0
+
+    @COMMON
+    @given(st.integers(-(10**18), 10**18), st.integers(-(10**18), 10**18))
+    def test_div_trunc_truncates_toward_zero(self, a, b):
+        assume(b != 0)
+        q = div_trunc(a, b)
+        assert abs(q) == abs(a) // abs(b)
+        assert q * a * b >= 0 or q == 0  # sign follows a*b
+
+
+class TestSortRankProperties:
+    @COMMON
+    @given(st.lists(st.integers(-(10**9), 10**9), min_size=1, max_size=40))
+    def test_indexed_sort_permutation_with_cairo_tie_order(self, values):
+        """IndexedMergeSort parity: output values ascending, indices a
+        permutation, and ties in DESCENDING original-index order — the
+        Cairo merge takes the right element on ties
+        (``sort.cairo:96-101``), which decides which oracle gets masked
+        and must be reproduced exactly (NOT a stable sort)."""
+        pairs = indexed_sort_host(values)
+        assert sorted(i for i, _ in pairs) == list(range(len(values)))
+        assert [v for _, v in pairs] == sorted(values)
+        for (i1, v1), (i2, v2) in zip(pairs, pairs[1:]):
+            if v1 == v2:
+                assert i1 > i2  # Cairo tie order: right half first
+
+    @COMMON
+    @given(st.lists(st.integers(-(10**6), 10**6), min_size=2, max_size=32))
+    def test_rank_array_is_a_permutation_with_reference_orientation(self, xs):
+        scores = jnp.asarray(np.asarray(xs, np.float32))
+        normalized, ranks = rank_array(scores)
+        r = np.asarray(ranks)
+        assert sorted(r.tolist()) == list(range(len(xs)))
+        # Reference orientation (oracle_scheduler.py:94-104): the
+        # SMALLEST score gets the HIGHEST rank (least deviant).
+        assert r[int(np.argmin(xs))] == len(xs) - 1 or xs.count(min(xs)) > 1
+        np.testing.assert_allclose(
+            np.asarray(normalized), r / (len(xs) - 1), atol=1e-6
+        )
+
+    @COMMON
+    @given(
+        st.lists(
+            st.floats(0, 100, allow_nan=False), min_size=3, max_size=24
+        ),
+        st.integers(0, 8),
+    )
+    def test_reliability_mask_cardinality(self, risks, n_failing):
+        n_failing = min(n_failing, len(risks) - 1)
+        # Compare in float32 — the mask is computed in float32, where
+        # float64 near-ties can collapse into exact ties (broken by the
+        # Cairo descending-index order, not by magnitude).
+        risks32 = np.asarray(risks, np.float32)
+        mask = np.asarray(reliability_mask(jnp.asarray(risks32), n_failing))
+        assert mask.sum() == len(risks) - n_failing
+        # The masked-out entries carry the LARGEST risks.
+        if n_failing:
+            worst_kept = max(risks32[mask], default=np.float32(0.0))
+            best_dropped = min(risks32[~mask])
+            assert worst_kept <= best_dropped
+
+
+class TestPackingProperties:
+    @COMMON
+    @given(
+        st.lists(
+            st.lists(st.integers(4, 1000), min_size=0, max_size=12),
+            min_size=1,
+            max_size=16,
+        )
+    )
+    def test_pack_tokens_owner_bijection_and_content(self, token_lists):
+        seq_len, max_segments, pad_id = 16, 4, 1
+        batch, n = pack_tokens(token_lists, seq_len, max_segments, pad_id)
+        assert n == len(token_lists)  # rows=None consumes everything
+        owners = batch.owner[batch.seg_valid > 0]
+        assert sorted(owners.tolist()) == list(range(len(token_lists)))
+        # Each segment's tokens reproduce its (truncated) input.
+        for r in range(batch.ids.shape[0]):
+            for s in range(max_segments):
+                if not batch.seg_valid[r, s]:
+                    continue
+                seg_tokens = batch.ids[r][batch.seg[r] == s + 1]
+                owner = batch.owner[r, s]
+                expected = list(token_lists[owner][:seq_len]) or [pad_id]
+                assert seg_tokens.tolist() == expected
+                # positions restart at pad_id + 1 per segment
+                pos = batch.pos[r][batch.seg[r] == s + 1]
+                assert pos.tolist() == list(
+                    range(pad_id + 1, pad_id + 1 + len(seg_tokens))
+                )
+
+
+class TestConsensusProperties:
+    @COMMON
+    @given(
+        st.integers(4, 12),
+        st.integers(1, 3),
+        st.integers(0, 2**31 - 1),
+    )
+    def test_two_pass_invariants(self, n_oracles, n_failing, seed):
+        assume(n_failing < n_oracles - 1)
+        rng = np.random.default_rng(seed)
+        values = jnp.asarray(
+            rng.uniform(0.02, 0.98, size=(n_oracles, 3)), jnp.float32
+        )
+        out = consensus_step(
+            values, ConsensusConfig(n_failing=n_failing, constrained=True)
+        )
+        reliable = np.asarray(out.reliable)
+        assert reliable.sum() == n_oracles - n_failing
+        essence = np.asarray(out.essence)
+        # The restricted smooth median stays inside the reliable set's
+        # per-component hull.
+        kept = np.asarray(values)[reliable]
+        assert np.all(essence >= kept.min(axis=0) - 1e-6)
+        assert np.all(essence <= kept.max(axis=0) + 1e-6)
+        assert 0.0 <= float(out.reliability_first_pass) <= 1.0
+        assert 0.0 <= float(out.reliability_second_pass) <= 1.0
