@@ -37,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="auto_fetch period in seconds (common.py:11)")
     p.add_argument("--live-scraper", action="store_true",
                    help="scrape HN via Selenium when available")
+    p.add_argument("--int8", action="store_true",
+                   help="serve sentiment through the W8A8 dynamic-PTQ "
+                        "forward (2x the bf16 MXU rate on v5e)")
     p.add_argument("--live_mode", action="store_true")
     p.add_argument("--disable_startup_fetch", action="store_true")
     p.add_argument("--db", default=":memory:",
@@ -86,6 +89,7 @@ def main(argv=None) -> int:
             refresh_rate_s=args.refresh,
             scraper_rate_s=args.rate,
             live_scraper=args.live_scraper,
+            quant_inference="int8" if args.int8 else None,
         ),
         store=store,
         adapter=build_adapter(args),

@@ -66,6 +66,12 @@ class SessionConfig:
     #: ~3× fewer forward rows on HN-shaped comments with identical
     #: results to float tolerance.  The TPU-first default.
     packed_inference: bool = True
+    #: ``"int8"`` serves the default vectorizer through the W8A8
+    #: dynamic-PTQ forward (:mod:`svoc_tpu.models.quant` — 2× the bf16
+    #: MXU rate on v5e, results within quantization tolerance).  None
+    #: keeps the float forward (default: classification drives on-chain
+    #: consensus values, so precision is opt-out).
+    quant_inference: Optional[str] = None
     #: Deployment info (``data/contract_info.json`` fields).
     declared_address: Optional[str] = None
     deployed_address: Optional[str] = None
@@ -222,6 +228,7 @@ class Session:
                 batch_size=default_batch,
                 data_mesh=data_mesh,
                 packed=self.config.packed_inference,
+                quant=self.config.quant_inference,
             )
         return self._vectorizer
 

@@ -443,3 +443,67 @@ class TestConcurrency:
         np.testing.assert_array_equal(session.predictions, later["values"])
         assert session.last_preview["values"] is later["values"]
         assert session.state_version == version_after_later
+
+
+class TestInt8Session:
+    def test_lazy_vectorizer_receives_quant_and_serves(self, monkeypatch):
+        """SessionConfig(quant_inference='int8') must reach the lazy
+        vectorizer's SentimentPipeline construction (the REAL property
+        path — a hand-injected pipeline would leave the plumb untested)
+        and the session must still drive fetch->commit->consensus."""
+        import svoc_tpu.models.sentiment as sentiment_mod
+        from svoc_tpu.models.configs import TINY_TEST
+        from svoc_tpu.models.sentiment import SentimentPipeline
+
+        captured = {}
+        real = SentimentPipeline
+
+        def capturing_pipeline(**kwargs):
+            captured.update(kwargs)
+            # Substitute the tiny config so the test does not build
+            # RoBERTa-base; every session-supplied kwarg is kept.
+            return real(
+                cfg=TINY_TEST, seq_len=32, tokenizer_name=None, **kwargs
+            )
+
+        monkeypatch.setattr(
+            sentiment_mod, "SentimentPipeline", capturing_pipeline
+        )
+        store = CommentStore()
+        store.save(SyntheticSource(batch=200)())
+        session = Session(
+            config=SessionConfig(quant_inference="int8"), store=store
+        )
+        vec = session.vectorizer  # the real lazy property path
+        assert captured["quant"] == "int8"
+        assert captured["packed"] is True
+        from svoc_tpu.models.quant import is_quantized_tree
+
+        assert is_quantized_tree(vec.params)
+        session.fetch()
+        assert session.commit() == 7
+        assert session.adapter.call_consensus_active()
+
+    def test_cli_int8_flag_reaches_session_config(self, monkeypatch):
+        """--int8 must land in the constructed Session's config through
+        main() itself, not just argparse."""
+        import io
+        import sys
+
+        import svoc_tpu.apps.cli as cli_mod
+
+        built = {}
+        real_session = cli_mod.Session
+
+        def capturing_session(**kwargs):
+            s = real_session(**kwargs)
+            built["config"] = s.config
+            return s
+
+        monkeypatch.setattr(cli_mod, "Session", capturing_session)
+        monkeypatch.setattr(sys, "stdin", io.StringIO("exit\n"))
+        rc = cli_mod.main(
+            ["--int8", "--disable_startup_fetch", "--seed-comments", "5"]
+        )
+        assert rc == 0
+        assert built["config"].quant_inference == "int8"
