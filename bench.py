@@ -58,7 +58,9 @@ of a traceback.  Any other failure prints a parseable one-line JSON
 ``{"error": ...}``.
 
 Env knobs: ``SVOC_BENCH_SMALL=1`` shrinks everything for CPU smoke
-runs; ``SVOC_BENCH_SECONDS`` (default 10) sets the timed window;
+runs (a CPU *fallback* auto-shrinks too — the full-size workload
+exceeds 29 min there; ``SVOC_BENCH_FORCE_FULL=1`` overrides);
+``SVOC_BENCH_SECONDS`` (default 10) sets the timed window;
 ``SVOC_BENCH_PROBE_TIMEOUT``/``SVOC_BENCH_PROBE_ATTEMPTS`` tune the
 backend probe; ``SVOC_PEAK_TFLOPS`` overrides the assumed chip peak for
 the MFU estimate (default 197 bf16 TFLOP/s, TPU v5e).
@@ -1757,6 +1759,21 @@ def main(argv=None) -> int:
     platform, fallback_reason = resolve_backend()
     _pin_platform(platform)
 
+    auto_small = False
+    if (
+        platform == "cpu"
+        and not small
+        and os.environ.get("SVOC_BENCH_FORCE_FULL") != "1"
+    ):
+        # The backend is CPU (TPU fallback or a genuinely TPU-less
+        # host): the FULL-SIZE workload does not finish in bounded time
+        # there (measured: a 256x128 RoBERTa-base flagship exceeds
+        # 29 min wall), so it would wedge the caller instead of
+        # producing a result line.  Shrink to the small workload and
+        # say so — an honest bounded number beats a timeout.  Override
+        # with SVOC_BENCH_FORCE_FULL=1.
+        small = auto_small = True
+
     try:
         import jax
 
@@ -1768,6 +1785,11 @@ def main(argv=None) -> int:
             result["detail"]["backend_fallback"] = fallback_reason
         if small:
             result["detail"]["small_mode"] = True
+        if auto_small:
+            result["detail"]["small_mode_auto"] = (
+                "full-size workload auto-shrunk: CPU fallback cannot "
+                "complete it in bounded time"
+            )
         mfu = result["detail"].get("mfu_estimate")
         if mfu is not None and mfu > 1.0:
             # A >100%-of-peak number is a measurement bug, never a

@@ -72,6 +72,12 @@ def run_item(name: str, cmd, timeout_s: float):
                 except json.JSONDecodeError:
                     pass
                 break
+        # A result produced on the CPU fallback (tunnel died mid-queue)
+        # is NOT the hardware measurement this queue exists to capture
+        # — mark the item failed so all_ok stays honest.
+        detail = out.get("result", {}).get("detail", {})
+        if detail.get("backend_fallback") or detail.get("small_mode_auto"):
+            out["rc"] = "cpu-fallback"
         return out
     except subprocess.TimeoutExpired as e:
         # Keep the partial output — it is the only evidence telling a
