@@ -23,3 +23,32 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def fake_sentiment_vectorizer(texts):
+    """Cheap deterministic stand-in for the sentiment pipeline —
+    shared by the apps and property suites so the fake cannot drift."""
+    import numpy as np
+
+    rng = np.random.default_rng(len(texts))
+    v = rng.uniform(0.05, 0.95, size=(len(texts), 6))
+    return v / v.sum(axis=1, keepdims=True)
+
+
+def make_fake_console(n_comments: int = 200):
+    """A CommandConsole over a seeded in-memory session with the fake
+    vectorizer (no transformer builds)."""
+    from svoc_tpu.apps.commands import CommandConsole
+    from svoc_tpu.apps.session import Session, SessionConfig
+    from svoc_tpu.io.comment_store import CommentStore
+    from svoc_tpu.io.scraper import SyntheticSource
+
+    store = CommentStore()
+    store.save(SyntheticSource(batch=n_comments)())
+    return CommandConsole(
+        Session(
+            config=SessionConfig(),
+            store=store,
+            vectorizer=fake_sentiment_vectorizer,
+        )
+    )

@@ -9,11 +9,7 @@ from svoc_tpu.io.comment_store import CommentStore
 from svoc_tpu.io.scraper import SyntheticSource
 
 
-def fake_vectorizer(texts):
-    """Cheap deterministic stand-in for the sentiment pipeline."""
-    rng = np.random.default_rng(len(texts))
-    v = rng.uniform(0.05, 0.95, size=(len(texts), 6))
-    return v / v.sum(axis=1, keepdims=True)
+from conftest import fake_sentiment_vectorizer as fake_vectorizer  # noqa: E402
 
 
 def make_session(**cfg_kwargs) -> Session:

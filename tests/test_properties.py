@@ -216,3 +216,48 @@ class TestConsensusProperties:
         assert np.all(essence <= kept.max(axis=0) + 1e-6)
         assert 0.0 <= float(out.reliability_first_pass) <= 1.0
         assert 0.0 <= float(out.reliability_second_pass) <= 1.0
+
+
+import pytest  # noqa: E402  (grouped with the fuzz suite it serves)
+from conftest import make_fake_console  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def fuzz_console():
+    """One console reused across fuzz examples (construction is the
+    expensive part); each example restores the flags the dispatcher may
+    flip so examples stay independent and failures reproduce."""
+    return make_fake_console(n_comments=100)
+
+
+class TestConsoleFuzz:
+    @settings(deadline=None, max_examples=120)
+    @given(
+        st.text(
+            alphabet=st.characters(
+                whitelist_categories=("L", "N", "P", "S", "Z")
+            ),
+            max_size=60,
+        )
+    )
+    def test_dispatcher_never_raises_and_session_survives(
+        self, fuzz_console, text
+    ):
+        """query() converts every failure to an 'error:'/'Unknown'
+        line — arbitrary input must neither raise nor wedge the
+        session (the reference's eel REPL has the same contract,
+        web_interface.py:133-303)."""
+        console = fuzz_console
+        try:
+            out = console.query(text)
+            assert isinstance(out, list)
+            assert all(isinstance(line, str) for line in out)
+            # The session stays fully usable afterwards.
+            assert console.query("dimension") == ["Dimension: 6"]
+        finally:
+            # 'exit'/'auto_fetch on'/'scraper on' examples flip durable
+            # state — restore it so examples stay order-independent.
+            console.stop()
+            console.session.application_on = True
+            console.session.auto_commit = False
+            console.session.auto_resume = False

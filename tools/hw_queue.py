@@ -74,9 +74,13 @@ def run_item(name: str, cmd, timeout_s: float):
                 break
         # A result produced on the CPU fallback (tunnel died mid-queue)
         # is NOT the hardware measurement this queue exists to capture
-        # — mark the item failed so all_ok stays honest.
+        # — mark the otherwise-successful item failed so all_ok stays
+        # honest.  A real nonzero exit keeps its own rc: that failure
+        # cause must not be masked by the fallback label.
         detail = out.get("result", {}).get("detail", {})
-        if detail.get("backend_fallback") or detail.get("small_mode_auto"):
+        if out["rc"] == 0 and (
+            detail.get("backend_fallback") or detail.get("small_mode_auto")
+        ):
             out["rc"] = "cpu-fallback"
         return out
     except subprocess.TimeoutExpired as e:
