@@ -1,0 +1,62 @@
+"""bench.py harness behavior (subprocess; the one-line JSON contract).
+
+Runs the cheapest config end to end in a child process with the CPU
+platform pinned — fast, hermetic, and exercising the REAL main() path
+including backend resolution, the CPU auto-shrink, and the result-line
+format the driver and tools/hw_queue.py parse.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_bench(args, env_extra):
+    env = dict(os.environ)
+    # Hermetic against the caller's own bench knobs — an exported
+    # SVOC_BENCH_SMALL would suppress auto-shrink, FORCE_FULL would run
+    # the unbounded full-size workload.
+    for knob in ("SVOC_BENCH_SMALL", "SVOC_BENCH_FORCE_FULL", "SVOC_BENCH_SECONDS"):
+        env.pop(knob, None)
+    env.update(env_extra)
+    proc = subprocess.run(
+        [sys.executable, BENCH, *args],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+    )
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert lines, (proc.stdout, proc.stderr[-1500:])
+    return proc.returncode, json.loads(lines[-1])
+
+
+def test_cpu_platform_auto_shrinks_and_labels():
+    """On a CPU backend the full-size workload auto-shrinks (it cannot
+    finish in bounded time) with the reason stamped in detail — the
+    round-end bench must emit an honest line, never wedge."""
+    rc, result = _run_bench(
+        ["--config", "2", "--seconds", "1"],
+        {"JAX_PLATFORMS": "cpu"},
+    )
+    assert rc == 0
+    assert result["unit"] == "consensus-updates/sec"  # config 2's metric
+    assert result["value"] > 0
+    assert result["detail"]["backend"] == "cpu"
+    assert result["detail"]["small_mode"] is True
+    assert "auto-shrunk" in result["detail"]["small_mode_auto"]
+
+
+def test_explicit_small_mode_is_not_labeled_auto():
+    rc, result = _run_bench(
+        ["--config", "2", "--seconds", "1"],
+        {"JAX_PLATFORMS": "cpu", "SVOC_BENCH_SMALL": "1"},
+    )
+    assert rc == 0
+    assert result["detail"]["small_mode"] is True
+    assert "small_mode_auto" not in result["detail"]
