@@ -34,11 +34,9 @@ import os
 import threading
 from typing import Any, Dict, List, Optional, Protocol, Sequence, Tuple
 
-import numpy as np
-
 from svoc_tpu.consensus.state import ContractError, OracleConsensusContract
 from svoc_tpu.ops.fixedpoint import (
-    float_to_fwsad,
+    encode_vector,
     fwsad_to_float,
     wsad_to_felt,
 )
@@ -147,6 +145,27 @@ class LocalChainBackend:
             )
         else:
             raise KeyError(f"unknown invoke function {function_name!r}")
+
+    def invoke_update_predictions_batch(
+        self,
+        callers: Sequence[int],
+        predictions: Sequence[Sequence[int]],
+        on_uncertified: str = "raise",
+    ) -> int:
+        """Fleet-scale commit: same sequential-tx semantics as looping
+        ``invoke(…, "update_prediction")`` caller by caller, at O(1)
+        golden-engine recomputes (:mod:`svoc_tpu.consensus.batch`).
+        Only the local simulator offers this — the real chain has no
+        batched entrypoint, so :class:`StarknetBackend` keeps the
+        per-tx loop.  Default ``on_uncertified="raise"``: the adapter
+        reruns its own per-tx loop rather than holding its lock across
+        an O(N)-recompute fallback."""
+        return self.contract.update_predictions_batch(
+            callers,
+            predictions,
+            encoding="felt",
+            on_uncertified=on_uncertified,
+        )
 
 
 class StarknetBackend:
@@ -489,12 +508,20 @@ class ChainAdapter:
 
     @_atomic
     def invoke_update_prediction(self, oracle_address, prediction) -> None:
-        felts = [float_to_fwsad(float(x)) for x in np.asarray(prediction).ravel()]
         self.backend.invoke(
-            oracle_address, "update_prediction", prediction=felts
+            oracle_address,
+            "update_prediction",
+            prediction=encode_vector(prediction),
         )
 
-    def update_all_the_predictions(self, predictions: Sequence) -> int:
+    #: Fleets at or above this size take the backend's batched commit
+    #: when it has one (the local simulator); below it the per-tx loop
+    #: keeps the reference's tx-granular interleaving observable.
+    BATCH_COMMIT_THRESHOLD = 64
+
+    def update_all_the_predictions(
+        self, predictions: Sequence, *, batch: Optional[bool] = None
+    ) -> int:
         """One signed tx per oracle, in oracle-list order
         (``client/contract.py:200-208``); returns tx count.
 
@@ -503,8 +530,72 @@ class ChainAdapter:
         previous returned).  A failure mid-loop raises
         :class:`ChainCommitError` with the partial-commit count — the
         earlier transactions are on chain and are NOT rolled back.
+
+        ``batch=None`` auto-selects the backend's batched fleet commit
+        (same sequential semantics, O(1) golden recomputes — see
+        :meth:`svoc_tpu.consensus.state.OracleConsensusContract.update_predictions_batch`)
+        for fleets ≥ ``BATCH_COMMIT_THRESHOLD``; ``True``/``False``
+        force it on/off.
         """
         oracles = self.call_oracle_list()
+        total = min(len(oracles), len(predictions))
+        batched_invoke = getattr(
+            self.backend, "invoke_update_predictions_batch", None
+        )
+        if batch is None:
+            batch = (
+                batched_invoke is not None
+                and total >= self.BATCH_COMMIT_THRESHOLD
+            )
+        if batch:
+            if batched_invoke is None:
+                raise ValueError(
+                    "backend has no batched commit (Sepolia submits one "
+                    "signed tx per oracle) — use batch=False"
+                )
+            from svoc_tpu.consensus.state import BatchNotCertified, BatchTxError
+
+            # Per-tx codec semantics: a malformed prediction (NaN, junk)
+            # is THAT tx's failure after the prefix commits, exactly as
+            # in the per-tx loop — not a whole-batch abort.
+            felts = []
+            codec_failure = None
+            for t, p in enumerate(predictions[:total]):
+                try:
+                    felts.append(encode_vector(p))
+                except Exception as e:
+                    codec_failure = (t, e)
+                    break
+            # The fast path is bounded work (one device sweep + one
+            # golden recompute) — safe to hold the adapter lock for.
+            # An UNCERTIFIED batch raises before any mutation, and the
+            # O(N)-golden-recompute fallback runs through the ordinary
+            # per-tx loop below instead, which locks per transaction —
+            # a long commit must never monopolize the adapter
+            # (the _atomic design note).
+            fell_through = False
+            with self._lock:
+                try:
+                    committed = batched_invoke(oracles[: len(felts)], felts)
+                except BatchTxError as e:
+                    raise ChainCommitError(
+                        committed=e.index,
+                        total=total,
+                        failed_oracle=e.oracle_address,
+                        cause=e.cause,
+                    ) from e
+                except BatchNotCertified:
+                    fell_through = True  # exact per-tx loop below
+            if not fell_through:
+                if codec_failure is not None:
+                    t, cause = codec_failure
+                    raise ChainCommitError(
+                        committed=committed,
+                        total=total,
+                        failed_oracle=oracles[t],
+                        cause=cause,
+                    ) from cause
+                return committed
         n = 0
         for oracle, prediction in zip(oracles, predictions):
             try:
@@ -514,7 +605,7 @@ class ChainAdapter:
             except Exception as e:
                 raise ChainCommitError(
                     committed=n,
-                    total=min(len(oracles), len(predictions)),
+                    total=total,
                     failed_oracle=oracle,
                     cause=e,
                 ) from e

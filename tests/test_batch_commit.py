@@ -1,0 +1,475 @@
+"""Batched fleet commit: exact equivalence with the sequential tx loop.
+
+The batched path (``consensus/batch.py`` + ``update_predictions_batch``)
+must be observably IDENTICAL to looping ``update_prediction`` — final
+wsad state, panic index, partial-commit accounting — while doing O(1)
+golden recomputes.  Every test here drives both paths on twin contracts
+and compares exact integers.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from svoc_tpu.consensus.state import (
+    BatchTxError,
+    OracleConsensusContract,
+)
+
+ADMINS = ["a0", "a1", "a2"]
+
+
+def make_pair(n_oracles, n_failing, dimension=3, constrained=True, spread=10.0):
+    """Twin contracts (sequential reference / batched subject)."""
+
+    def build():
+        return OracleConsensusContract(
+            ADMINS,
+            [f"o{i}" for i in range(n_oracles)],
+            required_majority=2,
+            n_failing_oracles=n_failing,
+            constrained=constrained,
+            unconstrained_max_spread=spread,
+            dimension=dimension,
+        )
+
+    return build(), build()
+
+
+def fleet(rng, n, m, lo=0.05, hi=0.95):
+    return rng.uniform(lo, hi, size=(n, m))
+
+
+def state_dict(c):
+    return {
+        "consensus_active": c.consensus_active,
+        "value": c.get_consensus_value(),
+        "rel1": c.get_first_pass_consensus_reliability(),
+        "rel2": c.get_second_pass_consensus_reliability(),
+        "skew": c.get_skewness(),
+        "kurt": c.get_kurtosis(),
+        "oracles": c.get_oracle_value_list("a0"),
+        "n_active": c.n_active_oracles,
+    }
+
+
+def run_sequential(c, callers, preds):
+    """The reference commit loop; returns (committed, error or None)."""
+    for k, (caller, p) in enumerate(zip(callers, preds)):
+        try:
+            c.update_prediction(caller, p)
+        except Exception as e:
+            return k, e
+    return len(callers), None
+
+
+def run_batch(c, callers, preds):
+    try:
+        return c.update_predictions_batch(callers, preds), None
+    except BatchTxError as e:
+        return e.index, e
+
+
+@pytest.mark.parametrize("n,n_failing", [(7, 2), (13, 4), (8, 0)])
+@pytest.mark.parametrize("constrained", [True, False])
+def test_batch_equals_sequential_two_cycles(n, n_failing, constrained):
+    rng = np.random.default_rng(n * 100 + n_failing + constrained)
+    seq, bat = make_pair(n, n_failing, constrained=constrained)
+    callers = [f"o{i}" for i in range(n)]
+    for cycle in range(3):  # activation cycle + 2 post-activation cycles
+        preds = fleet(rng, n, 3)
+        rs = run_sequential(seq, callers, preds)
+        rb = run_batch(bat, callers, preds)
+        assert rs[0] == rb[0], f"cycle {cycle}: committed count differs"
+        assert (rs[1] is None) == (rb[1] is None)
+        assert state_dict(seq) == state_dict(bat), f"cycle {cycle}"
+
+
+def test_fast_path_is_actually_taken(monkeypatch):
+    """A healthy varied fleet must certify — the equivalence above would
+    silently pass if everything fell back to the sequential loop."""
+    rng = np.random.default_rng(0)
+    _, bat = make_pair(7, 2)
+    callers = [f"o{i}" for i in range(7)]
+
+    def boom(*a, **k):
+        raise AssertionError("fell back to the sequential path")
+
+    monkeypatch.setattr(bat, "_sequential_batch", boom)
+    bat.update_predictions_batch(callers, fleet(rng, 7, 3))  # activation
+    bat.update_predictions_batch(callers, fleet(rng, 7, 3))  # full sweep
+    assert bat.consensus_active
+
+
+def test_validation_failure_mid_batch_commits_prefix():
+    rng = np.random.default_rng(1)
+    seq, bat = make_pair(7, 2)
+    callers = [f"o{i}" for i in range(7)]
+    preds = fleet(rng, 7, 3)
+    preds[4] = [1.5, 0.5, 0.5]  # interval violation at tx 4
+    rs = run_sequential(seq, callers, preds)
+    rb = run_batch(bat, callers, preds)
+    assert rs[0] == rb[0] == 4
+    assert rb[1].index == 4 and rb[1].oracle_address == "o4"
+    assert "interval" in str(rb[1].cause)
+    assert state_dict(seq) == state_dict(bat)
+
+
+def test_unknown_caller_mid_batch():
+    rng = np.random.default_rng(2)
+    seq, bat = make_pair(7, 2)
+    callers = [f"o{i}" for i in range(6)] + ["eve"]
+    preds = fleet(rng, 7, 3)
+    rs = run_sequential(seq, callers, preds)
+    rb = run_batch(bat, callers, preds)
+    assert rs[0] == rb[0] == 6
+    assert "not an oracle" in str(rb[1].cause)
+    assert state_dict(seq) == state_dict(bat)
+
+
+def test_final_recompute_panic_reverts_last_tx():
+    """Zero-variance fleet: the activation recompute panics on the LAST
+    tx exactly like the sequential loop (tx reverted, prefix kept)."""
+    seq, bat = make_pair(7, 2)
+    callers = [f"o{i}" for i in range(7)]
+    preds = [[0.5 + i * 1e-6, 0.5, 0.5] for i in range(7)]
+    rs = run_sequential(seq, callers, preds)
+    rb = run_batch(bat, callers, preds)
+    assert rs[0] == rb[0] == 6
+    assert isinstance(rb[1].cause, ZeroDivisionError)
+    assert state_dict(seq) == state_dict(bat)
+    assert bat.consensus_active is False
+    assert bat.n_active_oracles == 6  # last tx reverted
+
+
+def test_intermediate_panic_falls_back_to_exact():
+    """An interval panic at an INTERMEDIATE recompute (prefix 5 of 7)
+    must fail certification and reproduce the exact panic index."""
+    rng = np.random.default_rng(3)
+    seq, bat = make_pair(7, 2, dimension=2)
+    callers = [f"o{i}" for i in range(7)]
+    base = fleet(rng, 7, 2)
+    run_sequential(seq, callers, base)
+    run_batch(bat, callers, base)  # both active, identical
+    # 5 identical extremes onto a varied fleet: after tx 4 (0-based) the
+    # reliable subset is the five [1,1] rows — zero variance, the Cairo
+    # division-by-zero panic, at an INTERMEDIATE prefix.
+    preds = [[1.0, 1.0]] * 5 + [[0.0, 0.0]] * 2
+    rs = run_sequential(seq, callers, preds)
+    rb = run_batch(bat, callers, preds)
+    assert rs[0] == rb[0] == 4
+    assert type(rb[1].cause) is type(rs[1])
+    assert isinstance(rb[1].cause, ZeroDivisionError)
+    assert state_dict(seq) == state_dict(bat)
+
+
+def test_final_panic_after_intermediates_leaves_prefix_consensus(monkeypatch):
+    """When the LAST tx's recompute panics but earlier txs in the batch
+    DID recompute (certified fast path), the derived state must be the
+    prefix-(T-1) consensus — what the sequential loop leaves — not the
+    pre-batch state.  Construction keeps every intermediate prefix
+    varied (certifiable) and collapses the reliable subset to identical
+    values only on the final tx."""
+    rng = np.random.default_rng(9)
+    seq, bat = make_pair(7, 2)
+    callers = [f"o{i}" for i in range(7)]
+    c0 = [0.4, 0.5, 0.6]
+    base = fleet(rng, 7, 3)
+    # Enable all but o0 so the batch's FIRST tx opens the gate
+    # (first_recompute == 1 < T: intermediates recompute).
+    for i in range(1, 7):
+        seq.update_prediction(f"o{i}", base[i])
+        bat.update_prediction(f"o{i}", base[i])
+    preds = [list(base[0]), list(base[1]), c0, c0, c0, c0, c0]
+    # After tx 6 the five c0 rows are the reliable subset → variance 0
+    # → golden panic; after tx 5 (prefix 6) only four c0 rows exist and
+    # a varied row completes the subset → certifiable.
+    boom = AssertionError("fell back to the sequential path")
+    monkeypatch.setattr(
+        bat, "_sequential_batch", lambda *a, **k: (_ for _ in ()).throw(boom)
+    )
+    rs = run_sequential(seq, callers, preds)
+    rb = run_batch(bat, callers, preds)
+    assert rs[0] == rb[0] == 6
+    assert isinstance(rb[1].cause, ZeroDivisionError)
+    assert state_dict(seq) == state_dict(bat)
+    # The panic left the PREFIX consensus, not stale pre-batch state.
+    assert bat.consensus_active is True
+
+
+def test_malformed_element_is_a_tx_failure():
+    """A non-numeric element is THAT tx's failure (prefix committed),
+    exactly like the sequential loop — not an API error."""
+    rng = np.random.default_rng(10)
+    seq, bat = make_pair(7, 2)
+    callers = [f"o{i}" for i in range(7)]
+    preds = [list(p) for p in fleet(rng, 7, 3)]
+    preds[5][0] = "not-a-number"
+    rs = run_sequential(seq, callers, preds)
+    rb = run_batch(bat, callers, preds)
+    assert rs[0] == rb[0] == 5
+    assert isinstance(rb[1].cause, (TypeError, ValueError))
+    assert state_dict(seq) == state_dict(bat)
+    with pytest.raises(ValueError):  # API misuse stays an API error
+        bat.update_predictions_batch(callers, fleet(rng, 7, 3), encoding="hex")
+
+
+def test_tiny_reliable_subset_panics_at_the_right_tx():
+    """N - n_failing ≤ 3 zeroes the moment denominators: EVERY recompute
+    panics (math.cairo:336/:358) — the batch must reproduce the panic at
+    the first gate-opening tx, not at the end."""
+    rng = np.random.default_rng(11)
+    seq, bat = make_pair(6, 3)  # reliable subset = 3 → (n-2)(n-3) = 0
+    callers = [f"o{i}" for i in range(6)]
+    preds = fleet(rng, 6, 3)
+    rs = run_sequential(seq, callers, preds)
+    rb = run_batch(bat, callers, preds)
+    assert rs[0] == rb[0] == 5  # panic on the activating (6th) tx
+    assert isinstance(rb[1].cause, ZeroDivisionError)
+    assert state_dict(seq) == state_dict(bat)
+
+
+def test_adapter_uncertified_falls_through_to_tx_loop():
+    """An uncertifiable fleet through the adapter must complete via the
+    per-tx loop (BatchNotCertified never escapes) with exact sequential
+    results.  Construction: after a varied activation cycle, commit 64
+    IDENTICAL rows — late intermediate prefixes have a zero-variance
+    reliable subset (uncertifiable, and the exact engine panics there),
+    so the certified fast path is impossible."""
+    from svoc_tpu.io.chain import ChainAdapter, ChainCommitError, LocalChainBackend
+
+    n = 64
+    rng = np.random.default_rng(12)
+    callers = [f"o{i}" for i in range(n)]
+    base = fleet(rng, n, 3)
+    preds = np.tile(rng.uniform(0.2, 0.8, size=3), (n, 1))
+
+    def build():
+        return OracleConsensusContract(
+            ADMINS,
+            callers,
+            n_failing_oracles=8,
+            dimension=3,
+        )
+
+    seq = build()
+    run_sequential(seq, callers, base)
+    rs = run_sequential(seq, callers, preds)
+    assert rs[1] is not None  # the degenerate cycle panics mid-loop
+
+    bat = build()
+    a = ChainAdapter(LocalChainBackend(bat))
+    a.update_all_the_predictions(base, batch=True)
+    with pytest.raises(ChainCommitError) as ei:
+        a.update_all_the_predictions(preds, batch=True)
+    assert ei.value.committed == rs[0]
+    assert state_dict(seq) == state_dict(bat)
+
+
+def test_large_magnitude_unconstrained_falls_back():
+    """Unconstrained values beyond the f32 guard-band analysis (>16)
+    must take the exact path: at magnitude ~12000, float quantization
+    scatter could inflate a truly-zero wsad variance past the band and
+    mis-certify a fleet whose every recompute panics."""
+    n = 64
+    seq, bat = make_pair(n, 8, constrained=False, spread=1e9)
+    callers = [f"o{i}" for i in range(n)]
+    rng = np.random.default_rng(13)
+    base = 12000.0 + fleet(rng, n, 3)  # varied activation cycle
+    rs = run_sequential(seq, callers, base)
+    rb = run_batch(bat, callers, base)
+    assert rs[0] == rb[0]
+    assert state_dict(seq) == state_dict(bat)
+    # Near-identical at large magnitude: exact variance truncates to 0.
+    preds = [[12000.0 + i * 1e-6, 0.5, 0.5] for i in range(n)]
+    rs = run_sequential(seq, callers, preds)
+    rb = run_batch(bat, callers, preds)
+    assert rs[0] == rb[0]
+    assert isinstance(rb[1].cause, ZeroDivisionError)
+    assert state_dict(seq) == state_dict(bat)
+
+
+def test_rederive_failure_never_masks_the_tx_error(monkeypatch):
+    """Even if certification were unsound (forced here by stubbing it
+    out), a panic in the prefix re-derive must not escape as a raw
+    exception — the BatchTxError accounting survives."""
+    from svoc_tpu.consensus import batch as dev
+
+    n = 7
+    _, bat = make_pair(n, 2)
+    callers = [f"o{i}" for i in range(n)]
+    rng = np.random.default_rng(14)
+    run_batch(bat, callers, fleet(rng, n, 3))
+    monkeypatch.setattr(
+        dev, "certify", lambda *a, **k: np.ones(10_000, dtype=bool)
+    )
+    # Every prefix (and the final block) is zero-variance → the forced
+    # fast path panics at the end AND in the prefix re-derive.
+    preds = [[0.5 + i * 1e-6, 0.5, 0.5] for i in range(n)]
+    committed, err = run_batch(bat, callers, preds)
+    assert committed == n - 1
+    assert isinstance(err.cause, ZeroDivisionError)
+
+
+def test_duplicate_caller_falls_back():
+    rng = np.random.default_rng(4)
+    seq, bat = make_pair(7, 2)
+    first = fleet(rng, 7, 3)
+    run_sequential(seq, [f"o{i}" for i in range(7)], first)
+    run_batch(bat, [f"o{i}" for i in range(7)], first)
+    callers = ["o0", "o1", "o1", "o3", "o4", "o5", "o6"]
+    preds = fleet(rng, 7, 3)
+    rs = run_sequential(seq, callers, preds)
+    rb = run_batch(bat, callers, preds)
+    assert rs[0] == rb[0] == 7
+    assert state_dict(seq) == state_dict(bat)
+
+
+def test_batch_equals_sequential_fleet_64():
+    """Certification path at fleet scale: 63 intermediate recomputes
+    certified on device, final state bit-equal to 64 golden recomputes."""
+    rng = np.random.default_rng(5)
+    n = 64
+    seq, bat = make_pair(n, 16, dimension=6)
+    callers = [f"o{i}" for i in range(n)]
+    for _ in range(2):
+        preds = fleet(rng, n, 6)
+        rs = run_sequential(seq, callers, preds)
+        rb = run_batch(bat, callers, preds)
+        assert rs == (n, None) and rb == (n, None)
+        assert state_dict(seq) == state_dict(bat)
+
+
+def test_fleet_1024_cycle_completes_in_seconds():
+    """The BASELINE product config: a full 1024-oracle post-activation
+    commit cycle (1023 device-certified recomputes + 1 golden) must take
+    seconds, not the sequential path's minutes."""
+    rng = np.random.default_rng(6)
+    n = 1024
+    c = OracleConsensusContract(
+        ADMINS,
+        [f"o{i}" for i in range(n)],
+        n_failing_oracles=256,
+        constrained=True,
+        dimension=6,
+    )
+    callers = [f"o{i}" for i in range(n)]
+    c.update_predictions_batch(callers, fleet(rng, n, 6))  # activation
+    assert c.consensus_active
+    t0 = time.perf_counter()
+    c.update_predictions_batch(callers, fleet(rng, n, 6))  # full sweep
+    dt = time.perf_counter() - t0
+    # CI bound is loose (shared CPU); interactively this is ~1-3 s.
+    assert dt < 120, f"fleet cycle took {dt:.1f}s"
+    # The committed state must be the golden engine's on the final block.
+    from svoc_tpu.consensus import wsad_engine as eng
+
+    golden = eng.two_pass_consensus(
+        [o.value for o in c.oracles],
+        constrained=True,
+        n_failing=256,
+        max_spread=0,
+    )
+    assert c.get_consensus_value() == golden["essence"]
+    assert c.get_first_pass_consensus_reliability() == (
+        golden["reliability_first_pass"]
+    )
+
+
+def test_adapter_batch_commit_accounting():
+    """ChainCommitError accounting parity through the adapter, both
+    forced-batch and sequential."""
+    from svoc_tpu.io.chain import ChainAdapter, ChainCommitError, LocalChainBackend
+
+    rng = np.random.default_rng(7)
+
+    def build():
+        return ChainAdapter(
+            LocalChainBackend(
+                OracleConsensusContract(
+                    [0xA0, 0xA1, 0xA2],
+                    [0x10 + i for i in range(7)],
+                    n_failing_oracles=2,
+                    constrained=True,
+                    dimension=3,
+                )
+            )
+        )
+
+    good = fleet(rng, 7, 3)
+    bad = good.copy()
+    bad[4] = [1.5, 0.5, 0.5]
+
+    results = {}
+    for name, flag in [("seq", False), ("batch", True)]:
+        a = build()
+        assert a.update_all_the_predictions(good, batch=flag) == 7
+        with pytest.raises(ChainCommitError) as ei:
+            a.update_all_the_predictions(bad, batch=flag)
+        results[name] = (
+            ei.value.committed,
+            ei.value.total,
+            ei.value.failed_oracle,
+            a.backend.contract.get_consensus_value(),
+        )
+    assert results["seq"] == results["batch"]
+    assert results["seq"][0] == 4 and results["seq"][2] == 0x10 + 4
+
+
+def test_adapter_codec_failure_accounting_parity():
+    """A NaN prediction mid-fleet must yield the SAME ChainCommitError
+    accounting through the batch path as through the per-tx loop (the
+    prefix commits; the bad tx is the failure)."""
+    from svoc_tpu.io.chain import ChainAdapter, ChainCommitError, LocalChainBackend
+
+    n = 64
+    rng = np.random.default_rng(15)
+    preds = fleet(rng, n, 3)
+    preds[40, 0] = np.nan
+
+    results = {}
+    for name, flag in [("seq", False), ("batch", True)]:
+        a = ChainAdapter(
+            LocalChainBackend(
+                OracleConsensusContract(
+                    ADMINS,
+                    [f"o{i}" for i in range(n)],
+                    n_failing_oracles=8,
+                    dimension=3,
+                )
+            )
+        )
+        with pytest.raises(ChainCommitError) as ei:
+            a.update_all_the_predictions(preds, batch=flag)
+        results[name] = (
+            ei.value.committed,
+            ei.value.total,
+            ei.value.failed_oracle,
+            a.backend.contract.n_active_oracles,
+        )
+    assert results["seq"] == results["batch"]
+    assert results["seq"][0] == 40
+
+
+def test_adapter_auto_threshold():
+    """Auto mode batches at ≥64 oracles and loops below."""
+    from svoc_tpu.io.chain import ChainAdapter, LocalChainBackend
+
+    rng = np.random.default_rng(8)
+    n = 64
+    contract = OracleConsensusContract(
+        ADMINS,
+        [f"o{i}" for i in range(n)],
+        n_failing_oracles=8,
+        dimension=3,
+    )
+    a = ChainAdapter(LocalChainBackend(contract))
+    calls = []
+    orig = contract.update_predictions_batch
+    contract.update_predictions_batch = lambda *a_, **k: (
+        calls.append("batch"),
+        orig(*a_, **k),
+    )[1]
+    assert a.update_all_the_predictions(fleet(rng, n, 3)) == n
+    assert calls == ["batch"]

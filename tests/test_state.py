@@ -175,6 +175,27 @@ def test_interval_panic_reverts_the_commit():
     assert c.consensus_active is False
 
 
+def test_zero_variance_panics_like_cairo():
+    """Near-identical predictions drive the reliable set's sample
+    variance to exactly 0 in wsad fixed point, and skewness/kurtosis
+    divide by sqrt(variance) UNGUARDED — in the reference contract too
+    (``math.cairo:320-343``), where the tx panics with 'Division by 0'.
+    The simulator must reproduce the panic and revert the triggering
+    commit (found by the live-mode soak: a degenerate vectorizer that
+    maps every comment to the same vector wedges the fleet exactly
+    like it would on chain)."""
+    c = make_constrained()
+    # Distinct at the 6th decimal: differences of 1e-6 wsad-square to
+    # 1e-12 < 1 wsad unit, so every component variance truncates to 0.
+    for i, o in enumerate(ORACLES[:6]):
+        c.update_prediction(o, [0.5 + i * 1e-6, 0.5])
+    with pytest.raises(ZeroDivisionError, match="i128 division by zero"):
+        c.update_prediction(ORACLES[6], [0.5 + 6e-6, 0.5])
+    # Reverted, exactly like the interval panic above.
+    assert c.consensus_active is False
+    assert c.n_active_oracles == 6
+
+
 def test_vote_out_of_range_target_is_harmless():
     """Cairo's LegacyMap reads default-false/None for unknown keys, so
     voting for a non-existent admin's proposition must not crash (and a

@@ -1,0 +1,78 @@
+"""Persistent tunnel watcher: loop until TPU liveness, then run hw_queue.
+
+``tools/hw_queue.py`` aborts early (by design) when the tunnel is dead so
+its artifact records the outage.  This wrapper is the long-running side:
+probe liveness every ``--interval`` seconds and, the moment a probe
+passes, run the full queue once and exit with its code.  Intended to run
+in a tmux/background session for the whole round.
+
+Usage::
+
+    python tools/hw_watch.py [--interval 600] [--seconds 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LIVENESS_SNIPPET = (
+    "import jax, jax.numpy as jnp, numpy as np;"
+    "assert jax.devices()[0].platform == 'tpu', jax.devices();"
+    "x = jnp.ones((1024, 1024), jnp.bfloat16);"
+    "s = float(np.asarray(jnp.sum(jax.jit(lambda a: a @ a)(x))));"
+    "print('LIVE', s)"
+)
+
+
+def probe(timeout_s: float = 240.0) -> bool:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", LIVENESS_SNIPPET],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--interval", type=float, default=600.0)
+    p.add_argument("--seconds", type=float, default=10.0)
+    args = p.parse_args(argv)
+
+    attempt = 0
+    while True:
+        attempt += 1
+        stamp = time.strftime("%H:%M:%S")
+        print(f"[hw_watch] probe #{attempt} at {stamp} ...", flush=True)
+        if probe():
+            print("[hw_watch] TPU LIVE — running hw_queue", flush=True)
+            rc = subprocess.run(
+                [
+                    sys.executable,
+                    "tools/hw_queue.py",
+                    "--seconds",
+                    str(args.seconds),
+                ],
+                cwd=REPO,
+            ).returncode
+            print(f"[hw_watch] hw_queue rc={rc}", flush=True)
+            return rc
+        print(
+            f"[hw_watch] tunnel dead; retry in {args.interval:.0f}s", flush=True
+        )
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
