@@ -26,6 +26,9 @@ bootstrap fleet -> two-pass consensus, overlapped via a prefetch queue.
 9. Sequence-packed data-parallel serving: config 7 x config 8 — the
    packing factor compounds with the device count (the framework's
    highest-throughput serving configuration)
+12. Packed flagship through the flash segment-tag kernel (config 8
+   without the [R, 1, T, T] bias materialization) — the packed×flash
+   vs packed×dense decision measurement.
 10. INT8 sequence-packed flagship: config 8 with the W8A8 dynamic-PTQ
     forward (``svoc_tpu/models/quant.py``) — block matmuls on the MXU
     int8 path (2x the bf16 rate on v5e); MFU normalized to the int8
@@ -143,6 +146,11 @@ def encoder_matmul_flops_per_token(cfg, seq_len: int) -> float:
 
 
 def assumed_peak_flops(platform: str):
+    """Assumed BF16-EQUIVALENT chip peak.  ``SVOC_PEAK_TFLOPS`` must be
+    the chip's bf16 peak (e.g. 197 for v5e), NOT the int8 one — int8
+    configs always multiply by 2 in :func:`quant_peak_and_meta`, so an
+    operator who exported the int8 peak here would get MFU silently
+    halved (ADVICE r3)."""
     env = os.environ.get("SVOC_PEAK_TFLOPS")
     if env:
         return float(env) * 1e12
@@ -1344,9 +1352,24 @@ def bench_config10(seconds: float, small: bool, platform: str) -> dict:
     return _bench_packed_flagship(seconds, small, platform, quant="int8")
 
 
+def bench_config12(seconds: float, small: bool, platform: str) -> dict:
+    """Sequence-packed flagship through the FLASH segment-tag kernel:
+    config 8 with ``attention="flash"`` — the Pallas kernel rebuilds
+    each tile's block-diagonal mask from the [R, T] segment ids, so the
+    packed hot path's [R, 1, T, T] additive bias (the largest HBM
+    intermediate at seq 128) never materializes.  Decision measurement
+    for packed×flash vs packed×dense (VERDICT r3 item 4): compare
+    against config 8 on the same chip."""
+    return _bench_packed_flagship(
+        seconds, small, platform, quant=None, attention="flash"
+    )
+
+
 def _bench_packed_flagship(
-    seconds: float, small: bool, platform: str, quant=None
+    seconds: float, small: bool, platform: str, quant=None, attention="dense"
 ) -> dict:
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
 
@@ -1361,6 +1384,8 @@ def _bench_packed_flagship(
         enc_cfg, rows, seq, n_oracles, max_seg = TINY_TEST, 32, 32, 64, 4
     else:
         enc_cfg, rows, seq, n_oracles, max_seg = ROBERTA_GO_EMOTIONS, 256, 128, 1024, 8
+    if attention != "dense":
+        enc_cfg = dataclasses.replace(enc_cfg, attention=attention)
 
     window_size = min(50, rows)
     ccfg = ConsensusConfig(n_failing=max(2, n_oracles // 8), constrained=True)
@@ -1458,7 +1483,12 @@ def _bench_packed_flagship(
     peak, quant_meta = quant_peak_and_meta(assumed_peak_flops(platform), quant)
     mfu = row_tokens_per_sec * flops_per_token / peak if peak else None
 
-    cfg_label = "config 10: INT8 (W8A8 dynamic PTQ)" if quant else "config 8:"
+    if quant:
+        cfg_label = "config 10: INT8 (W8A8 dynamic PTQ)"
+    elif attention == "flash":
+        cfg_label = "config 12: FLASH segment-tag"
+    else:
+        cfg_label = "config 8:"
     size_label = "tiny" if small else "roberta-base"
     dtype_label = f"{size_label}-{'int8' if quant else ('f32' if small else 'bf16')}"
     return {
@@ -1492,6 +1522,7 @@ def _bench_packed_flagship(
             "rows": rows,
             "max_segments": max_seg,
             "seq_len": seq,
+            "attention": attention,
             "consensus_reliability2": device_fetch(rel2),
             "elapsed_s": round(elapsed, 2),
             **checksum_stats(checksums),
@@ -1681,6 +1712,7 @@ CONFIGS = {
     9: bench_config9,
     10: bench_config10,
     11: bench_config11,
+    12: bench_config12,
 }
 
 

@@ -317,8 +317,22 @@ class CommandConsole:
                 caller = self._make_admin_address(args[0]) if args else (
                     adapter.call_admin_list()[0]
                 )
-                for row in adapter.call_oracle_value_list(caller):
-                    emit(str(row))
+                from svoc_tpu.ops.fixedpoint import wsad_to_string
+
+                for addr, vec, enabled, reliable in (
+                    adapter.call_oracle_value_list_wsad(caller)
+                ):
+                    # wsad_to_string rendering (utils.cairo:283-297) —
+                    # truncated 3-digit decimals of the EXACT stored
+                    # wsad ints (a float round trip can lose an ulp and
+                    # print a wrong digit).
+                    values = ", ".join(
+                        wsad_to_string(v, 3) for v in vec
+                    )
+                    emit(
+                        f"{_addr_str(addr)} : [{values}] "
+                        f"enabled={enabled} reliable={reliable}"
+                    )
             elif cmd == "contract_declaration_address":
                 emit(
                     "Contract Declaration Address :\n"
@@ -389,6 +403,8 @@ class CommandConsole:
         def loop():
             import time
 
+            from svoc_tpu.apps.session import EmptyStoreError
+
             while (
                 gen == self._auto_fetch_gen
                 and self.session.auto_fetch
@@ -406,6 +422,14 @@ class CommandConsole:
                         if self.session.auto_resume:
                             self.session.adapter.resume()
                             self.session.bump_state()
+                except EmptyStoreError:
+                    # Not an error in a composite loop: live mode starts
+                    # the scraper and this loop together, so early
+                    # cycles legitimately find an empty store — wait for
+                    # ingest instead of error-spamming.
+                    from svoc_tpu.utils.metrics import registry as _m
+
+                    _m.counter("auto_fetch_waiting").add(1)
                 except Exception as e:
                     # Surface the failure (once per distinct message) and
                     # count it, instead of silently spinning.
@@ -448,6 +472,17 @@ class CommandConsole:
             # A just-stopped loop is winding down — wait it out (outside
             # the lock) so the restart actually starts a fresh loop.
             winding_down.join(timeout=5)
+            if winding_down.is_alive():
+                # Still wedged (e.g. a hung Selenium page fetch): do NOT
+                # start a second loop writing to the same store — report
+                # "not started"; the user can retry once it dies
+                # (ADVICE r3).  Mark our claim stopped so the retry
+                # takes the winding-down path instead of "already
+                # running".
+                with self._bg_lock:
+                    if self._scraper_stop is stop:
+                        stop.set()
+                return None
 
         from svoc_tpu.io.scraper import (
             SeleniumHNSource,

@@ -40,6 +40,14 @@ from svoc_tpu.sim.oracle import gen_oracle_predictions
 from svoc_tpu.utils.metrics import registry as metrics
 
 
+class EmptyStoreError(RuntimeError):
+    """Fetch found no comments.  Interactive ``fetch`` surfaces it as an
+    error (the reference's fetch on an empty DB also fails); the
+    auto-fetch loop treats it as *waiting for ingest* — in live mode the
+    scraper and the fetch loop start together, so the first fetch can
+    legitimately race the first scrape."""
+
+
 @dataclasses.dataclass
 class SessionConfig:
     """``client/common.py:7-31`` constants, as explicit configuration."""
@@ -155,7 +163,11 @@ class Session:
         #: - ``lock`` (reentrant) — session field mutation: fetch's
         #:   cursor/PRNG-split/preview, state_version bumps, commit's
         #:   predictions snapshot.  Held only around in-memory /
-        #:   on-device work.
+        #:   on-device work, with ONE deliberate exception: fetch's
+        #:   ``store.read_window`` (SQLite) runs under it so the cursor
+        #:   advance is atomic with the read that consumed it — bounded
+        #:   by ``fetch_limit`` rows against a local file, not chain
+        #:   I/O (ADVICE r3).
         #: - ``_commit_lock`` — whole-fleet commit atomicity: two
         #:   concurrent commits must not interleave per-oracle txs (a
         #:   mixed fleet no fetch produced would reach consensus).
@@ -272,7 +284,7 @@ class Session:
                 self._fetch_claim += 1
                 claim = self._fetch_claim
             if not comments:
-                raise RuntimeError(
+                raise EmptyStoreError(
                     "comment store is empty — run the scraper (or seed the "
                     "store) before fetching"
                 )

@@ -490,6 +490,23 @@ class ChainAdapter:
         self.cache["oracle_value_list"] = v
         return v
 
+    @_atomic
+    def call_oracle_value_list_wsad(self, caller) -> List:
+        """Like :meth:`call_oracle_value_list` but with EXACT wsad ints
+        (felt calldata two's-complement-decoded, no float round trip) —
+        the console's ``wsad_to_string`` rendering needs the stored
+        integer: ~28 % of wsad values lose an ulp through
+        float-and-back, which truncated display turns into a whole
+        wrong digit (0.007000 → '0.006')."""
+        from svoc_tpu.ops.fixedpoint import felt_to_wsad
+
+        return [
+            (addr, [felt_to_wsad(int(x)) for x in vec], enabled, reliable)
+            for addr, vec, enabled, reliable in self.backend.call_as(
+                caller, "get_oracle_value_list"
+            )
+        ]
+
     # -- index/address resolution (client/contract.py:95-123) --------------
 
     def address_to_oracle_index(self, address) -> int:

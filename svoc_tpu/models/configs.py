@@ -42,9 +42,12 @@ class EncoderConfig:
     #: (1.16×) and dominates long context (49× at T=8192, where the
     #: dense [B,H,T,T] HBM blowup bites); at the classifier's T=128
     #: dense is ~8% faster, so it stays the default.  Flash trains too
-    #: (FlashAttention-2 custom VJP, gradient-parity-tested vs dense);
-    #: only the ring/lse composition and packed batches require dense.
-    #: The params tree is impl-independent — train/serve with either.
+    #: (FlashAttention-2 custom VJP, gradient-parity-tested vs dense)
+    #: and composes with packed batches via segment tags (no
+    #: [R, 1, T, T] bias materialization — bench --config 12 measures
+    #: it against packed×dense); only the ring/lse composition is
+    #: inference-only.  The params tree is impl-independent —
+    #: train/serve with either.
     attention: str = "dense"
 
     @property

@@ -34,7 +34,12 @@ class SelfAttention(nn.Module):
     cfg: EncoderConfig
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, bias: jnp.ndarray) -> jnp.ndarray:
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        bias: jnp.ndarray,
+        segments: jnp.ndarray | None = None,
+    ) -> jnp.ndarray:
         cfg = self.cfg
         b, t, _ = x.shape
         h, d = cfg.n_heads, cfg.head_dim
@@ -46,11 +51,17 @@ class SelfAttention(nn.Module):
         if cfg.attention == "flash":
             from svoc_tpu.ops.pallas_attention import flash_attention
 
-            # The additive bias encodes key padding (0 kept / -1e9
-            # masked, broadcast [B, 1, 1, T]) — recover the boolean
-            # per-key mask the kernel consumes.
-            kmask = (bias[:, 0, 0, :] > -1.0).astype(jnp.int32)
-            ctx = flash_attention(q, k, v, kmask).reshape(b, t, cfg.hidden)
+            if segments is not None:
+                # Packed rows: the kernel masks per tile from the [B, T]
+                # segment ids — no [B, 1, T, T] bias ever materializes.
+                ctx = flash_attention(q, k, v, segment_ids=segments)
+            else:
+                # The additive bias encodes key padding (0 kept / -1e9
+                # masked, broadcast [B, 1, 1, T]) — recover the boolean
+                # per-key mask the kernel consumes.
+                kmask = (bias[:, 0, 0, :] > -1.0).astype(jnp.int32)
+                ctx = flash_attention(q, k, v, kmask)
+            ctx = ctx.reshape(b, t, cfg.hidden)
         else:
             scale = jnp.asarray(1.0 / jnp.sqrt(d), cfg.dtype)
             scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
@@ -64,9 +75,14 @@ class EncoderBlock(nn.Module):
     cfg: EncoderConfig
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, bias: jnp.ndarray) -> jnp.ndarray:
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        bias: jnp.ndarray,
+        segments: jnp.ndarray | None = None,
+    ) -> jnp.ndarray:
         cfg = self.cfg
-        a = SelfAttention(cfg, name="attention")(x, bias)
+        a = SelfAttention(cfg, name="attention")(x, bias, segments)
         x = nn.LayerNorm(epsilon=cfg.ln_eps, dtype=jnp.float32, name="ln_attn")(
             x + a
         ).astype(cfg.dtype)

@@ -31,9 +31,13 @@ and softmax support as the unpacked forward, so logits match the
 unpacked encoder to float tolerance (asserted in
 ``tests/test_packing.py``).
 
-Packing requires ``cfg.attention == "dense"``: the flash kernel's
-per-key boolean mask cannot express block-diagonal segment masks (a
-block-sparse flash variant would be the long-context analogue).
+Packing composes with both attention implementations: ``"dense"``
+materializes the block-diagonal additive bias ``[R, 1, T, T]``;
+``"flash"`` feeds the raw ``[R, T]`` segment ids to the Pallas kernel,
+which rebuilds each ``[bq, bk]`` tile's mask from two integer vectors
+(:func:`svoc_tpu.ops.pallas_attention._tag_mask`) — no quadratic bias
+tensor ever reaches HBM, removing the packed hot path's largest
+intermediate.
 """
 
 from __future__ import annotations
@@ -203,11 +207,10 @@ class PackedSentimentEncoder(nn.Module):
         cls_pos: jnp.ndarray,
     ) -> jnp.ndarray:
         cfg = self.cfg
-        if cfg.attention != "dense":
+        if cfg.attention not in ("dense", "flash"):
             raise ValueError(
-                "packed batches need cfg.attention == 'dense' — the flash "
-                "kernel's per-key mask cannot express block-diagonal "
-                f"segments (got {cfg.attention!r})"
+                "packed batches support cfg.attention 'dense' or 'flash' "
+                f"(got {cfg.attention!r})"
             )
 
         tok = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype, name="tok_emb")(
@@ -220,14 +223,21 @@ class PackedSentimentEncoder(nn.Module):
             tok + pos
         ).astype(cfg.dtype)
 
-        # Block-diagonal additive bias [R, 1, T, T]: query q sees key k
-        # iff both live in the same (real) segment.
-        same = (seg[:, :, None] == seg[:, None, :]) & (seg[:, :, None] > 0)
-        bias = jnp.where(same[:, None, :, :], 0.0, -1e9).astype(jnp.float32)
+        if cfg.attention == "flash":
+            # The flash kernel masks per tile straight from the [R, T]
+            # segment ids (pallas_attention._tag_mask) — the packed
+            # hot path's [R, 1, T, T] bias never materializes in HBM.
+            bias, segments = None, seg
+        else:
+            # Block-diagonal additive bias [R, 1, T, T]: query q sees
+            # key k iff both live in the same (real) segment.
+            same = (seg[:, :, None] == seg[:, None, :]) & (seg[:, :, None] > 0)
+            bias = jnp.where(same[:, None, :, :], 0.0, -1e9).astype(jnp.float32)
+            segments = None
 
         block = nn.remat(EncoderBlock) if cfg.remat else EncoderBlock
         for i in range(cfg.n_layers):
-            x = block(cfg, name=f"block_{i}")(x, bias)
+            x = block(cfg, name=f"block_{i}")(x, bias, segments)
 
         # Per-segment first-token head: gather each segment's BOS hidden
         # state, then the RobertaClassificationHead stack.

@@ -89,7 +89,9 @@ class SentimentPipeline:
     #: Route ``__call__`` through the sequence-packed forward
     #: (:mod:`svoc_tpu.models.packing`): several comments per fixed row,
     #: ~3× fewer device rows on HN-shaped text, identical results to
-    #: float tolerance.  Requires ``cfg.attention == "dense"``.
+    #: float tolerance.  Composes with ``cfg.attention`` "dense" (additive
+    #: block-diagonal bias) or "flash" (segment tags in the kernel — no
+    #: [R, 1, T, T] bias materialization).
     packed: bool = False
     #: Segments per packed row (only read when ``packed``).
     max_segments: int = 8
@@ -107,11 +109,10 @@ class SentimentPipeline:
 
         # ALL config validation up front — before the tree cast and the
         # tokenizer load, so a misconfiguration fails in microseconds.
-        if self.packed and self.cfg.attention != "dense":
+        if self.packed and self.cfg.attention not in ("dense", "flash"):
             raise ValueError(
-                "packed inference needs cfg.attention == 'dense' — the "
-                "flash kernel's per-key mask cannot express block-diagonal "
-                f"segments (got {self.cfg.attention!r})"
+                "packed inference supports cfg.attention 'dense' or "
+                f"'flash' (got {self.cfg.attention!r})"
             )
         if max(self.label_indices) >= self.cfg.n_labels:
             raise ValueError(
@@ -120,6 +121,17 @@ class SentimentPipeline:
                 f"matching the model (e.g. (0, 1) for SST-2)"
             )
         validate_quant(self.cfg, self.quant)
+        if self.quant is None and self.params is not None:
+            from svoc_tpu.models.quant import is_quantized_tree
+
+            if is_quantized_tree(self.params):
+                # Without this, the float forward dies at trace time
+                # with an opaque KeyError('kernel') (ADVICE r3).
+                raise ValueError(
+                    "params is a pre-quantized (int8) tree but quant is "
+                    "None — pass quant='int8' to serve it, or load the "
+                    "float checkpoint for the float forward"
+                )
         if self.quant and self.params_dtype is not None:
             raise ValueError(
                 "params_dtype is not meaningful under quant='int8' — "

@@ -108,6 +108,27 @@ def fwsad_to_float(x: int) -> float:
     return float(x - FELT_PRIME if x > I128_MAX else x) * 1e-6
 
 
+def wsad_to_string(value: int, n_digits: int = 3) -> str:
+    """Decimal rendering of a wsad int (``contract/src/utils.cairo:
+    283-297`` ``wsad_to_string``): sign, integer part, then the first
+    ``n_digits`` decimal digits TRUNCATED (not rounded), zero-padded on
+    the left exactly like the Cairo ``lfill``."""
+    if n_digits < 0 or n_digits > 6:
+        raise ValueError(f"n_digits must be in [0, 6], got {n_digits}")
+    u = abs(value)
+    sign = "-" if value < 0 else ""
+    integer_part = u // WSAD
+    decimal_reduced = (u % WSAD) // (10 ** (6 - n_digits))
+    if n_digits == 0:
+        return f"{sign}{integer_part}."
+    return f"{sign}{integer_part}.{str(decimal_reduced).zfill(n_digits)}"
+
+
+def felt_wsad_to_string(value: int, n_digits: int = 3) -> str:
+    """``utils.cairo:279-281`` — felt252 calldata → decimal string."""
+    return wsad_to_string(felt_to_wsad(value), n_digits)
+
+
 def wsad_to_felt(x: int) -> int:
     """Signed wsad int → felt252 (``signed_decimal.cairo:26-28`` via felt cast)."""
     return x % FELT_PRIME

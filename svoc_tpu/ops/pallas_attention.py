@@ -57,11 +57,23 @@ def _largest_aligned_divisor(t: int, requested: int):
     return None
 
 
+def _tag_mask(qtag, ktag):
+    """Attention mask from integer tags: query i sees key j iff their
+    tags match and the key's tag is live (> 0).
+
+    Subsumes both masking modes with one rule: per-key padding masks
+    (qtag ≡ 1, ktag = 0/1 mask) and packed block-diagonal segments
+    (qtag = ktag = segment ids, 0 = padding — a padding QUERY matches no
+    live key, hence the dead-row 0-output convention)."""
+    return (qtag[:, None] == ktag[None, :]) & (ktag[None, :] > 0)
+
+
 def _flash_kernel(
     q_ref,  # [1, bq, D]   resident across the k dimension
     k_ref,  # [1, bk, D]   streamed per k step
     v_ref,  # [1, bk, D]   streamed per k step
-    mask_ref,  # [1, 1, bk]
+    qtag_ref,  # [1, 1, bq]
+    ktag_ref,  # [1, 1, bk]
     o_ref,  # [1, bq, D]   written on the last k step
     *rest,  # [lse_ref [1, 1, bq] when with_lse] + 3 VMEM scratch refs
     scale: float,
@@ -83,12 +95,13 @@ def _flash_kernel(
     q = q_ref[0].astype(jnp.float32) * scale  # [bq, D]
     k_blk = k_ref[0].astype(jnp.float32)  # [bk, D]
     v_blk = v_ref[0].astype(jnp.float32)  # [bk, D]
-    kmask = mask_ref[0, 0]  # [bk]
 
     scores = jax.lax.dot_general(
         q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [bq, bk]
-    scores = jnp.where(kmask[None, :] > 0, scores, NEG_INF)
+    scores = jnp.where(
+        _tag_mask(qtag_ref[0, 0], ktag_ref[0, 0]), scores, NEG_INF
+    )
 
     m = m_scr[...]
     m_blk = jnp.max(scores, axis=1, keepdims=True)  # [bq, 1]
@@ -126,7 +139,7 @@ def _flash_kernel(
 # --------------------------------------------------------------------------
 
 
-def _p_block(q_blk, k_blk, kmask, lse_row, *, scale):
+def _p_block(q_blk, k_blk, qtag, ktag, lse_row, *, scale):
     """Recomputed softmax block ``p [bq, bk]`` from saved lse.
 
     ``lse = -inf`` marks a fully-masked query row (forward emits 0);
@@ -137,7 +150,7 @@ def _p_block(q_blk, k_blk, kmask, lse_row, *, scale):
         q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [bq, bk]
     p = jnp.exp(s - lse_row[:, None])
-    p = jnp.where(kmask[None, :] > 0, p, 0.0)
+    p = jnp.where(_tag_mask(qtag, ktag), p, 0.0)
     return jnp.where(jnp.isfinite(lse_row)[:, None], p, 0.0)
 
 
@@ -145,7 +158,8 @@ def _flash_dq_kernel(
     q_ref,  # [1, bq, D]  resident across k steps
     k_ref,  # [1, bk, D]  streamed
     v_ref,  # [1, bk, D]  streamed
-    mask_ref,  # [1, 1, bk]
+    qtag_ref,  # [1, 1, bq]
+    ktag_ref,  # [1, 1, bk]
     do_ref,  # [1, bq, D]
     lse_ref,  # [1, 1, bq]
     delta_ref,  # [1, 1, bq]
@@ -168,7 +182,9 @@ def _flash_dq_kernel(
     lse_row = lse_ref[0, 0]
     delta_row = delta_ref[0, 0]
 
-    p = _p_block(q, k_blk, mask_ref[0, 0], lse_row, scale=scale)
+    p = _p_block(
+        q, k_blk, qtag_ref[0, 0], ktag_ref[0, 0], lse_row, scale=scale
+    )
     dp = jax.lax.dot_general(
         do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [bq, bk]
@@ -185,8 +201,9 @@ def _flash_dq_kernel(
 def _flash_dkv_kernel(
     k_ref,  # [1, bk, D]  resident across q steps
     v_ref,  # [1, bk, D]
-    mask_ref,  # [1, 1, bk]
+    ktag_ref,  # [1, 1, bk]
     q_ref,  # [1, bq, D]  streamed
+    qtag_ref,  # [1, 1, bq]
     do_ref,  # [1, bq, D]  streamed
     lse_ref,  # [1, 1, bq]
     delta_ref,  # [1, 1, bq]
@@ -212,7 +229,9 @@ def _flash_dkv_kernel(
     lse_row = lse_ref[0, 0]
     delta_row = delta_ref[0, 0]
 
-    p = _p_block(q, k_blk, mask_ref[0, 0], lse_row, scale=scale)  # [bq, bk]
+    p = _p_block(
+        q, k_blk, qtag_ref[0, 0], ktag_ref[0, 0], lse_row, scale=scale
+    )  # [bq, bk]
     dv_scr[...] += jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )  # [bk, D]
@@ -230,7 +249,9 @@ def _flash_dkv_kernel(
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_core(qf, kf, vf, maskf, *, block_q, block_k, d, interpret, with_lse):
+def _flash_core(
+    qf, kf, vf, qtagf, ktagf, *, block_q, block_k, d, interpret, with_lse
+):
     """The forward pallas_call over pre-flattened ``[B·H, T, D]``."""
     bh, t, _ = qf.shape
     n_k = t // block_k
@@ -268,6 +289,10 @@ def _flash_core(qf, kf, vf, maskf, *, block_q, block_k, d, interpret, with_lse):
                 memory_space=pltpu.VMEM,
             ),
             pl.BlockSpec(
+                (1, 1, block_q), lambda b, qi, ki: (b, 0, qi),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
                 (1, 1, block_k), lambda b, qi, ki: (b, 0, ki),
                 memory_space=pltpu.VMEM,
             ),
@@ -280,10 +305,12 @@ def _flash_core(qf, kf, vf, maskf, *, block_q, block_k, d, interpret, with_lse):
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, maskf)
+    )(qf, kf, vf, qtagf, ktagf)
 
 
-def _flash_grads(qf, kf, vf, maskf, dof, lsef, deltaf, *, block_q, block_k, d, interpret):
+def _flash_grads(
+    qf, kf, vf, qtagf, ktagf, dof, lsef, deltaf, *, block_q, block_k, d, interpret
+):
     """Backward pallas_calls over pre-flattened arrays → (dqf, dkf, dvf)."""
     bh, t, _ = qf.shape
     scale = 1.0 / (d**0.5)
@@ -295,7 +322,7 @@ def _flash_grads(qf, kf, vf, maskf, dof, lsef, deltaf, *, block_q, block_k, d, i
     k_at_ki = pl.BlockSpec(
         (1, block_k, d), lambda b, qi, ki: (b, ki, 0), memory_space=pltpu.VMEM
     )
-    mask_at_ki = pl.BlockSpec(
+    tag_at_ki = pl.BlockSpec(
         (1, 1, block_k), lambda b, qi, ki: (b, 0, ki), memory_space=pltpu.VMEM
     )
     row_at_qi = pl.BlockSpec(
@@ -304,18 +331,21 @@ def _flash_grads(qf, kf, vf, maskf, dof, lsef, deltaf, *, block_q, block_k, d, i
     dqf = pl.pallas_call(
         functools.partial(_flash_dq_kernel, scale=scale, n_k=n_k),
         grid=(bh, n_q, n_k),
-        in_specs=[q_at_qi, k_at_ki, k_at_ki, mask_at_ki, q_at_qi, row_at_qi, row_at_qi],
+        in_specs=[
+            q_at_qi, k_at_ki, k_at_ki, row_at_qi, tag_at_ki,
+            q_at_qi, row_at_qi, row_at_qi,
+        ],
         out_specs=q_at_qi,
         out_shape=jax.ShapeDtypeStruct((bh, t, d), qf.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, maskf, dof, lsef, deltaf)
+    )(qf, kf, vf, qtagf, ktagf, dof, lsef, deltaf)
 
     # dk/dv grid: k blocks outer, q blocks inner (scratch carries over qi).
     k_outer = pl.BlockSpec(
         (1, block_k, d), lambda b, ki, qi: (b, ki, 0), memory_space=pltpu.VMEM
     )
-    mask_outer = pl.BlockSpec(
+    tag_outer = pl.BlockSpec(
         (1, 1, block_k), lambda b, ki, qi: (b, 0, ki), memory_space=pltpu.VMEM
     )
     q_inner = pl.BlockSpec(
@@ -327,7 +357,10 @@ def _flash_grads(qf, kf, vf, maskf, dof, lsef, deltaf, *, block_q, block_k, d, i
     dkf, dvf = pl.pallas_call(
         functools.partial(_flash_dkv_kernel, scale=scale, n_q=n_q),
         grid=(bh, n_k, n_q),
-        in_specs=[k_outer, k_outer, mask_outer, q_inner, q_inner, row_inner, row_inner],
+        in_specs=[
+            k_outer, k_outer, tag_outer, q_inner, row_inner,
+            q_inner, row_inner, row_inner,
+        ],
         out_specs=(k_outer, k_outer),
         out_shape=(
             jax.ShapeDtypeStruct((bh, t, d), kf.dtype),
@@ -338,44 +371,45 @@ def _flash_grads(qf, kf, vf, maskf, dof, lsef, deltaf, *, block_q, block_k, d, i
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(kf, vf, maskf, qf, dof, lsef, deltaf)
+    )(kf, vf, ktagf, qf, qtagf, dof, lsef, deltaf)
     return dqf, dkf, dvf
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_diff(qf, kf, vf, maskf, block_q, block_k, d, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_diff(qf, kf, vf, qtagf, ktagf, block_q, block_k, d, interpret):
     """Differentiable flattened flash attention (custom VJP)."""
     return _flash_core(
-        qf, kf, vf, maskf,
+        qf, kf, vf, qtagf, ktagf,
         block_q=block_q, block_k=block_k, d=d,
         interpret=interpret, with_lse=False,
     )
 
 
-def _flash_diff_fwd(qf, kf, vf, maskf, block_q, block_k, d, interpret):
+def _flash_diff_fwd(qf, kf, vf, qtagf, ktagf, block_q, block_k, d, interpret):
     out, lse = _flash_core(
-        qf, kf, vf, maskf,
+        qf, kf, vf, qtagf, ktagf,
         block_q=block_q, block_k=block_k, d=d,
         interpret=interpret, with_lse=True,
     )
-    return out, (qf, kf, vf, maskf, out, lse)
+    return out, (qf, kf, vf, qtagf, ktagf, out, lse)
 
 
 def _flash_diff_bwd(block_q, block_k, d, interpret, res, dout):
     import numpy as np
 
-    qf, kf, vf, maskf, out, lse = res
+    qf, kf, vf, qtagf, ktagf, out, lse = res
     # delta = rowsum(dO · O) per query row — cheap elementwise in XLA.
     delta = jnp.sum(
         dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )[:, None, :]  # [B·H, 1, T]
     dqf, dkf, dvf = _flash_grads(
-        qf, kf, vf, maskf, dout, lse, delta,
+        qf, kf, vf, qtagf, ktagf, dout, lse, delta,
         block_q=block_q, block_k=block_k, d=d, interpret=interpret,
     )
-    # kmask is integer-valued: its tangent space is float0.
-    dmask = np.zeros(maskf.shape, jax.dtypes.float0)
-    return dqf, dkf, dvf, dmask
+    # Tags are integer-valued: their tangent space is float0.
+    dqtag = np.zeros(qtagf.shape, jax.dtypes.float0)
+    dktag = np.zeros(ktagf.shape, jax.dtypes.float0)
+    return dqf, dkf, dvf, dqtag, dktag
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
@@ -393,11 +427,20 @@ def flash_attention(
     block_k: int = 256,
     interpret: bool | None = None,
     return_lse: bool = False,
+    segment_ids: jnp.ndarray | None = None,
 ) -> "jnp.ndarray | tuple[jnp.ndarray, jnp.ndarray]":
     """``q/k/v [B, T, H, D]``, ``kmask [B, T]`` (1 = real key) →
     ``[B, T, H, D]``.  T must divide by the block sizes (pad the batch
     to the model's fixed seq_len upstream, as the pipeline already
     does).
+
+    ``segment_ids [B, T]`` (mutually exclusive with ``kmask``) switches
+    to PACKED attention: token i attends token j iff their segment ids
+    match and are > 0 (0 = padding) — the block-diagonal mask of
+    :mod:`svoc_tpu.models.packing`, computed per tile from two [T] int
+    vectors instead of a materialized [B, 1, T, T] bias.  Per-key
+    masking is the special case ``q tags ≡ 1, k tags = kmask``; both
+    modes share one kernel (``_tag_mask``).
 
     ``return_lse=True`` also returns the per-row log-sum-exp
     ``[B, T, H]`` so independently-normalized outputs can be merged
@@ -405,12 +448,20 @@ def flash_attention(
     flash-inner/ring-outer composition
     (:func:`svoc_tpu.parallel.ring_attention.ring_attention`).
 
-    Convention: a FULLY-masked query row yields 0 output and ``-inf``
-    lse (the dense softmax would yield the degenerate uniform average
-    of V) — required for exact ring merging of padding-only blocks."""
+    Convention: a FULLY-masked query row (all keys masked, or a padding
+    query under ``segment_ids``) yields 0 output and ``-inf`` lse (the
+    dense softmax would yield the degenerate uniform average of V) —
+    required for exact ring merging of padding-only blocks."""
     b, t, h, d = q.shape
-    if kmask is None:
-        kmask = jnp.ones((b, t), jnp.int32)
+    if segment_ids is not None:
+        if kmask is not None:
+            raise ValueError("pass kmask or segment_ids, not both")
+        qtag = ktag = segment_ids.astype(jnp.int32)
+    else:
+        if kmask is None:
+            kmask = jnp.ones((b, t), jnp.int32)
+        qtag = jnp.ones((b, t), jnp.int32)
+        ktag = kmask.astype(jnp.int32)
     # Clamp each block to the LARGEST 8-aligned divisor of T that fits
     # the request — T=384 with the default 256 falls back to 192-wide
     # blocks, and T=520 gets 104 (gcd would degenerate to 8-wide tiles).
@@ -428,18 +479,21 @@ def flash_attention(
     qf = jnp.transpose(q, (0, 2, 1, 3)).reshape(b * h, t, d)
     kf = jnp.transpose(k, (0, 2, 1, 3)).reshape(b * h, t, d)
     vf = jnp.transpose(v, (0, 2, 1, 3)).reshape(b * h, t, d)
-    # [B·H, 1, T]: the singleton middle axis keeps the mask BlockSpec's
+    # [B·H, 1, T]: the singleton middle axis keeps the tag BlockSpecs'
     # trailing dims TPU-tileable ((1, bk) blocks are rejected by Mosaic).
-    maskf = jnp.repeat(kmask, h, axis=0)[:, None, :]
+    qtagf = jnp.repeat(qtag, h, axis=0)[:, None, :]
+    ktagf = jnp.repeat(ktag, h, axis=0)[:, None, :]
 
     if not return_lse:
         # Differentiable path (custom VJP — FlashAttention-2 backward):
         # the fwd rule re-runs the kernel with lse saved as a residual.
-        out = _flash_diff(qf, kf, vf, maskf, block_q, block_k, d, interpret)
+        out = _flash_diff(
+            qf, kf, vf, qtagf, ktagf, block_q, block_k, d, interpret
+        )
         return jnp.transpose(out.reshape(b, h, t, d), (0, 2, 1, 3))
     # lse path (ring composition) — inference-only.
     out, lse = _flash_core(
-        qf, kf, vf, maskf,
+        qf, kf, vf, qtagf, ktagf,
         block_q=block_q, block_k=block_k, d=d,
         interpret=interpret, with_lse=True,
     )

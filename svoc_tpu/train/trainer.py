@@ -73,14 +73,14 @@ def _packed_loss_fn(packed_model, params, batch: PackedTrainBatch) -> jnp.ndarra
 
 
 def _reject_non_dense_packed(cfg) -> None:
-    if cfg.attention != "dense":
-        # Early, factory-level version of PackedSentimentEncoder's own
-        # trace-time check: packed batches need block-diagonal masking
-        # the flash kernel's per-key mask cannot express.
+    # Early, factory-level version of PackedSentimentEncoder's own
+    # trace-time check.  "flash" trains through the segment-tag kernel's
+    # custom VJP (svoc_tpu.ops.pallas_attention); "dense" through the
+    # additive block-diagonal bias.
+    if cfg.attention not in ("dense", "flash"):
         raise ValueError(
-            "packed fine-tuning needs cfg.attention == 'dense' — the "
-            "flash kernel's per-key mask cannot express block-diagonal "
-            f"segments (got {cfg.attention!r})"
+            "packed fine-tuning supports cfg.attention 'dense' or "
+            f"'flash' (got {cfg.attention!r})"
         )
 
 

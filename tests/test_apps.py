@@ -503,3 +503,24 @@ class TestInt8Session:
         )
         assert rc == 0
         assert built["config"].quant_inference == "int8"
+
+
+def test_oracle_dump_renders_exact_wsad_digits():
+    """wsad 7000 (0.007000) must print '0.007' — the float round trip
+    yields 6999 and would truncate to '0.006' (code-review r4)."""
+    from svoc_tpu.apps.commands import CommandConsole
+    from svoc_tpu.apps.session import Session, SessionConfig
+    from svoc_tpu.consensus.state import OracleConsensusContract
+    from svoc_tpu.io.chain import ChainAdapter, LocalChainBackend
+
+    contract = OracleConsensusContract(
+        [0xA0], [0x10, 0x11, 0x12], constrained=True, dimension=2
+    )
+    contract.update_prediction(0x10, [7000, 123456], encoding="wsad")
+    session = Session(
+        config=SessionConfig(n_oracles=3, n_admins=1, dimension=2),
+        adapter=ChainAdapter(LocalChainBackend(contract)),
+        vectorizer=lambda texts: None,
+    )
+    out = CommandConsole(session).query("get_oracle_value_list")
+    assert "[0.007, 0.123]" in out[0]

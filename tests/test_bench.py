@@ -60,3 +60,28 @@ def test_explicit_small_mode_is_not_labeled_auto():
     assert rc == 0
     assert result["detail"]["small_mode"] is True
     assert "small_mode_auto" not in result["detail"]
+
+
+def test_soak_recovered_reads_snapshot_series():
+    """Recovery = a commit SUCCEEDED after the last panic; commit
+    attempts and dedup'd console lines must not fool it (code-review
+    r4)."""
+    from tools.soak import soak_recovered
+
+    def snap(commits, failures, active=True):
+        return {
+            "commits": commits,
+            "chain_commit_failures": failures,
+            "consensus_active": active,
+        }
+
+    # healthy run, no panics
+    assert soak_recovered([snap(5, 0), snap(10, 0)])
+    # panic then recovery (successes 4 -> 8)
+    assert soak_recovered([snap(5, 1), snap(9, 1)])
+    # every later commit fails: attempts grow, successes don't
+    assert not soak_recovered([snap(5, 1), snap(30, 26)])
+    # consensus lost at the end
+    assert not soak_recovered([snap(5, 0), snap(10, 0, active=False)])
+    # empty run
+    assert not soak_recovered([])

@@ -87,3 +87,38 @@ def test_to_cairo_fixture_reproduces_recorded_vectors():
     ]
     neg = to_cairo_fixture([[-1.5]])
     assert neg == f"array![{FELT_PRIME - 1_500_000}].span(),"
+
+
+class TestWsadToString:
+    """``utils.cairo:283-297`` decimal rendering (truncated, lfilled)."""
+
+    def test_reference_shapes(self):
+        from svoc_tpu.ops.fixedpoint import wsad_to_string
+
+        assert wsad_to_string(1_234_567, 3) == "1.234"
+        assert wsad_to_string(1_234_567, 6) == "1.234567"
+        assert wsad_to_string(-500_000, 3) == "-0.500"
+        assert wsad_to_string(20_714_285, 3) == "20.714"
+        # lfill zero-padding: 0.004999 at 3 digits is "0.004"
+        assert wsad_to_string(4_999, 3) == "0.004"
+        # truncation, never rounding (Cairo integer division)
+        assert wsad_to_string(999_999, 2) == "0.99"
+        assert wsad_to_string(0, 3) == "0.000"
+        assert wsad_to_string(7, 0) == "0."
+
+    def test_felt_roundtrip(self):
+        from svoc_tpu.ops.fixedpoint import (
+            felt_wsad_to_string,
+            float_to_fwsad,
+        )
+
+        assert felt_wsad_to_string(float_to_fwsad(-1.25), 3) == "-1.250"
+        assert felt_wsad_to_string(float_to_fwsad(2.5), 2) == "2.50"
+
+    def test_bad_digits_rejected(self):
+        import pytest
+
+        from svoc_tpu.ops.fixedpoint import wsad_to_string
+
+        with pytest.raises(ValueError):
+            wsad_to_string(1, 7)

@@ -75,6 +75,42 @@ class TestAutoFlags:
             c.stop()
         assert session.auto_fetch is False and session.auto_commit is False
 
+    def test_auto_fetch_on_empty_store_waits_not_errors(self):
+        """Auto-fetch racing an empty store (live-mode startup: scraper
+        and fetch loop begin together) is WAITING, not an error — the
+        1024-oracle soak flagged the old error-spam on its first cycle."""
+        from svoc_tpu.io.comment_store import CommentStore
+        from svoc_tpu.utils.metrics import registry
+
+        session = Session(
+            config=SessionConfig(refresh_rate_s=0.03),
+            store=CommentStore(),  # stays empty: no scraper started
+            vectorizer=fake_vectorizer,
+        )
+        c = CommandConsole(session)
+        errors0 = registry.counter("auto_fetch_errors").count
+        waiting0 = registry.counter("auto_fetch_waiting").count
+        c.query("auto_fetch on")
+        try:
+            assert wait_until(
+                lambda: registry.counter("auto_fetch_waiting").count
+                >= waiting0 + 3
+            ), "empty-store cycles never counted as waiting"
+        finally:
+            c.query("auto_fetch off")
+            c.stop()
+        assert registry.counter("auto_fetch_errors").count == errors0
+        # Ingest arriving later unblocks the same loop.
+        from svoc_tpu.io.scraper import SyntheticSource
+
+        session.store.save(SyntheticSource(batch=60)())
+        c.query("auto_fetch on")
+        try:
+            assert wait_until(lambda: session.predictions is not None)
+        finally:
+            c.query("auto_fetch off")
+            c.stop()
+
     def test_rapid_off_on_restarts_scraper(self):
         """off→on with no delay must start a fresh ingest loop, not
         report ENABLED while the old stopping thread dies."""

@@ -143,10 +143,41 @@ def test_packed_param_tree_is_identical(pipe):
     assert ref_shapes == got_shapes
 
 
-def test_packed_rejects_flash():
+def test_packed_flash_matches_dense(pipe):
+    """The flash segment-tag path must reproduce the dense block-diagonal
+    bias path's logits on every REAL segment (padding rows legitimately
+    differ: flash's dead-row convention emits 0 where dense emits the
+    degenerate uniform average — neither is ever gathered)."""
+    from dataclasses import replace
+
+    texts = _texts(11, seed=21)
+    ids, mask = pipe.tokenizer(texts, SEQ)
+    batch, _ = pack_tokens(
+        strip_padding(ids, mask), SEQ, max_segments=4, pad_id=pipe.tokenizer.pad_id
+    )
+    args = (
+        jnp.asarray(batch.ids),
+        jnp.asarray(batch.pos),
+        jnp.asarray(batch.seg),
+        jnp.asarray(batch.cls_pos),
+    )
+    dense_logits = PackedSentimentEncoder(TINY_TEST).apply(pipe.params, *args)
+    flash_logits = PackedSentimentEncoder(
+        replace(TINY_TEST, attention="flash")
+    ).apply(pipe.params, *args)
+    valid = batch.seg_valid > 0
+    np.testing.assert_allclose(
+        np.asarray(flash_logits)[valid],
+        np.asarray(dense_logits)[valid],
+        rtol=2e-4,
+        atol=2e-5,
+    )
+
+
+def test_packed_rejects_unknown_attention():
     cfg = EncoderConfig(
         vocab_size=64, hidden=16, n_layers=1, n_heads=2, intermediate=32,
-        max_len=32, dtype=jnp.float32, attention="flash",
+        max_len=32, dtype=jnp.float32, attention="ring",
     )
     packed_model = PackedSentimentEncoder(cfg)
     batch, _ = pack_tokens([[5, 6]], 16, max_segments=2, pad_id=1)
@@ -187,12 +218,31 @@ def test_pipeline_packed_flag_routes_call():
     np.testing.assert_allclose(p(texts), ref(texts), rtol=2e-4, atol=2e-5)
 
 
-def test_pipeline_packed_rejects_flash():
+def test_pipeline_packed_flash_matches_dense_pipeline():
+    """End to end: packed×flash pipeline == packed×dense pipeline ==
+    the plain unpacked pipeline, on the same texts."""
+    from dataclasses import replace
+
+    flash = SentimentPipeline(
+        cfg=replace(TINY_TEST, attention="flash"),
+        seq_len=SEQ,
+        batch_size=4,
+        tokenizer_name=None,
+        packed=True,
+    )
+    ref = SentimentPipeline(
+        cfg=TINY_TEST, seq_len=SEQ, batch_size=4, tokenizer_name=None
+    )
+    texts = _texts(9, seed=13)
+    np.testing.assert_allclose(flash(texts), ref(texts), rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_packed_rejects_unknown_attention():
     from dataclasses import replace
 
     with pytest.raises(ValueError, match="dense"):
         SentimentPipeline(
-            cfg=replace(TINY_TEST, attention="flash"),
+            cfg=replace(TINY_TEST, attention="ring"),
             seq_len=SEQ,
             batch_size=4,
             tokenizer_name=None,

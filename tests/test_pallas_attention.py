@@ -131,3 +131,117 @@ def test_flash_backward_bf16_smoke():
     for g, x in zip(grads, (q, k, v)):
         assert g.shape == x.shape and g.dtype == x.dtype
         assert np.all(np.isfinite(np.asarray(g, np.float32)))
+
+
+# -- segment-tag (packed) masking -------------------------------------------
+
+
+def dense_segment_reference(q, k, v, seg):
+    """Dense packed attention with the flash dead-row convention:
+    token i attends token j iff seg[i] == seg[j] > 0; a padding query
+    (seg 0) attends nothing and outputs exactly 0."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.float32(d))
+    m = (seg[:, :, None] == seg[:, None, :]) & (seg[:, None, :] > 0)
+    probs = jax.nn.softmax(jnp.where(m[:, None], scores, -1e30), axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    dead = ~m.any(-1)  # [B, Tq]
+    return jnp.where(dead[:, :, None, None], 0.0, out)
+
+
+def _segments(b=2, t=64, seed=7, max_segments=5):
+    """Random contiguous segment layouts with a padding tail."""
+    rng = np.random.default_rng(seed)
+    seg = np.zeros((b, t), np.int32)
+    for i in range(b):
+        pos = 0
+        for s in range(1, max_segments + 1):
+            length = int(rng.integers(3, t // max_segments + 1))
+            if pos + length > t:
+                break
+            seg[i, pos : pos + length] = s
+            pos += length
+    return jnp.asarray(seg)
+
+
+class TestFlashSegments:
+    def test_segments_match_dense_blockdiag(self):
+        q, k, v = qkv(jax.random.PRNGKey(10), t=64)
+        seg = _segments(t=64)
+        out = flash_attention(
+            q, k, v, segment_ids=seg, block_q=16, block_k=16
+        )
+        ref = dense_segment_reference(q, k, v, seg)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+    def test_segments_blocks_straddle_boundaries(self):
+        """Block sizes that do NOT align with segment boundaries must
+        still mask exactly (a tile can contain pieces of 3 segments)."""
+        q, k, v = qkv(jax.random.PRNGKey(11), t=64)
+        seg = _segments(t=64, seed=12)
+        for bq, bk in [(8, 32), (32, 8), (64, 64)]:
+            out = flash_attention(
+                q, k, v, segment_ids=seg, block_q=bq, block_k=bk
+            )
+            ref = dense_segment_reference(q, k, v, seg)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5,
+                err_msg=f"bq={bq} bk={bk}",
+            )
+
+    def test_segments_rejects_both_masks(self):
+        q, k, v = qkv(jax.random.PRNGKey(12), t=32)
+        seg = _segments(t=32)
+        with pytest.raises(ValueError, match="not both"):
+            flash_attention(q, k, v, jnp.ones((2, 32), jnp.int32), segment_ids=seg)
+
+    def test_segments_backward_matches_dense(self):
+        rng = np.random.default_rng(3)
+        b, t, h, d = 2, 32, 2, 8
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+            for _ in range(3)
+        )
+        seg = _segments(b=b, t=t, seed=14, max_segments=3)
+        cot = jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+        gf = jax.grad(
+            lambda *a: jnp.sum(
+                flash_attention(*a, segment_ids=seg, block_q=8, block_k=16)
+                * cot
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gd = jax.grad(
+            lambda *a: jnp.sum(dense_segment_reference(*a, seg) * cot),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for name, a, b_ in zip("qkv", gf, gd):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=1e-4, err_msg=f"d{name}"
+            )
+
+    def test_segments_padding_gets_zero_grad(self):
+        """Padding tokens (seg 0) are outside every softmax support —
+        their q/k/v gradients must be EXACTLY zero."""
+        rng = np.random.default_rng(4)
+        b, t, h, d = 1, 32, 1, 8
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+            for _ in range(3)
+        )
+        seg = jnp.asarray(
+            np.where(np.arange(t)[None, :] < 20, 1 + np.arange(t)[None, :] // 10, 0),
+            jnp.int32,
+        )
+        dq, dk, dv = jax.grad(
+            lambda *a: jnp.sum(
+                flash_attention(*a, segment_ids=seg, block_q=8, block_k=8)
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        pad = np.asarray(seg)[0] == 0
+        assert np.all(np.asarray(dq)[0, pad] == 0)
+        assert np.all(np.asarray(dk)[0, pad] == 0)
+        assert np.all(np.asarray(dv)[0, pad] == 0)

@@ -157,6 +157,20 @@ class TestPipelineIntegration:
                 quant="int4",
             )
 
+    def test_quantized_tree_with_quant_none_rejected(self):
+        """A pre-folded int8 tree passed to a FLOAT pipeline must fail
+        with a clear config error, not a trace-time KeyError (ADVICE
+        r3)."""
+        qp = SentimentPipeline(
+            cfg=CFG, seq_len=32, batch_size=4, tokenizer_name=None, seed=5,
+            quant="int8",
+        )
+        with pytest.raises(ValueError, match="pre-quantized"):
+            SentimentPipeline(
+                cfg=CFG, seq_len=32, batch_size=4, tokenizer_name=None,
+                params=qp.params,
+            )
+
 
 class TestPersistence:
     def test_quantized_tree_roundtrips_npz_and_serves(self, tmp_path):

@@ -239,7 +239,7 @@ def test_sharded_packed_train_step_matches_unsharded():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
-def test_packed_trainer_rejects_flash():
+def test_packed_trainer_rejects_unknown_attention():
     import dataclasses
 
     import pytest
@@ -248,7 +248,7 @@ def test_packed_trainer_rejects_flash():
 
     with pytest.raises(ValueError, match="dense"):
         make_packed_train_step(
-            dataclasses.replace(TINY_TEST, attention="flash"), optax.adamw(1e-4)
+            dataclasses.replace(TINY_TEST, attention="ring"), optax.adamw(1e-4)
         )
 
 
@@ -329,4 +329,33 @@ def test_sp_trainer_rejects_flash():
     with pytest.raises(ValueError, match="dense"):
         make_sp_train_step(
             dataclasses.replace(TINY_TEST, attention="flash"), optax.sgd(0.1), mesh
+        )
+
+
+def test_packed_flash_train_step_matches_unpacked():
+    """packed × flash fine-tuning: the segment-tag kernel's custom VJP
+    must deliver the same loss and gradients as the unpacked dense
+    reference on the same comments+labels."""
+    from dataclasses import replace
+
+    from svoc_tpu.models.packing import PackedSentimentEncoder
+    from svoc_tpu.train.trainer import _loss_fn, _packed_loss_fn
+
+    cfg, batch, packed = _packed_pair()
+    model = SentimentEncoder(cfg)
+    params = init_params(model, seed=0)
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: _loss_fn(model, p, batch)
+    )(params)
+    flash_cfg = replace(cfg, attention="flash")
+    loss, grads = jax.value_and_grad(
+        lambda p: _packed_loss_fn(PackedSentimentEncoder(flash_cfg), p, packed)
+    )(params)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(grads), jax.tree_util.tree_leaves(ref_grads)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
         )

@@ -1,0 +1,133 @@
+"""Real-weights accuracy parity (VERDICT r3 item 3).
+
+Skip-with-reason when ``SamLowe/roberta-base-go_emotions`` is absent
+from the local HF cache (the build image has no egress); the moment the
+weights are present these tests prove the converter + every serving
+path reproduce the reference pipeline's tracked sentiment vectors
+(``client/oracle_scheduler.py:23-40``) on the committed 30-comment
+fixture, and quantify the int8 accuracy cost against real weights.
+
+The fixture-shaped machinery itself (fixture loads, harness wiring,
+skip path) is tested unconditionally below via a tiny hermetic
+checkpoint standing in for the real one.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+MODEL = "SamLowe/roberta-base-go_emotions"
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "comments_30.json"
+)
+
+
+def _have_real_weights() -> bool:
+    try:
+        from transformers import AutoModelForSequenceClassification
+
+        AutoModelForSequenceClassification.from_pretrained(
+            MODEL, local_files_only=True
+        )
+        return True
+    except Exception:
+        return False
+
+
+HAVE_WEIGHTS = _have_real_weights()
+needs_weights = pytest.mark.skipif(
+    not HAVE_WEIGHTS,
+    reason=(
+        f"{MODEL} not in the local HF cache (no egress in this image) — "
+        "tools/weights_parity.py proves parity the moment it is"
+    ),
+)
+
+
+def test_fixture_is_committed_and_sane():
+    with open(FIXTURE) as f:
+        fx = json.load(f)
+    assert len(fx["comments"]) == 30
+    assert all(isinstance(c, str) and c.strip() for c in fx["comments"])
+
+
+@needs_weights
+def test_all_paths_match_hf_reference():
+    """Float/packed/flash paths within 2e-3 of the HF pipeline vectors;
+    int8 within the 0.05 accuracy budget — on REAL weights."""
+    from tools.weights_parity import main
+
+    assert main(["--out", "/tmp/weights_parity_test.json"]) == 0
+    with open("/tmp/weights_parity_test.json") as f:
+        report = json.load(f)
+    assert report["ok"]
+    assert set(report["paths"]) == {
+        "float", "packed_dense", "packed_flash", "int8_packed",
+    }
+
+
+def test_harness_machinery_on_hermetic_checkpoint(tmp_path):
+    """Without the real cache, prove the harness MATH end to end on a
+    tiny locally-saved HF model: save → reference vectors via torch →
+    convert → float/packed paths agree with the torch reference."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    cfg = transformers.RobertaConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=64,
+        max_position_embeddings=66,
+        num_labels=28,
+        pad_token_id=1,
+        bos_token_id=0,
+        eos_token_id=2,
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.RobertaForSequenceClassification(cfg)
+    hf_model.eval()
+
+    from svoc_tpu.models.convert import config_from_hf, convert_roberta_state_dict
+    from svoc_tpu.models.sentiment import (
+        TRACKED_INDICES,
+        SentimentPipeline,
+    )
+
+    enc_cfg = config_from_hf(cfg)
+    import jax.numpy as jnp
+    from dataclasses import replace
+
+    enc_cfg = replace(enc_cfg, dtype=jnp.float32)
+    params = convert_roberta_state_dict(hf_model.state_dict(), enc_cfg)
+
+    with open(FIXTURE) as f:
+        comments = json.load(f)["comments"][:8]
+
+    seq = 32
+    pipe = SentimentPipeline(
+        cfg=enc_cfg, params=params, seq_len=seq, batch_size=8,
+        tokenizer_name=None,
+    )
+    packed = SentimentPipeline(
+        cfg=enc_cfg, params=params, seq_len=seq, batch_size=8,
+        tokenizer_name=None, packed=True,
+    )
+
+    # Torch reference over the SAME token ids (the hashing tokenizer —
+    # no HF tokenizer for a from-scratch config).
+    ids, mask = pipe.tokenizer(comments, seq)
+    with torch.no_grad():
+        logits = hf_model(
+            input_ids=torch.tensor(np.asarray(ids), dtype=torch.long),
+            attention_mask=torch.tensor(np.asarray(mask), dtype=torch.long),
+        ).logits
+        scores = torch.sigmoid(logits).numpy()
+    sel = scores[:, list(TRACKED_INDICES)]
+    ref = sel / sel.sum(axis=1, keepdims=True)
+
+    np.testing.assert_allclose(pipe(comments), ref, atol=2e-5, rtol=2e-4)
+    np.testing.assert_allclose(packed(comments), ref, atol=2e-5, rtol=2e-4)

@@ -12,7 +12,8 @@ the run), writing incremental results to ``HW_QUEUE_RESULTS.json``:
 4. bench --config 6  — the pallas-vs-XLA consensus decision number
    (VERDICT round-2 item 5);
 5. bench --config 0  — fresh honest flagship;
-6. bench --config 8/9/10/11 — packed, packed×dp, int8, int8×packed×dp.
+6. bench --config 8/12/9/10/11 — packed, packed×flash, packed×dp,
+   int8, int8×packed×dp.
 
 Usage::
 
@@ -136,7 +137,7 @@ def main(argv=None) -> int:
     # Window + generous compile/warmup/probe margin — a fixed cap would
     # spuriously kill long --seconds windows.
     bench_timeout = args.seconds + 1800
-    for cfg in (6, 0, 8, 9, 10, 11):
+    for cfg in (6, 0, 8, 12, 9, 10, 11):
         queue.append(
             (
                 f"bench_config{cfg}",
