@@ -34,7 +34,8 @@ def test_entry_traces():
 
 def test_dryrun_multichip_subprocess_fresh_env():
     """The real thing: fresh interpreter, hostile JAX_PLATFORMS, hard
-    timeout far below the driver's.  Must print all six section marks."""
+    timeout far below the driver's.  Must print every section mark, in
+    order (the list below is the coverage contract)."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "tpu,cpu"  # hostile: would hang if probed first
@@ -60,6 +61,8 @@ def test_dryrun_multichip_subprocess_fresh_env():
         "pipeline-parallel-forward",
         "packed-forward-dp",
         "int8-packed-serving-dp",
+        "packed-flash-forward-dp",
+        "batched-fleet-commit",
     ]
 
 
