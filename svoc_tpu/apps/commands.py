@@ -499,21 +499,27 @@ class CommandConsole:
         if source is None:
             source = SyntheticSource()
 
-        def loop():
-            run_scraper(
-                self.session.store,
-                source,
-                rate_s=self.session.config.scraper_rate_s,
-                stop_event=stop,
-                sleep=lambda s: stop.wait(s),
-            )
-
         def discard() -> None:
-            # The claim lost — release the built source (a Selenium
-            # source holds a live headless Firefox that GC never quits).
+            # Release the source (a Selenium source holds a live
+            # headless Firefox that GC never quits) — on a lost claim
+            # AND when the loop exits (the reference gets this for free
+            # by running the scraper as a killable subprocess,
+            # ``main.py:38``; a thread must quit the browser itself).
             close = getattr(source, "close", None)
             if close:
                 close()
+
+        def loop():
+            try:
+                run_scraper(
+                    self.session.store,
+                    source,
+                    rate_s=self.session.config.scraper_rate_s,
+                    stop_event=stop,
+                    sleep=lambda s: stop.wait(s),
+                )
+            finally:
+                discard()
 
         with self._bg_lock:
             if self._scraper_stop is not stop:
