@@ -46,11 +46,16 @@ def generate_beta_oracles(
     a,
     b,
     dim: int = 1,
+    fail_lo: float = 0.0,
+    fail_hi: float = 1.0,
 ):
     """Beta-distributed honest oracles + uniform failing oracles.
 
     ``a``/``b`` may be scalars or per-dimension arrays (the notebook's
-    2-D variant passes per-axis parameters).
+    2-D variant passes per-axis parameters).  ``fail_lo``/``fail_hi``
+    bound the adversary draw — the defaults are the reference's
+    symmetric ]0,1[ model; a narrow off-center band models a
+    coordinated bias attack (:func:`generate_biased_beta_oracles`).
     """
     k_beta, k_unif, k_perm = jax.random.split(key, 3)
     a = jnp.broadcast_to(jnp.asarray(a, jnp.float32), (dim,))
@@ -58,10 +63,40 @@ def generate_beta_oracles(
     honest_vals = jax.random.beta(
         k_beta, a[None, :], b[None, :], shape=(n_oracles - n_failing, dim)
     )
-    failing_vals = jax.random.uniform(k_unif, (n_failing, dim))
+    failing_vals = jax.random.uniform(
+        k_unif, (n_failing, dim), minval=fail_lo, maxval=fail_hi
+    )
     values = jnp.concatenate([failing_vals, honest_vals], axis=0)
     honest = jnp.arange(n_oracles) >= n_failing
     return _shuffle(k_perm, values, honest)
+
+
+def generate_biased_beta_oracles(
+    key,
+    n_oracles: int,
+    n_failing: int,
+    a,
+    b,
+    dim: int = 1,
+    bias_lo: float = 0.85,
+    bias_hi: float = 1.0,
+):
+    """Beta honest oracles + COORDINATED biased adversaries.
+
+    The reference's failure model (uniform over ]0,1[,
+    ``documentation/README.md:105-114``) is symmetric about the same
+    center the honest mass concentrates on, so it cannot displace a
+    median even in the majority — this variant models the attack that
+    CAN: adversaries draw from a narrow corner band
+    ``[bias_lo, bias_hi]^dim``, all pushing the same direction.  Used
+    by :func:`svoc_tpu.sim.montecarlo.fleet_breakdown_curve` to measure
+    the estimator's actual breakdown point (≈ N/2, the theoretical
+    bound for any median-based rule).
+    """
+    return generate_beta_oracles(
+        key, n_oracles, n_failing, a, b, dim=dim,
+        fail_lo=bias_lo, fail_hi=bias_hi,
+    )
 
 
 def generate_kumaraswamy_oracles(

@@ -33,7 +33,11 @@ import jax.numpy as jnp
 
 from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step
 from svoc_tpu.ops.stats import rank_array
-from svoc_tpu.sim.generators import generate_beta_oracles, generate_gaussian_oracles
+from svoc_tpu.sim.generators import (
+    generate_beta_oracles,
+    generate_biased_beta_oracles,
+    generate_gaussian_oracles,
+)
 
 
 def true_median(values: jnp.ndarray) -> jnp.ndarray:
@@ -246,15 +250,15 @@ def benchmark_unconstrained(
 
 
 @partial(
-    jax.jit, static_argnames=("n_oracles", "n_failing", "dim", "k_trials")
+    jax.jit,
+    static_argnames=("n_oracles", "n_failing", "dim", "k_trials", "biased"),
 )
-def _fleet_trials(key, a, b, *, n_oracles, n_failing, dim, k_trials):
+def _fleet_trials(key, a, b, *, n_oracles, n_failing, dim, k_trials, biased=False):
     m = n_oracles - n_failing
+    gen = generate_biased_beta_oracles if biased else generate_beta_oracles
 
     def trial(key):
-        values, honest = generate_beta_oracles(
-            key, n_oracles, n_failing, a, b, dim=dim
-        )
+        values, honest = gen(key, n_oracles, n_failing, a, b, dim=dim)
         out = consensus_step(
             values, ConsensusConfig(n_failing=n_failing, constrained=True)
         )
@@ -284,6 +288,7 @@ def fleet_benchmark(
     b: float = 20.0,
     k_trials: int = 200,
     dim: int = 6,
+    biased: bool = False,
 ) -> Dict[str, float]:
     """Estimator quality at PRODUCT scale — the framework's pitch is a
     1024-oracle fleet, whose detection statistics the reference's
@@ -311,6 +316,7 @@ def fleet_benchmark(
         n_failing=n_failing,
         dim=dim,
         k_trials=k_trials,
+        biased=biased,
     )
     return {
         "identification_success_pct": float(exact) * 100.0,
@@ -319,6 +325,34 @@ def fleet_benchmark(
         "reliability_pct": (1.0 - 2.0 * float(dist)) * 100.0,
         "mean_onchain_reliability2_pct": float(rel2) * 100.0,
     }
+
+
+def _fleet_sweep(
+    key, n_oracles, rows, *, biased, k_trials, a, b, dim, print_fn, label_fn
+):
+    """Shared row sweep behind the acceptance grid and the breakdown
+    curve: one independent key and one :func:`fleet_benchmark` call per
+    (result-key, n_failing) row."""
+    results = {}
+    for i, (result_key, n_failing) in enumerate(rows):
+        r = fleet_benchmark(
+            jax.random.fold_in(key, i),
+            n_oracles,
+            n_failing,
+            a=a,
+            b=b,
+            k_trials=k_trials,
+            dim=dim,
+            biased=biased,
+        )
+        results[result_key] = r
+        print_fn(
+            f"N={n_oracles} {label_fn(result_key, n_failing)} | "
+            f"misflag rate {r['misclassified_rate_pct']:6.2f} % | "
+            f"reliability {r['reliability_pct']:7.2f} % | rel2(chain) "
+            f"{r['mean_onchain_reliability2_pct']:6.2f} %"
+        )
+    return results
 
 
 def fleet_acceptance_grid(
@@ -334,26 +368,56 @@ def fleet_acceptance_grid(
     """The fleet-scale acceptance table (rows = adversary count) —
     published in ``docs/ALGORITHM.md`` and pinned by
     ``tests/test_sim.py`` at sampling tolerance."""
-    results = {}
-    for i, n_failing in enumerate(failing_list):
-        r = fleet_benchmark(
-            jax.random.fold_in(key, i),
-            n_oracles,
-            n_failing,
-            a=a,
-            b=b,
-            k_trials=k_trials,
-            dim=dim,
-        )
-        results[n_failing] = r
-        print_fn(
-            f"N={n_oracles} failing={n_failing:<4} | exact-id "
-            f"{r['identification_success_pct']:6.2f} % | mean misflags "
-            f"{r['mean_misclassified']:8.2f} | reliability "
-            f"{r['reliability_pct']:6.2f} % | rel2(chain) "
-            f"{r['mean_onchain_reliability2_pct']:6.2f} %"
-        )
-    return results
+    return _fleet_sweep(
+        key,
+        n_oracles,
+        [(n, n) for n in failing_list],
+        biased=False,
+        k_trials=k_trials,
+        a=a,
+        b=b,
+        dim=dim,
+        print_fn=print_fn,
+        label_fn=lambda _k, n: f"failing={n:<4}",
+    )
+
+
+def fleet_breakdown_curve(
+    key,
+    n_oracles: int = 1024,
+    fractions=(0.1, 0.25, 0.4, 0.45, 0.49, 0.51, 0.55),
+    k_trials: int = 100,
+    a: float = 20.0,
+    b: float = 20.0,
+    dim: int = 6,
+    print_fn: Callable[[str], None] = print,
+) -> Dict[float, Dict[str, float]]:
+    """The estimator's TRUE breakdown point, measured.
+
+    Uniform adversaries (the reference's failure model) are symmetric
+    about the honest center and never displace the median, so the
+    acceptance table stays benign even at 75 % adversarial.  This curve
+    uses COORDINATED biased adversaries
+    (:func:`svoc_tpu.sim.generators.generate_biased_beta_oracles` — a
+    narrow corner band, all pushing one direction): below N/2 the
+    first-pass median stays with the honest mass and detection holds;
+    crossing N/2 the median jumps INTO the adversary band and the
+    estimator inverts (it marks the honest minority as outliers) — the
+    theoretical breakdown bound for any median-based rule, visible here
+    as a cliff between 49 % and 51 %.
+    """
+    return _fleet_sweep(
+        key,
+        n_oracles,
+        [(frac, int(round(frac * n_oracles))) for frac in fractions],
+        biased=True,
+        k_trials=k_trials,
+        a=a,
+        b=b,
+        dim=dim,
+        print_fn=print_fn,
+        label_fn=lambda frac, n: f"biased={frac:5.0%} ({n:4d})",
+    )
 
 
 def launch_benchmark(

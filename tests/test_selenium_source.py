@@ -156,9 +156,18 @@ def test_scrape_loop_with_selenium_source(fake_selenium):
     assert store.count() == 2 * len(HN_COMMENTS)
 
 
+def _join_scraper(console, timeout=5.0):
+    t = console._scraper_thread
+    if t is not None:
+        t.join(timeout=timeout)
+
+
 def test_console_selects_hn_live_source(fake_selenium):
     """live_scraper=True + selenium present → the 'hn-live' source runs
-    and fills the store; 'scraper off' quits nothing (loop owns it)."""
+    and fills the store; stopping releases the browser (loop-exit
+    finally)."""
+    import time
+
     from svoc_tpu.apps.commands import CommandConsole
     from svoc_tpu.apps.session import Session, SessionConfig
     from svoc_tpu.io.comment_store import CommentStore
@@ -173,8 +182,6 @@ def test_console_selects_hn_live_source(fake_selenium):
     out = c.query("scraper on")
     assert out == ["Scraper: ENABLED (hn-live)"]
     try:
-        import time
-
         deadline = time.time() + 5
         while session.store.count() == 0 and time.time() < deadline:
             time.sleep(0.02)
@@ -182,16 +189,43 @@ def test_console_selects_hn_live_source(fake_selenium):
     finally:
         c.query("scraper off")
         c.stop()
+        # Join before fixture teardown removes the fake modules — an
+        # in-flight round would otherwise import real selenium and die
+        # noisily in the background.
+        _join_scraper(c)
+    assert any(d.quit_called for d in fake_selenium), (
+        "scraper stop leaked the browser (loop-exit discard)"
+    )
 
 
-def test_lost_claim_quits_the_browser(fake_selenium):
-    """A scraper claim superseded before commit must quit its freshly
-    launched browser (no headless-Firefox leak) — the discard path in
-    CommandConsole._start_scraper."""
+def test_lost_claim_quits_the_browser(fake_selenium, monkeypatch):
+    """A scraper claim superseded DURING its source build must quit the
+    browser it launched (the supersession discard branch of
+    CommandConsole._start_scraper), while the winning claim's loop
+    keeps its own.  Deterministic: the first Firefox launch blocks
+    until a second 'scraper on' has claimed the slot and committed."""
+    import threading
+    import time
+
     from svoc_tpu.apps.commands import CommandConsole
     from svoc_tpu.apps.session import Session, SessionConfig
     from svoc_tpu.io.comment_store import CommentStore
     from tests.conftest import fake_sentiment_vectorizer
+
+    first_build_started = threading.Event()
+    release_first_build = threading.Event()
+    webdriver = sys.modules["selenium.webdriver"]
+    orig_firefox = webdriver.Firefox
+    n_builds = []
+
+    def slow_first_firefox(options=None):
+        n_builds.append(1)
+        if len(n_builds) == 1:
+            first_build_started.set()
+            assert release_first_build.wait(5)
+        return orig_firefox(options)
+
+    monkeypatch.setattr(webdriver, "Firefox", slow_first_firefox)
 
     session = Session(
         config=SessionConfig(scraper_rate_s=0.05, live_scraper=True),
@@ -199,21 +233,34 @@ def test_lost_claim_quits_the_browser(fake_selenium):
         vectorizer=fake_sentiment_vectorizer,
     )
     c = CommandConsole(session)
-    try:
-        c.query("scraper on")
-        # immediate stop: the running loop's browser must be released
-        # once the loop notices (stop_event set before its next round).
-        c.query("scraper off")
-        import time
+    results = {}
 
+    def first_claim():
+        results["first"] = c.query("scraper on")
+
+    t = threading.Thread(target=first_claim)
+    t.start()
+    try:
+        assert first_build_started.wait(5)
+        # Second claim wins the slot while the first is mid-build.
+        out = c.query("scraper on")
+        assert out == ["Scraper: ENABLED (hn-live)"]
+        release_first_build.set()
+        t.join(timeout=5)
+        assert results["first"] == [
+            "Scraper: not started (superseded or stopped)"
+        ]
+        # Driver construction order: the first claim blocks BEFORE its
+        # FakeDriver exists, so the winner's driver is [0] and the
+        # superseded claim's is [1].  The loser's must be quit; the
+        # winner's loop keeps its own alive.
         deadline = time.time() + 5
-        while (
-            not any(d.quit_called for d in fake_selenium)
-            and time.time() < deadline
-        ):
+        while not fake_selenium[1].quit_called and time.time() < deadline:
             time.sleep(0.02)
-        assert any(d.quit_called for d in fake_selenium), (
-            "scraper stop leaked the browser"
-        )
+        assert fake_selenium[1].quit_called, "lost claim leaked its browser"
+        assert not fake_selenium[0].quit_called
     finally:
+        release_first_build.set()
+        c.query("scraper off")
         c.stop()
+        _join_scraper(c)

@@ -256,3 +256,27 @@ class TestFleetScale:
         assert r["misclassified_rate_pct"] <= 2.0
         assert r["reliability_pct"] >= 98.0
         assert 75.0 <= r["mean_onchain_reliability2_pct"] <= 95.0
+
+    def test_breakdown_below_half_is_perfect(self):
+        """40% COORDINATED biased adversaries: still exactly detected
+        (docs/ALGORITHM.md §5 breakdown curve)."""
+        from svoc_tpu.sim.montecarlo import fleet_benchmark
+
+        r = fleet_benchmark(
+            jax.random.PRNGKey(9), 1024, 410, k_trials=30, biased=True
+        )
+        assert r["misclassified_rate_pct"] <= 0.5
+        assert r["reliability_pct"] >= 99.0
+
+    def test_breakdown_above_half_inverts(self):
+        """55% coordinated adversaries capture the median: the estimator
+        inverts (masks the honest minority) while on-chain rel2 still
+        reads healthy — the documented capture-invisibility property."""
+        from svoc_tpu.sim.montecarlo import fleet_benchmark
+
+        r = fleet_benchmark(
+            jax.random.PRNGKey(10), 1024, 563, k_trials=30, biased=True
+        )
+        assert r["misclassified_rate_pct"] >= 60.0
+        assert r["reliability_pct"] <= 0.0
+        assert r["mean_onchain_reliability2_pct"] >= 70.0
