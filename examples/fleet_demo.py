@@ -1,0 +1,127 @@
+"""Fleet-scale walkthrough: the product configuration, end to end.
+
+The reference runs 7 oracles in a Python loop; this framework's pitch
+is a 1024-oracle fleet on TPU.  This demo drives that configuration on
+any backend (tiny encoder so CPU finishes in ~a minute):
+
+1. comments → sequence-packed sentiment (flash segment-tag attention) →
+   vmapped bootstrap fleet → fused two-pass consensus, the flagship
+   device path (``bench.py --config 12`` measures it for real);
+2. the same fleet committed THROUGH THE CHAIN ADAPTER — 1024 signed-tx
+   semantics in one device-certified batched commit
+   (:mod:`svoc_tpu.consensus.batch`), then ``resume`` reads the
+   contract back;
+3. detection quality at fleet scale: a mini acceptance row (uniform
+   adversaries) and a mini breakdown row (coordinated 55 % bias — the
+   capture regime documented in ``docs/ALGORITHM.md`` §5).
+
+Usage::
+
+    python examples/fleet_demo.py [--oracles 1024] [--failing 256]
+        [--trials 50] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--oracles", type=int, default=1024)
+    p.add_argument("--failing", type=int, default=256)
+    p.add_argument("--trials", type=int, default=50)
+    p.add_argument(
+        "--platform",
+        default="cpu",
+        help=(
+            "JAX platform; 'cpu' (default) pins the CPU backend BEFORE "
+            "device init so a wedged accelerator plugin cannot hang the "
+            "demo; pass 'default' to use the ambient backend"
+        ),
+    )
+    args = p.parse_args()
+    if args.platform != "default":
+        os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+
+    if args.platform != "default":
+        jax.config.update("jax_platforms", args.platform)
+    import numpy as np
+    from dataclasses import replace
+
+    from svoc_tpu.apps.session import Session, SessionConfig
+    from svoc_tpu.io.comment_store import CommentStore
+    from svoc_tpu.io.scraper import SyntheticSource
+    from svoc_tpu.models.configs import TINY_TEST
+    from svoc_tpu.models.sentiment import SentimentPipeline
+    from svoc_tpu.sim.montecarlo import fleet_benchmark
+
+    n, f = args.oracles, args.failing
+
+    # -- 1. the device path: packed x flash sentiment → fleet → consensus
+    print(f"== fleet demo: {n} oracles, {f} adversarial ==")
+    pipe = SentimentPipeline(
+        cfg=replace(TINY_TEST, attention="flash"),
+        seq_len=32,
+        batch_size=16,
+        tokenizer_name=None,
+        packed=True,
+    )
+    store = CommentStore()
+    store.save(SyntheticSource(batch=60, seed=1)())
+    session = Session(
+        config=SessionConfig(n_oracles=n, n_failing=f),
+        store=store,
+        vectorizer=pipe,
+    )
+    t0 = time.perf_counter()
+    preview = session.fetch()
+    t_fetch = time.perf_counter() - t0
+    print(
+        f"fetch: {preview['n_comments']} comments -> {n} oracle "
+        f"predictions in {t_fetch:.2f}s (packed x flash forward + "
+        "bootstrap fleet + preview ranks)"
+    )
+    suspects = int(np.sum(preview["normalized_ranks"] <= 0.2))
+    print(f"preview flags {suspects} oracles as suspect (red in the UI)")
+
+    # -- 2. fleet-scale commit through the chain adapter (batched path)
+    t0 = time.perf_counter()
+    n_tx = session.commit()
+    t_commit = time.perf_counter() - t0
+    state = session.adapter.resume()
+    print(
+        f"commit: {n_tx} txs in {t_commit:.2f}s (device-certified batch "
+        "— sequential-loop semantics, O(1) golden recomputes)"
+    )
+    print(
+        f"on-chain: active={state['consensus_active']} rel1="
+        f"{state['reliability_first_pass']:.3f} rel2="
+        f"{state['reliability_second_pass']:.3f}"
+    )
+
+    # -- 3. detection quality at this scale
+    key = jax.random.PRNGKey(0)
+    r = fleet_benchmark(key, n, f, k_trials=args.trials)
+    print(
+        f"uniform adversaries ({f}/{n}): per-oracle misflag rate "
+        f"{r['misclassified_rate_pct']:.2f} %, restricted-median "
+        f"reliability {r['reliability_pct']:.2f} %"
+    )
+    f_capture = int(0.55 * n)
+    r = fleet_benchmark(key, n, f_capture, k_trials=args.trials, biased=True)
+    print(
+        f"coordinated capture ({f_capture}/{n}, biased): misflag rate "
+        f"{r['misclassified_rate_pct']:.2f} % — the estimator inverts "
+        "past N/2 (docs/ALGORITHM.md §5 breakdown curve)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -238,6 +238,18 @@ class CommandConsole:
                     "reliability : "
                     f"{adapter.call_second_pass_consensus_reliability()}"
                 )
+                trend = adapter.rel2_trend()
+                if trend["n"] >= 2:
+                    emit(
+                        f"trend ({trend['n']} samples): "
+                        f"{trend['delta']:+.3f}"
+                        + (
+                            "  ⚠ falling — a coordinated-bias approach "
+                            "shows as a rel₂ slide (ALGORITHM.md §5)"
+                            if trend["falling"]
+                            else ""
+                        )
+                    )
             elif cmd == "resume":
                 state = adapter.resume()
                 self.session.bump_state()

@@ -43,7 +43,10 @@ _PAGE = """<!DOCTYPE html>
 <body>
 <h2>svoc — stochastic vector oracle consensus</h2>
 <div>reliability first pass <div class="bar" id="rel1"><div style="width:0"></div></div>
-     reliability second pass <div class="bar" id="rel2"><div style="width:0"></div></div></div>
+     reliability second pass <div class="bar" id="rel2"><div style="width:0"></div></div>
+     trend <canvas id="rel2spark" width="160" height="16"
+            style="vertical-align:middle"></canvas>
+     <span id="rel2warn" style="color:#e55"></span></div>
 <div id="plots"></div>
 <button id="replace-btn">Oracle Replacement</button>
 <div id="replace-menu" style="display:none; border:1px solid #345; padding:.5rem; margin:.5rem 0">
@@ -143,6 +146,28 @@ async function refresh(s) {
     bar.firstElementChild.style.width = pct + '%';
     bar.classList.toggle('low', pct < 50);  // sepolia_graphics.js:53-69
   }
+  // rel2 TRAJECTORY sparkline: a capture approach shows as a slide,
+  // not a low level (docs/ALGORITHM.md section 5 security note).
+  const spark = document.getElementById('rel2spark');
+  const sctx = spark.getContext('2d');
+  sctx.clearRect(0, 0, spark.width, spark.height);
+  const hist = s.rel2_history || [];
+  if (hist.length >= 2) {
+    // y normalized to the window's own range: the alarm slide is a few
+    // percent absolute and would be sub-pixel on a [0,1] scale.
+    const lo = Math.min(...hist), hi = Math.max(...hist);
+    const span = Math.max(hi - lo, 1e-6);
+    sctx.strokeStyle = s.rel2_falling ? '#e55' : '#5b5';
+    sctx.beginPath();
+    hist.forEach((v, i) => {
+      const x = i * (spark.width - 2) / (hist.length - 1) + 1;
+      const y = spark.height - 1 - ((v - lo) / span) * (spark.height - 2);
+      i ? sctx.lineTo(x, y) : sctx.moveTo(x, y);
+    });
+    sctx.stroke();
+  }
+  document.getElementById('rel2warn').textContent =
+    s.rel2_falling ? '⚠ falling' : '';
   updateReplacementMenu(s);
   const plots = document.getElementById('plots');
   plots.innerHTML = '';
@@ -255,11 +280,18 @@ class _Handler(BaseHTTPRequestHandler):
 
                 return to_hex(x) if isinstance(x, int) else str(x)
 
+            trend = session.adapter.rel2_trend()
             payload = {
                 "state_version": state_version,
                 "auto_fetch": session.auto_fetch,
                 "reliability_first_pass": state.get("reliability_first_pass"),
                 "reliability_second_pass": state.get("reliability_second_pass"),
+                # trajectory, not just level: capture is invisible in
+                # the level (docs/ALGORITHM.md §5 security note).  The
+                # FULL trend window ships (≤256 floats) so the warn
+                # flag and the sparkline always describe the same data.
+                "rel2_history": trend["history"],
+                "rel2_falling": trend["falling"],
                 "consensus": state.get("consensus"),
                 "consensus_active": state.get("consensus_active"),
                 "labels": session.label_names,

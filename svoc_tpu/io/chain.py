@@ -32,6 +32,8 @@ import functools
 import json
 import os
 import threading
+import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Protocol, Sequence, Tuple
 
 from svoc_tpu.consensus.state import ContractError, OracleConsensusContract
@@ -398,11 +400,21 @@ class ChainAdapter:
     simulator's state machine and the read cache), while composites
     interleave at tx granularity like the real chain."""
 
+    #: rel₂ trajectory ring size (~4 h of 1-per-minute resumes).
+    REL2_HISTORY = 256
+
     def __init__(self, backend: ChainBackend):
         self.backend = backend
         #: Last-read cache, the ``globalState.remote_*`` equivalent
         #: (``client/common.py:43-55``) — rehydrated by ``resume``.
         self.cache: Dict[str, Any] = {}
+        #: (monotonic_s, rel₂) samples appended on every second-pass
+        #: reliability read.  The LEVEL of rel₂ cannot detect a
+        #: coordinated majority capture (after takeover it reads the
+        #: adversary band's dispersion — healthy); the TRAJECTORY shows
+        #: the approach (docs/ALGORITHM.md §5 breakdown curve), so the
+        #: console and web UI surface the trend.
+        self.rel2_history: deque = deque(maxlen=self.REL2_HISTORY)
         self._lock = threading.RLock()
 
     def cache_snapshot(self) -> Dict[str, Any]:
@@ -445,7 +457,29 @@ class ChainAdapter:
             self.backend.call("get_second_pass_consensus_reliability")
         )
         self.cache["reliability_second_pass"] = v
+        self.rel2_history.append((time.monotonic(), v))
         return v
+
+    def rel2_trend(self, window_s: float = 1800.0) -> Dict[str, Any]:
+        """Trajectory summary of the second-pass reliability over the
+        trailing ``window_s``: ``delta`` (latest − window start),
+        ``falling`` (delta below −0.05 — the operator alarm condition:
+        capture approaches as a rel₂ SLIDE, docs/ALGORITHM.md §5),
+        ``n`` samples considered, and the ``history`` values."""
+        with self._lock:
+            samples = list(self.rel2_history)
+        now = time.monotonic()
+        window = [v for t, v in samples if now - t <= window_s]
+        if len(window) < 2:
+            return {"delta": 0.0, "falling": False, "n": len(window),
+                    "history": window}
+        delta = window[-1] - window[0]
+        return {
+            "delta": delta,
+            "falling": delta < -0.05,
+            "n": len(window),
+            "history": window,
+        }
 
     @_atomic
     def call_consensus_active(self) -> bool:

@@ -147,3 +147,51 @@ class TestChainAdapter:
 
     def test_to_hex(self):
         assert to_hex(255) == "0xff"
+
+
+class TestRel2Trend:
+    """rel₂ trajectory surface (docs/ALGORITHM.md §5 security note: a
+    coordinated capture is invisible in the LEVEL of rel₂ — the
+    operators' alarm is the slide)."""
+
+    def _adapter(self):
+        from svoc_tpu.consensus.state import OracleConsensusContract
+        from svoc_tpu.io.chain import ChainAdapter, LocalChainBackend
+
+        return ChainAdapter(
+            LocalChainBackend(
+                OracleConsensusContract(
+                    ["a0"], [f"o{i}" for i in range(7)], dimension=2
+                )
+            )
+        )
+
+    def test_history_accrues_on_reads(self):
+        a = self._adapter()
+        assert a.rel2_trend()["n"] < 2
+        for _ in range(3):
+            a.call_second_pass_consensus_reliability()
+        t = a.rel2_trend()
+        assert t["n"] == 3 and t["falling"] is False and t["delta"] == 0.0
+
+    def test_slide_flags_falling(self, monkeypatch):
+        a = self._adapter()
+        values = iter([0.9, 0.85, 0.78, 0.7])
+        monkeypatch.setattr(
+            a.backend, "call", lambda fn: int(next(values) * 1e6)
+        )
+        for _ in range(4):
+            a.call_second_pass_consensus_reliability()
+        t = a.rel2_trend()
+        assert t["falling"] is True
+        assert t["delta"] == pytest.approx(-0.2, abs=1e-6)
+
+    def test_resume_feeds_the_history(self):
+        import numpy as np
+
+        a = self._adapter()
+        rng = np.random.default_rng(0)
+        a.update_all_the_predictions(rng.uniform(0.1, 0.9, (7, 2)))
+        a.resume()
+        a.resume()
+        assert a.rel2_trend()["n"] == 2

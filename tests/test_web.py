@@ -56,6 +56,9 @@ class TestWebUI:
         assert state["consensus_active"] is True
         assert len(state["preview"]["values"]) == 7
         assert 0 < state["reliability_second_pass"] <= 1
+        # trajectory surface (ALGORITHM.md §5): resume fed the history
+        assert state["rel2_history"]
+        assert state["rel2_falling"] is False
 
     def test_unknown_path_404(self, server):
         base, _ = server
