@@ -261,3 +261,101 @@ class TestConsoleFuzz:
             console.session.application_on = True
             console.session.auto_commit = False
             console.session.auto_resume = False
+
+
+class TestBatchCommitEquivalence:
+    """The batched fleet commit's contract, as a law: for ANY tx
+    sequence — valid, out-of-interval, wrong-dimension, unknown caller,
+    duplicate caller, degenerate values — the batch produces the same
+    final wsad state, committed count, and failure class as looping
+    ``update_prediction``."""
+
+    @staticmethod
+    def _state(c):
+        return (
+            c.consensus_active,
+            c.n_active_oracles,
+            tuple(c.consensus_value),
+            c.reliability_first_pass,
+            c.reliability_second_pass,
+            tuple(tuple(o.value) + (o.enabled, o.reliable) for o in c.oracles),
+        )
+
+    @settings(
+        deadline=None, max_examples=25,
+        suppress_health_check=[HealthCheck.too_slow],
+    )
+    @given(
+        n_oracles=st.integers(min_value=5, max_value=9),
+        n_failing=st.integers(min_value=0, max_value=4),
+        seed=st.integers(min_value=0, max_value=2**31),
+        n_cycles=st.integers(min_value=1, max_value=3),
+        corrupt=st.lists(
+            st.tuples(
+                st.integers(min_value=0, max_value=2),  # cycle
+                st.integers(min_value=0, max_value=8),  # tx index
+                st.sampled_from(
+                    ["interval", "dim", "caller", "dup", "degenerate"]
+                ),
+            ),
+            max_size=3,
+        ),
+    )
+    def test_batch_equals_sequential(
+        self, n_oracles, n_failing, seed, n_cycles, corrupt
+    ):
+        import numpy as np
+
+        from svoc_tpu.consensus.state import (
+            BatchTxError,
+            OracleConsensusContract,
+        )
+
+        assume(n_failing < n_oracles)
+
+        def build():
+            return OracleConsensusContract(
+                ["a0"],
+                [f"o{i}" for i in range(n_oracles)],
+                n_failing_oracles=n_failing,
+                constrained=True,
+                dimension=2,
+            )
+
+        rng = np.random.default_rng(seed)
+        seq, bat = build(), build()
+        for cycle in range(n_cycles):
+            callers = [f"o{i}" for i in range(n_oracles)]
+            preds = [list(p) for p in rng.uniform(0.05, 0.95, (n_oracles, 2))]
+            for c_cycle, t, kind in corrupt:
+                if c_cycle != cycle or t >= n_oracles:
+                    continue
+                if kind == "interval":
+                    preds[t][0] = 1.5
+                elif kind == "dim":
+                    preds[t] = [0.5]
+                elif kind == "caller":
+                    callers[t] = "eve"
+                elif kind == "dup":
+                    callers[t] = callers[0]
+                elif kind == "degenerate":
+                    for j in range(t, n_oracles):
+                        preds[j] = [0.5, 0.5]
+
+            seq_res = None
+            for k, (caller, p) in enumerate(zip(callers, preds)):
+                try:
+                    seq.update_prediction(caller, p)
+                except Exception as e:
+                    seq_res = (k, type(e).__name__)
+                    break
+
+            try:
+                n = bat.update_predictions_batch(callers, preds)
+                bat_res = None
+                assert n == n_oracles
+            except BatchTxError as e:
+                bat_res = (e.index, type(e.cause).__name__)
+
+            assert seq_res == bat_res
+            assert self._state(seq) == self._state(bat)
