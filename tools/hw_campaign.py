@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Round-long hardware measurement campaign for a flapping TPU tunnel.
+
+``tools/hw_queue.py`` assumes the tunnel stays up once it answers; on
+2026-07-30 it was up for ~15 minutes, died mid-queue, and the alive
+window went to the probes while every bench config fell back to CPU.
+This script inverts the strategy:
+
+- **liveness-gated**: a cheap fetch-proven matmul (90 s cap) runs
+  before every item; while the tunnel is dead the campaign sleeps
+  instead of burning item timeouts;
+- **value-ordered**: bench configs first (flagship, packed,
+  packed x flash, int8, DP serving), probes last — a short alive
+  window captures the numbers that matter;
+- **fallback-aware**: a bench line recorded on the CPU fallback
+  (``rc == "cpu-fallback"`` from :func:`tools.hw_queue.run_item`)
+  means the tunnel died mid-item; the attempt is refunded, the item
+  stays pending, and the campaign goes back to watching — but
+  fallbacks are counted per item (MAX_FALLBACKS) so a tunnel that
+  passes liveness yet always fails bench's deeper backend probe
+  retires the item instead of livelocking on it;
+- **bounded retries**: a hard timeout or real failure (e.g. the
+  consensus-kernel Mosaic compile hang seen in ``TPU_PROBE``) retires
+  an item after MAX_ATTEMPTS so one wedged kernel cannot eat the
+  round.
+
+State after every step goes to ``HW_CAMPAIGN.json`` (atomic rename —
+safe to poll); an in-progress item is flagged in
+``/tmp/svoc_tpu_measuring`` so round automation can avoid competing
+for the single host core while a timed measurement is live.  Run it in
+the background for the whole round::
+
+    python tools/hw_campaign.py [--seconds 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from hw_queue import (  # noqa: E402
+    BENCH_TIMEOUT_MARGIN_S,
+    LIVENESS_SNIPPET,
+    bench_cmd,
+    run_item,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "HW_CAMPAIGN.json")
+BUSY_FLAG = "/tmp/svoc_tpu_measuring"
+
+MAX_ATTEMPTS = 3
+# A liveness-passing tunnel whose bench still falls back to CPU (the
+# 2026-07-30 morning pattern: 5 s matmul OK, bench's 120 s backend
+# probe dead) must not livelock the head item: fallbacks are counted
+# separately and retire the item at this cap.
+MAX_FALLBACKS = 4
+LIVENESS_TIMEOUT_S = 90.0
+DEAD_SLEEP_S = 120.0
+
+
+def bench_item(cfg: int, seconds: float):
+    return {
+        "name": f"bench_config{cfg}",
+        "cmd": bench_cmd(cfg, seconds),
+        "timeout": seconds + BENCH_TIMEOUT_MARGIN_S,
+    }
+
+
+def build_items(seconds: float):
+    items = [bench_item(c, seconds) for c in (0, 8, 12, 10, 9, 11, 6)]
+    items += [
+        # tpu_probe's consensus1024 doubles as the compile-hang
+        # diagnosis; per-probe cap 300 s keeps one hang from eating
+        # the whole item budget.
+        {
+            "name": "tpu_probe",
+            "cmd": ["tools/tpu_probe.py", "--timeout", "300"],
+            "timeout": 1500,
+        },
+        {"name": "flash_probe", "cmd": ["tools/flash_probe.py"], "timeout": 1500},
+    ]
+    for it in items:
+        it.update(attempts=0, fallbacks=0, done=False, results=[])
+    return items
+
+
+def tunnel_alive(py: str) -> bool:
+    try:
+        proc = subprocess.run(
+            [py, "-c", LIVENESS_SNIPPET],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=LIVENESS_TIMEOUT_S,
+        )
+        return proc.returncode == 0 and "LIVE" in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seconds", type=float, default=10.0, help="bench window")
+    args = p.parse_args(argv)
+    py = sys.executable
+
+    items = build_items(args.seconds)
+    state = {
+        "started_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "liveness_checks": 0,
+        "liveness_up": 0,
+        "items": items,
+    }
+
+    def flush(note=""):
+        state["updated_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
+        tmp = OUT + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, OUT)
+        if note:
+            print(f"[campaign] {note}", flush=True)
+
+    # A previous campaign killed mid-item (OOM, kill -9) may have left
+    # the busy flag behind; it describes nothing now — clear it.
+    try:
+        os.remove(BUSY_FLAG)
+    except OSError:
+        pass
+
+    flush("started")
+    while True:
+        pending = [
+            i
+            for i in items
+            if not i["done"]
+            and i["attempts"] < MAX_ATTEMPTS
+            and i["fallbacks"] < MAX_FALLBACKS
+        ]
+        if not pending:
+            break
+        state["liveness_checks"] += 1
+        if not tunnel_alive(py):
+            flush(f"tunnel dead ({len(pending)} pending) — sleeping")
+            time.sleep(DEAD_SLEEP_S)
+            continue
+        state["liveness_up"] += 1
+        item = pending[0]
+        item["attempts"] += 1
+        flush(f"tunnel up — running {item['name']} (attempt {item['attempts']})")
+        try:
+            with open(BUSY_FLAG, "w") as f:
+                f.write(f"{os.getpid()} {item['name']}")
+            res = run_item(item["name"], [py] + item["cmd"], item["timeout"])
+        finally:
+            try:
+                os.remove(BUSY_FLAG)
+            except OSError:
+                pass
+        item["results"].append(res)
+        del item["results"][:-MAX_ATTEMPTS - MAX_FALLBACKS]  # bounded
+        if res["rc"] == 0:
+            item["done"] = True
+            val = res.get("result", {}).get("value", "ok")
+            flush(f"{item['name']}: DONE value={val} ({res['seconds']}s)")
+        elif res["rc"] == "cpu-fallback":
+            # Mid-item tunnel death, not an item failure: refund the
+            # attempt (counted separately so a persistently half-dead
+            # tunnel retires the item instead of livelocking on it),
+            # and treat the tunnel as dead — sleep before re-probing.
+            item["attempts"] -= 1
+            item["fallbacks"] += 1
+            flush(
+                f"{item['name']}: cpu-fallback "
+                f"({item['fallbacks']}/{MAX_FALLBACKS}) — sleeping"
+            )
+            time.sleep(DEAD_SLEEP_S)
+        else:
+            flush(f"{item['name']}: rc={res['rc']} ({res['seconds']}s)")
+
+    done = sum(1 for i in items if i["done"])
+    flush(f"campaign complete: {done}/{len(items)} items captured")
+    return 0 if done == len(items) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,16 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "HW_QUEUE_RESULTS.json")
 
+# Window + generous compile/warmup/probe margin — a fixed cap would
+# spuriously kill long --seconds windows.  Shared with
+# tools/hw_campaign.py so the margin cannot drift between the two.
+BENCH_TIMEOUT_MARGIN_S = 1800.0
+
+
+def bench_cmd(cfg: int, seconds: float):
+    """argv tail (no interpreter) for one bench config measurement."""
+    return ["bench.py", "--config", str(cfg), "--seconds", str(seconds)]
+
 LIVENESS_SNIPPET = (
     "import jax, jax.numpy as jnp, numpy as np;"
     "assert jax.devices()[0].platform == 'tpu', jax.devices();"
@@ -134,14 +144,12 @@ def main(argv=None) -> int:
             ("tpu_probe", [py, "tools/tpu_probe.py"], 900),
             ("flash_probe", [py, "tools/flash_probe.py"], 1200),
         ]
-    # Window + generous compile/warmup/probe margin — a fixed cap would
-    # spuriously kill long --seconds windows.
-    bench_timeout = args.seconds + 1800
+    bench_timeout = args.seconds + BENCH_TIMEOUT_MARGIN_S
     for cfg in (6, 0, 8, 12, 9, 10, 11):
         queue.append(
             (
                 f"bench_config{cfg}",
-                [py, "bench.py", "--config", str(cfg), "--seconds", str(args.seconds)],
+                [py] + bench_cmd(cfg, args.seconds),
                 bench_timeout,
             )
         )
