@@ -62,6 +62,58 @@ def test_explicit_small_mode_is_not_labeled_auto():
     assert "small_mode_auto" not in result["detail"]
 
 
+def test_perf_decision_precedence(tmp_path, monkeypatch):
+    """Routing decisions resolve env > committed record > default, and
+    report their source (the flagship/consensus paths route on this)."""
+    import bench
+
+    record = tmp_path / "PERF_DECISIONS.json"
+    monkeypatch.setattr(bench, "PERF_DECISIONS_PATH", str(record))
+    monkeypatch.delenv("SVOC_FLAGSHIP_VARIANT", raising=False)
+
+    # no env, no record -> default
+    assert bench.perf_decision(
+        "flagship_variant", "dense", "SVOC_FLAGSHIP_VARIANT"
+    ) == ("dense", "default")
+    # record wins over default
+    record.write_text(json.dumps({"flagship_variant": "packed_flash"}))
+    assert bench.perf_decision(
+        "flagship_variant", "dense", "SVOC_FLAGSHIP_VARIANT"
+    ) == ("packed_flash", "PERF_DECISIONS.json")
+    # env wins over record
+    monkeypatch.setenv("SVOC_FLAGSHIP_VARIANT", "packed")
+    assert bench.perf_decision(
+        "flagship_variant", "dense", "SVOC_FLAGSHIP_VARIANT"
+    ) == ("packed", "env:SVOC_FLAGSHIP_VARIANT")
+    # a corrupt record degrades to the default, never raises —
+    # including JSON-valid non-object content
+    monkeypatch.delenv("SVOC_FLAGSHIP_VARIANT")
+    for bad in ("{not json", "null", "[]", '"dense"'):
+        record.write_text(bad)
+        assert bench.perf_decision(
+            "flagship_variant", "dense", "SVOC_FLAGSHIP_VARIANT"
+        ) == ("dense", "default"), bad
+
+
+def test_flagship_routes_packed_variant():
+    """config 0 with a variant override runs the packed body and labels
+    the emitted line as the flagship with variant + source stamped."""
+    rc, result = _run_bench(
+        ["--config", "0", "--seconds", "1"],
+        {
+            "JAX_PLATFORMS": "cpu",
+            "SVOC_BENCH_SMALL": "1",
+            "SVOC_FLAGSHIP_VARIANT": "packed",
+        },
+    )
+    assert rc == 0
+    assert result["metric"].startswith("flagship (packed):")
+    assert result["unit"] == "comments/sec"
+    assert result["detail"]["flagship_variant"] == "packed"
+    assert result["detail"]["flagship_variant_source"] == "env:SVOC_FLAGSHIP_VARIANT"
+    assert result["detail"]["attention"] == "dense"
+
+
 def test_soak_recovered_reads_snapshot_series():
     """Recovery = a commit SUCCEEDED after the last panic; commit
     attempts and dedup'd console lines must not fool it (code-review

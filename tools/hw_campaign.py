@@ -78,11 +78,14 @@ def build_items(seconds: float):
     items += [
         # tpu_probe's consensus1024 doubles as the compile-hang
         # diagnosis; per-probe cap 300 s keeps one hang from eating
-        # the whole item budget.
+        # the whole item budget.  The outer cap must exceed the
+        # worst-case sum of the 6 inner probe caps (6 x 300 s), or an
+        # outside kill loses the probes that DID complete (the results
+        # file is written after the loop).
         {
             "name": "tpu_probe",
             "cmd": ["tools/tpu_probe.py", "--timeout", "300"],
-            "timeout": 1500,
+            "timeout": 2100,
         },
         {"name": "flash_probe", "cmd": ["tools/flash_probe.py"], "timeout": 1500},
     ]
@@ -129,10 +132,24 @@ def main(argv=None) -> int:
             print(f"[campaign] {note}", flush=True)
 
     # A previous campaign killed mid-item (OOM, kill -9) may have left
-    # the busy flag behind; it describes nothing now — clear it.
+    # the busy flag behind.  Check the pid it records: a LIVE pid means
+    # another campaign is mid-measurement — refuse to start (two
+    # campaigns would corrupt each other's numbers and flags); a dead
+    # pid means the flag is stale — clear it.
     try:
-        os.remove(BUSY_FLAG)
-    except OSError:
+        with open(BUSY_FLAG) as f:
+            stale_pid = int(f.read().split()[0])
+        try:
+            os.kill(stale_pid, 0)
+            print(
+                f"[campaign] another campaign (pid {stale_pid}) is "
+                "mid-measurement — refusing to start",
+                flush=True,
+            )
+            return 2
+        except (OSError, ProcessLookupError):
+            os.remove(BUSY_FLAG)
+    except (OSError, ValueError, IndexError):
         pass
 
     flush("started")
