@@ -138,19 +138,41 @@ def main(argv=None) -> int:
     # pid means the flag is stale — clear it.
     try:
         with open(BUSY_FLAG) as f:
-            stale_pid = int(f.read().split()[0])
+            content = f.read()
+    except OSError:
+        content = None
+    if content is not None:
         try:
-            os.kill(stale_pid, 0)
+            stale_pid = int(content.split()[0])
+        except (ValueError, IndexError):
+            # Corrupt/empty flag (writer killed mid-write): stale by
+            # definition — clear it so automation stops deferring to a
+            # phantom measurement.
+            stale_pid = None
+        alive = False
+        if stale_pid is not None:
+            try:
+                os.kill(stale_pid, 0)
+                alive = True
+            except ProcessLookupError:
+                alive = False
+            except PermissionError:
+                # EPERM = the process EXISTS (owned by another user):
+                # that is a live campaign, not a stale flag.
+                alive = True
+            except OSError:
+                alive = False
+        if alive:
             print(
                 f"[campaign] another campaign (pid {stale_pid}) is "
                 "mid-measurement — refusing to start",
                 flush=True,
             )
             return 2
-        except (OSError, ProcessLookupError):
+        try:
             os.remove(BUSY_FLAG)
-    except (OSError, ValueError, IndexError):
-        pass
+        except OSError:
+            pass
 
     flush("started")
     while True:
