@@ -117,6 +117,15 @@ def perf_decision(key: str, default: str, env_var: str) -> tuple:
     return value, source
 
 
+def resolve_consensus_impl() -> str:
+    """The consensus-impl routing shared by the dense and packed
+    flagship bodies: PERF_DECISIONS / SVOC_CONSENSUS_IMPL, validated."""
+    impl, _ = perf_decision("consensus_impl", "xla", "SVOC_CONSENSUS_IMPL")
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"SVOC_CONSENSUS_IMPL={impl!r} not in xla|pallas")
+    return impl
+
+
 # --------------------------------------------------------------------------
 # Backend resolution (round-1 fix: never let a hung TPU plugin kill the run)
 # --------------------------------------------------------------------------
@@ -473,11 +482,7 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
     # ops/pallas_consensus.py).  Routed by the recorded --config 6
     # on-chip measurement (VERDICT r2 item 5 decision rule) via
     # PERF_DECISIONS.json; override with SVOC_CONSENSUS_IMPL to A/B.
-    consensus_impl, _ = perf_decision(
-        "consensus_impl", "xla", "SVOC_CONSENSUS_IMPL"
-    )
-    if consensus_impl not in ("xla", "pallas"):
-        raise ValueError(f"SVOC_CONSENSUS_IMPL={consensus_impl!r} not in xla|pallas")
+    consensus_impl = resolve_consensus_impl()
 
     @jax.jit
     def fleet_consensus(key, window):
@@ -1154,7 +1159,11 @@ while time.perf_counter() < t_end or len(samples) < 3:
     t1 = time.perf_counter()
     np.asarray(fused_consensus(values, cfg).essence)
     samples.append((time.perf_counter() - t1) * 1e3)
-# amortized exec: n_reps dispatches on perturbed inputs, fetch last
+# amortized exec: n_reps dispatches on perturbed inputs, fetch last.
+# Warm the perturbed dispatch pattern first (the eager add compiles on
+# first use) — mirrors the parent's amortized_step_ms warmup so the
+# pallas and XLA halves time the same thing.
+np.asarray(fused_consensus(values + 1e-6, cfg).essence)
 h = None
 t1 = time.perf_counter()
 for i in range(n_reps):
@@ -1576,11 +1585,7 @@ def _bench_packed_flagship(
 
     # Same consensus-impl routing as the dense flagship body — the
     # packed variants carry the identical fleet+consensus tail.
-    consensus_impl, _ = perf_decision(
-        "consensus_impl", "xla", "SVOC_CONSENSUS_IMPL"
-    )
-    if consensus_impl not in ("xla", "pallas"):
-        raise ValueError(f"SVOC_CONSENSUS_IMPL={consensus_impl!r} not in xla|pallas")
+    consensus_impl = resolve_consensus_impl()
 
     @jax.jit
     def fleet_consensus(key, vecs, valid):

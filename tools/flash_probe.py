@@ -34,6 +34,16 @@ def main():
     from svoc_tpu.parallel.ring_attention import dense_attention_reference
 
     results = []
+
+    def persist():
+        """Flush after every stage: the 2026-07-30 on-chip run hung in
+        this probe (suspect: the FA-2 backward Mosaic compile) and lost
+        every number because the file was written only at the end."""
+        tmp = "FLASH_PROBE.json.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(results, fh, indent=1)
+        os.replace(tmp, "FLASH_PROBE.json")
+
     h, d = 12, 64
     for b, t in ((256, 128), (8, 512), (8, 2048), (2, 8192)):
         key = jax.random.PRNGKey(0)
@@ -59,6 +69,8 @@ def main():
         entry["dense_ms"] = round(amortized_ms(lambda i: dense(qs[i % 4]), n=12), 3)
         entry["flash_ms"] = round(amortized_ms(lambda i: flash(qs[i % 4]), n=12), 3)
         entry["speedup"] = round(entry["dense_ms"] / entry["flash_ms"], 3)
+        results.append(entry)
+        persist()  # forward numbers are safe before the bwd compile
 
         # Backward (FlashAttention-2 custom VJP vs autodiff-of-dense):
         # grad of sum(out) wrt q/k/v, dq summed as the fetch handle.
@@ -91,11 +103,8 @@ def main():
         entry["bwd_speedup"] = round(
             entry["dense_bwd_ms"] / entry["flash_bwd_ms"], 3
         )
-        results.append(entry)
         print(json.dumps(entry), flush=True)
-
-    with open("FLASH_PROBE.json", "w") as fh:
-        json.dump(results, fh, indent=1)
+        persist()
 
 
 if __name__ == "__main__":

@@ -76,16 +76,16 @@ def bench_item(cfg: int, seconds: float):
 def build_items(seconds: float):
     items = [bench_item(c, seconds) for c in (0, 8, 12, 10, 9, 11, 6)]
     items += [
-        # tpu_probe's consensus1024 doubles as the compile-hang
-        # diagnosis; per-probe cap 300 s keeps one hang from eating
-        # the whole item budget.  The outer cap must exceed the
-        # worst-case sum of the 6 inner probe caps (6 x 300 s), or an
-        # outside kill loses the probes that DID complete (the results
-        # file is written after the loop).
+        # tpu_probe's consensus size-bisect doubles as the compile-hang
+        # diagnosis; per-probe cap 300 s keeps one hang from eating the
+        # whole item budget.  The outer cap exceeds the worst-case sum
+        # of the inner probe caps (up to 9 runs x 300 s); the probe
+        # also persists results incrementally, so even an outside kill
+        # keeps what completed.
         {
             "name": "tpu_probe",
             "cmd": ["tools/tpu_probe.py", "--timeout", "300"],
-            "timeout": 2100,
+            "timeout": 2800,
         },
         {"name": "flash_probe", "cmd": ["tools/flash_probe.py"], "timeout": 1500},
     ]

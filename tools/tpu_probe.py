@@ -113,12 +113,15 @@ print(json.dumps({"grid_compiles": True, "correct": ok,
 """
 
 PROBES["consensus1024"] = """
-import json, time
+import json, os, time
 import jax, jax.numpy as jnp
 from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step
 from svoc_tpu.ops.pallas_consensus import fused_consensus
 
-n, dim = 1024, 6
+# Size-bisect support: the 2026-07-30 on-chip run saw this probe hang
+# at N=1024 (Mosaic compile); SVOC_PROBE_N_ORACLES lets main() walk
+# sizes upward and localize where the hang starts.
+n, dim = int(os.environ.get("SVOC_PROBE_N_ORACLES", "1024")), 6
 cfg = ConsensusConfig(n_failing=n // 4, constrained=True)
 values = jax.random.uniform(jax.random.PRNGKey(0), (n, dim), minval=0.01, maxval=0.99)
 
@@ -239,26 +242,50 @@ def main(argv=None) -> int:
 
     names = [args.only] if args.only else list(PROBES)
     results = []
+    out_path = os.path.join(REPO, "TPU_PROBE.json")
+
+    def record(r):
+        """Print + persist after EVERY probe: an outer kill (campaign
+        item timeout, operator) must not lose completed probes."""
+        print(json.dumps(r), flush=True)
+        results.append(r)
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(results, f, indent=1)
+        os.replace(tmp, out_path)
+
     for name in names:
         extra = {}
+        if name == "consensus1024":
+            # Size bisect, ascending; stop at the first hang — larger
+            # sizes would only burn more of the alive window.
+            hung = False
+            for n_oracles in (128, 256, 512):
+                r1 = run_probe(
+                    name, args.timeout, {"SVOC_PROBE_N_ORACLES": str(n_oracles)}
+                )
+                r1["probe"] = f"consensus{n_oracles}"
+                record(r1)
+                if r1.get("timeout"):
+                    hung = True
+                    break
+            if hung:
+                continue
+            extra = {"SVOC_PROBE_N_ORACLES": "1024"}
         if name == "encoder512":
             # run twice: dense, then the flash-attention encoder config
             r1 = run_probe(name, args.timeout, {"SVOC_PROBE_ATTENTION": "dense"})
             r1["probe"] = "encoder512_dense"
-            print(json.dumps(r1), flush=True)
-            results.append(r1)
+            record(r1)
             extra = {"SVOC_PROBE_ATTENTION": "flash"}
         r = run_probe(name, args.timeout, extra)
         if name == "encoder512":
             r["probe"] = "encoder512_flash"
-        print(json.dumps(r), flush=True)
-        results.append(r)
+        record(r)
         if name == "backend" and not r["ok"]:
             print(json.dumps({"abort": "backend unreachable"}))
             break
 
-    with open(os.path.join(REPO, "TPU_PROBE.json"), "w") as f:
-        json.dump(results, f, indent=1)
     return 0 if all(r.get("ok") for r in results) else 1
 
 
