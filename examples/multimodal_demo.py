@@ -1,0 +1,120 @@
+"""Multimodal consensus demo — the reference's open problem, solved.
+
+``documentation/README.md:90-103`` defines the mixture-model oracle
+scenario (K poles, each honest oracle follows pole k with probability
+``p_k``) and ends with "Currently, we do not provide an algorithm for
+this specific modelization", leaving open whether the consensus should
+"take the biggest pole" or "average all poles".
+
+This demo runs the framework's answer
+(:mod:`svoc_tpu.sim.multimodal`) against the unimodal two-pass
+estimator on exactly that generative model:
+
+1. one bimodal fleet, showing the EM fit, per-pole assignment,
+   fixed-count masking, and both policies' essences;
+2. a Monte-Carlo table over pole weights (balanced → dominated):
+   nearest-pole error and dominant-pole hit rate for the mixture
+   estimator vs the unimodal kernel — the unimodal smooth-median
+   snaps to a majority cluster (or lands in the empty inter-pole gap
+   on balanced ties, a value supported by NO oracle), while the
+   mixture estimator stays on a pole and also reports every pole it
+   found;
+3. the policy comparison answering the reference's question:
+   "dominant" keeps the essence on a believed value; "average"
+   reproduces the between-poles failure by construction.
+
+Usage::
+
+    python examples/multimodal_demo.py [--trials 300] [--seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--trials", type=int, default=300)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--platform",
+        default="cpu",
+        choices=("cpu", "tpu", "default"),
+        help=(
+            "JAX platform; 'cpu' (default) pins the CPU backend BEFORE "
+            "first use — the axon sitecustomize otherwise routes to the "
+            "TPU tunnel even when JAX_PLATFORMS=cpu"
+        ),
+    )
+    args = p.parse_args()
+    if args.platform != "default":
+        jax.config.update("jax_platforms", args.platform)
+
+    from svoc_tpu.sim.multimodal import (
+        benchmark_multimodal,
+        generate_multimodal_oracles,
+        multimodal_consensus,
+    )
+
+    key = jax.random.PRNGKey(args.seed)
+    poles = jnp.array([[0.2, 0.2], [0.8, 0.7]], jnp.float32)
+    sigma = 0.03
+
+    print("== one bimodal fleet (N=64, 4 failing, weights 0.6/0.4) ==")
+    values, honest, pole_of = generate_multimodal_oracles(
+        key, 64, 4, poles, sigma, weights=[0.6, 0.4]
+    )
+    res = multimodal_consensus(values, 2, 4, policy="dominant")
+    avg = multimodal_consensus(values, 2, 4, policy="average")
+    print(f"true poles:        {poles.tolist()}")
+    print(f"EM pole means:     {res.pole_means.round(3).tolist()}")
+    print(f"EM pole weights:   {res.pole_weights.round(3).tolist()}")
+    print(f"essence (dominant): {res.essence.round(3).tolist()}")
+    print(f"essence (average):  {avg.essence.round(3).tolist()}  "
+          "<- between poles: held by no oracle")
+    flagged = int(jnp.sum(~res.reliable & ~honest))
+    print(f"adversaries caught in mask: {flagged}/4")
+
+    print(f"\n== Monte-Carlo ({args.trials} trials/cell): mixture vs "
+          "unimodal two-pass ==")
+    header = (
+        f"{'weights':>12} {'mix near-pole':>14} {'uni near-pole':>14} "
+        f"{'mix dom%':>9} {'uni dom%':>9} {'pole recov':>11}"
+    )
+    print(header)
+    for w0 in (0.5, 0.6, 0.7, 0.85):
+        cell = benchmark_multimodal(
+            jax.random.fold_in(key, int(w0 * 100)),
+            poles,
+            sigma,
+            weights=[w0, 1.0 - w0],
+            n_oracles=64,
+            n_failing=4,
+            k_trials=args.trials,
+        )
+        print(
+            f"{w0:>6.2f}/{1 - w0:<5.2f}"
+            f" {cell['mixture_nearest_pole_error']:>14.4f}"
+            f" {cell['unimodal_nearest_pole_error']:>14.4f}"
+            f" {cell['mixture_dominant_pole_pct']:>9.1f}"
+            f" {cell['unimodal_dominant_pole_pct']:>9.1f}"
+            f" {cell['pole_recovery_error']:>11.4f}"
+        )
+    print(
+        "\nReading: the mixture essence stays ~sigma from a true pole in "
+        "every regime and\nrecovers BOTH pole centers; the unimodal "
+        "median's nearest-pole error includes the\nbalanced-tie trials "
+        "where it lands in the empty gap between the poles."
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -12,4 +12,10 @@ from svoc_tpu.sim.montecarlo import (  # noqa: F401
     benchmark_unconstrained,
     launch_benchmark,
 )
+from svoc_tpu.sim.multimodal import (  # noqa: F401
+    benchmark_multimodal,
+    em_mixture,
+    generate_multimodal_oracles,
+    multimodal_consensus,
+)
 from svoc_tpu.sim.oracle import gen_oracle_predictions  # noqa: F401

@@ -1,0 +1,150 @@
+"""Multimodal (mixture-model) consensus — the reference's documented
+future-work scenario (``documentation/README.md:90-103``), for which it
+provides no algorithm.  These tests pin the framework's estimator:
+generator semantics, EM recovery, both consensus policies, and the
+Monte-Carlo comparison against the unimodal two-pass kernel."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from svoc_tpu.sim.multimodal import (
+    benchmark_multimodal,
+    em_mixture,
+    generate_multimodal_oracles,
+    multimodal_consensus,
+)
+
+POLES = jnp.array([[0.2, 0.2], [0.8, 0.7]], jnp.float32)
+
+
+def test_generator_shapes_and_labels():
+    values, honest, pole_of = generate_multimodal_oracles(
+        jax.random.PRNGKey(0), 32, 5, POLES, 0.03, weights=[0.6, 0.4]
+    )
+    assert values.shape == (32, 2)
+    assert int(honest.sum()) == 27
+    # failing oracles carry pole −1; honest carry a valid pole index
+    assert bool(jnp.all((pole_of == -1) == ~honest))
+    assert bool(jnp.all((pole_of >= 0) == honest))
+    # constrained: values inside the open interval
+    assert float(values.min()) > 0.0 and float(values.max()) < 1.0
+    # honest oracles sit near their assigned pole (sigma=0.03 ⇒ 5σ box)
+    hv = values[honest]
+    hp = POLES[pole_of[honest]]
+    assert float(jnp.max(jnp.linalg.norm(hv - hp, axis=-1))) < 0.15
+
+
+def test_generator_weights_bias_pole_choice():
+    _, honest, pole_of = generate_multimodal_oracles(
+        jax.random.PRNGKey(1), 512, 0, POLES, 0.01, weights=[0.9, 0.1]
+    )
+    frac0 = float(jnp.mean((pole_of == 0).astype(jnp.float32)))
+    assert 0.85 < frac0 < 0.95  # ~Binomial(512, 0.9) concentration
+
+
+def test_em_recovers_separated_poles():
+    values, _, _ = generate_multimodal_oracles(
+        jax.random.PRNGKey(2), 64, 0, POLES, 0.03, weights=[0.5, 0.5]
+    )
+    fit = em_mixture(values, 2)
+    # match each true pole to its nearest estimated mean
+    d = np.linalg.norm(
+        np.asarray(POLES)[:, None, :] - np.asarray(fit.means)[None, :, :],
+        axis=-1,
+    )
+    assert d.min(axis=1).max() < 0.05
+    assert np.isclose(float(fit.weights.sum()), 1.0, atol=1e-5)
+    assert float(fit.sigmas.min()) >= 1e-3  # floor respected
+    # responsibilities are a proper posterior
+    assert np.allclose(np.asarray(fit.resp.sum(axis=1)), 1.0, atol=1e-4)
+
+
+def test_consensus_dominant_policy_lands_on_heavier_pole():
+    values, honest, _ = generate_multimodal_oracles(
+        jax.random.PRNGKey(3), 64, 4, POLES, 0.03, weights=[0.75, 0.25]
+    )
+    res = multimodal_consensus(values, 2, 4, policy="dominant")
+    assert int(res.reliable.sum()) == 60  # fixed-count contract
+    # essence on the dominant pole, far from the other
+    assert float(jnp.linalg.norm(res.essence - POLES[0])) < 0.08
+    assert float(jnp.linalg.norm(res.essence - POLES[1])) > 0.4
+
+
+def test_consensus_average_policy_sits_between_poles():
+    values, _, _ = generate_multimodal_oracles(
+        jax.random.PRNGKey(4), 64, 4, POLES, 0.03, weights=[0.5, 0.5]
+    )
+    dom = multimodal_consensus(values, 2, 4, policy="dominant")
+    avg = multimodal_consensus(values, 2, 4, policy="average")
+    d_near = jnp.min(jnp.linalg.norm(POLES - avg.essence[None, :], axis=-1))
+    # the averaged essence is strictly farther from every pole than the
+    # dominant essence is from its pole — the "no oracle holds it" case
+    assert float(d_near) > 0.2
+    assert float(
+        jnp.min(jnp.linalg.norm(POLES - dom.essence[None, :], axis=-1))
+    ) < 0.08
+
+
+def test_consensus_policy_validated():
+    values, _, _ = generate_multimodal_oracles(
+        jax.random.PRNGKey(5), 16, 2, POLES, 0.03
+    )
+    with pytest.raises(ValueError, match="policy"):
+        multimodal_consensus(values, 2, 2, policy="median")
+
+
+def test_k1_reduces_to_unimodal_mean():
+    pole = jnp.array([[0.4, 0.6]], jnp.float32)
+    values, _, _ = generate_multimodal_oracles(
+        jax.random.PRNGKey(6), 32, 0, pole, 0.02
+    )
+    res = multimodal_consensus(values, 1, 0)
+    assert np.allclose(
+        np.asarray(res.essence), np.asarray(values.mean(axis=0)), atol=1e-4
+    )
+
+
+def test_consensus_vmaps_over_fleets():
+    keys = jax.random.split(jax.random.PRNGKey(7), 4)
+    fleets = jax.vmap(
+        lambda k: generate_multimodal_oracles(k, 32, 2, POLES, 0.03)[0]
+    )(keys)
+    out = jax.vmap(lambda v: multimodal_consensus(v, 2, 2).essence)(fleets)
+    assert out.shape == (4, 2)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_benchmark_mixture_beats_unimodal_on_balanced_poles():
+    cell = benchmark_multimodal(
+        jax.random.PRNGKey(8),
+        POLES,
+        0.03,
+        weights=[0.5, 0.5],
+        n_oracles=64,
+        n_failing=4,
+        k_trials=60,
+    )
+    # nearest-pole error: mixture ~sigma, unimodal includes snap noise
+    # + gap landings; require a decisive margin at sampling tolerance
+    assert cell["mixture_nearest_pole_error"] < 0.02
+    assert (
+        cell["unimodal_nearest_pole_error"]
+        > 3.0 * cell["mixture_nearest_pole_error"]
+    )
+    assert cell["pole_recovery_error"] < 0.05
+
+
+def test_benchmark_dominant_pole_at_asymmetric_weights():
+    cell = benchmark_multimodal(
+        jax.random.PRNGKey(9),
+        POLES,
+        0.03,
+        weights=[0.75, 0.25],
+        n_oracles=64,
+        n_failing=4,
+        k_trials=60,
+    )
+    assert cell["mixture_dominant_pole_pct"] >= 95.0
+    assert cell["mixture_nearest_pole_error"] < 0.02
