@@ -40,6 +40,7 @@ HELP = """Commands:
     - scraper on/off (default: off)
     - live_mode on/off (default: off; scraper + auto_fetch + auto_commit)
     - metrics (throughput / latency counters)
+    - multimodal [K] (mixture analysis of the last fetch; default K=2)
 
     - contract_declaration_address
     - contract_address
@@ -373,6 +374,60 @@ class CommandConsole:
                 lines = _metrics.report()
                 for line in lines or ["no metrics recorded yet"]:
                     emit(line)
+            elif cmd == "multimodal":
+                # Beyond-reference: mixture-model analysis of the LAST
+                # fetched fleet (the scenario documentation/README.md:
+                # 90-103 describes but provides no algorithm for) —
+                # docs/ALGORITHM.md §8, svoc_tpu/sim/multimodal.py.
+                if len(args) > 1:
+                    emit("Unexpected number of arguments.")
+                    return out
+                k_poles = int(args[0]) if args else 2
+                with self.session.lock:
+                    predictions = self.session.predictions
+                if predictions is None:
+                    emit("No predictions yet — run 'fetch' first.")
+                    return out
+                # K capped by the fleet size: a duplicated farthest-point
+                # center would split a true pole's weight across clones.
+                k_max = min(8, predictions.shape[0])
+                if not 1 <= k_poles <= k_max:
+                    emit(f"K must be in [1, {k_max}].")
+                    return out
+                import jax.numpy as jnp
+                import numpy as np
+
+                from svoc_tpu.sim.multimodal import multimodal_consensus
+
+                n_failing = min(
+                    self.session.config.n_failing,
+                    predictions.shape[0] - 1,
+                )
+                res = multimodal_consensus(
+                    jnp.asarray(predictions, jnp.float32),
+                    k_poles,
+                    n_failing,
+                )
+                order = np.argsort(-np.asarray(res.pole_weights))
+                emit(f"mixture fit over {predictions.shape[0]} oracles, "
+                     f"K={k_poles} pole(s):")
+                for rank, k in enumerate(order):
+                    mean = ", ".join(
+                        f"{x:0.3f}" for x in np.asarray(res.pole_means[k])
+                    )
+                    emit(
+                        f"  pole {rank} [w={float(res.pole_weights[k]):0.3f}"
+                        f" sigma={float(res.pole_sigmas[k]):0.4f}] : {mean}"
+                    )
+                emit(
+                    "essence (dominant pole) : "
+                    + ", ".join(f"{x:0.3f}" for x in np.asarray(res.essence))
+                )
+                flagged = [
+                    str(i) for i, r in enumerate(np.asarray(res.reliable))
+                    if not r
+                ]
+                emit("flagged unreliable : " + (", ".join(flagged) or "none"))
             elif cmd == "live_mode":
                 # The reference stubs this (web_interface.py:228;
                 # oracle_scheduler.py:174-182 TODO).  Here it is the

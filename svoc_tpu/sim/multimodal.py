@@ -247,7 +247,6 @@ def multimodal_consensus(
     """
     if policy not in ("dominant", "average"):
         raise ValueError(f"policy {policy!r} not in dominant|average")
-    n = values.shape[0]
     fit = em_mixture(values, k_components, n_iters=n_iters, seed=seed)
 
     d = jnp.linalg.norm(
@@ -255,8 +254,11 @@ def multimodal_consensus(
     )  # [N, K]
     scaled = d / fit.sigmas[None, :]
     score = jnp.min(scaled, axis=1)  # distance to nearest pole
-    order = jnp.argsort(score)  # ascending: best fits first
-    reliable = jnp.zeros((n,), bool).at[order[: n - n_failing]].set(True)
+    # The shared fixed-count masking helper — same ranking + tie order
+    # as the on-chain estimator (contract.cairo:345-363).
+    from ..ops.sort import reliability_mask
+
+    reliable = reliability_mask(score, n_failing)
 
     # Restricted soft re-estimate over the reliable set.
     r = fit.resp * reliable[:, None]

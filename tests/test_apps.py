@@ -187,6 +187,28 @@ class TestCommandConsole:
         out = c.query("get_oracle_value_list")
         assert len(out) == 7
 
+    def test_multimodal_requires_fetch(self):
+        c = self.make()
+        assert c.query("multimodal") == ["No predictions yet — run 'fetch' first."]
+
+    def test_multimodal_analyzes_last_fleet(self):
+        c = self.make()
+        c.query("fetch")
+        out = c.query("multimodal")
+        assert any("mixture fit over 7 oracles, K=2" in line for line in out)
+        poles = [line for line in out if line.strip().startswith("pole ")]
+        assert len(poles) == 2
+        # dominant pole listed first (sorted by weight)
+        w = [float(line.split("w=")[1].split()[0]) for line in poles]
+        assert w == sorted(w, reverse=True)
+        assert any(line.startswith("essence (dominant pole)") for line in out)
+        assert any(line.startswith("flagged unreliable") for line in out)
+        # explicit K and validation (K capped at the 7-oracle fleet size)
+        assert any("K=3" in line for line in c.query("multimodal 3"))
+        assert c.query("multimodal 0") == ["K must be in [1, 7]."]
+        assert c.query("multimodal 8") == ["K must be in [1, 7]."]
+        assert c.query("multimodal 1 2") == ["Unexpected number of arguments."]
+
 
 class TestCli:
     def test_cli_smoke(self, monkeypatch, capsys):
