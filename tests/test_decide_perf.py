@@ -1,0 +1,168 @@
+"""tools/decide_perf.py — the measured-results → PERF_DECISIONS rules.
+
+The routing record must be a pure function of qualifying TPU
+measurements: CPU fallbacks never qualify, the best LOSSLESS variant
+wins the flagship, and the pallas consensus routes only on a clean,
+matching, faster measurement (hang ⇒ xla by walkover)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+)
+
+import decide_perf  # noqa: E402
+
+
+def tpu_result(value, extra_detail=None):
+    return {
+        "value": value,
+        "detail": {"backend": "tpu", **(extra_detail or {})},
+    }
+
+
+def cpu_result(value):
+    return {
+        "value": value,
+        "detail": {
+            "backend": "cpu",
+            "backend_fallback": "probe timed out",
+            "small_mode": True,
+        },
+    }
+
+
+def campaign(items, rc=0):
+    return {
+        "items": [
+            {"name": name, "results": [{"rc": rc, "result": result}]}
+            for name, result in items
+        ]
+    }
+
+
+def write(tmp_path, data):
+    path = tmp_path / "HW_CAMPAIGN.json"
+    path.write_text(json.dumps(data))
+    return [str(path)]
+
+
+def test_cpu_fallbacks_never_qualify(tmp_path):
+    paths = write(tmp_path, campaign([("bench_config0", cpu_result(9999.0))]))
+    assert decide_perf.latest_tpu_results(paths) == {}
+    decisions, _ = decide_perf.decide({})
+    assert decisions == {}
+
+
+def test_best_lossless_variant_wins(tmp_path):
+    paths = write(
+        tmp_path,
+        campaign(
+            [
+                ("bench_config0", tpu_result(4500.0, {"mfu_estimate": 0.5})),
+                ("bench_config8", tpu_result(12000.0, {"mfu_estimate": 0.5})),
+                ("bench_config12", tpu_result(13500.0, {"mfu_estimate": 0.55})),
+                ("bench_config10", tpu_result(25000.0)),  # int8: excluded
+            ]
+        ),
+    )
+    results = decide_perf.latest_tpu_results(paths)
+    decisions, evidence = decide_perf.decide(results)
+    assert decisions["flagship_variant"] == "packed_flash"
+    assert set(evidence["flagship_variant"]) == {"dense", "packed", "packed_flash"}
+
+
+def test_config0_already_routed_credits_actual_variant():
+    results = {
+        "bench_config0": tpu_result(12000.0, {"flagship_variant": "packed"}),
+        "bench_config12": tpu_result(11000.0),
+    }
+    decisions, evidence = decide_perf.decide(results)
+    assert decisions["flagship_variant"] == "packed"
+    assert "dense" not in evidence["flagship_variant"]
+
+
+def test_routed_config0_never_clobbers_better_dedicated_measurement():
+    results = {
+        "bench_config0": tpu_result(9000.0, {"flagship_variant": "packed"}),
+        "bench_config8": tpu_result(12000.0),
+        "bench_config12": tpu_result(10000.0),
+    }
+    decisions, evidence = decide_perf.decide(results)
+    # packed keeps its dedicated 12000 measurement and wins the argmax
+    assert decisions["flagship_variant"] == "packed"
+    assert evidence["flagship_variant"]["packed"]["comments_per_sec"] == 12000.0
+
+
+def test_failed_attempts_never_qualify(tmp_path):
+    paths = write(
+        tmp_path, campaign([("bench_config8", tpu_result(12000.0))], rc=1)
+    )
+    assert decide_perf.latest_tpu_results(paths) == {}
+
+
+def test_pallas_routes_only_on_clean_win():
+    base = {
+        "pallas_kernel_active": True,
+        "pallas_hung": False,
+        "pallas_info": {"essence_match_xla": True},
+        "n_oracles": 1024,
+    }
+    win = {"bench_config6": tpu_result(0.3, {**base, "pallas_vs_xla_speedup": 1.3})}
+    lose = {"bench_config6": tpu_result(0.5, {**base, "pallas_vs_xla_speedup": 0.8})}
+    hung = {
+        "bench_config6": tpu_result(
+            0.5,
+            {
+                **base,
+                "pallas_hung": True,
+                "pallas_vs_xla_speedup": None,
+                "pallas_info": {"hung_after_s": 300, "hang_stage": "compile"},
+            },
+        )
+    }
+    mismatch = {
+        "bench_config6": tpu_result(
+            0.3,
+            {**base, "pallas_vs_xla_speedup": 1.3,
+             "pallas_info": {"essence_match_xla": False}},
+        )
+    }
+    assert decide_perf.decide(win)[0]["consensus_impl"] == "pallas"
+    assert decide_perf.decide(lose)[0]["consensus_impl"] == "xla"
+    assert decide_perf.decide(hung)[0]["consensus_impl"] == "xla"
+    assert decide_perf.decide(mismatch)[0]["consensus_impl"] == "xla"
+    assert decide_perf.decide(hung)[1]["consensus_impl"]["hang_info"] is not None
+
+
+def test_main_exit_3_without_measurements(tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(decide_perf, "REPO", str(tmp_path))
+    monkeypatch.setattr(decide_perf, "OUT", str(tmp_path / "PERF_DECISIONS.json"))
+    assert decide_perf.main([]) == 3
+    assert not (tmp_path / "PERF_DECISIONS.json").exists()
+
+
+def test_main_writes_record(tmp_path, monkeypatch):
+    (tmp_path / "HW_CAMPAIGN.json").write_text(
+        json.dumps(campaign([("bench_config8", tpu_result(12000.0))]))
+    )
+    monkeypatch.setattr(decide_perf, "REPO", str(tmp_path))
+    monkeypatch.setattr(decide_perf, "OUT", str(tmp_path / "PERF_DECISIONS.json"))
+    assert decide_perf.main([]) == 0
+    record = json.loads((tmp_path / "PERF_DECISIONS.json").read_text())
+    assert record["flagship_variant"] == "packed"
+    assert "evidence" in record and "decided_at" in record
+
+
+def test_dry_run_writes_nothing(tmp_path, monkeypatch):
+    monkeypatch.setattr(decide_perf, "REPO", str(tmp_path))
+    monkeypatch.setattr(decide_perf, "OUT", str(tmp_path / "PERF_DECISIONS.json"))
+    monkeypatch.setattr(
+        decide_perf,
+        "latest_tpu_results",
+        lambda paths: {"bench_config8": tpu_result(12000.0)},
+    )
+    assert decide_perf.main(["--dry-run"]) == 0
+    assert not (tmp_path / "PERF_DECISIONS.json").exists()
