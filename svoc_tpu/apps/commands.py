@@ -40,7 +40,8 @@ HELP = """Commands:
     - scraper on/off (default: off)
     - live_mode on/off (default: off; scraper + auto_fetch + auto_commit)
     - metrics (throughput / latency counters)
-    - multimodal [K] (mixture analysis of the last fetch; default K=2)
+    - multimodal [K|auto] (mixture analysis of the last fetch;
+      default K=2, 'auto' selects K by BIC)
 
     - contract_declaration_address
     - contract_address
@@ -382,22 +383,37 @@ class CommandConsole:
                 if len(args) > 1:
                     emit("Unexpected number of arguments.")
                     return out
-                k_poles = int(args[0]) if args else 2
                 with self.session.lock:
                     predictions = self.session.predictions
                 if predictions is None:
                     emit("No predictions yet — run 'fetch' first.")
                     return out
-                # K capped by the fleet size: a duplicated farthest-point
-                # center would split a true pole's weight across clones.
-                k_max = min(8, predictions.shape[0])
-                if not 1 <= k_poles <= k_max:
-                    emit(f"K must be in [1, {k_max}].")
-                    return out
                 import jax.numpy as jnp
                 import numpy as np
 
-                from svoc_tpu.sim.multimodal import multimodal_consensus
+                from svoc_tpu.sim.multimodal import (
+                    multimodal_consensus,
+                    select_k,
+                )
+
+                # K capped by the fleet size: a duplicated farthest-point
+                # center would split a true pole's weight across clones.
+                k_max = min(8, predictions.shape[0])
+                if args and args[0] == "auto":
+                    k_poles, bics = select_k(
+                        jnp.asarray(predictions, jnp.float32), k_max=k_max
+                    )
+                    emit(
+                        f"BIC selects K={k_poles} "
+                        f"(scores: "
+                        + ", ".join(f"{b:0.1f}" for b in bics)
+                        + ")"
+                    )
+                else:
+                    k_poles = int(args[0]) if args else 2
+                    if not 1 <= k_poles <= k_max:
+                        emit(f"K must be in [1, {k_max}].")
+                        return out
 
                 n_failing = min(
                     self.session.config.n_failing,

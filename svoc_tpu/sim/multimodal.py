@@ -52,6 +52,7 @@ __all__ = [
     "generate_multimodal_oracles",
     "em_mixture",
     "multimodal_consensus",
+    "select_k",
     "benchmark_multimodal",
 ]
 
@@ -283,6 +284,75 @@ def multimodal_consensus(
         pole_of=jnp.argmax(fit.resp, axis=1).astype(jnp.int32),
         fit=fit,
     )
+
+
+def select_k(
+    values: jnp.ndarray,
+    k_max: int = 8,
+    n_iters: int = 30,
+    seed: int = 0,
+    min_support: int = 3,
+) -> tuple:
+    """Pick the pole count by BIC over ``K = 1..k_max``.
+
+    The operator-facing answer to "how many poles does this fleet
+    have?": each candidate K is one static-shape EM fit (compiled
+    once, cached per K), scored by ``BIC = −2·N·mean_ll + p·ln N``
+    with ``p = K·dim + K + (K−1)`` free parameters (means, spreads,
+    weights).  Returns ``(best_k, bics)`` where ``bics[k-1]`` is the
+    score for K=k (lower is better, ``inf`` = disqualified).
+
+    Raw BIC is asymptotic and fails openly on small fleets: a
+    component can collapse onto 1-2 points with its spread at the
+    ``min_sigma`` floor, gaining ~``dim·ln(1/σ)`` log-likelihood per
+    captured point and out-scoring the ``p·ln N`` penalty, so a
+    7-oracle unimodal fleet would "select" K=6.  Two guards keep the
+    answer meaningful:
+
+    - a pole must be SUPPORTED: candidate Ks are capped at
+      ``N // min_support`` (a "pole" followed by fewer than
+      ``min_support`` oracles is not a pole — and the cap also bounds
+      the console's compile sweep);
+    - a fit whose smallest soft count ``n_k`` falls below 2 is
+      disqualified (scored ``inf``) — that component is a collapsed
+      singleton, not structure;
+    - poles must be IDENTIFIABLE: a fit where two means are closer
+      than ``2·(σ_i + σ_j)`` (≈4σ for equal spreads) is disqualified —
+      overlapping components are one pole split in two;
+    - selection is PARSIMONIOUS: a larger K wins only on *very strong*
+      evidence, ``ΔBIC > 10`` against the incumbent (the Kass–Raftery
+      scale) — on a 7-point fleet a lucky 2+5 split can edge BIC by
+      ~2, which is noise, not a second pole.
+
+    A unimodal fleet then selects K=1: the mixture machinery degrades
+    gracefully to the reference's original single-pole model.
+    """
+    import math
+
+    n, dim = values.shape
+    k_max = max(1, min(k_max, n // max(min_support, 1) or 1))
+    bics = []
+    for k in range(1, k_max + 1):
+        fit = em_mixture(values, k, n_iters=n_iters, seed=seed)
+        if k > 1:
+            if float(jnp.min(jnp.sum(fit.resp, axis=0))) < 2.0:
+                bics.append(float("inf"))
+                continue
+            pair_d = jnp.linalg.norm(
+                fit.means[:, None, :] - fit.means[None, :, :], axis=-1
+            )
+            sep = 2.0 * (fit.sigmas[:, None] + fit.sigmas[None, :])
+            off_diag = ~jnp.eye(k, dtype=bool)
+            if bool(jnp.any((pair_d < sep) & off_diag)):
+                bics.append(float("inf"))
+                continue
+        p = k * dim + k + (k - 1)
+        bics.append(-2.0 * float(fit.log_likelihood) * n + p * math.log(n))
+    best_k = 1
+    for k in range(2, len(bics) + 1):
+        if bics[k - 1] < bics[best_k - 1] - 10.0:
+            best_k = k
+    return best_k, bics
 
 
 def _pole_recovery_error(est_means, true_poles):

@@ -208,6 +208,10 @@ class TestCommandConsole:
         assert c.query("multimodal 0") == ["K must be in [1, 7]."]
         assert c.query("multimodal 8") == ["K must be in [1, 7]."]
         assert c.query("multimodal 1 2") == ["Unexpected number of arguments."]
+        # BIC auto-selection reports its pick and runs the analysis
+        out = c.query("multimodal auto")
+        assert any(line.startswith("BIC selects K=") for line in out)
+        assert any("mixture fit over 7 oracles" in line for line in out)
 
 
 class TestCli:

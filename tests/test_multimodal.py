@@ -136,6 +136,63 @@ def test_benchmark_mixture_beats_unimodal_on_balanced_poles():
     assert cell["pole_recovery_error"] < 0.05
 
 
+def test_select_k_finds_true_pole_count():
+    from svoc_tpu.sim.multimodal import select_k
+
+    bimodal, _, _ = generate_multimodal_oracles(
+        jax.random.PRNGKey(10), 64, 0, POLES, 0.03, weights=[0.5, 0.5]
+    )
+    k2, bics2 = select_k(bimodal, k_max=4)
+    assert k2 == 2 and len(bics2) == 4
+
+    unimodal, _, _ = generate_multimodal_oracles(
+        jax.random.PRNGKey(11), 64, 0, POLES[:1], 0.03
+    )
+    k1, _ = select_k(unimodal, k_max=4)
+    assert k1 == 1
+
+    trimodal, _, _ = generate_multimodal_oracles(
+        jax.random.PRNGKey(12),
+        96,
+        0,
+        jnp.array([[0.15, 0.15], [0.5, 0.85], [0.85, 0.2]]),
+        0.02,
+    )
+    k3, _ = select_k(trimodal, k_max=5)
+    assert k3 == 3
+
+
+def test_select_k_capped_by_pole_support():
+    from svoc_tpu.sim.multimodal import select_k
+
+    # N=5 with min_support=3: only K=1 is a supportable hypothesis
+    values, _, _ = generate_multimodal_oracles(
+        jax.random.PRNGKey(13), 5, 0, POLES, 0.03
+    )
+    k, bics = select_k(values, k_max=16)
+    assert len(bics) == 1 and k == 1
+
+
+def test_select_k_small_fleets_not_overfit():
+    """The raw-BIC degeneracy (collapsed near-singleton components
+    out-scoring the penalty on tiny fleets) must stay fixed: a
+    7-oracle unimodal fleet is K=1, an 8-oracle bimodal one K=2."""
+    from svoc_tpu.sim.multimodal import select_k
+
+    for seed in range(20, 30):
+        uni, _, _ = generate_multimodal_oracles(
+            jax.random.PRNGKey(seed), 7, 0, POLES[:1], 0.03
+        )
+        assert select_k(uni)[0] == 1, seed
+    bi_hits = 0
+    for seed in range(20, 30):
+        bi, _, _ = generate_multimodal_oracles(
+            jax.random.PRNGKey(seed), 8, 0, POLES, 0.03, weights=[0.5, 0.5]
+        )
+        bi_hits += select_k(bi)[0] == 2
+    assert bi_hits >= 8  # a lopsided 8-point draw may honestly read unimodal
+
+
 def test_benchmark_dominant_pole_at_asymmetric_weights():
     cell = benchmark_multimodal(
         jax.random.PRNGKey(9),
