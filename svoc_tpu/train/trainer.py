@@ -173,6 +173,7 @@ def make_sharded_train_step(
     params_template: Any,
     data_axis: str = "data",
     model_axis: str = "model",
+    zero1: bool = False,
 ):
     """GSPMD training step over a ``data × model`` mesh.
 
@@ -186,6 +187,13 @@ def make_sharded_train_step(
     ``attention='flash'`` shards too: the flash VJP under GSPMD
     data×model shardings matches the unsharded step to float epsilon on
     the virtual mesh (``tests/test_train.py``).
+
+    ``zero1=True`` additionally shards the optimizer moments over
+    ``data_axis`` (arXiv:2004.13336 / ZeRO stage 1): at-rest optimizer
+    state drops to ~1/D per data replica and the weight update runs
+    shard-wise, with XLA inserting the gathers.  Same update math as
+    the unsharded step, equivalent to float tolerance (cross-sharding
+    reduction order differs — parity-tested in ``tests/test_train.py``).
     """
     batch_sharding = Batch(
         ids=NamedSharding(mesh, P(data_axis, None)),
@@ -195,23 +203,61 @@ def make_sharded_train_step(
     return _sharded_factory(
         _step_body(model, tx), batch_sharding, tx, mesh,
         params_template=params_template, model_axis=model_axis,
+        zero1_axis=data_axis if zero1 else None,
     )
 
 
-def _opt_state_shardings(p_shard, scalar, tx, params_template):
+def _zero1_spec(spec: P, shape, data_axis: str, data_size: int) -> P:
+    """Augment a leaf's partition spec with the data axis on the first
+    free, divisible dimension — the ZeRO-1 / cross-replica weight-update
+    sharding of arXiv:2004.13336 expressed as a GSPMD constraint.  A
+    leaf with no such dimension keeps its spec (stays replicated over
+    data) rather than erroring: sharding optimizer state is a memory
+    optimization, never a correctness requirement."""
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (p, d) in enumerate(zip(parts, shape)):
+        if p is None and d > 0 and d % data_size == 0:
+            parts[i] = data_axis
+            return P(*parts)
+    return spec
+
+
+def _opt_state_shardings(
+    p_shard,
+    scalar,
+    tx,
+    params_template,
+    mesh=None,
+    zero1_axis=None,
+):
     """Optimizer moments mirror the param tree as subtrees (adam's
     ``mu``/``nu``), so match opt-state leaves to param shardings by
     tree-path *suffix*; anything else (step counts…) replicates.
-    ``eval_shape`` keeps this allocation-free."""
+    ``eval_shape`` keeps this allocation-free.
+
+    With ``zero1_axis`` set, each matched moment leaf is additionally
+    sharded over that (data) mesh axis on its first free divisible
+    dimension, so the at-rest optimizer state is ~1/D per replica and
+    XLA computes the weight update shard-wise (all-gathering the
+    updated params to their replicated sharding) — optimizer-state
+    sharding per arXiv:2004.13336 / ZeRO-1."""
     by_path = {}
     for path, s in jax.tree_util.tree_flatten_with_path(p_shard)[0]:
         by_path[tuple(str(k) for k in path)] = s
+    data_size = mesh.shape[zero1_axis] if zero1_axis else 1
 
     def for_leaf(path, leaf):
         keys = tuple(str(k) for k in path)
         for start in range(len(keys)):
             hit = by_path.get(keys[start:])
             if hit is not None:
+                if zero1_axis and data_size > 1 and leaf.ndim > 0:
+                    return NamedSharding(
+                        mesh,
+                        _zero1_spec(
+                            hit.spec, leaf.shape, zero1_axis, data_size
+                        ),
+                    )
                 return hit
         return scalar
 
@@ -230,16 +276,21 @@ def _sharded_factory(
     *,
     params_template: Any,
     model_axis: str = "model",
+    zero1_axis: str = None,
 ):
     """Shared GSPMD wiring: jit ``step_body`` with tensor-parallel
-    params, suffix-matched optimizer-state shardings, and the given
-    batch shardings."""
+    params, suffix-matched optimizer-state shardings (optionally
+    ZeRO-1-sharded over ``zero1_axis``), and the given batch
+    shardings."""
     p_shard = param_shardings(params_template, mesh, model_axis=model_axis)
     scalar = NamedSharding(mesh, P())
     state_shardings = TrainState(
         step=scalar,
         params=p_shard,
-        opt_state=_opt_state_shardings(p_shard, scalar, tx, params_template),
+        opt_state=_opt_state_shardings(
+            p_shard, scalar, tx, params_template,
+            mesh=mesh, zero1_axis=zero1_axis,
+        ),
     )
     train_step = jax.jit(
         step_body,
@@ -261,12 +312,14 @@ def make_sharded_packed_train_step(
     params_template: Any,
     data_axis: str = "data",
     model_axis: str = "model",
+    zero1: bool = False,
 ):
     """GSPMD packed fine-tune step (packed twin of
     :func:`make_sharded_train_step`): rows shard over ``data_axis``,
     params follow the Megatron layout over ``model_axis`` — the packed
     module's parameter tree is identical, so the same
-    :func:`param_shardings` apply."""
+    :func:`param_shardings` apply.  ``zero1`` as in the unpacked
+    factory."""
     row = NamedSharding(mesh, P(data_axis, None))
     batch_sharding = PackedTrainBatch(
         ids=row,
@@ -279,4 +332,5 @@ def make_sharded_packed_train_step(
     return _sharded_factory(
         _packed_step_body(cfg, tx), batch_sharding, tx, mesh,
         params_template=params_template, model_axis=model_axis,
+        zero1_axis=data_axis if zero1 else None,
     )

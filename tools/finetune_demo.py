@@ -118,6 +118,15 @@ def main(argv=None) -> int:
     p.add_argument("--target-f1", type=float, default=0.9)
     p.add_argument("--eval-n", type=int, default=128)
     p.add_argument("--out", default="FINETUNE_r04.json")
+    p.add_argument(
+        "--zero1",
+        action="store_true",
+        help=(
+            "shard optimizer state over the data axis "
+            "(arXiv:2004.13336 / ZeRO-1); same update math, "
+            "~1/D at-rest optimizer memory per replica"
+        ),
+    )
     args = p.parse_args(argv)
 
     import optax
@@ -158,7 +167,7 @@ def main(argv=None) -> int:
             devices.reshape(mesh_shape), axis_names=("data", "model")
         )
         step_fn, shard_state, _ = make_sharded_train_step(
-            model, tx, mesh, params_template=params
+            model, tx, mesh, params_template=params, zero1=args.zero1
         )
         return mesh, step_fn, shard_state
 
@@ -229,6 +238,7 @@ def main(argv=None) -> int:
     report = {
         "task": "synthetic keyword sentiment (6 tracked families)",
         "config": "TINY_TEST encoder, GSPMD data(4)xmodel(2) virtual mesh",
+        "zero1_opt_sharding": bool(args.zero1),
         "steps": args.steps,
         "batch": args.batch,
         "loss_curve": [round(x, 4) for x in losses],
