@@ -207,6 +207,14 @@ def make_sharded_train_step(
     )
 
 
+def max_shard_fraction(arr) -> float:
+    """Largest addressable shard of ``arr`` as a fraction of its total
+    size — 1.0 for a replicated array, ~1/D for one sharded D ways.
+    Shared by the zero1 tests and the driver dryrun so the at-rest
+    memory check cannot drift between them."""
+    return max(s.data.size for s in arr.addressable_shards) / arr.size
+
+
 def _zero1_spec(spec: P, shape, data_axis: str, data_size: int) -> P:
     """Augment a leaf's partition spec with the data axis on the first
     free, divisible dimension — the ZeRO-1 / cross-replica weight-update

@@ -21,6 +21,27 @@ def test_finetune_demo_mechanics(tmp_path):
     )
     report = json.loads(out.read_text())
     assert rc == 0, report
+    assert report["zero1_opt_sharding"] is False
+    assert report["same_mesh_replay_max_abs_param_delta"] == 0.0
+    assert report["changed_mesh_restore_max_abs_param_delta"] == 0.0
+    assert report["loss_curve"][-1] < report["loss_curve"][0]
+
+
+def test_finetune_demo_zero1_checkpoint_mechanics(tmp_path):
+    """The PARALLELISM.md claim under test: with ZeRO-1-sharded
+    optimizer state, the mid-run orbax checkpoint still replays
+    bit-exactly on the same mesh AND restores bit-exactly onto a
+    different data×model layout (4×2 → 2×4)."""
+    from tools.finetune_demo import main
+
+    out = tmp_path / "ft_zero1.json"
+    rc = main(
+        ["--steps", "12", "--batch", "16", "--target-f1", "0.0",
+         "--out", str(out), "--zero1"]
+    )
+    report = json.loads(out.read_text())
+    assert rc == 0, report
+    assert report["zero1_opt_sharding"] is True
     assert report["same_mesh_replay_max_abs_param_delta"] == 0.0
     assert report["changed_mesh_restore_max_abs_param_delta"] == 0.0
     assert report["loss_curve"][-1] < report["loss_curve"][0]

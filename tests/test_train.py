@@ -109,14 +109,14 @@ def test_zero1_matches_unsharded_and_shards_opt_state():
     # at-rest memory: every non-scalar momentum leaf shards over "data"
     # — its largest addressable shard holds at most 1/4 of the elements
     # (modulo a dimension the leaf cannot split).
+    from svoc_tpu.train.trainer import max_shard_fraction
+
     mu = state.opt_state[0].trace
     sharded = 0
     for leaf in jax.tree_util.tree_leaves(mu):
         if leaf.ndim == 0:
             continue
-        frac = max(
-            s.data.size for s in leaf.addressable_shards
-        ) / leaf.size
+        frac = max_shard_fraction(leaf)
         if frac <= 0.25 + 1e-9:
             sharded += 1
         spec = leaf.sharding.spec
@@ -161,14 +161,15 @@ def test_zero1_packed_step_runs_and_shards():
     state = shard_state(init_state(model, params, tx))
     state, metrics = step(state, batch)
     assert np.isfinite(float(metrics["loss"]))
+    from svoc_tpu.train.trainer import max_shard_fraction
+
     trace_leaves = [
         leaf
         for leaf in jax.tree_util.tree_leaves(state.opt_state[0].trace)
         if leaf.ndim > 0
     ]
     assert any(
-        max(s.data.size for s in leaf.addressable_shards) / leaf.size <= 0.25 + 1e-9
-        for leaf in trace_leaves
+        max_shard_fraction(leaf) <= 0.25 + 1e-9 for leaf in trace_leaves
     )
 
 
