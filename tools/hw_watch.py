@@ -1,5 +1,11 @@
 """Persistent tunnel watcher: loop until TPU liveness, then run hw_queue.
 
+**Superseded by ``tools/hw_campaign.py``** — the 2026-07-30 alive
+window showed the one-shot fire-the-queue strategy loses the window to
+probes when the tunnel dies mid-queue; the campaign re-gates liveness
+per item, orders by value, and survives flapping.  This wrapper is
+kept for the simple case (a tunnel that stays up once it answers).
+
 ``tools/hw_queue.py`` aborts early (by design) when the tunnel is dead so
 its artifact records the outage.  This wrapper is the long-running side:
 probe liveness every ``--interval`` seconds and, the moment a probe
