@@ -1,0 +1,160 @@
+"""Campaign/probe measurement-infrastructure logic.
+
+These pin the behaviors that decide whether a flapping-tunnel round
+captures its hardware numbers: attempt refunds vs caps, retirement,
+busy-flag self-healing, value ordering, and the probe bisect's
+stop-at-first-hang rule.  All drives use fakes — no TPU, no bench
+subprocesses."""
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    ),
+)
+
+import hw_campaign  # noqa: E402
+import hw_queue  # noqa: E402
+import tpu_probe  # noqa: E402
+
+
+def run_campaign(monkeypatch, tmp_path, run_item, alive=lambda py: True):
+    monkeypatch.setattr(hw_campaign, "run_item", run_item)
+    monkeypatch.setattr(hw_campaign, "tunnel_alive", alive)
+    monkeypatch.setattr(hw_campaign, "OUT", str(tmp_path / "HW_CAMPAIGN.json"))
+    monkeypatch.setattr(hw_campaign, "BUSY_FLAG", str(tmp_path / "busy"))
+    monkeypatch.setattr(hw_campaign, "DEAD_SLEEP_S", 0.0)
+    rc = hw_campaign.main(["--seconds", "1"])
+    state = json.loads((tmp_path / "HW_CAMPAIGN.json").read_text())
+    return rc, {i["name"]: i for i in state["items"]}
+
+
+def ok(value=1.0):
+    return {"rc": 0, "seconds": 0.1, "result": {"value": value, "detail": {}}}
+
+
+def test_flagship_runs_first_and_fallbacks_are_refunded(
+    monkeypatch, tmp_path
+):
+    order = []
+    calls = {"n": 0}
+
+    def fake(name, cmd, timeout):
+        order.append(name)
+        calls["n"] += 1
+        if name == "bench_config0" and calls["n"] <= 2:
+            return {"rc": "cpu-fallback", "seconds": 0.1}
+        return ok()
+
+    rc, items = run_campaign(monkeypatch, tmp_path, fake)
+    assert rc == 0
+    assert order[0] == "bench_config0"  # value order: flagship first
+    assert order[-2:] == ["tpu_probe", "flash_probe"]  # probes last
+    flagship = items["bench_config0"]
+    assert flagship["done"]
+    assert flagship["attempts"] == 1  # both fallbacks refunded
+    assert flagship["fallbacks"] == 2
+
+
+def test_timeouts_retire_after_max_attempts(monkeypatch, tmp_path):
+    def fake(name, cmd, timeout):
+        if name == "bench_config8":
+            return {"rc": "timeout", "seconds": 0.1}
+        return ok()
+
+    rc, items = run_campaign(monkeypatch, tmp_path, fake)
+    assert rc == 1  # not everything captured
+    retired = items["bench_config8"]
+    assert not retired["done"]
+    assert retired["attempts"] == hw_campaign.MAX_ATTEMPTS
+    # retirement must not block later items
+    assert items["bench_config12"]["done"]
+
+
+def test_persistent_fallbacks_cannot_livelock(monkeypatch, tmp_path):
+    """The 2026-07-30 pattern: liveness passes while bench's deeper
+    backend probe always falls back — the head item must retire at the
+    fallback cap instead of spinning forever."""
+
+    def fake(name, cmd, timeout):
+        if name == "bench_config12":
+            return {"rc": "cpu-fallback", "seconds": 0.1}
+        return ok()
+
+    rc, items = run_campaign(monkeypatch, tmp_path, fake)
+    assert rc == 1
+    half_dead = items["bench_config12"]
+    assert not half_dead["done"]
+    assert half_dead["fallbacks"] == hw_campaign.MAX_FALLBACKS
+    assert len(half_dead["results"]) <= (
+        hw_campaign.MAX_ATTEMPTS + hw_campaign.MAX_FALLBACKS
+    )
+    assert items["bench_config6"]["done"]  # later items still ran
+
+
+def test_stale_busy_flag_cleared_live_flag_refused(monkeypatch, tmp_path):
+    flag = tmp_path / "busy"
+
+    # dead pid -> stale, cleared, campaign proceeds.  A reaped child's
+    # pid is PROVEN dead (hard-coded large pids can be live under a
+    # raised kernel.pid_max).
+    dead_pid = os.fork()
+    if dead_pid == 0:
+        os._exit(0)
+    os.waitpid(dead_pid, 0)
+    flag.write_text(f"{dead_pid} bench_config0")
+    rc, items = run_campaign(monkeypatch, tmp_path, lambda n, c, t: ok())
+    assert rc == 0 and not flag.exists()
+
+    # corrupt flag -> stale by definition, cleared
+    flag.write_text("")
+    rc, _ = run_campaign(monkeypatch, tmp_path, lambda n, c, t: ok())
+    assert rc == 0 and not flag.exists()
+
+    # live pid -> another campaign is measuring: refuse to start
+    flag.write_text(f"{os.getpid()} bench_config0")
+    monkeypatch.setattr(hw_campaign, "BUSY_FLAG", str(flag))
+    assert hw_campaign.main(["--seconds", "1"]) == 2
+    assert flag.exists()
+
+
+def test_campaign_shares_bench_cmd_with_queue(monkeypatch, tmp_path):
+    rc, items = run_campaign(monkeypatch, tmp_path, lambda n, c, t: ok())
+    assert items["bench_config0"]["cmd"] == hw_queue.bench_cmd(0, 1.0)
+    assert (
+        items["bench_config0"]["timeout"]
+        == 1.0 + hw_queue.BENCH_TIMEOUT_MARGIN_S
+    )
+
+
+def test_probe_bisect_stops_at_first_hang(monkeypatch, tmp_path):
+    """The consensus size-bisect walks 128/256/512/1024 ascending and
+    stops at the first hang — larger sizes would only burn the alive
+    window; results persist incrementally."""
+    ran = []
+
+    def fake_probe(name, timeout, extra_env=None):
+        env = extra_env or {}
+        n = env.get("SVOC_PROBE_N_ORACLES")
+        ran.append((name, n, env.get("SVOC_PROBE_ATTENTION")))
+        if name == "consensus1024" and n == "512":
+            return {"probe": name, "ok": False, "timeout": True}
+        return {"probe": name, "ok": True}
+
+    monkeypatch.setattr(tpu_probe, "run_probe", fake_probe)
+    monkeypatch.setattr(tpu_probe, "REPO", str(tmp_path))
+    rc = tpu_probe.main(["--only", "consensus1024"])
+    sizes = [n for name, n, _ in ran if name == "consensus1024"]
+    assert sizes == ["128", "256", "512"]  # stopped before 1024
+    assert rc == 1  # the hang keeps the run marked not-ok
+    recorded = json.loads((tmp_path / "TPU_PROBE.json").read_text())
+    assert [r["probe"] for r in recorded] == [
+        "consensus128",
+        "consensus256",
+        "consensus512",
+    ]
+    assert recorded[-1]["timeout"] is True
