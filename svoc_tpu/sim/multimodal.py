@@ -54,6 +54,7 @@ __all__ = [
     "multimodal_consensus",
     "select_k",
     "benchmark_multimodal",
+    "multimodal_breakdown_curve",
 ]
 
 
@@ -419,6 +420,112 @@ def _multimodal_trials(
         jnp.mean(ident.astype(jnp.float32)),
         jnp.mean(pole_err),
     )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_oracles", "n_failing", "k_components"),
+)
+def _coordinated_trials(
+    keys,
+    poles,
+    sigma,
+    weights,
+    adv_point,
+    adv_spread,
+    *,
+    n_oracles: int,
+    n_failing: int,
+    k_components: int,
+):
+    dominant_pole = poles[jnp.argmax(weights)]
+
+    def trial(key):
+        k_gen, k_adv = jax.random.split(key)
+        values, honest, _ = generate_multimodal_oracles(
+            k_gen, n_oracles, n_failing, poles, sigma, weights
+        )
+        # Replace the uniform adversaries with a COORDINATED cluster: a
+        # tight fake pole at adv_point (the attack the uniform failure
+        # model of documentation/README.md:105-114 cannot mount).
+        adv = adv_point[None, :] + adv_spread * jax.random.normal(
+            k_adv, (n_oracles, values.shape[1])
+        )
+        # Same constrained state space as every other oracle draw: the
+        # contract rejects values outside ]0,1[^M, so the modeled
+        # attack must stay inside it too.
+        adv = jnp.clip(adv, 1e-4, 1.0 - 1e-4)
+        values = jnp.where(honest[:, None], values, adv)
+        mm = multimodal_consensus(values, k_components, n_failing)
+        err = jnp.linalg.norm(mm.essence - dominant_pole)
+        on_honest = err < jnp.linalg.norm(mm.essence - adv_point)
+        return err, on_honest
+
+    err, on_honest = jax.vmap(trial)(keys)
+    return jnp.mean(err), jnp.mean(on_honest.astype(jnp.float32))
+
+
+def multimodal_breakdown_curve(
+    key,
+    poles,
+    sigma,
+    weights=None,
+    n_oracles: int = 64,
+    fractions=(0.1, 0.2, 0.3, 0.35, 0.45, 0.55),
+    adv_point=None,
+    adv_spread: float = 0.01,
+    k_trials: int = 200,
+) -> dict:
+    """Breakdown of the MIXTURE estimator under coordinated adversaries.
+
+    The adversaries form their own tight fake pole (the attack that
+    actually threatens a clustering estimator — uniform failures just
+    score badly against every pole and get masked).  The estimator fits
+    K+1 components (the honest Ks plus one for the fake pole it must be
+    allowed to represent) and masks the worst ``n_failing``; its
+    essence follows the heaviest RELIABLE pole.  Expected phenomenology,
+    measured here: while the adversary fraction is below the dominant
+    honest pole's share the essence stays on the honest pole (the fake
+    pole is fully masked — unlike the unimodal median there is no
+    gradual drag); once the adversary cluster outweighs the dominant
+    honest pole the dominance argmax flips and the essence jumps to the
+    fake pole — a cliff at ``frac ≈ max_k w_k · (1 − frac)``, i.e. the
+    mixture estimator's breakdown point is the dominant pole's own
+    weight, NOT N/2.
+
+    Returns ``{fraction: {"essence_err": ..., "on_honest_pole_pct":
+    ...}}`` with errors measured against the dominant honest pole.
+    """
+    poles = jnp.asarray(poles, jnp.float32)
+    if weights is None:
+        weights = jnp.full((poles.shape[0],), 1.0 / poles.shape[0])
+    else:
+        weights = jnp.asarray(weights, jnp.float32)
+        weights = weights / jnp.sum(weights)
+    if adv_point is None:
+        adv_point = jnp.full((poles.shape[1],), 0.95, jnp.float32)
+    else:
+        adv_point = jnp.asarray(adv_point, jnp.float32)
+    out = {}
+    for frac in fractions:
+        n_failing = int(round(frac * n_oracles))
+        keys = jax.random.split(jax.random.fold_in(key, n_failing), k_trials)
+        err, on_honest = _coordinated_trials(
+            keys,
+            poles,
+            jnp.asarray(sigma, jnp.float32),
+            weights,
+            adv_point,
+            adv_spread,
+            n_oracles=n_oracles,
+            n_failing=n_failing,
+            k_components=int(poles.shape[0]) + 1,
+        )
+        out[frac] = {
+            "essence_err": float(err),
+            "on_honest_pole_pct": float(on_honest) * 100.0,
+        }
+    return out
 
 
 def benchmark_multimodal(

@@ -193,6 +193,32 @@ def test_select_k_small_fleets_not_overfit():
     assert bi_hits >= 8  # a lopsided 8-point draw may honestly read unimodal
 
 
+def test_multimodal_breakdown_cliff_at_dominant_weight():
+    """Coordinated adversaries forming a tight fake pole: the mixture
+    estimator holds the honest dominant pole until the adversary share
+    exceeds the dominant pole's own weight — for w_dom=0.6 the
+    theoretical cliff is frac > 0.6·(1−frac) ⇒ ≈0.375 — then flips.
+    (A tight plausible cluster cannot be masked by any scoring rule;
+    dominance is the defense, and this pins where it ends.)"""
+    from svoc_tpu.sim.multimodal import multimodal_breakdown_curve
+
+    poles = jnp.array([[0.2, 0.2], [0.7, 0.6]], jnp.float32)
+    curve = multimodal_breakdown_curve(
+        jax.random.PRNGKey(0),
+        poles,
+        0.03,
+        weights=[0.6, 0.4],
+        n_oracles=64,
+        fractions=(0.1, 0.2, 0.45, 0.55),
+        k_trials=60,
+    )
+    assert curve[0.1]["on_honest_pole_pct"] >= 80.0
+    assert curve[0.2]["on_honest_pole_pct"] >= 80.0
+    assert curve[0.45]["on_honest_pole_pct"] <= 25.0
+    assert curve[0.55]["on_honest_pole_pct"] <= 5.0
+    assert curve[0.55]["essence_err"] > 0.5
+
+
 def test_benchmark_dominant_pole_at_asymmetric_weights():
     cell = benchmark_multimodal(
         jax.random.PRNGKey(9),
