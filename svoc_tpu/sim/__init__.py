@@ -16,6 +16,8 @@ from svoc_tpu.sim.multimodal import (  # noqa: F401
     benchmark_multimodal,
     em_mixture,
     generate_multimodal_oracles,
+    multimodal_breakdown_curve,
     multimodal_consensus,
+    select_k,
 )
 from svoc_tpu.sim.oracle import gen_oracle_predictions  # noqa: F401
