@@ -54,6 +54,11 @@ def test_flagship_runs_first_and_fallbacks_are_refunded(
     assert rc == 0
     assert order[0] == "bench_config0"  # value order: flagship first
     assert order[-2:] == ["tpu_probe", "flash_probe"]  # probes last
+    # the routed flagship re-capture follows the lossless variants but
+    # outranks the remaining configs — it is the headline number
+    assert order.index("bench_config0_routed") == order.index(
+        "bench_config10"
+    ) + 1
     flagship = items["bench_config0"]
     assert flagship["done"]
     assert flagship["attempts"] == 1  # both fallbacks refunded
@@ -129,6 +134,52 @@ def test_campaign_shares_bench_cmd_with_queue(monkeypatch, tmp_path):
         items["bench_config0"]["timeout"]
         == 1.0 + hw_queue.BENCH_TIMEOUT_MARGIN_S
     )
+
+
+def test_resume_keeps_captured_results(monkeypatch, tmp_path):
+    """A campaign killed mid-round (session restart) must resume from
+    its journal: captured measurements survive, done items never
+    re-run, pending items continue.  2026-07-31 pattern — four bench
+    results captured, session died, remaining items still pending."""
+    import pytest
+
+    first_ran = []
+
+    def die_after_flagship(name, cmd, timeout):
+        first_ran.append(name)
+        if name != "bench_config0":
+            raise RuntimeError("session killed mid-campaign")
+        return ok(42.0)
+
+    with pytest.raises(RuntimeError):
+        run_campaign(monkeypatch, tmp_path, die_after_flagship)
+    journal = json.loads((tmp_path / "HW_CAMPAIGN.json").read_text())
+    flagship = {i["name"]: i for i in journal["items"]}["bench_config0"]
+    assert flagship["done"] and flagship["results"][0]["result"]["value"] == 42.0
+
+    second_ran = []
+
+    def finish(name, cmd, timeout):
+        second_ran.append(name)
+        return ok(7.0)
+
+    rc, items = run_campaign(monkeypatch, tmp_path, finish)
+    assert rc == 0
+    assert "bench_config0" not in second_ran  # captured result kept
+    assert items["bench_config0"]["results"][0]["result"]["value"] == 42.0
+    assert items["bench_config8"]["done"]  # pending items completed
+
+    # --fresh discards the journal and re-runs everything
+    third_ran = []
+
+    def fresh(name, cmd, timeout):
+        third_ran.append(name)
+        return ok(9.0)
+
+    monkeypatch.setattr(hw_campaign, "run_item", fresh)
+    monkeypatch.setattr(hw_campaign, "tunnel_alive", lambda py: True)
+    assert hw_campaign.main(["--seconds", "1", "--fresh"]) == 0
+    assert "bench_config0" in third_ran
 
 
 def test_probe_bisect_stops_at_first_hang(monkeypatch, tmp_path):

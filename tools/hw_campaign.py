@@ -75,6 +75,14 @@ def bench_item(cfg: int, seconds: float):
 
 def build_items(seconds: float):
     items = [bench_item(c, seconds) for c in (0, 8, 12, 10, 9, 11, 6)]
+    # Once the lossless variants are measured, tools/decide_perf.py
+    # reroutes the flagship through PERF_DECISIONS.json; capture
+    # config 0 again under the committed routing so the headline
+    # number reflects the measured-best variant.  Distinct name so the
+    # resume path keeps both the pre- and post-routing captures.
+    routed = bench_item(0, seconds)
+    routed["name"] = "bench_config0_routed"
+    items.insert(4, routed)
     items += [
         # tpu_probe's consensus size-bisect doubles as the compile-hang
         # diagnosis; per-probe cap 300 s keeps one hang from eating the
@@ -108,17 +116,56 @@ def tunnel_alive(py: str) -> bool:
         return False
 
 
+def resume_items(items, prior_items):
+    """Merge a prior journal's progress into a fresh item list.
+
+    A campaign killed mid-round (session restart, OOM) must not re-run
+    measurements it already captured: an alive window is the scarcest
+    resource in the round.  Matching is by item name; captured
+    results, attempt/fallback counters, and done flags carry over.
+    Items added to ``build_items`` after the prior journal was written
+    simply start fresh.
+    """
+    prior = {it.get("name"): it for it in prior_items if isinstance(it, dict)}
+    for it in items:
+        old = prior.get(it["name"])
+        if not old:
+            continue
+        it["attempts"] = int(old.get("attempts", 0))
+        it["fallbacks"] = int(old.get("fallbacks", 0))
+        it["done"] = bool(old.get("done", False))
+        it["results"] = list(old.get("results", []))
+    return items
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--seconds", type=float, default=10.0, help="bench window")
+    p.add_argument(
+        "--fresh",
+        action="store_true",
+        help="ignore an existing HW_CAMPAIGN.json instead of resuming it",
+    )
     args = p.parse_args(argv)
     py = sys.executable
 
     items = build_items(args.seconds)
+    started = time.strftime("%Y-%m-%d %H:%M:%S")
+    liveness_checks = liveness_up = 0
+    if not args.fresh:
+        try:
+            with open(OUT) as f:
+                prior = json.load(f)
+            items = resume_items(items, prior.get("items", []))
+            started = prior.get("started_at", started)
+            liveness_checks = int(prior.get("liveness_checks", 0))
+            liveness_up = int(prior.get("liveness_up", 0))
+        except (OSError, ValueError):
+            pass
     state = {
-        "started_at": time.strftime("%Y-%m-%d %H:%M:%S"),
-        "liveness_checks": 0,
-        "liveness_up": 0,
+        "started_at": started,
+        "liveness_checks": liveness_checks,
+        "liveness_up": liveness_up,
         "items": items,
     }
 
