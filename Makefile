@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web test test_fast bench native clean
+.PHONY: run run_with_scraper run_scraper web test test_fast presnapshot bench native clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -39,6 +39,14 @@ test_fast:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_fixedpoint.py tests/test_sort.py \
 	tests/test_consensus_kernel.py tests/test_state.py tests/test_apps.py -q
+
+# End-of-round gate: the driver-contract guards FIRST (fast, loud —
+# round 4 shipped a red test_graft_entry pinning a stale dryrun section
+# list), then the full hermetic suite.  Run before EVERY snapshot.
+presnapshot:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/test_graft_entry.py tests/test_bench.py -q
+	$(MAKE) test
 
 # One-line JSON throughput benchmark (flagship; --config N for others).
 bench:

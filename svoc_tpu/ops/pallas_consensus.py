@@ -37,56 +37,78 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from svoc_tpu.consensus.kernel import ConsensusConfig
 
 
-#: Column-block width for the rank computation.  Each unrolled body
-#: touches an [N, _RANK_BLOCK] tile, so VMEM working set stays O(N·B)
-#: — the round-1 version materialized the full [N, N] comparison matrix
-#: and took ~1 min to compile at N=128, capping the kernel below fleet
-#: scale.  The unroll emits N/B bodies per rank call, so compiled code
-#: size is O(N²/B) per call site; :data:`PALLAS_MAX_ORACLES` caps N.
+#: Column-block width for the rank computation.  Each loop body touches
+#: an [N, _RANK_BLOCK] tile, so VMEM working set stays O(N·B) — the
+#: round-1 version materialized the full [N, N] comparison matrix and
+#: took ~1 min to compile at N=128, capping the kernel below fleet
+#: scale.
 _RANK_BLOCK = 128
 
 
-def _stable_rank_2d(key_col: jnp.ndarray) -> jnp.ndarray:
+def _rank_body(key_col, idx, kj, jdx, acc, ones):
+    """One [N, B] comparison block reduced to partial rank counts.
+
+    HIGHEST precision: the TPU MXU otherwise rounds inputs to bf16,
+    corrupting both the integer counts and downstream selections."""
+    before = ((kj < key_col) | ((kj == key_col) & (jdx > idx))).astype(
+        jnp.float32
+    )  # [N, B]
+    return acc + jax.lax.dot_general(
+        before,
+        ones,
+        (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _stable_rank_2d(key_col: jnp.ndarray, keyrow_scr=None) -> jnp.ndarray:
     """Rank of each element of ``key_col [N, 1]`` in the Cairo order
     (ascending value, ties by descending index).  Returns ``[N, 1]`` f32
     (exact integers — N ≪ 2²⁴).
 
-    The [N, N] comparison matrix is never materialized: a statically
-    unrolled loop walks [N, B] column blocks, reducing each block to
+    The [N, N] comparison matrix is never materialized: a
+    ``fori_loop`` walks [N, B] column blocks, reducing each block to
     partial counts with an MXU matmul against ones (work O(N²), VMEM
-    O(N·B)).  The unroll is static Python slicing because Mosaic cannot
-    lower ``dynamic_slice`` on *values* (only on refs) — N/B bodies
-    (8 at the flagship N=1024) keep compile time bounded.  Matmul keeps
-    runtime far below the equivalent VPU multi-reductions."""
+    O(N·B)).  Mosaic cannot lower ``dynamic_slice`` on *values* (only
+    on refs), so the key vector is staged lane-major through the
+    ``keyrow_scr [1, N]`` VMEM scratch and each block is a dynamic
+    ``pl.load`` from it.  Round 4 measured the cost of getting this
+    wrong: the then-static N/B-body unroll (~104 bodies across the
+    kernel's 13 rank calls at the flagship N=1024) hung Mosaic's
+    compile for >420 s on real hardware (``HW_QUEUE_RESULTS.json``
+    consensus1024); the loop emits ONE body per rank call regardless
+    of N, making compiled code size O(1) in fleet size.  ``n <= B``
+    fleets skip the scratch entirely (single inline body)."""
     n = key_col.shape[0]
     block = min(n, _RANK_BLOCK)
     assert n % block == 0, f"fleet size {n} must be a multiple of {block}"
     idx = jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)  # row index i
-    key_row = key_col.reshape(1, n)  # lane-major for block slicing
     ones = jnp.ones((block, 1), jnp.float32)
 
-    acc = jnp.zeros((n, 1), jnp.float32)
-    for b in range(n // block):
+    if n == block:  # small fleet: one static body, no scratch needed
+        kj = key_col.reshape(1, n)
+        jdx = jax.lax.broadcasted_iota(jnp.int32, (n, block), 1)
+        acc = _rank_body(key_col, idx, kj, jdx, jnp.zeros((n, 1), jnp.float32), ones)
+        return jnp.round(acc)
+
+    assert keyrow_scr is not None, "fleet-scale rank needs the row scratch"
+    keyrow_scr[...] = key_col.reshape(1, n)  # lane-major for block loads
+    jdx0 = jax.lax.broadcasted_iota(jnp.int32, (n, block), 1)
+
+    def body(b, acc):
         j0 = b * block
-        kj = key_row[:, j0 : j0 + block]  # [1, B], static slice
-        jdx = jax.lax.broadcasted_iota(jnp.int32, (n, block), 1) + j0
-        before = ((kj < key_col) | ((kj == key_col) & (jdx > idx))).astype(
-            jnp.float32
-        )  # [N, B]
-        # HIGHEST precision: the TPU MXU otherwise rounds inputs to
-        # bf16, corrupting both the integer counts and downstream
-        # selections.
-        acc = acc + jax.lax.dot_general(
-            before,
-            ones,
-            (((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32,
-        )
+        kj = keyrow_scr[:, pl.dslice(j0, block)]  # [1, B] dynamic ref load
+        return _rank_body(key_col, idx, kj, jdx0 + j0, acc, ones)
+
+    acc = jax.lax.fori_loop(
+        0, n // block, body, jnp.zeros((n, 1), jnp.float32)
+    )
     return jnp.round(acc)
 
 
@@ -102,11 +124,11 @@ def _value_at_rank(col, ranks, r: int):
     )
 
 
-def _column_smooth_median(col, mask_col, m: int):
+def _column_smooth_median(col, mask_col, m: int, keyrow_scr):
     """Cairo smooth median of the ``m`` unmasked entries: mean of ranks
     m//2-1 and m//2 (``math.cairo:113-126`` degenerate branch).  [1,1]."""
     key = col if mask_col is None else jnp.where(mask_col, col, jnp.inf)
-    ranks = _stable_rank_2d(key)
+    ranks = _stable_rank_2d(key, keyrow_scr)
     a = _value_at_rank(col, ranks, m // 2 - 1)
     b = _value_at_rank(col, ranks, m // 2)
     return (a + b) * 0.5
@@ -120,6 +142,7 @@ def _consensus_kernel(
     mask_ref,
     qr_ref,
     moments_ref,
+    keyrow_scr,
     *,
     cfg: ConsensusConfig,
     n: int,
@@ -130,7 +153,7 @@ def _consensus_kernel(
 
     # ---- FIRST PASS ----
     essence1 = jnp.concatenate(
-        [_column_smooth_median(c, None, n) for c in cols], axis=1
+        [_column_smooth_median(c, None, n, keyrow_scr) for c in cols], axis=1
     )  # [1, M]
     diff = v - essence1
     qr = jnp.sum(diff * diff, axis=1, keepdims=True)  # [N, 1]
@@ -144,14 +167,15 @@ def _consensus_kernel(
     rel1 = reliability(jnp.sum(qr, axis=0, keepdims=True) / n)
 
     # Worst n_failing by risk → unreliable (contract.cairo:345-363).
-    risk_rank = _stable_rank_2d(qr)
+    risk_rank = _stable_rank_2d(qr, keyrow_scr)
     reliable = risk_rank < (n - cfg.n_failing)  # [N, 1] bool
 
     # ---- SECOND PASS (m = n - n_failing is static) ----
     m = n - cfg.n_failing
     if cfg.constrained:
         essence2 = jnp.concatenate(
-            [_column_smooth_median(c, reliable, m) for c in cols], axis=1
+            [_column_smooth_median(c, reliable, m, keyrow_scr) for c in cols],
+            axis=1,
         )
     else:
         w = reliable.astype(jnp.float32)
@@ -193,12 +217,13 @@ class FusedConsensusOutput(NamedTuple):
 
 
 #: Largest fleet the Pallas kernel compiles for, overridable via
-#: ``SVOC_PALLAS_MAX_ORACLES``.  The statically unrolled rank
-#: computation emits N/_RANK_BLOCK bodies per rank call (8 at the
-#: flagship N=1024), and the kernel makes ~2·M+1 rank calls — compiled
-#: code grows quadratically in N, so raising the cap raises Mosaic
-#: compile time accordingly; above the cap :func:`fused_consensus`
-#: transparently runs the XLA graph with identical semantics.
+#: ``SVOC_PALLAS_MAX_ORACLES``.  Since the round-5 rework the rank
+#: computation is a ``fori_loop`` (ONE compiled body per rank call
+#: regardless of N — see :func:`_stable_rank_2d`), so compiled code
+#: size no longer grows with fleet size; the cap now only bounds the
+#: [1, N] scratch row and the O(N²) runtime of rank counting.  Above
+#: the cap :func:`fused_consensus` transparently runs the XLA graph
+#: with identical semantics.
 PALLAS_MAX_ORACLES = int(os.environ.get("SVOC_PALLAS_MAX_ORACLES", "1024"))
 
 
@@ -248,6 +273,10 @@ def fused_consensus(
             jax.ShapeDtypeStruct((n, 1), jnp.float32),
             jax.ShapeDtypeStruct((2, dim), jnp.float32),
         ),
+        # Lane-major staging buffer for the fleet-scale rank loop's
+        # dynamic block loads (see _stable_rank_2d); reused by every
+        # rank call in the kernel.
+        scratch_shapes=[pltpu.VMEM((1, n), jnp.float32)],
         interpret=interpret,
     )(values)
     return FusedConsensusOutput(

@@ -114,6 +114,75 @@ def test_flagship_routes_packed_variant():
     assert result["detail"]["attention"] == "dense"
 
 
+def test_campaign_replay_prefers_routed_tpu_capture(tmp_path, monkeypatch):
+    """A CPU *fallback* at snapshot time must replay the campaign's
+    last on-TPU capture for the config (round-4 BENCH_r04 postmortem):
+    config 0 prefers the routed capture, non-TPU/failed results are
+    skipped, and provenance is stamped."""
+    import bench
+
+    journal = tmp_path / "HW_CAMPAIGN.json"
+    monkeypatch.setattr(bench, "HW_CAMPAIGN_PATH", str(journal))
+    monkeypatch.delenv("SVOC_BENCH_NO_REPLAY", raising=False)
+
+    def capture(value, backend="tpu", rc=0, at="2026-07-31 02:30:00", **detail):
+        return {
+            "rc": rc,
+            "captured_at": at,
+            "result": {
+                "metric": "m",
+                "value": value,
+                "unit": "comments/sec",
+                "vs_baseline": value / 6.0,
+                "detail": {"backend": backend, **detail},
+            },
+        }
+
+    # no journal -> no replay
+    assert bench.campaign_replay(0, "probe timed out") is None
+
+    journal.write_text(json.dumps({
+        "updated_at": "2026-07-31 04:00:00",
+        "items": [
+            {"name": "bench_config0", "done": True,
+             "results": [capture(4515.7)]},
+            {"name": "bench_config0_routed", "done": True,
+             "results": [capture(111.0, backend="cpu"),  # skipped
+                         capture(9582.95),
+                         # a recycled replay and malformed entries must
+                         # be skipped, never re-replayed or crash
+                         capture(8000.0, replayed_from="HW_CAMPAIGN.json"),
+                         "not-a-dict"]},
+            {"name": "bench_config10", "done": False,    # not done
+             "results": [capture(11471.0)]},
+            {"name": "bench_config11", "done": True, "results": None},
+        ],
+    }))
+    out = bench.campaign_replay(0, "probe timed out")
+    assert out["value"] == 9582.95
+    assert out["detail"]["replayed_from"] == "HW_CAMPAIGN.json"
+    assert out["detail"]["replay_item"] == "bench_config0_routed"
+    assert out["detail"]["replay_captured_at"] == "2026-07-31 02:30:00"
+    assert out["detail"]["fresh_probe_failure"] == "probe timed out"
+    # a pre-captured_at-era capture must NOT inherit the journal's
+    # liveness-poll updated_at as its provenance (code-review r5)
+    journal.write_text(json.dumps({
+        "updated_at": "2026-07-31 05:31:43",
+        "items": [{"name": "bench_config0", "done": True,
+                   "results": [{"rc": 0, "result": {
+                       "metric": "m", "value": 4515.7, "unit": "c/s",
+                       "vs_baseline": 1, "detail": {"backend": "tpu"}}}]}],
+    }))
+    legacy = bench.campaign_replay(0, "x")
+    assert legacy["value"] == 4515.7
+    assert "replay_captured_at" not in legacy["detail"]
+    # config with only a not-done item -> no replay
+    assert bench.campaign_replay(10, "x") is None
+    # kill switch
+    monkeypatch.setenv("SVOC_BENCH_NO_REPLAY", "1")
+    assert bench.campaign_replay(0, "x") is None
+
+
 def test_soak_recovered_reads_snapshot_series():
     """Recovery = a commit SUCCEEDED after the last panic; commit
     attempts and dedup'd console lines must not fool it (code-review

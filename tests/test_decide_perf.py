@@ -137,6 +137,126 @@ def test_pallas_routes_only_on_clean_win():
     assert decide_perf.decide(hung)[1]["consensus_impl"]["hang_info"] is not None
 
 
+def test_flash_diverged_verdict_excludes_packed_flash():
+    """An on-TPU 'diverged' parity verdict routes the flagship back to
+    the best non-flash variant (VERDICT r4 item 2); rounding-equivalent
+    and unmeasured keep it eligible."""
+    results = {
+        "bench_config0": tpu_result(4500.0),
+        "bench_config8": tpu_result(9200.0),
+        "bench_config12": tpu_result(9600.0),
+    }
+    d_div, e_div = decide_perf.decide(dict(results), "diverged")
+    assert d_div["flagship_variant"] == "packed"
+    assert d_div["flash_numerics"] == "diverged"
+    assert e_div["flash_numerics"]["packed_flash_eligible"] is False
+    d_ok, _ = decide_perf.decide(dict(results), "rounding-equivalent")
+    assert d_ok["flagship_variant"] == "packed_flash"
+    d_none, e_none = decide_perf.decide(dict(results), None)
+    assert d_none["flagship_variant"] == "packed_flash"
+    assert "flash_numerics" not in d_none and "flash_numerics" not in e_none
+
+
+def test_load_flash_verdict_requires_tpu_platform(tmp_path):
+    path = tmp_path / "FLASH_PARITY.json"
+    assert decide_perf.load_flash_verdict(str(tmp_path)) is None
+    path.write_text(json.dumps({"platform": "cpu", "verdict": "diverged"}))
+    assert decide_perf.load_flash_verdict(str(tmp_path)) is None
+    path.write_text(json.dumps({"platform": "tpu", "verdict": "rounding-equivalent"}))
+    assert decide_perf.load_flash_verdict(str(tmp_path)) == "rounding-equivalent"
+    path.write_text("{corrupt")
+    assert decide_perf.load_flash_verdict(str(tmp_path)) is None
+
+
+def test_config6_hang_walkover_records_xla(tmp_path):
+    """With no clean config-6 measurement but a recorded on-HW timeout,
+    consensus_impl is decided 'xla' by walkover instead of staying
+    pending (VERDICT r4 item 3)."""
+    hang = {"item": "consensus1024", "source": "HW_QUEUE_RESULTS.json",
+            "timeout_after_s": 420.1}
+    decisions, evidence = decide_perf.decide(
+        {"bench_config8": tpu_result(9000.0)}, None, hang
+    )
+    assert decisions["consensus_impl"] == "xla"
+    assert evidence["consensus_impl"]["walkover"]
+    # a clean config-6 result takes precedence over the hang evidence
+    clean = {
+        "bench_config6": tpu_result(0.3, {
+            "pallas_kernel_active": True, "pallas_hung": False,
+            "pallas_info": {"essence_match_xla": True},
+            "pallas_vs_xla_speedup": 1.4, "n_oracles": 1024,
+        })
+    }
+    decisions2, _ = decide_perf.decide(clean, None, hang)
+    assert decisions2["consensus_impl"] == "pallas"
+
+
+def test_config6_hang_evidence_requires_stage_level_records(tmp_path):
+    """A whole-script timeout (dead tunnel) is NOT hang evidence; a
+    consensus probe line with timeout:true (embedded stdout_tail or a
+    TPU_PROBE.json entry) or a bench_config6 hard timeout is."""
+    path = tmp_path / "HW_QUEUE_RESULTS.json"
+    assert decide_perf.config6_hang_evidence([str(path)]) is None
+    # whole-script tpu_probe timeout, no stage records: proves nothing
+    path.write_text(json.dumps({"items": [
+        {"name": "tpu_probe", "rc": "timeout", "seconds": 900.1,
+         "stdout_tail": []},
+        {"name": "bench_config0", "results": [{"rc": "timeout", "seconds": 5}]},
+    ]}))
+    assert decide_perf.config6_hang_evidence([str(path)]) is None
+    # the round-4 shape: consensus1024 stage record inside stdout_tail,
+    # neighbors alive around it
+    path.write_text(json.dumps({"items": [
+        {"name": "tpu_probe", "rc": "timeout", "seconds": 900.1,
+         "stdout_tail": [
+             '{"probe": "grid_copy", "ok": true}',
+             '{"probe": "consensus1024", "ok": false, "timeout": true, "elapsed_s": 420.1}',
+             "not json at all",
+         ]},
+    ]}))
+    ev = decide_perf.config6_hang_evidence([str(path)])
+    assert ev["item"] == "consensus1024" and ev["timeout_after_s"] == 420.1
+    # TPU_PROBE.json shape: a top-level list of probe entries
+    probe_path = tmp_path / "TPU_PROBE.json"
+    probe_path.write_text(json.dumps([
+        {"probe": "backend", "ok": True},
+        {"probe": "consensus512", "ok": False, "timeout": True, "elapsed_s": 300.0},
+    ]))
+    ev2 = decide_perf.config6_hang_evidence([str(probe_path)])
+    assert ev2["item"] == "consensus512"
+    # bench_config6's own hard timeout qualifies (its dead-tunnel mode
+    # is cpu-fallback, not timeout)
+    path.write_text(json.dumps({"items": [
+        {"name": "bench_config6", "results": [
+            {"rc": "cpu-fallback", "seconds": 250.0},
+            {"rc": "timeout", "seconds": 1810.0},
+        ]},
+    ]}))
+    ev3 = decide_perf.config6_hang_evidence([str(path)])
+    assert ev3["item"] == "bench_config6"
+
+
+def test_replayed_lines_never_qualify_as_measurements(tmp_path):
+    """A campaign_replay line recycled into a journal must not feed the
+    routing as a fresh capture (code-review r5)."""
+    replay = tpu_result(9582.95, {"replayed_from": "HW_CAMPAIGN.json"})
+    paths = write(tmp_path, campaign([("bench_config12", replay)]))
+    assert decide_perf.latest_tpu_results(paths) == {}
+
+
+def test_iter_result_entries_tolerates_malformed_journals(tmp_path):
+    path = tmp_path / "J.json"
+    path.write_text(json.dumps({"items": [
+        "not-a-dict",
+        {"name": "a", "results": None},
+        {"name": "b", "results": ["oops", {"rc": 0, "result": {"v": 1}}]},
+        {"probe": "flat", "ok": True},
+    ]}))
+    entries = list(decide_perf.iter_result_entries([str(path)]))
+    names = [n for _, n, _ in entries]
+    assert names == ["a", "b", "flat"]
+
+
 def test_main_exit_3_without_measurements(tmp_path, monkeypatch, capsys):
     monkeypatch.setattr(decide_perf, "REPO", str(tmp_path))
     monkeypatch.setattr(decide_perf, "OUT", str(tmp_path / "PERF_DECISIONS.json"))
@@ -154,6 +274,70 @@ def test_main_writes_record(tmp_path, monkeypatch):
     record = json.loads((tmp_path / "PERF_DECISIONS.json").read_text())
     assert record["flagship_variant"] == "packed"
     assert "evidence" in record and "decided_at" in record
+
+
+def test_main_merges_prior_record(tmp_path, monkeypatch):
+    """A run that re-derives only a subset of decisions must not drop a
+    previously committed flagship_variant (code-review r5)."""
+    out = tmp_path / "PERF_DECISIONS.json"
+    out.write_text(json.dumps({
+        "flagship_variant": "packed_flash",
+        "evidence": {"flagship_variant": {"packed_flash": {"comments_per_sec": 9582.95}}},
+    }))
+    # only hang evidence survives: no flagship measurements at all
+    (tmp_path / "TPU_PROBE.json").write_text(json.dumps([
+        {"probe": "consensus1024", "ok": False, "timeout": True, "elapsed_s": 420.1},
+    ]))
+    monkeypatch.setattr(decide_perf, "REPO", str(tmp_path))
+    monkeypatch.setattr(decide_perf, "OUT", str(out))
+    assert decide_perf.main([]) == 0
+    record = json.loads(out.read_text())
+    assert record["consensus_impl"] == "xla"  # newly decided
+    assert record["flagship_variant"] == "packed_flash"  # preserved
+    assert "flagship_variant" in record["evidence"]  # evidence preserved
+
+
+def test_main_carries_prior_diverged_verdict_without_artifact(
+    tmp_path, monkeypatch
+):
+    """A committed 'diverged' verdict must keep excluding packed_flash
+    on a fresh checkout where FLASH_PARITY.json is absent (code-review
+    r5): the merged record may never route through a kernel it records
+    as diverged."""
+    out = tmp_path / "PERF_DECISIONS.json"
+    out.write_text(json.dumps({
+        "flagship_variant": "packed",
+        "flash_numerics": "diverged",
+        "evidence": {},
+    }))
+    (tmp_path / "HW_CAMPAIGN.json").write_text(json.dumps(campaign([
+        ("bench_config8", tpu_result(9271.0)),
+        ("bench_config12", tpu_result(9583.0)),  # top value, but diverged
+    ])))
+    monkeypatch.setattr(decide_perf, "REPO", str(tmp_path))
+    monkeypatch.setattr(decide_perf, "OUT", str(out))
+    assert decide_perf.main([]) == 0
+    record = json.loads(out.read_text())
+    assert record["flash_numerics"] == "diverged"
+    assert record["flagship_variant"] == "packed"
+
+
+def test_run_item_labels_replay_as_cpu_fallback(tmp_path):
+    """hw_queue must not record a campaign-replay line as a fresh
+    hardware capture (code-review r5)."""
+    import sys
+
+    import hw_queue
+
+    line = json.dumps({
+        "metric": "m", "value": 9582.95, "unit": "c/s", "vs_baseline": 1,
+        "detail": {"backend": "tpu", "replayed_from": "HW_CAMPAIGN.json"},
+    })
+    out = hw_queue.run_item(
+        "bench_config0", [sys.executable, "-c", f"print({line!r})"], 30
+    )
+    assert out["rc"] == "cpu-fallback"
+    assert out["result"]["detail"]["replayed_from"]
 
 
 def test_dry_run_writes_nothing(tmp_path, monkeypatch):

@@ -54,6 +54,7 @@ def test_dryrun_multichip_subprocess_fresh_env():
     sections = re.findall(r"\[dryrun\] ([\w-]+) ok", proc.stdout)
     assert sections == [
         "sharded-train-step",
+        "zero1-train-step",
         "sharded-fleet-consensus",
         "ring-attention",
         "sequence-parallel-forward",

@@ -22,13 +22,21 @@ import hw_queue  # noqa: E402
 import tpu_probe  # noqa: E402
 
 
-def run_campaign(monkeypatch, tmp_path, run_item, alive=lambda py: True):
+def run_campaign(
+    monkeypatch,
+    tmp_path,
+    run_item,
+    alive=lambda py: True,
+    decide=lambda py: (0, "packed_flash"),
+    argv=("--seconds", "1"),
+):
     monkeypatch.setattr(hw_campaign, "run_item", run_item)
     monkeypatch.setattr(hw_campaign, "tunnel_alive", alive)
+    monkeypatch.setattr(hw_campaign, "run_decide_perf", decide)
     monkeypatch.setattr(hw_campaign, "OUT", str(tmp_path / "HW_CAMPAIGN.json"))
     monkeypatch.setattr(hw_campaign, "BUSY_FLAG", str(tmp_path / "busy"))
     monkeypatch.setattr(hw_campaign, "DEAD_SLEEP_S", 0.0)
-    rc = hw_campaign.main(["--seconds", "1"])
+    rc = hw_campaign.main(list(argv))
     state = json.loads((tmp_path / "HW_CAMPAIGN.json").read_text())
     return rc, {i["name"]: i for i in state["items"]}
 
@@ -54,11 +62,19 @@ def test_flagship_runs_first_and_fallbacks_are_refunded(
     assert rc == 0
     assert order[0] == "bench_config0"  # value order: flagship first
     assert order[-2:] == ["tpu_probe", "flash_probe"]  # probes last
-    # the routed flagship re-capture follows the lossless variants but
-    # outranks the remaining configs — it is the headline number
-    assert order.index("bench_config0_routed") == order.index(
-        "bench_config10"
-    ) + 1
+    # decision items ride right after the lossless trio (VERDICT r4
+    # item 6): flash numerics parity, then the pallas-consensus config 6,
+    # then the routed flagship re-capture — the headline number —
+    # before int8 + DP serving.
+    dedup = list(dict.fromkeys(order))
+    assert dedup[1:7] == [
+        "bench_config8",
+        "bench_config12",
+        "flash_parity",
+        "bench_config6",
+        "bench_config0_routed",
+        "bench_config10",
+    ]
     flagship = items["bench_config0"]
     assert flagship["done"]
     assert flagship["attempts"] == 1  # both fallbacks refunded
@@ -178,8 +194,73 @@ def test_resume_keeps_captured_results(monkeypatch, tmp_path):
 
     monkeypatch.setattr(hw_campaign, "run_item", fresh)
     monkeypatch.setattr(hw_campaign, "tunnel_alive", lambda py: True)
+    monkeypatch.setattr(hw_campaign, "run_decide_perf", lambda py: (0, None))
     assert hw_campaign.main(["--seconds", "1", "--fresh"]) == 0
     assert "bench_config0" in third_ran
+
+
+def test_resume_refunds_in_flight_attempt_and_keeps_done_cmd():
+    """ADVICE r4: (a) a kill mid-item burned an attempt with no recorded
+    result — resume refunds it; (b) a done item resumed under a
+    different --seconds keeps the cmd/timeout that produced its
+    results."""
+    items = hw_campaign.build_items(20.0)
+    prior = [
+        # killed mid-item twice: 2 attempts, 1 recorded failure result
+        {"name": "bench_config8", "attempts": 2, "fallbacks": 0,
+         "done": False, "results": [{"rc": "timeout"}]},
+        # done under the old 10 s window
+        {"name": "bench_config0", "attempts": 1, "fallbacks": 0,
+         "done": True, "cmd": hw_queue.bench_cmd(0, 10.0),
+         "timeout": 10.0 + hw_queue.BENCH_TIMEOUT_MARGIN_S,
+         "results": [{"rc": 0, "result": {"value": 4515.7}}]},
+        # null counters must not crash the merge
+        {"name": "bench_config12", "attempts": None, "fallbacks": None,
+         "done": False, "results": []},
+    ]
+    merged = {i["name"]: i for i in hw_campaign.resume_items(items, prior)}
+    assert merged["bench_config8"]["attempts"] == 1  # in-flight refunded
+    assert merged["bench_config0"]["cmd"] == hw_queue.bench_cmd(0, 10.0)
+    assert merged["bench_config0"]["timeout"] == 10.0 + hw_queue.BENCH_TIMEOUT_MARGIN_S
+    assert merged["bench_config12"]["attempts"] == 0
+    # not-done items DO get the new window
+    assert merged["bench_config8"]["cmd"] == hw_queue.bench_cmd(8, 20.0)
+
+
+def test_corrupt_journal_starts_fresh(monkeypatch, tmp_path):
+    """A journal whose top level is a list, or whose counters are null,
+    must start fresh instead of crashing main (ADVICE r4)."""
+    ran = []
+
+    def fake(name, cmd, timeout):
+        ran.append(name)
+        return ok()
+
+    for corrupt in ("[1, 2]", '{"items": null, "liveness_checks": null}',
+                    '{"items": [["not", "a", "dict"]]}'):
+        (tmp_path / "HW_CAMPAIGN.json").write_text(corrupt)
+        ran.clear()
+        rc, items = run_campaign(monkeypatch, tmp_path, fake)
+        assert rc == 0, corrupt
+        assert "bench_config0" in ran, corrupt
+
+
+def test_routed_item_refreshes_decide_perf(monkeypatch, tmp_path):
+    """The campaign derives the routing right before the routed
+    flagship capture and records the resolved variant (ADVICE r4)."""
+    decided = []
+
+    def decide(py):
+        decided.append("called")
+        return 0, "packed"
+
+    rc, items = run_campaign(monkeypatch, tmp_path, lambda n, c, t: ok(),
+                             decide=decide)
+    assert rc == 0
+    assert decided == ["called"]  # exactly once, for the routed item
+    routed = items["bench_config0_routed"]
+    assert routed["decide_perf_rc"] == 0
+    assert routed["decided_variant"] == "packed"
 
 
 def test_probe_bisect_stops_at_first_hang(monkeypatch, tmp_path):

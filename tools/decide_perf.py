@@ -52,30 +52,121 @@ def is_tpu_result(result: dict) -> bool:
     )
 
 
-def latest_tpu_results(paths) -> dict:
-    """``{item_name: result}`` — last qualifying TPU result per item
-    across the given artifacts (later files win)."""
-    found = {}
+def iter_result_entries(paths):
+    """Yield ``(path, item_name, res_dict)`` for every result entry in
+    the given queue/campaign artifacts, tolerating both journal shapes
+    (items with a ``results`` list vs flat one-shot items) and skipping
+    malformed entries instead of crashing — the single journal-walking
+    loop shared by every evidence scan in this module."""
     for path in paths:
         try:
             with open(path) as f:
                 data = json.load(f)
         except (OSError, ValueError):
             continue
-        for item in data.get("items", []):
-            name = item.get("name", "")
-            for res in item.get("results", [item]):
-                result = res.get("result")
-                # Only CLEAN attempts qualify: a bench that printed its
-                # result line but exited nonzero (teardown crash, MFU
-                # hard-fail) was rejected by the queue itself and must
-                # not drive the committed routing.
-                if res.get("rc") == 0 and result and is_tpu_result(result):
-                    found[name] = result
+        items = data.get("items") if isinstance(data, dict) else data
+        for item in items or []:
+            if not isinstance(item, dict):
+                continue
+            name = item.get("name", item.get("probe", ""))
+            results = item.get("results")
+            for res in results if isinstance(results, list) else [item]:
+                if isinstance(res, dict):
+                    yield path, name, res
+
+
+def latest_tpu_results(paths) -> dict:
+    """``{item_name: result}`` — last qualifying TPU result per item
+    across the given artifacts (later files win)."""
+    found = {}
+    for _path, name, res in iter_result_entries(paths):
+        result = res.get("result")
+        # Only CLEAN attempts qualify: a bench that printed its result
+        # line but exited nonzero (teardown crash, MFU hard-fail) was
+        # rejected by the queue itself, and a campaign_replay line is
+        # recycled data, not a capture — neither may drive the
+        # committed routing.
+        if (
+            res.get("rc") == 0
+            and isinstance(result, dict)
+            and is_tpu_result(result)
+            and not result.get("detail", {}).get("replayed_from")
+        ):
+            found[name] = result
     return found
 
 
-def decide(results: dict) -> tuple:
+def config6_hang_evidence(paths):
+    """Evidence that the pallas-consensus KERNEL itself wedged on real
+    hardware.  Returns the evidence dict or None.
+
+    A whole-script timeout proves nothing — the tunnel may simply have
+    died (``hw_queue.run_item``'s own docs say the partial stdout is
+    the only way to tell those apart).  So this accepts only
+    STAGE-LEVEL records: a ``consensus*`` probe line with
+    ``timeout: true`` (from ``TPU_PROBE.json`` or embedded in an
+    item's ``stdout_tail``, where neighboring probe lines prove the
+    tunnel was alive around the hang), or a hard timeout of
+    ``bench_config6`` itself (whose dead-tunnel mode is the distinct
+    ``cpu-fallback`` rc, not a timeout).
+
+    This is the VERDICT r2/r4 walkover rule made durable: a kernel
+    whose decision measurement cannot complete on the chip loses to
+    XLA by walkover, and the decision gets RECORDED instead of staying
+    "pending" for another round (the round-4 journal held a >420 s
+    Mosaic compile hang but PERF_DECISIONS.json carried no
+    consensus_impl key at all)."""
+
+    def probe_hang(entry, source):
+        if (
+            isinstance(entry, dict)
+            and str(entry.get("probe", "")).startswith("consensus")
+            and entry.get("timeout")
+        ):
+            return {
+                "item": entry["probe"],
+                "source": source,
+                "timeout_after_s": entry.get("elapsed_s"),
+            }
+        return None
+
+    for path, name, res in iter_result_entries(paths):
+        source = os.path.basename(path)
+        hit = probe_hang(res, source)
+        if hit:
+            return hit
+        for line in res.get("stdout_tail") or []:
+            try:
+                hit = probe_hang(json.loads(line), f"{source}:{name}")
+            except (ValueError, TypeError):
+                hit = None
+            if hit:
+                return hit
+        if name == "bench_config6" and res.get("rc") == "timeout":
+            return {
+                "item": name,
+                "source": source,
+                "timeout_after_s": res.get("seconds"),
+            }
+    return None
+
+
+def load_flash_verdict(repo: str):
+    """The on-TPU flash numerics verdict from FLASH_PARITY.json
+    (``tools/flash_probe.py --parity-only``), or None when unmeasured.
+    Only a verdict captured on the real chip counts — the interpret-mode
+    CPU run cannot see Mosaic-specific numerics."""
+    try:
+        with open(os.path.join(repo, "FLASH_PARITY.json")) as f:
+            parity = json.load(f)
+        if isinstance(parity, dict) and parity.get("platform") == "tpu":
+            return parity.get("verdict")
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def decide(results: dict, flash_verdict=None, c6_hang=None) -> tuple:
     """``(decisions, evidence)`` from qualifying TPU results only."""
     decisions = {}
     evidence = {}
@@ -97,6 +188,17 @@ def decide(results: dict) -> tuple:
                 or flagship[routed]["value"] < moved["value"]
             ):
                 flagship[routed] = moved
+    # Flash on-HW numerics adjudication (VERDICT r4 item 2): the
+    # flagship must not route through packed_flash while its only
+    # on-silicon parity signal says "diverged".  "rounding-equivalent"
+    # keeps packed_flash eligible, "diverged" excludes it, None =
+    # unmeasured (eligible — the interpret-mode CPU tests remain the
+    # only parity evidence).
+    if flash_verdict:
+        decisions["flash_numerics"] = flash_verdict
+        if flash_verdict != "rounding-equivalent":
+            flagship.pop("packed_flash", None)
+
     if flagship:
         best = max(flagship, key=lambda v: flagship[v]["value"])
         decisions["flagship_variant"] = best
@@ -107,6 +209,11 @@ def decide(results: dict) -> tuple:
             }
             for v in flagship
         }
+        if flash_verdict:
+            evidence["flash_numerics"] = {
+                "source": "FLASH_PARITY.json",
+                "packed_flash_eligible": flash_verdict == "rounding-equivalent",
+            }
 
     c6 = results.get("bench_config6")
     if c6:
@@ -126,6 +233,16 @@ def decide(results: dict) -> tuple:
             "hang_info": detail.get("pallas_info") if detail.get("pallas_hung") else None,
             "n_oracles": detail.get("n_oracles"),
         }
+    elif c6_hang:
+        # No clean measurement, but the measurement itself wedged on the
+        # chip: xla wins by walkover and the decision is RECORDED — a
+        # kernel that cannot complete its own decision bench at fleet
+        # scale is not routable (two rounds of "pending" is enough).
+        decisions["consensus_impl"] = "xla"
+        evidence["consensus_impl"] = {
+            "walkover": "measurement timed out on hardware",
+            **c6_hang,
+        }
 
     return decisions, evidence
 
@@ -135,22 +252,54 @@ def main(argv=None) -> int:
     p.add_argument("--dry-run", action="store_true")
     args = p.parse_args(argv)
 
-    results = latest_tpu_results(
-        [
-            os.path.join(REPO, "HW_QUEUE_RESULTS.json"),
-            os.path.join(REPO, "HW_CAMPAIGN.json"),
-        ]
+    paths = [
+        os.path.join(REPO, "HW_QUEUE_RESULTS.json"),
+        os.path.join(REPO, "HW_CAMPAIGN.json"),
+    ]
+    # MERGE with the committed record: a run that can only re-derive a
+    # subset of the decisions (e.g. queue artifacts were reset and only
+    # the hang evidence survives) must not silently drop a previously
+    # measured flagship_variant back to bench.py's default.
+    prior_decisions, prior_evidence = {}, {}
+    try:
+        with open(OUT) as f:
+            prior = json.load(f)
+        if isinstance(prior, dict):
+            prior_evidence = (
+                prior.get("evidence") if isinstance(prior.get("evidence"), dict) else {}
+            )
+            prior_decisions = {
+                k: v
+                for k, v in prior.items()
+                if k in ("flagship_variant", "consensus_impl", "flash_numerics")
+            }
+    except (OSError, ValueError):
+        pass
+
+    # The committed flash_numerics verdict outlives FLASH_PARITY.json
+    # (the journals feeding the routing are committed, the parity
+    # artifact may not be): without this carry-over, a fresh checkout
+    # would re-route the flagship through packed_flash while the merged
+    # record still says "diverged" — a self-contradictory artifact.
+    flash_verdict = load_flash_verdict(REPO) or prior_decisions.get(
+        "flash_numerics"
     )
-    decisions, evidence = decide(results)
+    results = latest_tpu_results(paths)
+    decisions, evidence = decide(
+        results,
+        flash_verdict,
+        config6_hang_evidence(paths + [os.path.join(REPO, "TPU_PROBE.json")]),
+    )
     if not decisions:
         print("[decide_perf] no qualifying TPU measurements — nothing written")
         return 3
 
     record = {
+        **prior_decisions,
         **decisions,
         "decided_at": time.strftime("%Y-%m-%d %H:%M:%S"),
         "rules": "tools/decide_perf.py (fixed; see module docstring)",
-        "evidence": evidence,
+        "evidence": {**prior_evidence, **evidence},
     }
     print(json.dumps(record, indent=1))
     if not args.dry_run:

@@ -6,7 +6,38 @@ last — see tools/dispatch_probe3.py): ``block_until_ready`` does not
 prove execution on the tunneled backend, so the round-2
 ``TPU_PROBE.json`` flash/dense numbers were meaningless.  Writes
 FLASH_PROBE.json.
+
+``--parity-only`` runs the NUMERICS adjudication alone (VERDICT r4
+item 2) and writes ``FLASH_PARITY.json``.  The round-4 evidence —
+``max_abs_diff == 0.015625`` (= 2^-6) at every probed shape, and the
+``flash512 match_dense: false`` at a naive atol of 2e-3 — is exactly
+the signature of bf16 OUTPUT rounding, not a kernel bug:
+
+- both kernels accumulate in f32 on the MXU
+  (``pallas_attention.py``: every dot has
+  ``preferred_element_type=f32``; the XLA dense path accumulates bf16
+  dots in f32) and cast the final output to bf16, so each is a
+  faithful-rounding of the true f32 result to within O(eps_bf16) of
+  the output scale, where eps_bf16 = 2^-8 (7 mantissa bits);
+- the DENSE reference additionally rounds the softmax probabilities to
+  bf16 before the PV matmul (``ring_attention.py:71``,
+  ``p.astype(v.dtype)``) — the flash kernel keeps P in f32
+  (``pallas_attention.py:114``), so where they differ, flash is the
+  MORE accurate of the two;
+- a flash-vs-dense diff of 1-2 ulp at output magnitude ~2 (ulp = 2^-6
+  on [2,4)) is therefore EXPECTED; asserting atol 2e-3 < 1 ulp between
+  two independently-rounded bf16 results was a tolerance bug in the
+  probe, not a numerics failure in the kernel.
+
+The adjudication therefore compares BOTH bf16 kernels against an
+f32-truth dense attention and passes iff flash's error stays within
+the dtype-aware bound ``BOUND_ULPS x eps_bf16 x max|truth|`` and is no
+worse than the dense path's own error (modulo one rounding).  CPU
+tests run the kernel in f32 interpret mode and cannot see
+Mosaic-specific numerics — this probe is the on-silicon check, queued
+as the campaign's ``flash_parity`` decision item.
 """
+import argparse
 import json
 import os
 import sys
@@ -27,6 +58,113 @@ def amortized_ms(step, n=16):
         h = step(i + 1)
     float(np.asarray(jnp.sum(h)))
     return (time.perf_counter() - t0) / n * 1e3
+
+
+EPS_BF16 = 2.0 ** -8  # 7 explicit mantissa bits -> rounding unit 2^-8
+# Headroom over a single final-cast rounding: the f32 accumulation
+# order differs between the two kernels (blocked online softmax vs one
+# monolithic softmax), contributing a few more ulps of f32-level noise
+# scaled up to the bf16 grid by the final cast.
+BOUND_ULPS = 4.0
+
+
+def parity_only():
+    """Dtype-aware on-HW numerics adjudication -> FLASH_PARITY.json."""
+    import numpy as np
+
+    from svoc_tpu.ops.pallas_attention import flash_attention
+    from svoc_tpu.parallel.ring_attention import dense_attention_reference
+
+    # The axon sitecustomize pins the TPU plugin regardless of env
+    # vars; honor an explicit CPU request BEFORE the first device probe
+    # or a dead tunnel hangs this process (verify-skill gotcha).
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+    if platform != "tpu":
+        # The adjudication only means anything on Mosaic — an interpret
+        # -mode verdict would mark the campaign item done while
+        # decide_perf ignores the artifact (platform gate).  Emit the
+        # bench-shaped fallback line so hw_queue demotes this run to
+        # "cpu-fallback" (attempt refunded, item retried on the next
+        # alive window) and write no artifact.
+        print(json.dumps({
+            "metric": "flash numerics parity (on-HW adjudication)",
+            "value": None,
+            "unit": "verdict",
+            "vs_baseline": None,
+            "detail": {
+                "backend": platform,
+                "backend_fallback": "parity adjudication requires the real chip",
+            },
+        }), flush=True)
+        return 0
+    entries = []
+    h, d = 12, 64
+    for b, t in ((256, 128), (8, 512)):
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(jax.random.fold_in(key, 7), (b, t, h, d), jnp.bfloat16)
+        mask = jnp.ones((b, t), jnp.int32)
+        truth = np.asarray(
+            dense_attention_reference(
+                q.astype(jnp.float32),
+                q.astype(jnp.float32),
+                q.astype(jnp.float32),
+                mask,
+            )
+        )
+        dense_bf16 = np.asarray(
+            jax.jit(lambda x: dense_attention_reference(x, x, x, mask))(q)
+        ).astype(np.float32)
+        flash_bf16 = np.asarray(
+            jax.jit(
+                lambda x: flash_attention(x, x, x, mask, block_q=256, block_k=256)
+            )(q)
+        ).astype(np.float32)
+        scale = float(np.max(np.abs(truth)))
+        bound = BOUND_ULPS * EPS_BF16 * scale
+        err_flash = float(np.max(np.abs(flash_bf16 - truth)))
+        err_dense = float(np.max(np.abs(dense_bf16 - truth)))
+        flash_vs_dense = float(np.max(np.abs(flash_bf16 - dense_bf16)))
+        ok = err_flash <= bound and err_flash <= 2.0 * err_dense + EPS_BF16 * scale
+        entries.append({
+            "b": b, "t": t, "h": h, "d": d,
+            "out_scale": scale,
+            "bound": bound,
+            "err_flash_vs_f32_truth": err_flash,
+            "err_dense_vs_f32_truth": err_dense,
+            "flash_vs_dense": flash_vs_dense,
+            "flash_within_bound": ok,
+        })
+        print(json.dumps(entries[-1]), flush=True)
+    verdict = {
+        "platform": platform,
+        "eps_bf16": EPS_BF16,
+        "bound_ulps": BOUND_ULPS,
+        "entries": entries,
+        "verdict": (
+            "rounding-equivalent"
+            if all(e["flash_within_bound"] for e in entries)
+            else "diverged"
+        ),
+        "note": (
+            "flash keeps softmax P in f32 (pallas_attention.py:114); the "
+            "dense reference rounds P to bf16 before PV "
+            "(ring_attention.py:71) — where they differ, flash is the "
+            "more accurate; see module docstring for the full bound"
+        ),
+        "captured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+    }
+    tmp = "FLASH_PARITY.json.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(verdict, fh, indent=1)
+    os.replace(tmp, "FLASH_PARITY.json")
+    print(json.dumps({"verdict": verdict["verdict"]}), flush=True)
+    # A completed adjudication is a SUCCESS whichever way it lands —
+    # "diverged" is a valid decision outcome (it routes the flagship
+    # back to packed×dense via decide_perf), not an item failure for
+    # the campaign to burn retries on.
+    return 0
 
 
 def main():
@@ -108,4 +246,11 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--parity-only",
+        action="store_true",
+        help="numerics adjudication only -> FLASH_PARITY.json",
+    )
+    ns = ap.parse_args()
+    sys.exit(parity_only() if ns.parity_only else (main() or 0))

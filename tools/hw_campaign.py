@@ -74,15 +74,35 @@ def bench_item(cfg: int, seconds: float):
 
 
 def build_items(seconds: float):
-    items = [bench_item(c, seconds) for c in (0, 8, 12, 10, 9, 11, 6)]
+    # Queue order = decision value per alive-minute (VERDICT r4 item 6:
+    # the round-4 tunnel died after four items and the decision
+    # measurements never ran).  Lossless trio first (they feed the
+    # routing), then the two DECISION items (flash numerics parity, the
+    # pallas-consensus config 6), then the routed flagship capture,
+    # then int8 + DP serving, probes last.
+    items = [bench_item(c, seconds) for c in (0, 8, 12)]
+    items.append(
+        # Flash on-HW parity with the dtype-aware bound (VERDICT r4
+        # item 2) — adjudicates packed_flash's match_dense before the
+        # routing that may pick it.
+        {
+            "name": "flash_parity",
+            "cmd": ["tools/flash_probe.py", "--parity-only"],
+            "timeout": 900,
+        }
+    )
+    items.append(bench_item(6, seconds))  # pallas-consensus decision
     # Once the lossless variants are measured, tools/decide_perf.py
     # reroutes the flagship through PERF_DECISIONS.json; capture
     # config 0 again under the committed routing so the headline
     # number reflects the measured-best variant.  Distinct name so the
-    # resume path keeps both the pre- and post-routing captures.
+    # resume path keeps both the pre- and post-routing captures; the
+    # campaign itself runs decide_perf.py right before this item (see
+    # ``main``) so the routing can never be stale.
     routed = bench_item(0, seconds)
     routed["name"] = "bench_config0_routed"
-    items.insert(4, routed)
+    items.append(routed)
+    items += [bench_item(c, seconds) for c in (10, 9, 11)]
     items += [
         # tpu_probe's consensus size-bisect doubles as the compile-hang
         # diagnosis; per-probe cap 300 s keeps one hang from eating the
@@ -100,6 +120,30 @@ def build_items(seconds: float):
     for it in items:
         it.update(attempts=0, fallbacks=0, done=False, results=[])
     return items
+
+
+def run_decide_perf(py: str):
+    """Invoke tools/decide_perf.py and return ``(rc, flagship_variant)``
+    — the routing freshness gate for the ``bench_config0_routed``
+    capture (ADVICE r4: a stale PERF_DECISIONS.json made the routed
+    item silently duplicate the pre-routing dense run)."""
+    try:
+        dec = subprocess.run(
+            [py, "tools/decide_perf.py"],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        rc = dec.returncode
+    except subprocess.TimeoutExpired:
+        rc = "timeout"
+    try:
+        with open(os.path.join(REPO, "PERF_DECISIONS.json")) as f:
+            variant = json.load(f).get("flagship_variant")
+    except (OSError, ValueError, AttributeError):
+        variant = None
+    return rc, variant
 
 
 def tunnel_alive(py: str) -> bool:
@@ -125,16 +169,42 @@ def resume_items(items, prior_items):
     results, attempt/fallback counters, and done flags carry over.
     Items added to ``build_items`` after the prior journal was written
     simply start fresh.
+
+    Two resume invariants (ADVICE r4):
+
+    - the journal flushes ``attempts += 1`` BEFORE ``run_item`` returns,
+      so a kill mid-item leaves an attempt with no recorded result;
+      every counted attempt/fallback appends exactly one result and the
+      bounded trim (MAX_ATTEMPTS + MAX_FALLBACKS) can never drop one
+      while the item is still pending, so the in-flight attempt is
+      exactly ``attempts + fallbacks - len(results)`` — refund it
+      rather than letting three restarts retire an item that never
+      genuinely failed;
+    - a DONE item's results were captured under the prior journal's
+      cmd/timeout; carry those over so the journal keeps describing the
+      command that actually produced the numbers even when the campaign
+      is resumed with a different ``--seconds``.
     """
-    prior = {it.get("name"): it for it in prior_items if isinstance(it, dict)}
+    prior = {
+        it.get("name"): it
+        for it in prior_items
+        if isinstance(it, dict) and it.get("name")
+    }
     for it in items:
         old = prior.get(it["name"])
         if not old:
             continue
-        it["attempts"] = int(old.get("attempts", 0))
-        it["fallbacks"] = int(old.get("fallbacks", 0))
+        it["attempts"] = int(old.get("attempts", 0) or 0)
+        it["fallbacks"] = int(old.get("fallbacks", 0) or 0)
         it["done"] = bool(old.get("done", False))
         it["results"] = list(old.get("results", []))
+        if it["done"]:
+            it["cmd"] = list(old.get("cmd", it["cmd"]))
+            it["timeout"] = old.get("timeout", it["timeout"])
+        else:
+            in_flight = it["attempts"] + it["fallbacks"] - len(it["results"])
+            if in_flight > 0:
+                it["attempts"] = max(0, it["attempts"] - in_flight)
     return items
 
 
@@ -153,14 +223,19 @@ def main(argv=None) -> int:
     started = time.strftime("%Y-%m-%d %H:%M:%S")
     liveness_checks = liveness_up = 0
     if not args.fresh:
+        # Any malformed prior journal (including a JSON-valid non-dict
+        # top level or null counters) starts fresh instead of crashing
+        # the campaign (ADVICE r4).
         try:
             with open(OUT) as f:
                 prior = json.load(f)
-            items = resume_items(items, prior.get("items", []))
-            started = prior.get("started_at", started)
-            liveness_checks = int(prior.get("liveness_checks", 0))
-            liveness_up = int(prior.get("liveness_up", 0))
-        except (OSError, ValueError):
+            if not isinstance(prior, dict):
+                raise ValueError(f"journal top level is {type(prior).__name__}")
+            items = resume_items(items, prior.get("items") or [])
+            started = prior.get("started_at") or started
+            liveness_checks = int(prior.get("liveness_checks") or 0)
+            liveness_up = int(prior.get("liveness_up") or 0)
+        except (OSError, ValueError, TypeError, AttributeError):
             pass
     state = {
         "started_at": started,
@@ -239,6 +314,14 @@ def main(argv=None) -> int:
             continue
         state["liveness_up"] += 1
         item = pending[0]
+        if item["name"] == "bench_config0_routed":
+            # Derive the routing from the measurements just captured —
+            # a missing/stale PERF_DECISIONS.json would make this item
+            # silently duplicate the pre-routing dense run (ADVICE r4).
+            dec_rc, variant = run_decide_perf(py)
+            item["decide_perf_rc"] = dec_rc
+            item["decided_variant"] = variant
+            flush(f"decide_perf rc={dec_rc} -> flagship_variant={variant}")
         item["attempts"] += 1
         flush(f"tunnel up — running {item['name']} (attempt {item['attempts']})")
         try:

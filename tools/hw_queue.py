@@ -70,6 +70,7 @@ def run_item(name: str, cmd, timeout_s: float):
             "name": name,
             "rc": proc.returncode,
             "seconds": round(time.time() - t0, 1),
+            "captured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
             "stdout_tail": proc.stdout.strip().splitlines()[-3:],
         }
         if proc.returncode != 0:
@@ -87,10 +88,17 @@ def run_item(name: str, cmd, timeout_s: float):
         # is NOT the hardware measurement this queue exists to capture
         # — mark the otherwise-successful item failed so all_ok stays
         # honest.  A real nonzero exit keeps its own rc: that failure
-        # cause must not be masked by the fallback label.
+        # cause must not be masked by the fallback label.  A
+        # campaign-replay line (bench.py recycling an earlier capture
+        # when its fresh probe failed) is equally NOT a new
+        # measurement: without this check the replay would be recorded
+        # as a fresh rc=0 TPU result and one old capture could
+        # recirculate forever through the journal.
         detail = out.get("result", {}).get("detail", {})
         if out["rc"] == 0 and (
-            detail.get("backend_fallback") or detail.get("small_mode_auto")
+            detail.get("backend_fallback")
+            or detail.get("small_mode_auto")
+            or detail.get("replayed_from")
         ):
             out["rc"] = "cpu-fallback"
         return out
