@@ -322,6 +322,22 @@ def _tree_leaves(x):
     return jax.tree_util.tree_leaves(x)
 
 
+def stream_detail(stream_stats: dict, steps: int) -> dict:
+    """Host-vs-device accounting detail from
+    :meth:`svoc_tpu.io.pipeline.PrefetchPipeline.stats`: producer busy
+    ms per batch vs consumer starvation ms per step — starvation ≈ 0
+    means the device is the bottleneck, large means the host feeder
+    can't keep up.  One home so the three bench bodies cannot drift."""
+    return {
+        "host_produce_ms_per_batch": round(
+            1e3 * stream_stats["produce_s"] / max(stream_stats["produced"], 1), 3
+        ),
+        "consumer_wait_ms_per_step": round(
+            1e3 * stream_stats["consumer_wait_s"] / max(steps, 1), 3
+        ),
+    }
+
+
 def measure_roundtrip_ms(reps: int = 10) -> float:
     """Median host↔device roundtrip for a trivial jitted op + scalar
     fetch — the per-sync overhead every honest timing pays.  ~67 ms on
@@ -657,6 +673,7 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
         # host — every counted step is provably executed.
         final_checksum = device_fetch(essence)
         elapsed = time.perf_counter() - t0
+        stream_stats = stream.stats()
     fetcher.finish()
     checksums = fetcher.checksums()
     if (steps - 1) % sync_every != 0:  # final step not already submitted
@@ -687,6 +704,7 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
             "device_roundtrip_ms": round(roundtrip, 3),
             "tokens_per_sec": round(tokens_per_sec, 1),
             "host_tokenize_per_sec": round(tok_per_sec, 2),
+            **stream_detail(stream_stats, steps),
             "encoder_forward_ms": round(fwd_ms, 3),
             "encoder_forward_exec_ms": round(fwd_exec_ms, 3),
             "consensus_update_latency_ms": round(consensus_ms, 3),
@@ -1667,13 +1685,16 @@ def _bench_packed_flagship(
     # packed variants carry the identical fleet+consensus tail.
     consensus_impl = resolve_consensus_impl()
 
-    @jax.jit
-    def fleet_consensus(key, vecs, valid):
-        # First `window_size` VALID segments, fixed-shape: stable argsort
-        # puts valid segments first in packer (= input) order.
-        flat = vecs.reshape(-1, dim)
-        order = jnp.argsort(jnp.logical_not(valid.reshape(-1)), stable=True)
-        window = flat[order[:window_size]]
+    def fleet_consensus_body(key, vecs, valid):
+        # First `window_size` VALID segments in packer (= input) order —
+        # the sort-free compaction (a TPU stable argsort here was the
+        # prime suspect in the packed path's 21.4 ms-vs-10.6 ms
+        # consensus gap: svoc_tpu/ops/select.py).
+        from svoc_tpu.ops.select import first_valid_window
+
+        window = first_valid_window(
+            vecs.reshape(-1, dim), valid.reshape(-1), window_size
+        )
         values, honest = gen_oracle_predictions(
             key, window, n_oracles, ccfg.n_failing, subset_size=10
         )
@@ -1684,6 +1705,21 @@ def _bench_packed_flagship(
         else:
             out = consensus_step(values, ccfg)
         return out.essence, out.reliability_second_pass, honest
+
+    fleet_consensus = jax.jit(fleet_consensus_body)
+
+    # Software-pipelined serving step: consensus for batch k-1 fused
+    # into the same XLA program as the forward for batch k.  The two
+    # subgraphs are data-independent, so the compiler can overlap the
+    # consensus tail (sort/VPU-heavy) with the forward's MXU matmuls
+    # instead of serializing them as back-to-back programs — on the
+    # round-4 numbers that serialization cost 21.4 ms of the 83.8 ms
+    # step.  Lossless: identical per-batch outputs, one step later.
+    @jax.jit
+    def pipelined_step(params, dev, key, prev_vecs, prev_valid):
+        vecs = forward(params, *dev)
+        essence, rel2, _ = fleet_consensus_body(key, prev_vecs, prev_valid)
+        return vecs, essence, rel2
 
     roundtrip = measure_roundtrip_ms()
     source = SyntheticSource(batch=rows, seed=0)
@@ -1724,25 +1760,55 @@ def _bench_packed_flagship(
     steps = 0
     fetcher = AsyncResultFetcher(maxsize=2)
     rel2 = None
+    pipelined = os.environ.get("SVOC_BENCH_NO_PIPELINE") != "1"
     with PrefetchPipeline(
         packed_batches(), tokenizer=None, seq_len=seq, depth=4, device_put=put
     ) as stream:
+        if pipelined:
+            # Prime the software pipeline with the (uncounted) warmup
+            # batch so iteration k always fuses consensus(k-1) with
+            # forward(k); its consensus recompute is PAID in elapsed but
+            # its comments are never counted — conservative.  Batch k's
+            # consensus must consume the SAME chained key the
+            # non-pipelined path would fold at step k (losslessness is
+            # a key-for-key claim, not just a value-shape one), so the
+            # key rides the pipeline next to the vecs; the warmup slot
+            # re-uses the pre-chain base key, like the warmup fetches.
+            prev_vecs, prev_valid = forward(pipe.params, *dev0), valid0
+            prev_key = key
         t0 = time.perf_counter()
         for dev, valid, n_batch in stream:
-            vecs = forward(pipe.params, *dev)
             key = jax.random.fold_in(key, steps)
-            essence, rel2, _ = fleet_consensus(key, vecs, valid)
-            if steps % sync_every == 0:
-                fetcher.submit(steps, essence)
+            if pipelined:
+                vecs, essence, rel2 = pipelined_step(
+                    pipe.params, dev, prev_key, prev_vecs, prev_valid
+                )
+                prev_vecs, prev_valid, prev_key = vecs, valid, key
+                # essence belongs to batch steps-1 (warmup at steps=0):
+                # label the checksum with the batch it proves.
+                if steps > 0 and (steps - 1) % sync_every == 0:
+                    fetcher.submit(steps - 1, essence)
+            else:
+                vecs = forward(pipe.params, *dev)
+                essence, rel2, _ = fleet_consensus(key, vecs, valid)
+                if steps % sync_every == 0:
+                    fetcher.submit(steps, essence)
             n_comments += n_batch
             steps += 1
             if time.perf_counter() - t0 >= seconds:
                 break
+        if pipelined:
+            # Drain: the last counted batch's consensus hasn't run yet;
+            # it consumes the key chained at its own step.
+            essence, rel2, _ = fleet_consensus(prev_key, prev_vecs, prev_valid)
         final_checksum = device_fetch(essence)
         elapsed = time.perf_counter() - t0
+        stream_stats = stream.stats()
     fetcher.finish()
     checksums = fetcher.checksums()
-    if (steps - 1) % sync_every != 0:
+    # In pipelined mode the drain's checksum is batch steps-1's and the
+    # in-loop cadence never reaches past steps-2, so it always appends.
+    if pipelined or (steps - 1) % sync_every != 0:
         checksums.append((steps - 1, final_checksum))
     assert_checksums_distinct(checksums)
 
@@ -1782,7 +1848,15 @@ def _bench_packed_flagship(
                 "unique packed batches per step; async host-fetch checksum "
                 f"every {sync_every} steps; clock stopped after final-step "
                 "fetch"
+                + (
+                    "; software-pipelined (consensus k-1 fused into "
+                    "forward k's XLA program, drained after the loop)"
+                    if pipelined
+                    else ""
+                )
             ),
+            "pipelined": pipelined,
+            **stream_detail(stream_stats, steps),
             "device_roundtrip_ms": round(roundtrip, 3),
             "packing_factor": round(packing_factor, 3),
             "comments_per_step_mean": round(n_comments / max(steps, 1), 1),
@@ -1921,6 +1995,7 @@ def _bench_packed_dp_serving(
                 break
         final_checksum = device_fetch(out.essence)
         elapsed = time.perf_counter() - t0
+        stream_stats = stream.stats()
     fetcher.finish()
     checksums = fetcher.checksums()
     if (steps - 1) % sync_every != 0:
@@ -1957,6 +2032,7 @@ def _bench_packed_dp_serving(
             "device_roundtrip_ms": round(roundtrip, 3),
             "n_mesh_devices": n_dev,
             "per_device_rows": per_dev_rows,
+            **stream_detail(stream_stats, steps),
             "packing_factor": round(packing_factor, 3),
             "serving_step_ms": round(step_ms, 3),
             "serving_step_exec_ms": round(step_exec_ms, 3),

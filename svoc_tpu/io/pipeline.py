@@ -52,20 +52,33 @@ class PrefetchPipeline:
         self._device_put = device_put
         self._error: Optional[BaseException] = None
         self._stop = threading.Event()
+        # Bottleneck instrumentation: where a timed loop's wall clock
+        # actually goes is unknowable from throughput alone — these
+        # counters split it into host produce time (tokenize + pack +
+        # H2D on the producer thread) vs consumer starvation (queue-get
+        # wait = the host could not keep the device fed).
+        self._produced = 0
+        self._produce_s = 0.0
+        self._consumer_wait_s = 0.0
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
 
     def _produce(self) -> None:
+        import time
+
         try:
             for texts in self._source:
                 if self._stop.is_set():
                     break
+                t0 = time.perf_counter()
                 if self._tokenizer is None:  # raw mode — item is ready
                     batch = texts
                 else:
                     batch = self._tokenizer(list(texts), self._seq_len)
                 if self._device_put is not None:
                     batch = self._device_put(batch)
+                self._produced += 1
+                self._produce_s += time.perf_counter() - t0
                 while not self._stop.is_set():
                     try:
                         self._queue.put(batch, timeout=0.1)
@@ -86,12 +99,28 @@ class PrefetchPipeline:
         return self
 
     def __next__(self):
+        import time
+
+        t0 = time.perf_counter()
         item = self._queue.get()
+        self._consumer_wait_s += time.perf_counter() - t0
         if item is None:
             if self._error is not None:
                 raise self._error
             raise StopIteration
         return item
+
+    def stats(self) -> dict:
+        """``{produced, produce_s, consumer_wait_s}`` — produce time is
+        the producer thread's busy time per item (tokenize + pack +
+        device_put); consumer wait is time the consumer spent blocked on
+        an empty queue (≈0 when the device is the bottleneck, ≈the gap
+        when the host is)."""
+        return {
+            "produced": self._produced,
+            "produce_s": round(self._produce_s, 4),
+            "consumer_wait_s": round(self._consumer_wait_s, 4),
+        }
 
     def close(self) -> None:
         self._stop.set()

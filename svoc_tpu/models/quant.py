@@ -110,6 +110,14 @@ def quantized_size_bytes(qparams: Dict) -> int:
     )
 
 
+def _quantize_rows(x: jnp.ndarray):
+    """Per-row dynamic int8 quantization: ``x → (xq int8, s f32)``."""
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True), 1e-8) / 127.0
+    xq = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    return xq, s
+
+
 def qdense(x: jnp.ndarray, qp: Dict, dtype) -> jnp.ndarray:
     """Dynamically quantized replacement for ``encoder_math.dense``
     (same ``(x, params, dtype)`` signature, so ``encoder_block`` takes
@@ -119,9 +127,7 @@ def qdense(x: jnp.ndarray, qp: Dict, dtype) -> jnp.ndarray:
     runs int8×int8→int32 on the MXU; dequant + bias fold into one
     elementwise epilogue XLA fuses.
     """
-    xf = x.astype(jnp.float32)
-    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True), 1e-8) / 127.0
-    xq = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    xq, s = _quantize_rows(x)
     acc = jax.lax.dot_general(
         xq,
         qp["w_int8"],
@@ -130,6 +136,42 @@ def qdense(x: jnp.ndarray, qp: Dict, dtype) -> jnp.ndarray:
     )
     y = acc.astype(jnp.float32) * (s * qp["w_scale"]) + qp["bias"]
     return y.astype(dtype)
+
+
+def make_cached_qdense():
+    """A :func:`qdense` that quantizes each DISTINCT activation tensor
+    once per traced forward.
+
+    ``encoder_block`` calls ``dense_fn(x, …)`` three times on the same
+    ``x`` for Q/K/V (``encoder_math.py:102-104``); the naive qdense
+    re-ran the amax-reduce + round/clip/cast chain on every call — six
+    activation-quantization passes per layer where four distinct
+    activations exist, pure HBM traffic at serving batch sizes (part
+    of config 10's missing int8 speedup, VERDICT r5 item 5).  The
+    cache is keyed by tracer identity and holds a strong reference to
+    the key tensor, so a freed tracer's address can never alias a new
+    one; scope one instance per traced forward call (a fresh cache per
+    trace — never reuse across jit boundaries).
+    """
+    cache: Dict = {}
+
+    def cached_qdense(x: jnp.ndarray, qp: Dict, dtype) -> jnp.ndarray:
+        hit = cache.get(id(x))
+        if hit is not None and hit[0] is x:
+            _, xq, s = hit
+        else:
+            xq, s = _quantize_rows(x)
+            cache[id(x)] = (x, xq, s)
+        acc = jax.lax.dot_general(
+            xq,
+            qp["w_int8"],
+            (((xq.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        y = acc.astype(jnp.float32) * (s * qp["w_scale"]) + qp["bias"]
+        return y.astype(dtype)
+
+    return cached_qdense
 
 
 def _bias_attention(bias, cfg: EncoderConfig):
@@ -153,9 +195,10 @@ def quantized_forward(
     """Unpacked ``(ids, mask) → logits`` with int8 block matmuls —
     drop-in for ``SentimentEncoder.apply`` on a quantized tree."""
     rest = qparams["params"]
+    qd = make_cached_qdense()  # fresh per trace: Q/K/V share one quantize
     x = embed_tokens(ids, local_position_ids(mask, cfg), rest, cfg)
     for i in range(cfg.n_layers):
-        x = encoder_block(x, mask, rest[f"block_{i}"], cfg, dense_fn=qdense)
+        x = encoder_block(x, mask, rest[f"block_{i}"], cfg, dense_fn=qd)
     return cls_head(x[:, 0, :], rest, cfg)
 
 
@@ -171,13 +214,14 @@ def quantized_packed_forward(
     block-diagonal attention, per-segment CLS gather) with int8
     matmuls — the packing factor and the int8 MXU rate multiply."""
     rest = qparams["params"]
+    qd = make_cached_qdense()  # fresh per trace: Q/K/V share one quantize
     x = embed_tokens(ids, pos_ids, rest, cfg)
     same = (seg[:, :, None] == seg[:, None, :]) & (seg[:, :, None] > 0)
     bias = jnp.where(same[:, None, :, :], 0.0, -1e9).astype(jnp.float32)
     attn = _bias_attention(bias, cfg)
     for i in range(cfg.n_layers):
         x = encoder_block(
-            x, None, rest[f"block_{i}"], cfg, attention_fn=attn, dense_fn=qdense
+            x, None, rest[f"block_{i}"], cfg, attention_fn=attn, dense_fn=qd
         )
     cls = jnp.take_along_axis(x, cls_pos[:, :, None], axis=1)  # [R, S, D]
     return cls_head(cls, rest, cfg)

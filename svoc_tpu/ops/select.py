@@ -1,0 +1,58 @@
+"""Fixed-shape "first K valid rows" selection for consensus windows.
+
+The packed serving paths need the first ``window_size`` VALID segment
+vectors in packer order out of a flat ``[N, M]`` block (N = rows ×
+max_segments, ~2048 at flagship shape).  Round 4 implemented that as a
+stable ``argsort`` over the [N] validity flags inside the consensus
+program; TPU sorts lower to bitonic networks and the measured packed
+consensus step cost 21.4 ms vs the dense path's 10.6 ms on identical
+fleets (``HW_CAMPAIGN.json`` configs 8 vs 0) — the selection prologue
+was the prime suspect in the packed path's 15-point MFU regression
+(VERDICT r5 item 1).
+
+:func:`first_valid_window` does the same selection with a cumsum and
+ONE one-hot matmul: slot(i) = (#valid ≤ i) - 1 for valid i, and
+``window[k] = Σ_i [slot(i) = k] · vecs[i]`` — an exact gather (each
+one-hot row has at most a single 1, so the f32 sum is exact; HIGHEST
+precision keeps the MXU from rounding the vectors to bf16).  Work is
+O(W·N) on the MXU (~0.6 MFLOP at 50×2048) with no sort anywhere.
+
+Padding semantics when fewer than ``window_size`` segments are valid:
+missing slots are ZERO vectors (the argsort version padded with
+arbitrary invalid-segment vectors instead).  Both are out-of-contract
+— callers keep rows full (``bench.py packed_comment_stream`` buffers
+comments so every batch is) — but zeros are at least deterministic.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def first_valid_window(
+    vecs: jnp.ndarray, valid: jnp.ndarray, window_size: int
+) -> jnp.ndarray:
+    """First ``window_size`` rows of ``vecs[valid]`` in input order.
+
+    ``vecs [N, M]`` float, ``valid [N]`` bool → ``[window_size, M]``.
+    Equivalent to ``vecs[argsort(~valid, stable)[:window_size]]`` when
+    at least ``window_size`` entries are valid (the serving contract);
+    short windows pad with zeros.  Sort-free: cumsum + one one-hot
+    matmul, exact in f32.
+    """
+    n = valid.shape[0]
+    if vecs.shape[0] != n:
+        raise ValueError(f"vecs rows {vecs.shape[0]} != valid length {n}")
+    slot = jnp.cumsum(valid.astype(jnp.int32)) - 1  # [N]
+    slot = jnp.where(valid, slot, -1)
+    onehot = (
+        slot[None, :] == jnp.arange(window_size, dtype=jnp.int32)[:, None]
+    ).astype(vecs.dtype)  # [W, N], ≤ one 1 per row
+    return jax.lax.dot_general(
+        onehot,
+        vecs,
+        (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=vecs.dtype,
+    )

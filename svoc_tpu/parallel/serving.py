@@ -38,6 +38,7 @@ from svoc_tpu.consensus.kernel import ConsensusConfig
 from svoc_tpu.models.configs import EncoderConfig
 from svoc_tpu.models.forward import resolve_forward
 from svoc_tpu.models.sentiment import TRACKED_INDICES, scores_to_vectors
+from svoc_tpu.ops.select import first_valid_window
 from svoc_tpu.parallel.sharded import fleet_consensus_shard_map
 
 
@@ -163,11 +164,15 @@ def packed_serving_step_fn(
         vecs = scores_to_vectors(
             logits.reshape(r * s, l), label_indices, multi_label
         )
-        # First window_size valid segments in global row order — stable
-        # argsort over the tiny [R*S] flag vector (one small all-gather).
-        order = jnp.argsort(jnp.logical_not(valid.reshape(-1)), stable=True)
+        # First window_size valid segments in global row order — the
+        # sort-free cumsum + one-hot-matmul compaction (a TPU stable
+        # argsort here measurably dominated the packed consensus step:
+        # ops/select.py module docstring).
         window = jax.lax.with_sharding_constraint(
-            vecs[order[:window_size]].reshape(window_size, dim), replicated
+            first_valid_window(vecs, valid.reshape(-1), window_size).reshape(
+                window_size, dim
+            ),
+            replicated,
         )
         return fleet(key, window)
 

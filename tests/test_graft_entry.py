@@ -64,7 +64,11 @@ def test_dryrun_multichip_subprocess_fresh_env():
         "int8-packed-serving-dp",
         "packed-flash-forward-dp",
         "batched-fleet-commit",
+        "dp-serving-scaling",
     ]
+    # the scaling study emits its per-width timings for the round
+    # artifact (MULTICHIP_r{N}.json captures stdout)
+    assert re.search(r"\[dryrun\] scaling-law \[", proc.stdout)
 
 
 def test_ensure_devices_never_probes_before_pin():

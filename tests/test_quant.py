@@ -107,6 +107,26 @@ class TestQuantizedForward:
         agree = np.mean(np.argmax(out, -1) == np.argmax(ref, -1))
         assert agree >= 0.75
 
+    def test_qkv_share_one_activation_quantization(self):
+        """The traced forward quantizes each DISTINCT activation once:
+        4 per layer (x for Q/K/V, attn ctx, post-ln x, gelu out) plus
+        one softmax reduce_max per layer — the naive per-call qdense
+        emitted 6 per layer (Q/K/V re-quantized the same x; part of
+        config 10's missing int8 speedup)."""
+        from collections import Counter
+
+        params = _params()
+        qparams = quantize_params(params, CFG)
+        ids = jnp.ones((2, 16), jnp.int32)
+        mask = jnp.ones((2, 16), jnp.int32)
+        jaxpr = jax.make_jaxpr(
+            lambda q, i, m: quantized_forward(q, i, m, CFG)
+        )(qparams, ids, mask)
+        n_max = Counter(str(e.primitive) for e in jaxpr.eqns)["reduce_max"]
+        # 4 quantizations + 1 softmax max per layer; the naive scheme
+        # would show 7 per layer.
+        assert n_max == 5 * CFG.n_layers, n_max
+
 
 class TestPipelineIntegration:
     def test_int8_vectors_close_to_float(self):

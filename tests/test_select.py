@@ -1,0 +1,50 @@
+"""ops/select.py — the sort-free consensus-window compaction.
+
+Must be EXACTLY the stable-argsort selection it replaced (the packed
+serving paths' window semantics: first window_size valid segments in
+packer order) whenever the window fills; zero-padding when it cannot.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from svoc_tpu.ops.select import first_valid_window
+
+
+def argsort_reference(vecs, valid, w):
+    order = np.argsort(np.logical_not(valid), kind="stable")
+    return np.asarray(vecs)[order[:w]]
+
+
+@pytest.mark.parametrize("n,w,seed", [(64, 16, 0), (2048, 50, 1), (48, 48, 2)])
+def test_matches_stable_argsort_when_window_fills(n, w, seed):
+    rng = np.random.default_rng(seed)
+    vecs = rng.uniform(-1, 1, (n, 6)).astype(np.float32)
+    valid = np.zeros(n, bool)
+    valid[rng.choice(n, size=max(w, n // 3), replace=False)] = True
+    got = np.asarray(first_valid_window(jnp.asarray(vecs), jnp.asarray(valid), w))
+    np.testing.assert_array_equal(got, argsort_reference(vecs, valid, w))
+
+
+def test_exact_in_f32_no_mxu_rounding():
+    # Values with >8 mantissa bits of structure survive the matmul
+    # gather bit-exactly (HIGHEST precision; a bf16 MXU pass would not).
+    vecs = np.full((256, 4), np.float32(1 + 2**-20))
+    vecs[7] = np.float32(1 - 2**-20)
+    valid = np.ones(256, bool)
+    got = np.asarray(first_valid_window(jnp.asarray(vecs), jnp.asarray(valid), 16))
+    np.testing.assert_array_equal(got, vecs[:16])
+
+
+def test_short_window_pads_with_zeros():
+    vecs = np.ones((8, 3), np.float32)
+    valid = np.array([0, 1, 0, 0, 1, 0, 0, 0], bool)
+    got = np.asarray(first_valid_window(jnp.asarray(vecs), jnp.asarray(valid), 4))
+    np.testing.assert_array_equal(got[:2], vecs[[1, 4]])
+    np.testing.assert_array_equal(got[2:], 0)
+
+
+def test_shape_mismatch_raises():
+    with pytest.raises(ValueError):
+        first_valid_window(jnp.ones((4, 2)), jnp.ones(5, bool), 2)
