@@ -103,6 +103,61 @@ def dp_serving_step_fn(
     )
 
 
+def _packed_window_fn(
+    mesh: Mesh,
+    enc_cfg: EncoderConfig,
+    window_size: int,
+    label_indices: tuple,
+    quant: Optional[str],
+):
+    """The shared forward→window computation of the packed serving
+    steps: ``(params, ids, pos, seg, cls_pos, valid) → [window, M]``
+    replicated window of the first ``window_size`` VALID segment
+    vectors in global row order (sort-free compaction — a TPU stable
+    argsort here measurably dominated the packed consensus step:
+    ``ops/select.py`` module docstring).  One home so the plain and
+    pipelined twins can never drift."""
+    if max(label_indices) >= enc_cfg.n_labels:
+        raise ValueError(
+            f"label_indices {label_indices} out of range for a "
+            f"{enc_cfg.n_labels}-label head"
+        )
+    apply_fn = resolve_forward(enc_cfg, quant, packed=True)
+    multi_label = enc_cfg.head == "sigmoid"
+    dim = len(label_indices)
+    replicated = NamedSharding(mesh, P())
+
+    def window_of(params, ids, pos, seg, cls_pos, valid):
+        r, s = cls_pos.shape
+        if r * s < window_size:
+            raise ValueError(
+                f"packed batch capacity {r}x{s} segments is smaller than "
+                f"window_size {window_size} — the consensus window would "
+                "be silently truncated"
+            )
+        logits = apply_fn(params, ids, pos, seg, cls_pos)  # [R, S, L]
+        r, s, l = logits.shape
+        vecs = scores_to_vectors(
+            logits.reshape(r * s, l), label_indices, multi_label
+        )
+        return jax.lax.with_sharding_constraint(
+            first_valid_window(vecs, valid.reshape(-1), window_size).reshape(
+                window_size, dim
+            ),
+            replicated,
+        )
+
+    return window_of
+
+
+def _packed_in_shardings(mesh: Mesh, axis: str, extra: int = 0):
+    """jit in_shardings for ``(params, key, ids, pos, seg, cls_pos,
+    valid, *extra-replicated)`` of the packed serving steps."""
+    replicated = NamedSharding(mesh, P())
+    row_shard = NamedSharding(mesh, P(axis, None))
+    return (replicated, replicated) + (row_shard,) * 5 + (replicated,) * extra
+
+
 def packed_serving_step_fn(
     mesh: Mesh,
     enc_cfg: EncoderConfig,
@@ -134,59 +189,68 @@ def packed_serving_step_fn(
     The segment capacity ``R×S`` must cover ``window_size`` (checked at
     trace time).  The number of VALID segments is data-dependent and
     cannot be checked inside jit: a batch with fewer than
-    ``window_size`` valid segments silently pads the window with
-    invalid-segment vectors — callers must keep rows full (the bench's
+    ``window_size`` valid segments pads the window with ZERO vectors
+    (the sort-free compaction's deterministic padding — see
+    ``ops/select.py``) — callers must keep rows full (the bench's
     packed stream buffers comments so every batch does).
     """
-    if max(label_indices) >= enc_cfg.n_labels:
-        raise ValueError(
-            f"label_indices {label_indices} out of range for a "
-            f"{enc_cfg.n_labels}-label head"
-        )
-    apply_fn = resolve_forward(enc_cfg, quant, packed=True)
-    multi_label = enc_cfg.head == "sigmoid"
-    dim = len(label_indices)
+    window_of = _packed_window_fn(mesh, enc_cfg, window_size, label_indices, quant)
     fleet = fleet_consensus_shard_map(mesh, ccfg, n_oracles, subset_size, axis)
 
-    replicated = NamedSharding(mesh, P())
-    row_shard = NamedSharding(mesh, P(axis, None))
-
     def serve(params, key, ids, pos, seg, cls_pos, valid):
-        r, s = cls_pos.shape
-        if r * s < window_size:
-            raise ValueError(
-                f"packed batch capacity {r}x{s} segments is smaller than "
-                f"window_size {window_size} — the consensus window would "
-                "be silently truncated"
-            )
-        logits = apply_fn(params, ids, pos, seg, cls_pos)  # [R, S, L]
-        r, s, l = logits.shape
-        vecs = scores_to_vectors(
-            logits.reshape(r * s, l), label_indices, multi_label
-        )
-        # First window_size valid segments in global row order — the
-        # sort-free cumsum + one-hot-matmul compaction (a TPU stable
-        # argsort here measurably dominated the packed consensus step:
-        # ops/select.py module docstring).
-        window = jax.lax.with_sharding_constraint(
-            first_valid_window(vecs, valid.reshape(-1), window_size).reshape(
-                window_size, dim
-            ),
-            replicated,
-        )
-        return fleet(key, window)
+        return fleet(key, window_of(params, ids, pos, seg, cls_pos, valid))
 
+    return jax.jit(serve, in_shardings=_packed_in_shardings(mesh, axis))
+
+
+def packed_serving_pipelined_step_fn(
+    mesh: Mesh,
+    enc_cfg: EncoderConfig,
+    ccfg: ConsensusConfig,
+    n_oracles: int,
+    *,
+    window_size: int = 50,
+    subset_size: int = 10,
+    label_indices: tuple = TRACKED_INDICES,
+    axis: str = "data",
+    quant: Optional[str] = None,
+):
+    """Software-pipelined twin of :func:`packed_serving_step_fn`:
+    ``(params, key, ids, pos, seg, cls_pos, valid, prev_window) →
+    (window, ConsensusOutput, honest)`` — the fleet+consensus runs on
+    the PREVIOUS batch's (replicated, [window, M]) window inside the
+    same XLA program as the current batch's forward, so the
+    consensus tail overlaps the forward's MXU matmuls instead of
+    serializing behind them (the round-4 packed step spent 21.4 of
+    83.8 ms on that serialization).  ``key`` must be the key for the
+    PREVIOUS batch.  Lossless: identical windows and consensus
+    outputs, one step later; drain the last window with one
+    standalone fleet call (:func:`fleet_step_fn`).
+    """
+    window_of = _packed_window_fn(mesh, enc_cfg, window_size, label_indices, quant)
+    fleet = fleet_consensus_shard_map(mesh, ccfg, n_oracles, subset_size, axis)
+
+    def serve(params, key, ids, pos, seg, cls_pos, valid, prev_window):
+        window = window_of(params, ids, pos, seg, cls_pos, valid)
+        out, honest = fleet(key, prev_window)
+        return window, out, honest
+
+    return jax.jit(serve, in_shardings=_packed_in_shardings(mesh, axis, extra=1))
+
+
+def fleet_step_fn(
+    mesh: Mesh,
+    ccfg: ConsensusConfig,
+    n_oracles: int,
+    *,
+    subset_size: int = 10,
+    axis: str = "data",
+):
+    """Standalone jitted ``(key, window) → (ConsensusOutput, honest)``
+    on the serving mesh — the drain step for the pipelined serving
+    loop (and a direct window-consensus entry point)."""
     return jax.jit(
-        serve,
-        in_shardings=(
-            replicated,
-            replicated,
-            row_shard,
-            row_shard,
-            row_shard,
-            row_shard,
-            row_shard,
-        ),
+        fleet_consensus_shard_map(mesh, ccfg, n_oracles, subset_size, axis)
     )
 
 

@@ -20,7 +20,13 @@ def _run_bench(args, env_extra):
     # Hermetic against the caller's own bench knobs — an exported
     # SVOC_BENCH_SMALL would suppress auto-shrink, FORCE_FULL would run
     # the unbounded full-size workload.
-    for knob in ("SVOC_BENCH_SMALL", "SVOC_BENCH_FORCE_FULL", "SVOC_BENCH_SECONDS"):
+    for knob in (
+        "SVOC_BENCH_SMALL",
+        "SVOC_BENCH_FORCE_FULL",
+        "SVOC_BENCH_SECONDS",
+        "SVOC_BENCH_MAX_STEPS",
+        "SVOC_BENCH_NO_PIPELINE",
+    ):
         env.pop(knob, None)
     env.update(env_extra)
     proc = subprocess.run(
@@ -191,27 +197,24 @@ def test_pipelined_packed_step_is_lossless():
     env = {
         "JAX_PLATFORMS": "cpu",
         "SVOC_BENCH_SMALL": "1",
-        # fixed steps via the seconds window is racy; rely on the
-        # deterministic source (seed 0) + identical step count from the
-        # same 2 s window being unnecessary: compare the FIRST batches
-        # via the warmup-proven checksums and the final rel2 only when
-        # step counts agree.
-        "SVOC_BENCH_SECONDS": "2",
+        # Deterministic step budget: both runs must cover the SAME
+        # batches of the seed-0 stream or the comparison is vacuous.
+        "SVOC_BENCH_MAX_STEPS": "6",
     }
-    rc_a, a = _run_bench(["--config", "8", "--seconds", "2"], env)
+    rc_a, a = _run_bench(["--config", "8", "--seconds", "60"], env)
     rc_b, b = _run_bench(
-        ["--config", "8", "--seconds", "2"],
+        ["--config", "8", "--seconds", "60"],
         {**env, "SVOC_BENCH_NO_PIPELINE": "1"},
     )
     assert rc_a == 0 and rc_b == 0
     assert a["detail"]["pipelined"] is True
     assert b["detail"]["pipelined"] is False
-    # Same deterministic stream: if both runs covered the same number
-    # of steps, the final batch's consensus must match exactly.
-    if a["detail"]["steps"] == b["detail"]["steps"]:
-        assert a["detail"]["consensus_reliability2"] == (
-            b["detail"]["consensus_reliability2"]
-        )
+    assert a["detail"]["steps"] == b["detail"]["steps"] == 6
+    # Same batches, same chained keys: the final batch's consensus
+    # must match exactly.
+    assert a["detail"]["consensus_reliability2"] == (
+        b["detail"]["consensus_reliability2"]
+    )
 
 
 def test_soak_recovered_reads_snapshot_series():

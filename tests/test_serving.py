@@ -150,6 +150,75 @@ def test_packed_serving_matches_unpacked():
     )
 
 
+def test_pipelined_packed_serving_is_lossless():
+    """The software-pipelined serving twin must reproduce the plain
+    packed step exactly, one step later: same windows, same consensus
+    per (key, batch) pair, with the drain closing the last batch."""
+    from svoc_tpu.models.packing import pack_tokens, strip_padding
+    from svoc_tpu.models.tokenizer import load_tokenizer
+    from svoc_tpu.parallel.serving import (
+        fleet_step_fn,
+        packed_serving_pipelined_step_fn,
+        packed_serving_step_fn,
+    )
+
+    cfg = TINY_TEST
+    ccfg = ConsensusConfig(n_failing=4, constrained=True)
+    mesh = serving_mesh()
+    window, seq, n_oracles = 8, 16, 16
+    params = init_params(SentimentEncoder(cfg), seed=0)
+    tok = load_tokenizer(None, cfg.vocab_size, pad_id=cfg.pad_id, max_len=seq)
+    row = batch_sharding(mesh)
+
+    def packed(seed):
+        texts = [f"pipelined comment {seed}-{i} consensus" for i in range(16)]
+        ids, mask = tok(texts, seq)
+        batch, n = pack_tokens(
+            strip_padding(ids, mask), seq, max_segments=2,
+            pad_id=cfg.pad_id, rows=8,
+        )
+        assert n == 16
+        args = [
+            jax.device_put(jnp.asarray(a), row)
+            for a in (batch.ids, batch.pos, batch.seg, batch.cls_pos)
+        ]
+        return args, jax.device_put(jnp.asarray(batch.seg_valid > 0), row)
+
+    serve = packed_serving_step_fn(
+        mesh, cfg, ccfg, n_oracles, window_size=window, subset_size=4,
+        label_indices=LABEL_IDX,
+    )
+    pserve = packed_serving_pipelined_step_fn(
+        mesh, cfg, ccfg, n_oracles, window_size=window, subset_size=4,
+        label_indices=LABEL_IDX,
+    )
+    drain = fleet_step_fn(mesh, ccfg, n_oracles, subset_size=4)
+
+    batches = [packed(s) for s in range(3)]
+    keys = [jax.random.PRNGKey(50 + s) for s in range(3)]
+    ref = [serve(params, k, *a, v) for k, (a, v) in zip(keys, batches)]
+
+    # pipelined: prime with batch 0 (dummy prev window), then each call
+    # returns the PREVIOUS batch's consensus; drain the last.
+    dim = len(LABEL_IDX)
+    prev_window, _, _ = pserve(
+        params, keys[0], *batches[0][0], batches[0][1],
+        jnp.zeros((window, dim), jnp.float32),
+    )
+    got = []
+    for k_prev, (a, v) in zip(keys, batches[1:]):
+        prev_window, out, honest = pserve(params, k_prev, *a, v, prev_window)
+        got.append((out, honest))
+    got.append(drain(keys[2], prev_window))  # last batch's own key
+
+    assert len(got) == len(ref) == 3
+    for (out, honest), (ref_out, ref_honest) in zip(got, ref):
+        np.testing.assert_array_equal(
+            np.asarray(out.essence), np.asarray(ref_out.essence)
+        )
+        np.testing.assert_array_equal(np.asarray(honest), np.asarray(ref_honest))
+
+
 def test_int8_dp_serving_matches_single_device_int8():
     """quant='int8' serving on the 8-way mesh must agree exactly with
     the same int8 step on a 1-device mesh — data sharding cannot change
