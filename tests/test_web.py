@@ -186,3 +186,28 @@ class TestLiveRefresh:
         assert json.loads(get(base, "/api/state"))["auto_fetch"] is False
         console.session.auto_fetch = True
         assert json.loads(get(base, "/api/state"))["auto_fetch"] is True
+
+    def test_events_stream_pushes_state_changes(self, server):
+        """/api/events is the push channel (eel-websocket parity): the
+        current version arrives immediately, and a session change pushes
+        a new frame without the client asking."""
+        base, console = server
+        with urllib.request.urlopen(f"{base}/api/events", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+
+            def next_frame():
+                while True:
+                    line = r.readline().decode()
+                    if line.startswith("data: "):
+                        return json.loads(line[6:])
+
+            first = next_frame()
+            v0 = first["state_version"]
+            console.session.fetch()  # state change -> push
+            assert next_frame()["state_version"] == v0 + 1
+
+    def test_page_is_push_first_with_poll_fallback(self, server):
+        base, _ = server
+        page = get(base, "/").decode()
+        assert "EventSource('/api/events')" in page
+        assert "pushAlive" in page  # poll loop gated off while push is up
