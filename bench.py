@@ -71,7 +71,12 @@ exceeds 29 min there; ``SVOC_BENCH_FORCE_FULL=1`` overrides);
 ``SVOC_BENCH_SECONDS`` (default 10) sets the timed window;
 ``SVOC_BENCH_PROBE_TIMEOUT``/``SVOC_BENCH_PROBE_ATTEMPTS`` tune the
 backend probe; ``SVOC_PEAK_TFLOPS`` overrides the assumed chip peak for
-the MFU estimate (default 197 bf16 TFLOP/s, TPU v5e).
+the MFU estimate (default 197 bf16 TFLOP/s, TPU v5e);
+``SVOC_BENCH_MAX_STEPS`` caps the timed loop at a fixed step count
+(deterministic A/B runs); ``SVOC_BENCH_NO_PIPELINE=1`` disables the
+software-pipelined step; ``SVOC_BENCH_NO_REPLAY=1`` disables the
+campaign replay and ``SVOC_BENCH_CAMPAIGN_JOURNAL`` points it at a
+non-default journal (tests).
 """
 
 from __future__ import annotations
@@ -171,7 +176,7 @@ def resolve_backend() -> tuple:
     return "cpu", last_err
 
 
-HW_CAMPAIGN_PATH = os.path.join(
+HW_CAMPAIGN_PATH = os.environ.get("SVOC_BENCH_CAMPAIGN_JOURNAL") or os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "HW_CAMPAIGN.json"
 )
 
@@ -2039,6 +2044,7 @@ def _bench_packed_dp_serving(
     n_comments = 0
     steps = 0
     out = None
+    max_steps = int(os.environ.get("SVOC_BENCH_MAX_STEPS", "0"))
     fetcher = AsyncResultFetcher(maxsize=2)
     with PrefetchPipeline(
         packed_batches(), tokenizer=None, seq_len=seq, depth=4, device_put=put
@@ -2082,7 +2088,7 @@ def _bench_packed_dp_serving(
                     fetcher.submit(steps, out.essence)
             n_comments += n_batch
             steps += 1
-            if time.perf_counter() - t0 >= seconds:
+            if time.perf_counter() - t0 >= seconds or steps == max_steps:
                 break
         if pipelined:
             # Drain: the last counted batch's consensus.

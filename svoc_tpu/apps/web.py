@@ -231,7 +231,14 @@ events.onmessage = async (ev) => {
   if (pushRefreshing) return;  // serialized: out-of-order /api/state
   pushRefreshing = true;       // responses could paint stale state
   try {
-    while (pushedVersion !== lastVersion) await refresh();
+    // catch up to at least the pushed version; versions are monotonic,
+    // so a fetch that returns NEWER than the push exits immediately
+    // (no spin), and a transient fetch failure retries after a pause
+    // instead of leaving the page stale until the next state change.
+    while (pushedVersion > lastVersion) {
+      try { await refresh(); }
+      catch (e) { await new Promise(res => setTimeout(res, 500)); }
+    }
   } finally { pushRefreshing = false; }
 };
 let polling = false;

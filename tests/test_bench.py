@@ -189,6 +189,58 @@ def test_campaign_replay_prefers_routed_tpu_capture(tmp_path, monkeypatch):
     assert bench.campaign_replay(0, "x") is None
 
 
+def test_driver_snapshot_replays_tpu_capture_on_dead_tunnel(tmp_path):
+    """The round-artifact path end to end: `python bench.py` with a
+    dead/unreachable device backend and a campaign journal holding a
+    real TPU capture must emit THAT capture (provenance stamped), not
+    a CPU fallback line — the exact round-4 failure BENCH_r04.json
+    recorded."""
+    journal = tmp_path / "HW_CAMPAIGN.json"
+    journal.write_text(json.dumps({
+        "items": [{
+            "name": "bench_config0_routed", "done": True,
+            "results": [{
+                "rc": 0,
+                "captured_at": "2026-07-31 02:59:00",
+                "result": {
+                    "metric": "flagship (packed x flash): ...",
+                    "value": 9582.95,
+                    "unit": "comments/sec",
+                    "vs_baseline": 1597.16,
+                    "detail": {"backend": "tpu", "mfu_estimate": 0.3586},
+                },
+            }],
+        }],
+    }))
+    env = dict(os.environ)
+    for knob in ("SVOC_BENCH_SMALL", "SVOC_BENCH_NO_REPLAY"):
+        env.pop(knob, None)
+    env.update({
+        # No JAX_PLATFORMS=cpu: the probe must RUN and fail, like the
+        # driver's snapshot on a dead tunnel.
+        "JAX_PLATFORMS": "",
+        "SVOC_BENCH_PROBE_ATTEMPTS": "1",
+        "SVOC_BENCH_PROBE_TIMEOUT": "0.05",
+        "SVOC_BENCH_CAMPAIGN_JOURNAL": str(journal),
+    })
+    proc = subprocess.run(
+        [sys.executable, BENCH],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env,
+    )
+    lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-1500:])
+    out = json.loads(lines[-1])
+    assert out["value"] == 9582.95
+    assert out["detail"]["backend"] == "tpu"
+    assert out["detail"]["replayed_from"] == "HW_CAMPAIGN.json"
+    assert out["detail"]["replay_captured_at"] == "2026-07-31 02:59:00"
+    assert "timed out" in out["detail"]["fresh_probe_failure"]
+
+
 def test_pipelined_packed_step_is_lossless():
     """config 8 with and without the software pipeline must produce the
     SAME final consensus (key-for-key: batch k's consensus consumes the
@@ -215,6 +267,27 @@ def test_pipelined_packed_step_is_lossless():
     assert a["detail"]["consensus_reliability2"] == (
         b["detail"]["consensus_reliability2"]
     )
+
+
+def test_pipelined_dp_serving_is_lossless():
+    """The config 9 mesh-level pipelined loop: same A/B law as
+    config 8 — identical batches (fixed step budget), identical final
+    consensus between the pipelined and plain step."""
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "SVOC_BENCH_SMALL": "1",
+        "SVOC_BENCH_MAX_STEPS": "4",
+    }
+    rc_a, a = _run_bench(["--config", "9", "--seconds", "60"], env)
+    rc_b, b = _run_bench(
+        ["--config", "9", "--seconds", "60"],
+        {**env, "SVOC_BENCH_NO_PIPELINE": "1"},
+    )
+    assert rc_a == 0 and rc_b == 0
+    assert a["detail"]["pipelined"] is True
+    assert b["detail"]["pipelined"] is False
+    assert a["detail"]["steps"] == b["detail"]["steps"] == 4
+    assert a["detail"]["reliability2"] == b["detail"]["reliability2"]
 
 
 def test_soak_recovered_reads_snapshot_series():
