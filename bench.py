@@ -1803,6 +1803,21 @@ def _bench_packed_flagship(
     # losslessness test needs BOTH runs to cover the same batches; a
     # wall-clock window alone cannot guarantee that).
     max_steps = int(os.environ.get("SVOC_BENCH_MAX_STEPS", "0"))
+    # SVOC_BENCH_PROFILE=<dir>: wrap the TIMED region (after warmup /
+    # priming compiles, before the first counted step) in a
+    # jax.profiler trace — the on-chip attribution the MFU accounting
+    # in docs/PARALLELISM.md names as the only way to split
+    # compute-side residue (pair with SVOC_BENCH_MAX_STEPS to bound
+    # trace size).
+    import contextlib
+
+    profile_dir = os.environ.get("SVOC_BENCH_PROFILE")
+    if profile_dir:
+        from svoc_tpu.utils.metrics import profile_trace
+
+        profile_cm = profile_trace(profile_dir)
+    else:
+        profile_cm = contextlib.nullcontext()
     with PrefetchPipeline(
         packed_batches(), tokenizer=None, seq_len=seq, depth=4, device_put=put
     ) as stream:
@@ -1824,33 +1839,35 @@ def _bench_packed_flagship(
             device_fetch(
                 pipelined_step(pipe.params, dev1, prev_key, prev_vecs, prev_valid)[1]
             )
-        t0 = time.perf_counter()
-        for dev, valid, n_batch in stream:
-            key = jax.random.fold_in(key, steps)
+        with profile_cm:  # exception-safe; a no-op without the knob
+            t0 = time.perf_counter()
+            for dev, valid, n_batch in stream:
+                key = jax.random.fold_in(key, steps)
+                if pipelined:
+                    vecs, essence, rel2 = pipelined_step(
+                        pipe.params, dev, prev_key, prev_vecs, prev_valid
+                    )
+                    prev_vecs, prev_valid, prev_key = vecs, valid, key
+                    # essence belongs to batch steps-1 (warmup at
+                    # steps=0): label the checksum with the batch it
+                    # proves.
+                    if steps > 0 and (steps - 1) % sync_every == 0:
+                        fetcher.submit(steps - 1, essence)
+                else:
+                    vecs = forward(pipe.params, *dev)
+                    essence, rel2, _ = fleet_consensus(key, vecs, valid)
+                    if steps % sync_every == 0:
+                        fetcher.submit(steps, essence)
+                n_comments += n_batch
+                steps += 1
+                if time.perf_counter() - t0 >= seconds or steps == max_steps:
+                    break
             if pipelined:
-                vecs, essence, rel2 = pipelined_step(
-                    pipe.params, dev, prev_key, prev_vecs, prev_valid
-                )
-                prev_vecs, prev_valid, prev_key = vecs, valid, key
-                # essence belongs to batch steps-1 (warmup at steps=0):
-                # label the checksum with the batch it proves.
-                if steps > 0 and (steps - 1) % sync_every == 0:
-                    fetcher.submit(steps - 1, essence)
-            else:
-                vecs = forward(pipe.params, *dev)
-                essence, rel2, _ = fleet_consensus(key, vecs, valid)
-                if steps % sync_every == 0:
-                    fetcher.submit(steps, essence)
-            n_comments += n_batch
-            steps += 1
-            if time.perf_counter() - t0 >= seconds or steps == max_steps:
-                break
-        if pipelined:
-            # Drain: the last counted batch's consensus hasn't run yet;
-            # it consumes the key chained at its own step.
-            essence, rel2, _ = fleet_consensus(prev_key, prev_vecs, prev_valid)
-        final_checksum = device_fetch(essence)
-        elapsed = time.perf_counter() - t0
+                # Drain: the last counted batch's consensus hasn't run
+                # yet; it consumes the key chained at its own step.
+                essence, rel2, _ = fleet_consensus(prev_key, prev_vecs, prev_valid)
+            final_checksum = device_fetch(essence)
+            elapsed = time.perf_counter() - t0
         stream_stats = stream.stats()
     fetcher.finish()
     checksums = fetcher.checksums()

@@ -41,14 +41,16 @@ def test_dryrun_multichip_subprocess_fresh_env():
     env["JAX_PLATFORMS"] = "tpu,cpu"  # hostile: would hang if probed first
     # Internal budget below the subprocess timeout so a slow section
     # fails loudly with its name, not as an opaque TimeoutExpired.
-    env["SVOC_DRYRUN_BUDGET_S"] = "180"
+    # (13 sections incl. the scaling study compile ~8 mesh programs;
+    # ~160 s on an unloaded host, so leave real headroom for CI load.)
+    env["SVOC_DRYRUN_BUDGET_S"] = "260"
     proc = subprocess.run(
         [sys.executable, "-c", "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
         cwd=REPO,
         env=env,
         capture_output=True,
         text=True,
-        timeout=240,
+        timeout=320,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     sections = re.findall(r"\[dryrun\] ([\w-]+) ok", proc.stdout)
@@ -62,6 +64,7 @@ def test_dryrun_multichip_subprocess_fresh_env():
         "pipeline-parallel-forward",
         "packed-forward-dp",
         "int8-packed-serving-dp",
+        "packed-pipelined-serving-dp",
         "packed-flash-forward-dp",
         "batched-fleet-commit",
         "dp-serving-scaling",
