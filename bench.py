@@ -91,6 +91,11 @@ import time
 REFERENCE_COMMENTS_PER_SEC = 6.0  # 30 comments / 5 s simulation step
 REFERENCE_CONSENSUS_PER_SEC = 0.2  # one consensus update / 5 s step
 
+PIPELINED_TIMING_NOTE = (
+    "; software-pipelined (consensus k-1 fused into forward k's XLA "
+    "program, drained after the loop)"
+)
+
 # Committed record of on-chip A/B decisions (written by hand from
 # measured HW_CAMPAIGN/HW_QUEUE results, never at bench runtime):
 # {"flagship_variant": "dense"|"packed"|"packed_flash",
@@ -585,8 +590,7 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
     # PERF_DECISIONS.json; override with SVOC_CONSENSUS_IMPL to A/B.
     consensus_impl = resolve_consensus_impl()
 
-    @jax.jit
-    def fleet_consensus(key, window):
+    def fleet_consensus_body(key, window):
         values, honest = gen_oracle_predictions(
             key, window, n_oracles, ccfg.n_failing, subset_size=10
         )
@@ -597,6 +601,19 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
         else:
             out = consensus_step(values, ccfg)
         return out.essence, out.reliability_second_pass, honest
+
+    fleet_consensus = jax.jit(fleet_consensus_body)
+
+    # Software-pipelined step, same law as the packed body: the fleet+
+    # consensus tail for batch k-1 runs inside batch k's forward
+    # program (data-independent subgraphs — the compiler can overlap
+    # the tail with the MXU matmuls); key-for-key lossless with a
+    # one-consensus drain after the loop.
+    @jax.jit
+    def pipelined_step(params, ids, mask, key, prev_window):
+        vecs = forward(params, ids, mask)
+        essence, rel2, _ = fleet_consensus_body(key, prev_window)
+        return vecs[:window_size], essence, rel2
 
     roundtrip = measure_roundtrip_ms()
 
@@ -653,6 +670,8 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
     steps = 0
     fetcher = AsyncResultFetcher(maxsize=2)
     rel2 = None
+    pipelined = os.environ.get("SVOC_BENCH_NO_PIPELINE") != "1"
+    max_steps = int(os.environ.get("SVOC_BENCH_MAX_STEPS", "0"))
     with PrefetchPipeline(
         unique_batches(),
         pipe.tokenizer,
@@ -662,18 +681,39 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
         # consumer loop only dispatches device compute.
         device_put=lambda b: jax.device_put((jnp.asarray(b[0]), jnp.asarray(b[1]))),
     ) as stream:
+        if pipelined:
+            # Prime with the (uncounted) warmup batch's window (vecs0
+            # is already computed); compile the fused step outside the
+            # clock (see the packed body for the key-chain law).
+            prev_window = vecs0[:window_size]
+            prev_key = key
+            device_fetch(
+                pipelined_step(pipe.params, ids1, mask1, prev_key, prev_window)[1]
+            )
         t0 = time.perf_counter()
         for ids, mask in stream:
-            vecs = forward(pipe.params, ids, mask)
-            window = vecs[:window_size]
             key = jax.random.fold_in(key, steps)
-            essence, rel2, _ = fleet_consensus(key, window)
-            if steps % sync_every == 0:
-                fetcher.submit(steps, essence)
+            if pipelined:
+                window, essence, rel2 = pipelined_step(
+                    pipe.params, ids, mask, prev_key, prev_window
+                )
+                prev_window, prev_key = window, key
+                # essence belongs to batch steps-1 (warmup at steps=0)
+                if steps > 0 and (steps - 1) % sync_every == 0:
+                    fetcher.submit(steps - 1, essence)
+            else:
+                vecs = forward(pipe.params, ids, mask)
+                window = vecs[:window_size]
+                essence, rel2, _ = fleet_consensus(key, window)
+                if steps % sync_every == 0:
+                    fetcher.submit(steps, essence)
             n_comments += batch
             steps += 1
-            if time.perf_counter() - t0 >= seconds:
+            if time.perf_counter() - t0 >= seconds or steps == max_steps:
                 break
+        if pipelined:
+            # Drain: the last counted batch's consensus.
+            essence, rel2, _ = fleet_consensus(prev_key, prev_window)
         # The clock stops only once the final step's checksum is on the
         # host — every counted step is provably executed.
         final_checksum = device_fetch(essence)
@@ -681,7 +721,7 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
         stream_stats = stream.stats()
     fetcher.finish()
     checksums = fetcher.checksums()
-    if (steps - 1) % sync_every != 0:  # final step not already submitted
+    if pipelined or (steps - 1) % sync_every != 0:
         checksums.append((steps - 1, final_checksum))
     assert_checksums_distinct(checksums)
     rel2_value = device_fetch(rel2)
@@ -705,7 +745,9 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
             "timing_method": (
                 "unique batches per step; async host-fetch checksum every "
                 f"{sync_every} steps; clock stopped after final-step fetch"
+                + (PIPELINED_TIMING_NOTE if pipelined else "")
             ),
+            "pipelined": pipelined,
             "device_roundtrip_ms": round(roundtrip, 3),
             "tokens_per_sec": round(tokens_per_sec, 1),
             "host_tokenize_per_sec": round(tok_per_sec, 2),
@@ -1913,12 +1955,7 @@ def _bench_packed_flagship(
                 "unique packed batches per step; async host-fetch checksum "
                 f"every {sync_every} steps; clock stopped after final-step "
                 "fetch"
-                + (
-                    "; software-pipelined (consensus k-1 fused into "
-                    "forward k's XLA program, drained after the loop)"
-                    if pipelined
-                    else ""
-                )
+                + (PIPELINED_TIMING_NOTE if pipelined else "")
             ),
             "pipelined": pipelined,
             **stream_detail(stream_stats, steps),
@@ -2145,12 +2182,7 @@ def _bench_packed_dp_serving(
                 "unique packed batches per step; async host-fetch checksum "
                 f"every {sync_every} steps; clock stopped after final-step "
                 "fetch"
-                + (
-                    "; software-pipelined (consensus k-1 fused into "
-                    "forward k's XLA program, drained after the loop)"
-                    if pipelined
-                    else ""
-                )
+                + (PIPELINED_TIMING_NOTE if pipelined else "")
             ),
             "pipelined": pipelined,
             "device_roundtrip_ms": round(roundtrip, 3),

@@ -269,6 +269,28 @@ def test_pipelined_packed_step_is_lossless():
     )
 
 
+def test_pipelined_dense_flagship_is_lossless():
+    """The dense flagship body's pipelined loop, same A/B law."""
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "SVOC_BENCH_SMALL": "1",
+        "SVOC_BENCH_MAX_STEPS": "5",
+        "SVOC_FLAGSHIP_VARIANT": "dense",
+    }
+    rc_a, a = _run_bench(["--config", "0", "--seconds", "60"], env)
+    rc_b, b = _run_bench(
+        ["--config", "0", "--seconds", "60"],
+        {**env, "SVOC_BENCH_NO_PIPELINE": "1"},
+    )
+    assert rc_a == 0 and rc_b == 0
+    assert a["detail"]["pipelined"] is True
+    assert b["detail"]["pipelined"] is False
+    assert a["detail"]["steps"] == b["detail"]["steps"] == 5
+    assert a["detail"]["consensus_reliability2"] == (
+        b["detail"]["consensus_reliability2"]
+    )
+
+
 def test_pipelined_dp_serving_is_lossless():
     """The config 9 mesh-level pipelined loop: same A/B law as
     config 8 — identical batches (fixed step budget), identical final
