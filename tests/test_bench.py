@@ -11,6 +11,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
@@ -184,6 +186,29 @@ def test_campaign_replay_prefers_routed_tpu_capture(tmp_path, monkeypatch):
     assert "replay_captured_at" not in legacy["detail"]
     # config with only a not-done item -> no replay
     assert bench.campaign_replay(10, "x") is None
+    # Without the routed re-capture, config 0 follows the COMMITTED
+    # routing to the variant's own capture (the same bench body config
+    # 0 executes) — the round-4 journal shape, where falling back to
+    # the dense line would misreport the flagship by 2x.
+    monkeypatch.setenv("SVOC_FLAGSHIP_VARIANT", "packed_flash")
+    journal.write_text(json.dumps({
+        "items": [
+            {"name": "bench_config0", "done": True,
+             "results": [capture(4515.7)]},
+            {"name": "bench_config12", "done": True,
+             "results": [capture(9582.95)]},
+        ],
+    }))
+    routed = bench.campaign_replay(0, "probe timed out")
+    assert routed["value"] == 9582.95
+    assert routed["detail"]["replay_item"] == "bench_config12"
+    monkeypatch.delenv("SVOC_FLAGSHIP_VARIANT")
+    # an unknown routing fails loudly (same law as the live flagship
+    # body) instead of silently replaying the wrong capture
+    monkeypatch.setenv("SVOC_FLAGSHIP_VARIANT", "flash")
+    with pytest.raises(ValueError, match="flagship_variant"):
+        bench.campaign_replay(0, "x")
+    monkeypatch.delenv("SVOC_FLAGSHIP_VARIANT")
     # kill switch
     monkeypatch.setenv("SVOC_BENCH_NO_REPLAY", "1")
     assert bench.campaign_replay(0, "x") is None

@@ -36,10 +36,14 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "PERF_DECISIONS.json")
 
+sys.path.insert(0, REPO)
+from bench import LOSSLESS_VARIANT_CONFIGS  # noqa: E402
+
+# {item_name: variant} derived from bench.py's single mapping so the
+# decision rules and the replay routing can never drift.
 LOSSLESS_VARIANTS = {
-    "bench_config0": "dense",
-    "bench_config8": "packed",
-    "bench_config12": "packed_flash",
+    f"bench_config{cfg}": variant
+    for variant, cfg in LOSSLESS_VARIANT_CONFIGS.items()
 }
 
 
