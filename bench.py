@@ -279,19 +279,22 @@ def campaign_replay(config: int, fallback_reason: str):
                 if res.get("captured_at"):
                     out["detail"]["replay_captured_at"] = res["captured_at"]
                 out["detail"]["fresh_probe_failure"] = fallback_reason
-                if variant is not None:
+                # A routed capture (bench_config0_routed) already
+                # carries its OWN genuine flagship_variant fields from
+                # the run that produced it — never overwrite them with
+                # the current decision, which may have changed since.
+                if variant is not None and name != "bench_config0_routed":
                     # The line of record is config 0's: label it as the
                     # routed flagship (keeping the capture's original
                     # metric string as provenance) and stamp the
                     # routing fields every genuine flagship line gets.
                     out["detail"]["flagship_variant"] = variant
                     out["detail"]["flagship_variant_source"] = variant_source
-                    if name != "bench_config0_routed":
-                        out["detail"]["replayed_metric"] = out["metric"]
-                        out["metric"] = (
-                            f"flagship (routed: {variant}; replayed "
-                            f"capture of {name}): " + out["metric"]
-                        )
+                    out["detail"]["replayed_metric"] = out["metric"]
+                    out["metric"] = (
+                        f"flagship (routed: {variant}; replayed "
+                        f"capture of {name}): " + out["metric"]
+                    )
                 return out
     return None
 

@@ -117,6 +117,74 @@ def hybrid_mesh(
     return Mesh(grid.reshape((n_slices,) + ici_spec.axis_sizes), axis_names)
 
 
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> bool:
+    """Multi-HOST bring-up: the one call a pod/multi-host launch makes
+    before any mesh construction, after which every ``make_mesh`` /
+    ``hybrid_mesh`` in this module sees the GLOBAL device set and the
+    same jit code scales across hosts (XLA collectives ride ICI within
+    a slice and DCN across, per :func:`hybrid_mesh`'s policy — the
+    whole of the scale-out role the reference ecosystem delegates to
+    NCCL/MPI backends, with no transport code in the framework).
+
+    ``jax.distributed.initialize`` is always ATTEMPTED (it auto-detects
+    TPU-pod metadata and Slurm/Open-MPI cluster envs when called with
+    no args); a plain single-host run — where detection finds nothing —
+    is a documented NO-OP so library code can call this
+    unconditionally.  Returns True iff the distributed runtime was (or
+    already is) initialized.  Ordering matters: JAX requires the call
+    BEFORE anything touches an XLA backend — a late call is a no-op on
+    a lone host but raises when a bring-up was explicitly configured,
+    never silently degrading a pod into N independent jobs.
+    """
+    import os
+
+    explicit = any(
+        v is not None
+        for v in (coordinator_address, process_id, local_device_ids)
+    ) or (num_processes or 0) > 1
+    env_signal = any(
+        os.environ.get(v)
+        for v in ("JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    try:  # tolerate private-API drift across jax versions
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, "client", None) is not None:
+            return True  # already initialized by the launcher
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge.backends_are_initialized():
+            if explicit or env_signal:
+                raise RuntimeError(
+                    "init_distributed() must run before any JAX backend "
+                    "use, but an XLA backend is already live and a "
+                    "multi-host bring-up was configured"
+                )
+            return False  # benign late call on a lone host
+    except ImportError:  # pragma: no cover
+        pass
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+        return True
+    except (RuntimeError, ValueError):
+        if explicit or env_signal:
+            raise  # a configured bring-up must not fail silently
+        return False  # no cluster detected: single-host no-op
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

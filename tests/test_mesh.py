@@ -83,3 +83,52 @@ def test_hybrid_mesh_multi_slice_rejects_partial_ici_coverage(monkeypatch):
     )
     with pytest.raises(ValueError, match="covers 2 chips but"):
         hybrid_mesh(MeshSpec(("oracle",), (2,)), n_slices=2)
+
+
+def test_init_distributed_contract(monkeypatch):
+    """The multi-host bring-up law: auto-detection is always ATTEMPTED
+    (no silent skip of TPU-pod/Slurm launches), a lone host where
+    detection finds nothing is a no-op, an explicitly configured
+    bring-up never fails silently, and a late call (XLA backend live)
+    is benign alone but loud when configured."""
+    import jax
+    from jax._src import distributed as _dist
+    from jax._src import xla_bridge
+
+    from svoc_tpu.parallel.mesh import init_distributed
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setattr(_dist.global_state, "client", None, raising=False)
+
+    # --- late call (the live test backend): benign alone, loud when
+    # a bring-up is configured
+    assert xla_bridge.backends_are_initialized()
+    assert init_distributed() is False
+    with pytest.raises(RuntimeError, match="before any JAX backend"):
+        init_distributed(coordinator_address="10.0.0.1:1234", num_processes=4)
+
+    # --- pre-backend behavior (simulated): detection attempted, no-op
+    # only when jax itself finds no cluster
+    monkeypatch.setattr(xla_bridge, "backends_are_initialized", lambda: False)
+    calls = []
+
+    def fake_initialize(**kw):
+        calls.append(kw)
+        if not any(kw.values()):
+            raise RuntimeError("Please specify coordinator_address")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+    assert init_distributed() is False  # attempted, nothing detected
+    assert len(calls) == 1
+    assert init_distributed(
+        coordinator_address="10.0.0.1:1234", num_processes=4, process_id=1
+    ) is True
+    assert calls[-1]["coordinator_address"] == "10.0.0.1:1234"
+    assert calls[-1]["num_processes"] == 4
+
+    # already initialized by the launcher -> True, no re-init
+    monkeypatch.setattr(_dist.global_state, "client", object(), raising=False)
+    n = len(calls)
+    assert init_distributed() is True
+    assert len(calls) == n

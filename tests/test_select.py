@@ -48,3 +48,36 @@ def test_short_window_pads_with_zeros():
 def test_shape_mismatch_raises():
     with pytest.raises(ValueError):
         first_valid_window(jnp.ones((4, 2)), jnp.ones(5, bool), 2)
+
+
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+
+@settings(
+    max_examples=60,
+    deadline=None,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+@given(
+    n=st.integers(4, 96),
+    w=st.integers(1, 24),
+    seed=st.integers(0, 2**31 - 1),
+    p_valid=st.floats(0.0, 1.0),
+)
+def test_property_matches_argsort_or_zero_pads(n, w, seed, p_valid):
+    """For ANY validity pattern: where the window fills, exact equality
+    with the stable-argsort selection; where it cannot, packer-order
+    prefix + zero padding — the law the packed consensus window rests
+    on."""
+    rng = np.random.default_rng(seed)
+    vecs = rng.standard_normal((n, 3)).astype(np.float32)
+    valid = rng.random(n) < p_valid
+    got = np.asarray(first_valid_window(jnp.asarray(vecs), jnp.asarray(valid), w))
+    k = int(valid.sum())
+    ref = argsort_reference(vecs, valid, w)
+    if k >= w:
+        np.testing.assert_array_equal(got, ref)
+    else:
+        np.testing.assert_array_equal(got[:k], ref[:k])
+        np.testing.assert_array_equal(got[k:], 0)
