@@ -80,3 +80,35 @@ def test_tie_order_matches_cairo_sort():
     np.testing.assert_array_equal(
         np.asarray(out.reliable), np.asarray(ref.reliable)
     )
+
+
+def test_compiled_size_is_constant_in_fleet_size():
+    """The round-4 N=1024 Mosaic hang was compiled-CODE-SIZE blowup:
+    the rank computation statically unrolled N/128 bodies per rank
+    call.  Since the fori_loop rework the traced kernel must be the
+    same size at every fleet size — this pins the law the fix rests on
+    (a regression shows up as eqn counts growing with N long before
+    anyone hangs a real chip on it)."""
+    import jax
+
+    def eqn_count(n):
+        cfg = ConsensusConfig(n_failing=n // 8, constrained=True)
+        vals = jnp.zeros((n, 6), jnp.float32)
+        jaxpr = jax.make_jaxpr(
+            lambda v: fused_consensus(v, cfg, interpret=True)
+        )(vals)
+        total, stack = 0, [jaxpr.jaxpr]
+        while stack:
+            jx = stack.pop()
+            for e in jx.eqns:
+                total += 1
+                for p in e.params.values():
+                    cand = getattr(p, "jaxpr", p)
+                    if hasattr(cand, "eqns"):
+                        stack.append(cand)
+                    elif hasattr(cand, "jaxpr") and hasattr(cand.jaxpr, "eqns"):
+                        stack.append(cand.jaxpr)
+        return total
+
+    counts = {n: eqn_count(n) for n in (256, 512, 1024)}
+    assert len(set(counts.values())) == 1, counts
