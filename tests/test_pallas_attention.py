@@ -245,3 +245,42 @@ class TestFlashSegments:
         assert np.all(np.asarray(dq)[0, pad] == 0)
         assert np.all(np.asarray(dk)[0, pad] == 0)
         assert np.all(np.asarray(dv)[0, pad] == 0)
+
+
+def test_flash_is_more_accurate_than_dense_reference_in_bf16():
+    """The flash-numerics adjudication's core claim, pinned on the
+    interpret path (same dtype chain as Mosaic, different op order):
+    against an f32-truth dense attention, the bf16 flash kernel's error
+    stays within the 4-ulp bound AND below the bf16 dense reference's
+    own error (the dense path rounds softmax P to bf16 before PV,
+    ring_attention.py:71; flash keeps P in f32).  At (256, 128) the
+    flash-vs-dense diff here reproduces the on-HW probe's 0.015625
+    exactly — the 'match_dense: false' at naive atol 2e-3 was a
+    tolerance bug, not kernel numerics (FLASH_PROBE.json, VERDICT r4
+    item 2)."""
+    h, d = 12, 64
+    b, t = 64, 128  # same seq as the flagship; smaller batch for CI
+    q = jax.random.normal(
+        jax.random.fold_in(jax.random.PRNGKey(0), 7), (b, t, h, d), jnp.bfloat16
+    )
+    mask = jnp.ones((b, t), jnp.int32)
+    qf = q.astype(jnp.float32)
+    truth = np.asarray(dense_attention_reference(qf, qf, qf, mask))
+    dense_bf16 = np.asarray(
+        dense_attention_reference(q, q, q, mask)
+    ).astype(np.float32)
+    flash_bf16 = np.asarray(
+        flash_attention(q, q, q, mask, block_q=256, block_k=256)
+    ).astype(np.float32)
+    scale = float(np.max(np.abs(truth)))
+    bound = 4.0 * 2.0**-8 * scale  # 4 x eps_bf16 x out scale
+    err_flash = float(np.max(np.abs(flash_bf16 - truth)))
+    err_dense = float(np.max(np.abs(dense_bf16 - truth)))
+    assert err_flash <= bound, (err_flash, bound)
+    assert err_flash <= err_dense, (err_flash, err_dense)
+    # The exact on-HW reproduction the adjudication cites: the
+    # interpret path's flash-vs-dense diff equals FLASH_PROBE.json's
+    # max_abs_diff at this seq — the on-silicon divergence is fully
+    # explained by the dtype chain.
+    flash_vs_dense = float(np.max(np.abs(flash_bf16 - dense_bf16)))
+    assert flash_vs_dense == 0.015625, flash_vs_dense

@@ -89,7 +89,6 @@ def test_compiled_size_is_constant_in_fleet_size():
     same size at every fleet size — this pins the law the fix rests on
     (a regression shows up as eqn counts growing with N long before
     anyone hangs a real chip on it)."""
-    import jax
 
     def eqn_count(n):
         cfg = ConsensusConfig(n_failing=n // 8, constrained=True)
