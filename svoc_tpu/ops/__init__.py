@@ -1,3 +1,4 @@
-"""Numeric primitives: fixed-point codec, vectorized statistics, indexed sort."""
+"""Numeric primitives: fixed-point codec, vectorized statistics,
+indexed sort, sort-free window selection."""
 
-from svoc_tpu.ops import fixedpoint, sort, stats  # noqa: F401
+from svoc_tpu.ops import fixedpoint, select, sort, stats  # noqa: F401

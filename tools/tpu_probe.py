@@ -159,13 +159,23 @@ out = flash_attention(q, q, q, mask)
 jax.block_until_ready(out)
 compile_s = time.time() - t0
 ref = dense_attention_reference(q, q, q, mask)
-match = bool(np.allclose(np.asarray(out), np.asarray(ref), atol=2e-3))
+# Dtype-aware verdict (round-4 postmortem: a naive atol 2e-3 sat BELOW
+# one bf16 ulp of the output scale, so this probe cried
+# "match_dense: false" over pure matmul rounding — the TPU MXU rounds
+# inputs to bf16 at default precision even for f32 arrays.  Full
+# adjudication: tools/flash_probe.py --parity-only).
+diff = float(np.max(np.abs(np.asarray(out) - np.asarray(ref))))
+scale = float(np.max(np.abs(np.asarray(ref))))
+bound = 4.0 * 2.0 ** -8 * scale
+match = diff <= bound
 
 dense_jit = jax.jit(dense_attention_reference)
 flash_ms = lat(lambda: flash_attention(q, q, q, mask))
 dense_ms = lat(lambda: dense_jit(q, q, q, mask))
 print(json.dumps({"flash_compiles": True, "compile_s": round(compile_s, 1),
-                  "match_dense": match, "flash_ms": round(flash_ms, 3),
+                  "match_dense": match, "max_abs_diff": diff,
+                  "dtype_bound": round(bound, 6),
+                  "flash_ms": round(flash_ms, 3),
                   "dense_ms": round(dense_ms, 3),
                   "speedup": round(dense_ms / flash_ms, 2)}))
 """
