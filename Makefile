@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web test test_fast presnapshot bench native clean
+.PHONY: run run_with_scraper run_scraper web test test_fast presnapshot bench campaign native clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -51,6 +51,11 @@ presnapshot:
 # One-line JSON throughput benchmark (flagship; --config N for others).
 bench:
 	$(PY) bench.py
+
+# Round-long liveness-gated hardware measurement campaign (resumes its
+# HW_CAMPAIGN.json journal; run in the background for the whole round).
+campaign:
+	$(PY) tools/hw_campaign.py
 
 # Build/verify the native C++ runtime pieces (they also build lazily
 # on first import).

@@ -263,6 +263,36 @@ def test_routed_item_refreshes_decide_perf(monkeypatch, tmp_path):
     assert routed["decided_variant"] == "packed"
 
 
+def test_flash_parity_only_full_path_writes_verdict(monkeypatch, tmp_path):
+    """The campaign's flash_parity decision item, end to end on a
+    simulated TPU platform (interpret-mode kernels, real adjudication
+    math): writes FLASH_PARITY.json with a rounding-equivalent verdict
+    that decide_perf accepts, and exits 0."""
+    import json as _json
+
+    import flash_probe
+
+    class FakeDev:
+        platform = "tpu"
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(flash_probe.jax, "devices", lambda: [FakeDev()])
+    monkeypatch.setattr(flash_probe, "PARITY_SHAPES", ((16, 64),))
+    assert flash_probe.parity_only() == 0
+    data = _json.loads((tmp_path / "FLASH_PARITY.json").read_text())
+    assert data["platform"] == "tpu"
+    assert data["verdict"] == "rounding-equivalent"
+    assert all(e["flash_within_bound"] for e in data["entries"])
+    entry = data["entries"][0]
+    # the adjudication's substance, not just its plumbing: flash is no
+    # less accurate than the dense reference against the f32 truth
+    assert entry["err_flash_vs_f32_truth"] <= entry["bound"]
+
+    import decide_perf
+
+    assert decide_perf.load_flash_verdict(str(tmp_path)) == "rounding-equivalent"
+
+
 def test_probe_bisect_stops_at_first_hang(monkeypatch, tmp_path):
     """The consensus size-bisect walks 128/256/512/1024 ascending and
     stops at the first hang — larger sizes would only burn the alive

@@ -65,6 +65,10 @@ def amortized_ms(step, n=16):
 
 
 EPS_BF16 = 2.0 ** -8  # 7 explicit mantissa bits -> rounding unit 2^-8
+#: Shapes the parity adjudication probes ((batch, seq) at 12 heads x
+#: d 64): the flagship shape and the mid-length one the round-4
+#: flash512 signal came from.  Module-level so tests can shrink them.
+PARITY_SHAPES = ((256, 128), (8, 512))
 # Headroom over a single final-cast rounding: the f32 accumulation
 # order differs between the two kernels (blocked online softmax vs one
 # monolithic softmax), contributing a few more ulps of f32-level noise
@@ -105,7 +109,7 @@ def parity_only():
         return 0
     entries = []
     h, d = 12, 64
-    for b, t in ((256, 128), (8, 512)):
+    for b, t in PARITY_SHAPES:
         key = jax.random.PRNGKey(0)
         q = jax.random.normal(jax.random.fold_in(key, 7), (b, t, h, d), jnp.bfloat16)
         mask = jnp.ones((b, t), jnp.int32)
