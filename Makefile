@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web test test_fast presnapshot bench campaign native clean
+.PHONY: run run_with_scraper run_scraper web test test_fast presnapshot bench campaign native metrics-smoke clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -56,6 +56,26 @@ bench:
 # HW_CAMPAIGN.json journal; run in the background for the whole round).
 campaign:
 	$(PY) tools/hw_campaign.py
+
+# Observability smoke: boot a session on the hermetic CPU mesh, run one
+# fetch+commit, scrape GET /metrics, and assert the stage-span
+# histograms are present (docs/OBSERVABILITY.md).
+metrics-smoke:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	$(PY) -c "import json, urllib.request; \
+	from tests.conftest import make_fake_console; \
+	from svoc_tpu.apps.web import serve; \
+	console = make_fake_console(); \
+	srv, _ = serve(console, port=0, block=False); \
+	base = 'http://127.0.0.1:%d' % srv.server_address[1]; \
+	urllib.request.urlopen(urllib.request.Request(base + '/api/query', data=b'fetch', method='POST'), timeout=30).read(); \
+	urllib.request.urlopen(urllib.request.Request(base + '/api/query', data=b'commit', method='POST'), timeout=30).read(); \
+	text = urllib.request.urlopen(base + '/metrics', timeout=30).read().decode(); \
+	needed = ['svoc_stage_seconds_bucket{stage=\"fetch\"', 'svoc_stage_seconds_bucket{stage=\"fleet\"', 'svoc_stage_seconds_bucket{stage=\"consensus\"', 'svoc_stage_seconds_bucket{stage=\"commit\"', 'svoc_comments_processed_total']; \
+	missing = [n for n in needed if n not in text]; \
+	assert not missing, 'missing series: %s' % missing; \
+	srv.shutdown(); \
+	print('metrics-smoke OK: /metrics served %d lines' % len(text.splitlines()))"
 
 # Build/verify the native C++ runtime pieces (they also build lazily
 # on first import).

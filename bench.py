@@ -283,6 +283,7 @@ def campaign_replay(config: int, fallback_reason: str):
                 # carries its OWN genuine flagship_variant fields from
                 # the run that produced it — never overwrite them with
                 # the current decision, which may have changed since.
+                out["detail"]["replayed_metric"] = out["metric"]
                 if variant is not None and name != "bench_config0_routed":
                     # The line of record is config 0's: label it as the
                     # routed flagship (keeping the capture's original
@@ -290,10 +291,17 @@ def campaign_replay(config: int, fallback_reason: str):
                     # routing fields every genuine flagship line gets.
                     out["detail"]["flagship_variant"] = variant
                     out["detail"]["flagship_variant_source"] = variant_source
-                    out["detail"]["replayed_metric"] = out["metric"]
                     out["metric"] = (
                         f"flagship (routed: {variant}; replayed "
                         f"capture of {name}): " + out["metric"]
+                    )
+                else:
+                    # EVERY replayed line of record says so in the
+                    # top-level metric string, not only routed config-0
+                    # replays — a recycled number must never read as a
+                    # fresh capture in a BENCH artifact skim.
+                    out["metric"] = (
+                        f"(replayed capture of {name}) " + out["metric"]
                     )
                 return out
     return None
@@ -410,36 +418,59 @@ def measure_roundtrip_ms(reps: int = 10) -> float:
     return float(np.median(samples))
 
 
-def timed_latency_ms(fn, reps: int = 30) -> float:
+def timed_latency_ms(fn, reps: int = 30, stage: str = None) -> float:
     """Median SINGLE-SHOT latency of ``fn()`` in milliseconds, timed by
     host fetch of the result (see :func:`device_fetch`) — includes one
     device roundtrip; report ``measure_roundtrip_ms`` alongside so the
-    pure-execution part is explainable."""
+    pure-execution part is explainable.
+
+    ``stage`` feeds every sample into the shared observability
+    registry's ``stage_seconds{stage=...}`` histogram
+    (:mod:`svoc_tpu.utils.metrics`) — the same series live serving
+    telemetry fills — so a BENCH artifact's stage latencies and a
+    scraped ``/metrics`` percentile can never disagree about what was
+    measured.
+    """
     import numpy as np
 
+    hist = None
+    if stage is not None:
+        from svoc_tpu.utils.metrics import registry as _registry
+
+        hist = _registry.stage_histogram(stage)
     device_fetch(fn())  # warm
     samples = []
     for _ in range(reps):
         t0 = time.perf_counter()
         device_fetch(fn())
-        samples.append((time.perf_counter() - t0) * 1e3)
+        dt = time.perf_counter() - t0
+        if hist is not None:
+            hist.observe(dt)
+        samples.append(dt * 1e3)
     return float(np.median(samples))
 
 
-def amortized_step_ms(step, n: int = 32) -> float:
+def amortized_step_ms(step, n: int = 32, stage: str = None) -> float:
     """Per-step EXECUTION time: dispatch ``n`` dependent-free steps
     back-to-back, host-fetch only the last result.  The device executes
     dispatches in order, so the final fetch waits for all ``n``
     executions and the roundtrip amortizes to ~1/n per step.
     ``step(i)`` must dispatch with step-varying input and return a
-    device handle."""
+    device handle.  ``stage`` records the amortized per-step time into
+    the shared registry like :func:`timed_latency_ms` (one observation
+    — the n steps share one fetch, there is only one honest sample)."""
     device_fetch(step(0))  # warm this dispatch pattern
     t0 = time.perf_counter()
     h = None
     for i in range(n):
         h = step(i + 1)
     device_fetch(h)
-    return (time.perf_counter() - t0) / n * 1e3
+    per_step_s = (time.perf_counter() - t0) / n
+    if stage is not None:
+        from svoc_tpu.utils.metrics import registry as _registry
+
+        _registry.stage_histogram(stage).observe(per_step_s)
+    return per_step_s * 1e3
 
 
 class AsyncResultFetcher:
@@ -691,17 +722,23 @@ def bench_flagship(seconds: float, small: bool, platform: str) -> dict:
     # Isolated stage timings: single-shot latency (incl. one roundtrip)
     # and amortized pure-execution time for the forward.
     reps = latency_reps(platform)
-    fwd_ms = timed_latency_ms(lambda: forward(pipe.params, ids0, mask0), reps=reps)
+    fwd_ms = timed_latency_ms(
+        lambda: forward(pipe.params, ids0, mask0), reps=reps, stage="forward"
+    )
     fwd_exec_ms = amortized_step_ms(
         lambda i: forward(pipe.params, ids0 if i % 2 else ids1, mask0),
         n=amortize_reps(platform),
+        stage="forward_exec",
     )
     consensus_ms = timed_latency_ms(
-        lambda: fleet_consensus(key, vecs0[:window_size]), reps=reps
+        lambda: fleet_consensus(key, vecs0[:window_size]),
+        reps=reps,
+        stage="consensus",
     )
     consensus_exec_ms = amortized_step_ms(
         lambda i: fleet_consensus(jax.random.fold_in(key, i), vecs0[:window_size]),
         n=amortize_reps(platform),
+        stage="consensus_exec",
     )
 
     # Sync interval: amortize the fetch roundtrip to <~1/8 of execution
@@ -1573,6 +1610,7 @@ def bench_config7(seconds: float, small: bool, platform: str) -> dict:
     step_ms = timed_latency_ms(
         lambda: serve(pipe.params, key, ids0, mask0)[0].essence,
         reps=latency_reps(platform),
+        stage="serving_step_e2e",
     )
     step_exec_ms = amortized_step_ms(
         lambda i: serve(
@@ -1866,15 +1904,19 @@ def _bench_packed_flagship(
         )
 
     reps = latency_reps(platform)
-    fwd_ms = timed_latency_ms(lambda: forward(pipe.params, *dev0), reps=reps)
+    fwd_ms = timed_latency_ms(
+        lambda: forward(pipe.params, *dev0), reps=reps, stage="forward"
+    )
     fwd_exec_ms = amortized_step_ms(
         lambda i: forward(pipe.params, *(dev0 if i % 2 else dev1)),
         n=amortize_reps(platform),
+        stage="forward_exec",
     )
     vecs0 = forward(pipe.params, *dev0)
     consensus_exec_ms = amortized_step_ms(
         lambda i: fleet_consensus(jax.random.fold_in(key, i), vecs0, valid0)[0],
         n=amortize_reps(platform),
+        stage="consensus_exec",
     )
     step_exec_ms = fwd_exec_ms + consensus_exec_ms
     sync_every = max(1, min(64, int(round(8 * roundtrip / max(step_exec_ms, 1e-3)))))
@@ -2126,6 +2168,7 @@ def _bench_packed_dp_serving(
     step_ms = timed_latency_ms(
         lambda: serve(pipe.params, key, *dev0, valid0)[0].essence,
         reps=latency_reps(platform),
+        stage="serving_step_e2e",
     )
     step_exec_ms = amortized_step_ms(
         lambda i: serve(
@@ -2376,6 +2419,17 @@ def main(argv=None) -> int:
         result.setdefault("detail", {})
         result["detail"]["backend"] = jax.devices()[0].platform
         result["detail"]["n_devices"] = len(jax.devices())
+        # The shared observability registry collected every stage
+        # sample the bench body produced (timed_latency_ms /
+        # amortized_step_ms feed stage_seconds, the prefetch producer
+        # records tokenize/h2d spans): embed its percentile snapshot so
+        # the artifact and live telemetry are one data set, and mirror
+        # the step-time-derived MFU into the gauge /metrics exposes.
+        from svoc_tpu.utils.metrics import registry as _obs
+
+        stage_hists = _obs.stage_snapshot()
+        if stage_hists:
+            result["detail"]["stage_seconds"] = stage_hists
         if fallback_reason:
             result["detail"]["backend_fallback"] = fallback_reason
         if small:
@@ -2386,6 +2440,8 @@ def main(argv=None) -> int:
                 "complete it in bounded time"
             )
         mfu = result["detail"].get("mfu_estimate")
+        if mfu is not None:
+            _obs.gauge("mfu_estimate").set(mfu)
         if mfu is not None and mfu > 1.0:
             # A >100%-of-peak number is a measurement bug, never a
             # result (round-2 advisor finding) — refuse to report it

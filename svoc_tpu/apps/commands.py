@@ -39,7 +39,9 @@ HELP = """Commands:
     - auto_resume on/off (default: off, ie. commit => resume)
     - scraper on/off (default: off)
     - live_mode on/off (default: off; scraper + auto_fetch + auto_commit)
-    - metrics (throughput / latency counters)
+    - metrics [prom|trace] (throughput / latency / stage percentiles;
+      'prom' dumps the Prometheus text exposition the /metrics
+      endpoint serves; 'trace' lists the most recent stage spans)
     - multimodal [K|auto] (mixture analysis of the last fetch;
       default K=2, 'auto' selects K by BIC)
 
@@ -370,11 +372,35 @@ class CommandConsole:
                     self._stop_scraper()
                     emit("Scraper: DISABLED")
             elif cmd == "metrics":
-                from svoc_tpu.utils.metrics import registry as _metrics
+                from svoc_tpu.utils.metrics import (
+                    registry as _metrics,
+                    sample_runtime_gauges,
+                    tracer as _tracer,
+                )
 
-                lines = _metrics.report()
-                for line in lines or ["no metrics recorded yet"]:
-                    emit(line)
+                if len(args) > 1 or (args and args[0] not in ("prom", "trace")):
+                    emit("Usage: metrics [prom|trace]")
+                    return out
+                # Same on-demand device/runtime gauge sample as the
+                # /metrics endpoint — console and scrape agree.
+                sample_runtime_gauges(_metrics)
+                if args and args[0] == "prom":
+                    for line in _metrics.render_prometheus().splitlines():
+                        emit(line)
+                elif args and args[0] == "trace":
+                    spans = _tracer.recent(20)
+                    if not spans:
+                        emit("no spans recorded yet")
+                    for s in spans:
+                        emit(
+                            f"{'  ' * s.depth}{s.name}: "
+                            f"{s.duration_s * 1e3:.2f}ms "
+                            f"[{s.thread}]"
+                        )
+                else:
+                    lines = _metrics.report()
+                    for line in lines or ["no metrics recorded yet"]:
+                        emit(line)
             elif cmd == "multimodal":
                 # Beyond-reference: mixture-model analysis of the LAST
                 # fetched fleet (the scenario documentation/README.md:

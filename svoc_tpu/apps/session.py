@@ -38,6 +38,7 @@ from svoc_tpu.io.comment_store import (
 from svoc_tpu.ops.stats import rank_array
 from svoc_tpu.sim.oracle import gen_oracle_predictions
 from svoc_tpu.utils.metrics import registry as metrics
+from svoc_tpu.utils.metrics import stage_span
 
 
 class EmptyStoreError(RuntimeError):
@@ -276,7 +277,7 @@ class Session:
         # poll.  Racing fetches classify concurrently, each on the
         # distinct window its atomic cursor advance claimed; the claim
         # token keeps publishes in window order.
-        with metrics.timer("fetch_latency").time():
+        with metrics.timer("fetch_latency").time(), stage_span("fetch"):
             with self.lock:
                 comments, _dates, self.simulation_step = self.store.read_window(
                     self.simulation_step, self.config.window, self.config.fetch_limit
@@ -291,31 +292,39 @@ class Session:
             # Resolved only now: an empty store must fail in
             # milliseconds, not after a transformer build.
             vectorize = self.vectorizer
-            window = jnp.asarray(
-                np.asarray(vectorize(comments), dtype=np.float32)
-            )
+            # A SentimentPipeline records its own tokenize/pack/forward
+            # child spans; "vectorize" covers injected vectorizers too.
+            with stage_span("vectorize"):
+                window = jnp.asarray(
+                    np.asarray(vectorize(comments), dtype=np.float32)
+                )
             with self.lock:
                 if self._key_value is None:
                     self._key_value = jax.random.PRNGKey(self.config.seed)
                 self._key_value, sub = jax.random.split(self._key_value)
-            values, honest = _fleet(
-                sub,
-                window,
-                self.config.n_oracles,
-                self.config.n_failing,
-                self.config.bootstrap_subset,
-            )
-            mean, median, ranks = _preview_stats(values)
+            with stage_span("fleet"):
+                values, honest = _fleet(
+                    sub,
+                    window,
+                    self.config.n_oracles,
+                    self.config.n_failing,
+                    self.config.bootstrap_subset,
+                )
+            with stage_span("consensus"):
+                # The host conversions below are the existing fetch of
+                # the fleet/preview results — the span times dispatch +
+                # that fetch without adding any device sync of its own.
+                mean, median, ranks = _preview_stats(values)
+                predictions = np.asarray(values, dtype=np.float64)
+                preview = {
+                    "values": predictions,
+                    "mean": np.asarray(mean),
+                    "median": np.asarray(median),
+                    "normalized_ranks": np.asarray(ranks),
+                    "honest": np.asarray(honest),
+                    "n_comments": len(comments),
+                }
             metrics.counter("comments_processed").add(len(comments))
-            predictions = np.asarray(values, dtype=np.float64)
-            preview = {
-                "values": predictions,
-                "mean": np.asarray(mean),
-                "median": np.asarray(median),
-                "normalized_ranks": np.asarray(ranks),
-                "honest": np.asarray(honest),
-                "n_comments": len(comments),
-            }
             with self.lock:
                 # Publish only if no LATER claim already did — a slow
                 # fetch of an older window must not regress the state.

@@ -17,7 +17,12 @@ HTML page (hand-rolled canvas scatter plots) plus two JSON endpoints:
   eel-websocket push parity the reference gets from
   ``eel.expose``/``main.js:26``; VERDICT r4 "missing" item 5).  The
   page is push-first with the poll loop demoted to a slow reconnect
-  fallback.
+  fallback.  Concurrent streams are capped (``MAX_SSE_STREAMS``) —
+  each holds one ThreadingHTTPServer thread, and an abandoned tab
+  must not exhaust the thread pool,
+- ``GET /metrics`` — Prometheus text exposition of the shared
+  observability registry (stage-span histograms, counters, timers,
+  device gauges sampled on demand; docs/OBSERVABILITY.md).
 
 Start with ``python -m svoc_tpu.apps.web`` or ``serve(console)``.
 """
@@ -223,8 +228,13 @@ query('help');  // boot with the command list (main.js:45); its
 let pushAlive = false;
 let pushedVersion = null, pushRefreshing = false;
 const events = new EventSource('/api/events');
-events.onopen = () => { pushAlive = true; };
-events.onerror = () => { pushAlive = false; };
+// Reconnect resets the catch-up target: a pushed version from the
+// PREVIOUS server process is not comparable to the new process's
+// versions (a restarted server counts from 0 again, so a stale high
+// target would spin the catch-up loop forever against a server that
+// can never reach it).
+events.onopen = () => { pushAlive = true; pushedVersion = null; };
+events.onerror = () => { pushAlive = false; pushedVersion = null; };
 events.onmessage = async (ev) => {
   pushAlive = true;
   pushedVersion = JSON.parse(ev.data).state_version;
@@ -233,11 +243,15 @@ events.onmessage = async (ev) => {
   try {
     // catch up to at least the pushed version; versions are monotonic,
     // so a fetch that returns NEWER than the push exits immediately
-    // (no spin), and a transient fetch failure retries after a pause
-    // instead of leaving the page stale until the next state change.
-    while (pushedVersion > lastVersion) {
+    // (no spin), a transient fetch failure retries after a pause, and
+    // a SUCCESSFUL fetch that still trails the target (rapid pushes,
+    // or a version skew after restart) paces itself instead of
+    // hammering /api/state in a busy-loop.
+    while (pushedVersion !== null && pushedVersion > lastVersion) {
       try { await refresh(); }
       catch (e) { await new Promise(res => setTimeout(res, 500)); }
+      if (pushedVersion !== null && pushedVersion > lastVersion)
+        await new Promise(res => setTimeout(res, 250));
     }
   } finally { pushRefreshing = false; }
 };
@@ -258,6 +272,13 @@ setInterval(async () => {
 
 class _Handler(BaseHTTPRequestHandler):
     console: CommandConsole  # set by serve()
+
+    #: Concurrent /api/events streams allowed — each parks one
+    #: ThreadingHTTPServer thread in the push loop, so without a cap a
+    #: handful of abandoned tabs (or a reconnect storm) would starve
+    #: the query/state handlers of threads.  Excess clients get 503 +
+    #: Retry-After and fall back to the page's poll loop.
+    MAX_SSE_STREAMS = 16
 
     def _host_ok(self) -> bool:
         """DNS-rebinding guard for loopback serving: the Host header
@@ -341,6 +362,20 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, json.dumps(payload).encode(), "application/json")
         elif self.path == "/api/events":
             self._serve_events()
+        elif self.path == "/metrics":
+            # Prometheus text exposition of the shared registry.  The
+            # runtime gauges (live-array bytes per device, compile
+            # counts) are sampled here, on demand — never on the hot
+            # path, and a no-op before the first device touch (the
+            # lazy-backend design of apps/session.py).
+            from svoc_tpu.utils.metrics import registry, sample_runtime_gauges
+
+            sample_runtime_gauges(registry)
+            self._send(
+                200,
+                registry.render_prometheus().encode(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
         else:
             self._send(404, b"not found", "text/plain")
 
@@ -350,18 +385,36 @@ class _Handler(BaseHTTPRequestHandler):
         ``main.js:26``, on a stdlib transport).  Each open stream holds
         one ThreadingHTTPServer thread; the loop exits on client
         disconnect (write fails) or server shutdown (the ``__shutdown``
-        flag ``serve``'s closer sets), and a 15 s heartbeat comment
-        bounds how long a silent dead connection lingers."""
+        flag ``serve``'s closer sets), a 15 s heartbeat comment bounds
+        how long a silent dead connection lingers, and concurrent
+        streams are capped at ``MAX_SSE_STREAMS`` (503 + Retry-After
+        beyond it — the page's poll fallback covers rejected clients)."""
         import time as _time
 
+        # Admission under the server-wide lock: racing opens must not
+        # both pass the check and overshoot the cap.
+        with self.server.svoc_sse_lock:
+            if self.server.svoc_sse_streams >= self.MAX_SSE_STREAMS:
+                self.send_response(503)
+                self.send_header("Retry-After", "5")
+                body = b"too many event streams"
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                from svoc_tpu.utils.metrics import registry as _metrics
+
+                _metrics.counter("sse_rejected").add(1)
+                return
+            self.server.svoc_sse_streams += 1
         session = self.console.session
-        self.send_response(200)
-        self.send_header("Content-Type", "text/event-stream")
-        self.send_header("Cache-Control", "no-cache")
-        self.end_headers()
-        last_version = None
-        last_write = 0.0
         try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            last_version = None
+            last_write = 0.0
             while not getattr(self.server, "svoc_shutting_down", False):
                 with session.lock:
                     version = session.state_version
@@ -376,8 +429,15 @@ class _Handler(BaseHTTPRequestHandler):
                     self.wfile.flush()
                     last_write = now
                 _time.sleep(0.25)
-        except OSError:  # client went away (incl. BrokenPipe/Reset)
+        except (OSError, ValueError):
+            # Client went away (BrokenPipe/Reset) or the handler's
+            # wfile was torn down mid-write ("I/O operation on closed
+            # file" surfaces as ValueError) — either way this stream is
+            # done; the slot release below is what matters.
             return
+        finally:
+            with self.server.svoc_sse_lock:
+                self.server.svoc_sse_streams -= 1
 
     def do_POST(self):  # noqa: N802
         if self.path != "/api/query":
@@ -432,6 +492,9 @@ def serve(
     # (daemon threads — this bounds their lifetime under test servers
     # that start and stop within one process).
     server.svoc_shutting_down = False
+    # Live SSE stream accounting (the MAX_SSE_STREAMS cap).
+    server.svoc_sse_streams = 0
+    server.svoc_sse_lock = threading.Lock()
     orig_shutdown = server.shutdown
 
     def shutdown():
@@ -442,7 +505,12 @@ def serve(
     if block:  # pragma: no cover — interactive mode
         server.serve_forever()
         return server, None
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    # Tight poll interval: shutdown() blocks a full poll period, and
+    # embedded/test servers start and stop constantly — the stdlib
+    # default of 0.5 s turns every teardown into half a second.
+    thread = threading.Thread(
+        target=lambda: server.serve_forever(poll_interval=0.05), daemon=True
+    )
     thread.start()
     return server, thread
 

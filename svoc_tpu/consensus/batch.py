@@ -139,6 +139,19 @@ def certify(
 ) -> np.ndarray:
     """Per-prefix bool: ``True`` ⇒ the exact engine provably completes
     this recompute without a panic (within the guard bands)."""
+    from svoc_tpu.utils.metrics import stage_span
+
+    with stage_span("consensus_certify"):
+        return _certify(m, cfg, strict_interval, bands)
+
+
+def _certify(
+    m: PrefixMargins, cfg: ConsensusConfig, strict_interval: bool,
+    bands: CertifyMargins,
+) -> np.ndarray:
+    # The np.asarray calls below ARE the host fetch of the margin sweep
+    # (jit-dispatched by the caller) — the span covers device wait +
+    # the band checks without adding a sync of its own.
     rel1 = np.asarray(m.rel1, dtype=np.float64)
     rel2 = np.asarray(m.rel2, dtype=np.float64)
     a1 = np.asarray(m.sqrt_arg1, dtype=np.float64) * WSAD

@@ -588,6 +588,14 @@ class ChainAdapter:
         for fleets ≥ ``BATCH_COMMIT_THRESHOLD``; ``True``/``False``
         force it on/off.
         """
+        from svoc_tpu.utils.metrics import stage_span
+
+        with stage_span("commit"):
+            return self._update_all_the_predictions(predictions, batch=batch)
+
+    def _update_all_the_predictions(
+        self, predictions: Sequence, *, batch: Optional[bool] = None
+    ) -> int:
         oracles = self.call_oracle_list()
         total = min(len(oracles), len(predictions))
         batched_invoke = getattr(

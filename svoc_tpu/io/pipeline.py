@@ -66,6 +66,8 @@ class PrefetchPipeline:
     def _produce(self) -> None:
         import time
 
+        from svoc_tpu.utils.metrics import stage_span
+
         try:
             for texts in self._source:
                 if self._stop.is_set():
@@ -74,9 +76,11 @@ class PrefetchPipeline:
                 if self._tokenizer is None:  # raw mode — item is ready
                     batch = texts
                 else:
-                    batch = self._tokenizer(list(texts), self._seq_len)
+                    with stage_span("tokenize"):
+                        batch = self._tokenizer(list(texts), self._seq_len)
                 if self._device_put is not None:
-                    batch = self._device_put(batch)
+                    with stage_span("h2d"):
+                        batch = self._device_put(batch)
                 self._produced += 1
                 self._produce_s += time.perf_counter() - t0
                 while not self._stop.is_set():

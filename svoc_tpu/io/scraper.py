@@ -145,10 +145,13 @@ def run_scraper(
     if delay:
         sleep(delay)
     rounds = 0
+    from svoc_tpu.utils.metrics import stage_span
+
     while max_rounds is None or rounds < max_rounds:
         if stop_event is not None and stop_event.is_set():
             break
-        total += store.save(source())
+        with stage_span("scrape"):
+            total += store.save(source())
         rounds += 1
         if max_rounds is not None and rounds >= max_rounds:
             break

@@ -21,6 +21,7 @@ import numpy as np
 from svoc_tpu.models.configs import ROBERTA_GO_EMOTIONS, EncoderConfig
 from svoc_tpu.models.encoder import SentimentEncoder, init_params
 from svoc_tpu.models.tokenizer import load_tokenizer
+from svoc_tpu.utils.metrics import stage_span
 
 #: The 28 go_emotions labels in model-head order (the reference model's
 #: label space, https://huggingface.co/SamLowe/roberta-base-go_emotions).
@@ -260,11 +261,13 @@ class SentimentPipeline:
 
         if not len(texts):
             return np.zeros((0, self.dimension))
-        ids, mask = self.tokenizer(list(texts), self.seq_len)
-        token_lists = strip_padding(ids, mask)
-        batch, n = pack_tokens_auto(
-            token_lists, self.seq_len, max_segments, self.tokenizer.pad_id
-        )
+        with stage_span("tokenize"):
+            ids, mask = self.tokenizer(list(texts), self.seq_len)
+        with stage_span("pack"):
+            token_lists = strip_padding(ids, mask)
+            batch, n = pack_tokens_auto(
+                token_lists, self.seq_len, max_segments, self.tokenizer.pad_id
+            )
         assert n == len(texts), f"packer consumed {n}/{len(texts)} without a row cap"
         forward = self._packed_forward()
         out = np.zeros((len(texts), self.dimension), dtype=np.float64)
@@ -281,7 +284,10 @@ class SentimentPipeline:
                     )
                     for a in chunk
                 ]
-            vecs = np.asarray(forward(self.params, *chunk), dtype=np.float64)
+            # The span covers dispatch + the np.asarray host fetch that
+            # was already here — no added device sync.
+            with stage_span("forward"):
+                vecs = np.asarray(forward(self.params, *chunk), dtype=np.float64)
             valid = batch.seg_valid[sl] > 0
             out[batch.owner[sl][valid]] = vecs[:n_real][valid]
         return out
@@ -302,9 +308,13 @@ class SentimentPipeline:
             chunk = list(texts[i : i + b])
             n_real = len(chunk)
             chunk += [""] * (b - n_real)  # fixed shapes — no recompiles
-            ids, mask = self.tokenizer(chunk, self.seq_len)
+            with stage_span("tokenize"):
+                ids, mask = self.tokenizer(chunk, self.seq_len)
             # No explicit device_put: the jitted forward's in_shardings
             # place the raw numpy batch shard-wise in one transfer.
-            vecs = self._forward(self.params, ids, mask)
-            out.append(np.asarray(vecs[:n_real], dtype=np.float64))
+            # The span covers dispatch + the np.asarray host fetch that
+            # was already here — no added device sync.
+            with stage_span("forward"):
+                vecs = self._forward(self.params, ids, mask)
+                out.append(np.asarray(vecs[:n_real], dtype=np.float64))
         return np.concatenate(out, axis=0) if out else np.zeros((0, self.dimension))

@@ -151,12 +151,17 @@ def init_distributed(
         os.environ.get(v)
         for v in ("JAX_COORDINATOR_ADDRESS", "MEGASCALE_COORDINATOR_ADDRESS")
     )
+    # Both probes below touch jax._src private surfaces, which drift
+    # across jax versions in module path AND attribute shape — a
+    # missing module (ImportError) or a renamed/removed symbol
+    # (AttributeError) must stay a benign single-host no-op, never a
+    # crash in every make_mesh caller.
     try:  # tolerate private-API drift across jax versions
         from jax._src import distributed as _dist
 
         if getattr(_dist.global_state, "client", None) is not None:
             return True  # already initialized by the launcher
-    except ImportError:  # pragma: no cover
+    except (ImportError, AttributeError):  # pragma: no cover
         pass
     try:
         from jax._src import xla_bridge
@@ -169,7 +174,7 @@ def init_distributed(
                     "multi-host bring-up was configured"
                 )
             return False  # benign late call on a lone host
-    except ImportError:  # pragma: no cover
+    except (ImportError, AttributeError):  # pragma: no cover
         pass
     try:
         jax.distributed.initialize(

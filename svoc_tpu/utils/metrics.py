@@ -1,22 +1,56 @@
-"""Throughput / latency metrics and profiler hooks.
+"""Observability: counters, histograms, spans, gauges, and exposition.
 
 The reference's only instrumentation is Cairo gas budgets and print
 statements (SURVEY.md §5); the framework's north-star metric is
 end-to-end comments/sec and consensus-update latency, so those get
-first-class counters here, used by ``bench.py`` and the apps loop.
+first-class telemetry here, used by ``bench.py``, ``tools/soak.py``,
+the apps loop, and the web server's ``/metrics`` endpoint.
+
+Layered like a production trainer's telemetry (HybridFlow / G-Core
+style — every pipeline stage and collective phase is a first-class
+series):
+
+- :class:`Counter` — monotone event counts with windowed rates,
+- :class:`Histogram` — fixed log-spaced buckets, p50/p95/p99 snapshots,
+- :class:`Gauge` — last-written values (device bytes, MFU, …),
+- :class:`LatencyTimer` — running mean/max (kept for artifact compat),
+- :class:`Tracer` / :func:`stage_span` — nestable spans with a bounded
+  ring buffer and JSONL export (``SVOC_TRACE_FILE``), each completed
+  span feeding the shared per-stage histogram so traces and scraped
+  percentiles can never disagree,
+- :meth:`MetricsRegistry.render_prometheus` — text exposition served at
+  ``GET /metrics`` (``svoc_tpu.apps.web``) and dumped by the console's
+  ``metrics prom`` command,
+- :func:`sample_runtime_gauges` — on-demand device/runtime gauges
+  (``jax.live_arrays()`` bytes per device, compile counts via a
+  ``jax.monitoring`` listener, step-time-derived MFU).
+
+Cost model: spans record AROUND dispatch on the host — never inside
+``jit``, never adding a device sync — and one completed span is two
+``perf_counter`` calls plus a lock-guarded histogram increment
+(sub-microsecond against multi-ms stages).  Everything is thread-safe
+under the auto_fetch / auto_commit / web-handler threads.
 
 ``jax.profiler`` tracing is wrapped so a session can be profiled with
 one flag and inspected in TensorBoard/XProf.
+
+Stage-name conventions (docs/OBSERVABILITY.md): ``scrape``,
+``tokenize``, ``pack``, ``forward``, ``fleet``, ``consensus``,
+``consensus_certify``, ``fetch``, ``commit``, ``serving_step``.
 """
 
 from __future__ import annotations
 
+import bisect
 import contextlib
+import itertools
+import json
+import os
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 
 @dataclass
@@ -76,6 +110,148 @@ class Counter:
 
 
 @dataclass
+class Gauge:
+    """A last-written value (device bytes, MFU, queue depth, …)."""
+
+    value: float = 0.0
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def add(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def get(self) -> float:
+        with self._lock:
+            return self.value
+
+
+def log_buckets(
+    lo: float = 1e-4, hi: float = 120.0, per_decade: int = 4
+) -> Tuple[float, ...]:
+    """Fixed log-spaced bucket upper bounds, ``lo``…``hi`` inclusive.
+
+    The default grid (100 µs → 120 s, 4/decade ⇒ ~1.78× steps) spans
+    everything the pipeline produces — sub-ms consensus dispatches up
+    to first-call XLA compiles — with ≤ ~78 % worst-case interpolation
+    error per bucket, far inside what p95/p99 regressions look like.
+    """
+    edges = []
+    step = 10.0 ** (1.0 / per_decade)
+    v = lo
+    while v < hi * (1.0 + 1e-9):
+        edges.append(float(f"{v:.6g}"))  # stable, readable bounds
+        v *= step
+    return tuple(edges)
+
+
+DEFAULT_BUCKETS = log_buckets()
+
+
+class Histogram:
+    """Fixed-bucket histogram with percentile snapshots.
+
+    Buckets are cumulative-upper-bound (Prometheus ``le`` semantics)
+    with a final +Inf overflow bucket.  Percentiles interpolate
+    linearly inside the selected bucket — exact enough for log-spaced
+    buckets, and crucially *monotone* (a p99 regression can never hide
+    behind sample order).  Thread-safe: ``observe`` runs on producer /
+    auto_fetch threads while snapshots serve the web thread.
+    """
+
+    def __init__(self, buckets: Optional[Tuple[float, ...]] = None):
+        self.buckets: Tuple[float, ...] = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._min = None
+        self._max = None
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += value
+            self._count += 1
+            if self._min is None or value < self._min:
+                self._min = value
+            if self._max is None or value > self._max:
+                self._max = value
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def percentile(self, q: float) -> float:
+        """The q-th percentile (``q`` in [0, 100]), bucket-interpolated.
+
+        0 with no samples.  The overflow bucket reports the observed
+        max (a finite, honest answer — the +Inf bound is not a value).
+        """
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"percentile q={q} outside [0, 100]")
+        with self._lock:
+            if not self._count:
+                return 0.0
+            target = q / 100.0 * self._count
+            cumulative = 0
+            for i, c in enumerate(self._counts):
+                cumulative += c
+                if cumulative >= target and c:
+                    lo = self.buckets[i - 1] if i > 0 else min(
+                        self._min or 0.0, self.buckets[0]
+                    )
+                    if i >= len(self.buckets):  # overflow bucket
+                        return float(self._max)
+                    hi = self.buckets[i]
+                    frac = (target - (cumulative - c)) / c
+                    return float(lo + (hi - lo) * min(max(frac, 0.0), 1.0))
+            return float(self._max or 0.0)
+
+    def snapshot(self) -> Dict[str, float]:
+        """``{count, sum, min, max, p50, p95, p99}`` — the series every
+        artifact (BENCH / SOAK) and the live endpoint derive from, so
+        they can never disagree."""
+        p50, p95, p99 = (self.percentile(q) for q in (50, 95, 99))
+        with self._lock:
+            return {
+                "count": self._count,
+                "sum": round(self._sum, 6),
+                "min": round(self._min, 6) if self._min is not None else 0.0,
+                "max": round(self._max, 6) if self._max is not None else 0.0,
+                "p50": round(p50, 6),
+                "p95": round(p95, 6),
+                "p99": round(p99, 6),
+            }
+
+    def cumulative_buckets(self) -> List[Tuple[float, int]]:
+        """``[(le, cumulative_count), ...]`` ending with ``(inf, n)`` —
+        the Prometheus ``_bucket`` series."""
+        with self._lock:
+            out = []
+            cumulative = 0
+            for bound, c in zip(self.buckets, self._counts):
+                cumulative += c
+                out.append((bound, cumulative))
+            out.append((float("inf"), cumulative + self._counts[-1]))
+            return out
+
+
+@dataclass
 class LatencyTimer:
     """Running latency stats (count / mean / max, EMA of recent).
 
@@ -118,33 +294,467 @@ class LatencyTimer:
             self.observe(time.perf_counter() - t0)
 
 
+def _series_key(name: str, labels: Optional[Dict[str, str]]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize to the Prometheus metric-name charset, ``svoc_``-prefixed."""
+    safe = "".join(c if c.isalnum() or c in "_:" else "_" for c in name)
+    if safe and safe[0].isdigit():
+        safe = "_" + safe
+    return safe if safe.startswith("svoc_") else "svoc_" + safe
+
+
+@dataclass(frozen=True)
+class SpanRecord:
+    """One completed span — what the ring buffer and JSONL trace hold."""
+
+    name: str
+    start_s: float  # epoch seconds (wall clock, for cross-process merge)
+    duration_s: float
+    span_id: int
+    parent_id: Optional[int]
+    thread: str
+    depth: int
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "name": self.name,
+                "start_s": round(self.start_s, 6),
+                "duration_s": round(self.duration_s, 6),
+                "span_id": self.span_id,
+                "parent_id": self.parent_id,
+                "thread": self.thread,
+                "depth": self.depth,
+            }
+        )
+
+
+class Tracer:
+    """Nestable spans: a thread-local stack, a bounded ring buffer, and
+    optional JSONL export.
+
+    ``with tracer.span("tokenize"):`` times the enclosed host-side work
+    (around dispatch — never inside traced/jitted code, never forcing a
+    device sync).  On completion the span:
+
+    - appends a :class:`SpanRecord` to a bounded ring (``capacity``
+      newest spans, O(1) memory forever),
+    - feeds the shared per-stage histogram
+      (``stage_seconds{stage=<name>}``) in the attached registry, so
+      scraped percentiles and traces are the same data,
+    - when ``SVOC_TRACE_FILE`` is set (or :meth:`set_trace_file` was
+      called), appends one JSON line to that file.
+
+    Nesting is tracked per thread: a ``forward`` span opened inside a
+    ``fetch`` span records ``fetch``'s id as its parent, so the JSONL
+    reconstructs the stage tree.  Thread-safe; span bodies of different
+    threads interleave freely.
+    """
+
+    #: Env var consulted (per completion, so tests can monkeypatch it
+    #: after import) when no explicit trace file was configured.
+    TRACE_ENV = "SVOC_TRACE_FILE"
+
+    def __init__(self, registry: "MetricsRegistry" = None, capacity: int = 4096):
+        self._registry = registry
+        self._ring: deque = deque(maxlen=capacity)
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+        self._lock = threading.Lock()  # ring + file writes
+        self._trace_path: Optional[str] = None
+        self._trace_file = None
+        self._trace_error = False
+
+    # -- configuration ------------------------------------------------------
+
+    def set_trace_file(self, path: Optional[str]) -> None:
+        """Pin (or clear, with None) the JSONL destination, overriding
+        the env var.  The file opens lazily on the first completed span
+        and appends — a long session's traces survive restarts."""
+        with self._lock:
+            self._close_file_locked()
+            self._trace_path = path
+            self._trace_error = False
+
+    def _close_file_locked(self) -> None:
+        if self._trace_file is not None:
+            try:
+                self._trace_file.close()
+            except OSError:
+                pass
+            self._trace_file = None
+
+    def _resolve_path(self) -> Optional[str]:
+        return self._trace_path or os.environ.get(self.TRACE_ENV) or None
+
+    # -- the span API -------------------------------------------------------
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[int]:
+        """Time a host-side stage; yields the span id (for tests/tools)."""
+        stack = self._stack()
+        span_id = next(self._ids)
+        parent = stack[-1] if stack else None
+        stack.append(span_id)
+        start_wall = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield span_id
+        finally:
+            duration = time.perf_counter() - t0
+            stack.pop()
+            self._complete(
+                SpanRecord(
+                    name=name,
+                    start_s=start_wall,
+                    duration_s=duration,
+                    span_id=span_id,
+                    parent_id=parent,
+                    thread=threading.current_thread().name,
+                    depth=len(stack),
+                )
+            )
+
+    def _complete(self, record: SpanRecord) -> None:
+        if self._registry is not None:
+            self._registry.histogram(
+                "stage_seconds", labels={"stage": record.name}
+            ).observe(record.duration_s)
+        path = self._resolve_path()
+        with self._lock:
+            self._ring.append(record)
+            if path is None:
+                self._close_file_locked()
+                self._trace_error = False
+                return
+            if self._trace_file is None and not self._trace_error:
+                try:
+                    self._trace_file = open(path, "a", buffering=1)
+                except OSError:
+                    # A bad path must never take down the pipeline —
+                    # disable export (until reconfigured), keep spans.
+                    self._trace_error = True
+            if self._trace_file is not None:
+                try:
+                    self._trace_file.write(record.to_json() + "\n")
+                except (OSError, ValueError):
+                    self._close_file_locked()
+                    self._trace_error = True
+
+    def recent(self, n: Optional[int] = None) -> List[SpanRecord]:
+        """The newest ``n`` spans (all buffered when ``n`` is None)."""
+        with self._lock:
+            spans = list(self._ring)
+        return spans if n is None else spans[-n:]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def flush(self) -> None:
+        """Close the JSONL file so every written line is durable."""
+        with self._lock:
+            self._close_file_locked()
+
+
 class MetricsRegistry:
-    """Named counters/timers + one-line reporting."""
+    """Named counters/timers/histograms/gauges + reporting/exposition."""
 
     def __init__(self) -> None:
         self.counters: Dict[str, Counter] = {}
         self.timers: Dict[str, LatencyTimer] = {}
+        self.histograms: Dict[str, Histogram] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        # setdefault on a plain dict is atomic under the GIL, but the
+        # constructed-then-discarded loser of a race would drop the
+        # winner's concurrent observations on Histogram (its buckets
+        # allocate state) — create-once under a lock instead.
+        self._lock = threading.Lock()
+        #: Per-series labels, keyed like the metric dicts — used by the
+        #: Prometheus renderer to group families.
+        self._labels: Dict[str, Tuple[str, Dict[str, str]]] = {}
 
-    def counter(self, name: str) -> Counter:
-        return self.counters.setdefault(name, Counter())
+    def _get(self, store: Dict, name: str, labels, factory):
+        key = _series_key(name, labels)
+        obj = store.get(key)
+        if obj is None:
+            with self._lock:
+                obj = store.get(key)
+                if obj is None:
+                    obj = store[key] = factory()
+                    self._labels[key] = (name, dict(labels or {}))
+        return obj
 
-    def timer(self, name: str) -> LatencyTimer:
-        return self.timers.setdefault(name, LatencyTimer())
+    def counter(self, name: str, labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get(self.counters, name, labels, Counter)
+
+    def timer(self, name: str, labels: Optional[Dict[str, str]] = None) -> LatencyTimer:
+        return self._get(self.timers, name, labels, LatencyTimer)
+
+    def histogram(
+        self,
+        name: str,
+        labels: Optional[Dict[str, str]] = None,
+        buckets: Optional[Tuple[float, ...]] = None,
+    ) -> Histogram:
+        return self._get(
+            self.histograms, name, labels, lambda: Histogram(buckets)
+        )
+
+    def gauge(self, name: str, labels: Optional[Dict[str, str]] = None) -> Gauge:
+        return self._get(self.gauges, name, labels, Gauge)
+
+    def stage_histogram(self, stage: str) -> Histogram:
+        """The shared per-stage series every span feeds."""
+        return self.histogram("stage_seconds", labels={"stage": stage})
+
+    def stage_snapshot(self) -> Dict[str, Dict[str, float]]:
+        """``{stage: {count, sum, p50, p95, p99, ...}}`` for every stage
+        observed so far — the block BENCH/SOAK artifacts embed."""
+        out = {}
+        for key, h in sorted(self.histograms.items()):
+            name, labels = self._labels.get(key, (key, {}))
+            if name == "stage_seconds" and "stage" in labels:
+                out[labels["stage"]] = h.snapshot()
+        return out
 
     def report(self) -> List[str]:
         lines = []
-        for name, c in sorted(self.counters.items()):
-            lines.append(f"{name}: {c.count:,.0f} ({c.rate():,.1f}/s recent)")
-        for name, t in sorted(self.timers.items()):
+        for key, c in sorted(self.counters.items()):
+            lines.append(f"{key}: {c.count:,.0f} ({c.rate():,.1f}/s recent)")
+        for key, g in sorted(self.gauges.items()):
+            lines.append(f"{key}: {g.get():,.6g}")
+        for key, t in sorted(self.timers.items()):
             lines.append(
-                f"{name}: n={t.n} mean={t.mean_s * 1e3:.2f}ms "
+                f"{key}: n={t.n} mean={t.mean_s * 1e3:.2f}ms "
                 f"max={t.max_s * 1e3:.2f}ms"
             )
+        for key, h in sorted(self.histograms.items()):
+            s = h.snapshot()
+            lines.append(
+                f"{key}: n={s['count']} p50={s['p50'] * 1e3:.2f}ms "
+                f"p95={s['p95'] * 1e3:.2f}ms p99={s['p99'] * 1e3:.2f}ms "
+                f"max={s['max'] * 1e3:.2f}ms"
+            )
         return lines
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of every series.
+
+        Families emit one ``# TYPE`` line; histogram families emit the
+        classic cumulative ``_bucket{le=...}`` / ``_sum`` / ``_count``
+        triple (p50/p95/p99 derivable server-side via
+        ``histogram_quantile``); timers render as summary-style
+        ``_count`` / ``_sum`` plus a ``_max`` gauge.
+        """
+        lines: List[str] = []
+        typed: set = set()
+
+        def labels_of(key: str) -> Tuple[str, Dict[str, str]]:
+            return self._labels.get(key, (key, {}))
+
+        def fmt_labels(labels: Dict[str, str], extra: str = "") -> str:
+            inner = ",".join(
+                f'{k}="{v}"' for k, v in sorted(labels.items())
+            )
+            if extra:
+                inner = f"{inner},{extra}" if inner else extra
+            return "{" + inner + "}" if inner else ""
+
+        def type_line(prom: str, kind: str) -> None:
+            if prom not in typed:
+                typed.add(prom)
+                lines.append(f"# TYPE {prom} {kind}")
+
+        for key, c in sorted(self.counters.items()):
+            name, labels = labels_of(key)
+            prom = _prom_name(name + "_total")
+            type_line(prom, "counter")
+            lines.append(f"{prom}{fmt_labels(labels)} {c.count:g}")
+        for key, g in sorted(self.gauges.items()):
+            name, labels = labels_of(key)
+            prom = _prom_name(name)
+            type_line(prom, "gauge")
+            lines.append(f"{prom}{fmt_labels(labels)} {g.get():g}")
+        for key, t in sorted(self.timers.items()):
+            name, labels = labels_of(key)
+            prom = _prom_name(name + "_seconds")
+            type_line(prom, "summary")
+            lab = fmt_labels(labels)
+            lines.append(f"{prom}_count{lab} {t.n}")
+            lines.append(f"{prom}_sum{lab} {t.total_s:g}")
+            prom_max = _prom_name(name + "_seconds_max")
+            type_line(prom_max, "gauge")
+            lines.append(f"{prom_max}{lab} {t.max_s:g}")
+        for key, h in sorted(self.histograms.items()):
+            name, labels = labels_of(key)
+            prom = _prom_name(name)
+            type_line(prom, "histogram")
+            for bound, cumulative in h.cumulative_buckets():
+                le = "+Inf" if bound == float("inf") else f"{bound:g}"
+                le_label = 'le="' + le + '"'
+                lines.append(
+                    f"{prom}_bucket{fmt_labels(labels, le_label)} {cumulative}"
+                )
+            lab = fmt_labels(labels)
+            lines.append(f"{prom}_sum{lab} {h.sum:g}")
+            lines.append(f"{prom}_count{lab} {h.count}")
+        return "\n".join(lines) + "\n"
 
 
 #: Process-wide default registry (the apps layer and bench use this).
 registry = MetricsRegistry()
+
+#: Process-wide default tracer, feeding the default registry's
+#: per-stage histograms.
+tracer = Tracer(registry)
+
+
+def stage_span(name: str):
+    """``with stage_span("forward"):`` — the one-liner every hot-path
+    callsite uses: a span on the default tracer, feeding the shared
+    ``stage_seconds{stage=name}`` histogram in the default registry."""
+    return tracer.span(name)
+
+
+# --------------------------------------------------------------------------
+# Device / runtime gauges (sampled on demand — never on a hot path)
+# --------------------------------------------------------------------------
+
+_monitoring_listener_state = {"installed": False}
+_monitoring_lock = threading.Lock()
+
+
+def install_compile_listener() -> bool:
+    """Count XLA compilations via ``jax.monitoring`` when available.
+
+    Installs (once per process) a duration-event listener bumping
+    ``jit_compiles`` / ``jit_compile_seconds`` for every ``*compile*``
+    monitoring event.  Counts always land in the process-wide default
+    :data:`registry` — compiles are process-global events, and a
+    listener bound to whichever registry happened to call first would
+    silently starve every other scrape.  Returns True iff the listener
+    is installed; any API drift in this private-ish surface degrades to
+    a benign False — compile counts simply stay absent.
+    """
+    with _monitoring_lock:
+        if _monitoring_listener_state["installed"]:
+            return True
+        try:
+            from jax import monitoring as _monitoring
+
+            def _on_duration(event: str, duration: float, **kwargs) -> None:
+                if "compile" in event:
+                    registry.counter("jit_compiles").add(1)
+                    registry.counter("jit_compile_seconds").add(duration)
+
+            _monitoring.register_event_duration_secs_listener(_on_duration)
+        except (ImportError, AttributeError, TypeError):
+            return False
+        _monitoring_listener_state["installed"] = True
+        return True
+
+
+def _backend_initialized() -> bool:
+    """True iff an XLA backend is already live — the same probe
+    ``parallel/mesh.py`` uses, so sampling gauges from a device-free
+    session (lazy-key design, ``apps/session.py``) never forces a
+    backend bring-up just to serve ``/metrics``."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge.backends_are_initialized())
+    except (ImportError, AttributeError):
+        # Probe unavailable (API drift): assume initialized only when
+        # jax itself is already imported — the conservative reading.
+        import sys
+
+        return "jax" in sys.modules
+
+
+def sample_runtime_gauges(reg: Optional[MetricsRegistry] = None) -> Dict[str, float]:
+    """Refresh device/runtime gauges; returns ``{series_key: value}``.
+
+    Samples ``jax.live_arrays()`` bytes per device into
+    ``device_live_bytes{device=...}`` (plus array count), and installs
+    the compile-count listener.  On-demand only (the ``/metrics``
+    handler, the ``metrics`` command) — never called from the serving
+    hot path, and a no-op before the first device touch.
+    """
+    reg = reg or registry
+    out: Dict[str, float] = {}
+    install_compile_listener()
+    if not _backend_initialized():
+        return out
+    try:
+        import jax
+
+        per_device: Dict[str, float] = {}
+        n_arrays = 0
+        for arr in jax.live_arrays():
+            n_arrays += 1
+            shards = getattr(arr, "addressable_shards", None) or []
+            for shard in shards:
+                dev = str(shard.device)
+                data = getattr(shard, "data", None)
+                per_device[dev] = per_device.get(dev, 0.0) + float(
+                    getattr(data, "nbytes", 0) or 0
+                )
+        for dev, nbytes in per_device.items():
+            g = reg.gauge("device_live_bytes", labels={"device": dev})
+            g.set(nbytes)
+            out[_series_key("device_live_bytes", {"device": dev})] = nbytes
+        # A device whose arrays were ALL freed produces no entry above —
+        # zero its existing gauge, or the scrape reports the last-seen
+        # bytes forever (phantom leak, contradicting device_live_arrays).
+        sampled = {
+            _series_key("device_live_bytes", {"device": dev})
+            for dev in per_device
+        }
+        for key in list(reg.gauges):
+            name, _labels = reg._labels.get(key, (key, {}))
+            if name == "device_live_bytes" and key not in sampled:
+                reg.gauges[key].set(0.0)
+                out[key] = 0.0
+        reg.gauge("device_live_arrays").set(n_arrays)
+        out["device_live_arrays"] = float(n_arrays)
+    except Exception:
+        # Gauge sampling must never take down the caller: a backend in
+        # a weird state (mid-teardown, tunneled) just yields no gauges.
+        return out
+    return out
+
+
+def set_mfu_gauge(
+    step_seconds: float,
+    flops_per_step: float,
+    peak_flops: Optional[float],
+    reg: Optional[MetricsRegistry] = None,
+) -> Optional[float]:
+    """Step-time-derived MFU gauge, reusing bench.py's FLOP model: the
+    caller passes ``flops_per_step`` from
+    ``bench.encoder_matmul_flops_per_token × tokens`` and the assumed
+    chip peak (``bench.assumed_peak_flops``).  Returns the MFU (None
+    when the peak is unknown, e.g. CPU)."""
+    reg = reg or registry
+    if not peak_flops or step_seconds <= 0:
+        return None
+    mfu = flops_per_step / step_seconds / peak_flops
+    reg.gauge("mfu_estimate").set(mfu)
+    reg.gauge("step_seconds").set(step_seconds)
+    return mfu
 
 
 @contextlib.contextmanager

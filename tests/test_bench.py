@@ -172,6 +172,11 @@ def test_campaign_replay_prefers_routed_tpu_capture(tmp_path, monkeypatch):
     assert out["detail"]["replay_item"] == "bench_config0_routed"
     assert out["detail"]["replay_captured_at"] == "2026-07-31 02:30:00"
     assert out["detail"]["fresh_probe_failure"] == "probe timed out"
+    # EVERY replayed line says so in its top-level metric string, the
+    # routed-config0 capture included — not only the variant-routed
+    # relabel path (r5 satellite).
+    assert "replayed capture of bench_config0_routed" in out["metric"]
+    assert out["detail"]["replayed_metric"] == "m"
     # a pre-captured_at-era capture must NOT inherit the journal's
     # liveness-poll updated_at as its provenance (code-review r5)
     journal.write_text(json.dumps({
@@ -184,8 +189,19 @@ def test_campaign_replay_prefers_routed_tpu_capture(tmp_path, monkeypatch):
     legacy = bench.campaign_replay(0, "x")
     assert legacy["value"] == 4515.7
     assert "replay_captured_at" not in legacy["detail"]
+    assert "replayed capture of bench_config0" in legacy["metric"]
     # config with only a not-done item -> no replay
     assert bench.campaign_replay(10, "x") is None
+    # a NON-config-0 replay carries the provenance marker too (the r5
+    # satellite: previously only routed config-0 relabeled its metric)
+    journal.write_text(json.dumps({
+        "items": [{"name": "bench_config8", "done": True,
+                   "results": [capture(9271.0)]}],
+    }))
+    replay8 = bench.campaign_replay(8, "probe timed out")
+    assert replay8["value"] == 9271.0
+    assert replay8["metric"] == "(replayed capture of bench_config8) m"
+    assert replay8["detail"]["replayed_metric"] == "m"
     # Without the routed re-capture, config 0 follows the COMMITTED
     # routing to the variant's own capture (the same bench body config
     # 0 executes) — the round-4 journal shape, where falling back to

@@ -322,6 +322,43 @@ def test_main_carries_prior_diverged_verdict_without_artifact(
     assert record["flagship_variant"] == "packed"
 
 
+def test_merged_record_drops_contradictory_prior_flagship(
+    tmp_path, monkeypatch, capsys
+):
+    """Advisor round 5: a PRIOR flagship_variant=packed_flash merged
+    with a flash_numerics verdict that EXCLUDES packed_flash is a
+    self-contradictory record — with no qualifying measurement to
+    re-derive the routing, the stale variant must be dropped (bench.py's
+    default routing takes over), with the drop recorded in evidence."""
+    out = tmp_path / "PERF_DECISIONS.json"
+    out.write_text(json.dumps({
+        "flagship_variant": "packed_flash",
+        "flash_numerics": "diverged",
+        "evidence": {"flagship_variant": {"packed_flash": {}}},
+    }))
+    # Only consensus evidence survives — nothing re-derives the flagship.
+    (tmp_path / "TPU_PROBE.json").write_text(json.dumps([
+        {"probe": "consensus1024", "ok": False, "timeout": True,
+         "elapsed_s": 420.1},
+    ]))
+    monkeypatch.setattr(decide_perf, "REPO", str(tmp_path))
+    monkeypatch.setattr(decide_perf, "OUT", str(out))
+    assert decide_perf.main([]) == 0
+    record = json.loads(out.read_text())
+    assert record["flash_numerics"] == "diverged"
+    assert "flagship_variant" not in record  # contradiction resolved
+    assert "dropped" in record["evidence"]["flagship_variant"]
+    assert "dropped prior flagship_variant" in capsys.readouterr().out
+    # A re-derivable routing (fresh measurements present) re-routes to a
+    # non-excluded variant instead of dropping.
+    (tmp_path / "HW_CAMPAIGN.json").write_text(json.dumps(campaign([
+        ("bench_config8", tpu_result(9271.0)),
+    ])))
+    assert decide_perf.main([]) == 0
+    record = json.loads(out.read_text())
+    assert record["flagship_variant"] == "packed"
+
+
 def test_run_item_labels_replay_as_cpu_fallback(tmp_path):
     """hw_queue must not record a campaign-replay line as a fresh
     hardware capture (code-review r5)."""

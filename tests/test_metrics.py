@@ -1,6 +1,21 @@
-"""Metrics utilities."""
+"""Observability layer: counters, histograms, spans, gauges, exposition."""
 
-from svoc_tpu.utils.metrics import Counter, LatencyTimer, MetricsRegistry
+import json
+import threading
+
+import pytest
+
+from svoc_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    LatencyTimer,
+    MetricsRegistry,
+    SpanRecord,
+    Tracer,
+    log_buckets,
+    set_mfu_gauge,
+)
 
 
 def test_counter_rate():
@@ -48,3 +63,297 @@ def test_registry_report():
     lines = r.report()
     assert any("comments" in line for line in lines)
     assert any("consensus" in line for line in lines)
+
+
+# -- histograms --------------------------------------------------------------
+
+
+def test_log_buckets_are_monotone_and_span_range():
+    edges = log_buckets(1e-4, 120.0, per_decade=4)
+    assert edges == tuple(sorted(edges))
+    assert edges[0] == pytest.approx(1e-4)
+    assert edges[-1] >= 60.0
+    # ~1.78x steps: every edge strictly grows by the decade ratio.
+    for lo, hi in zip(edges, edges[1:]):
+        assert hi / lo == pytest.approx(10 ** 0.25, rel=1e-3)
+
+
+class TestHistogram:
+    def test_empty_percentiles_are_zero(self):
+        h = Histogram()
+        assert h.percentile(50) == 0.0
+        assert h.snapshot()["count"] == 0
+
+    def test_percentile_math_against_known_distribution(self):
+        """1000 samples spread uniformly over [1ms, 100ms]: the bucket
+        interpolation must land within one log-spaced bucket width of
+        the exact percentile — the property that makes a p99 regression
+        visible rather than bucket-quantized away."""
+        h = Histogram()
+        n = 1000
+        samples = [0.001 + (0.099 * i / (n - 1)) for i in range(n)]
+        for s in samples:
+            h.observe(s)
+        for q in (50, 95, 99):
+            exact = samples[int(q / 100 * (n - 1))]
+            got = h.percentile(q)
+            # within a bucket step (x1.78 either way) of exact
+            assert exact / 1.9 <= got <= exact * 1.9, (q, exact, got)
+        snap = h.snapshot()
+        assert snap["count"] == n
+        assert snap["p50"] <= snap["p95"] <= snap["p99"]
+        assert snap["min"] == pytest.approx(0.001)
+        assert snap["max"] == pytest.approx(0.1)
+
+    def test_overflow_bucket_reports_observed_max(self):
+        h = Histogram(buckets=(0.001, 0.01))
+        h.observe(5.0)  # beyond every bound
+        assert h.percentile(99) == pytest.approx(5.0)
+        buckets = h.cumulative_buckets()
+        assert buckets[-1] == (float("inf"), 1)
+        assert buckets[-2][1] == 0  # nothing below the finite bounds
+
+    def test_cumulative_buckets_are_monotone(self):
+        h = Histogram(buckets=(0.001, 0.01, 0.1))
+        for v in (0.0005, 0.005, 0.005, 0.05, 2.0):
+            h.observe(v)
+        counts = [c for _, c in h.cumulative_buckets()]
+        assert counts == sorted(counts)
+        assert counts[-1] == 5
+
+    def test_invalid_percentile_raises(self):
+        with pytest.raises(ValueError):
+            Histogram().percentile(101)
+
+
+# -- spans / tracer ----------------------------------------------------------
+
+
+class TestTracer:
+    def test_span_nesting_records_parent_and_depth(self):
+        r = MetricsRegistry()
+        t = Tracer(r)
+        with t.span("fetch") as fetch_id:
+            with t.span("forward") as fwd_id:
+                pass
+        spans = {s.name: s for s in t.recent()}
+        assert spans["forward"].parent_id == fetch_id
+        assert spans["forward"].depth == 1
+        assert spans["fetch"].parent_id is None
+        assert spans["fetch"].depth == 0
+        assert spans["forward"].span_id == fwd_id
+        # inner completed first, outer covers it
+        assert spans["fetch"].duration_s >= spans["forward"].duration_s
+
+    def test_spans_feed_stage_histograms(self):
+        r = MetricsRegistry()
+        t = Tracer(r)
+        with t.span("tokenize"):
+            pass
+        h = r.stage_histogram("tokenize")
+        assert h.count == 1
+        assert r.stage_snapshot()["tokenize"]["count"] == 1
+
+    def test_jsonl_round_trip(self, tmp_path):
+        """SVOC_TRACE_FILE-style export: every completed span is one
+        parseable JSON line reconstructing the nesting tree."""
+        path = tmp_path / "trace.jsonl"
+        r = MetricsRegistry()
+        t = Tracer(r)
+        t.set_trace_file(str(path))
+        with t.span("fetch"):
+            with t.span("tokenize"):
+                pass
+            with t.span("forward"):
+                pass
+        t.flush()
+        lines = path.read_text().strip().splitlines()
+        records = [json.loads(line) for line in lines]
+        assert [rec["name"] for rec in records] == [
+            "tokenize", "forward", "fetch",  # completion order
+        ]
+        by_name = {rec["name"]: rec for rec in records}
+        assert by_name["tokenize"]["parent_id"] == by_name["fetch"]["span_id"]
+        assert by_name["forward"]["parent_id"] == by_name["fetch"]["span_id"]
+        assert by_name["fetch"]["parent_id"] is None
+        for rec in records:
+            assert rec["duration_s"] >= 0
+            assert rec["start_s"] > 0
+
+    def test_env_var_export(self, tmp_path, monkeypatch):
+        path = tmp_path / "env_trace.jsonl"
+        monkeypatch.setenv(Tracer.TRACE_ENV, str(path))
+        r = MetricsRegistry()
+        t = Tracer(r)
+        with t.span("commit"):
+            pass
+        t.flush()
+        assert json.loads(path.read_text())["name"] == "commit"
+
+    def test_bad_trace_path_never_breaks_spans(self, tmp_path):
+        t = Tracer(MetricsRegistry())
+        t.set_trace_file(str(tmp_path / "no" / "such" / "dir" / "t.jsonl"))
+        with t.span("fetch"):
+            pass  # must not raise
+        assert len(t.recent()) == 1
+
+    def test_ring_buffer_is_bounded(self):
+        t = Tracer(MetricsRegistry(), capacity=8)
+        for i in range(50):
+            with t.span(f"s{i}"):
+                pass
+        spans = t.recent()
+        assert len(spans) == 8
+        assert spans[-1].name == "s49"
+
+    def test_span_record_json_fields(self):
+        rec = SpanRecord("x", 1.0, 0.5, 3, None, "main", 0)
+        assert json.loads(rec.to_json())["duration_s"] == 0.5
+
+
+# -- registry: labels, exposition, thread-safety -----------------------------
+
+
+class TestRegistry:
+    def test_labeled_series_are_distinct(self):
+        r = MetricsRegistry()
+        r.histogram("stage_seconds", labels={"stage": "a"}).observe(0.01)
+        r.histogram("stage_seconds", labels={"stage": "b"}).observe(0.02)
+        snap = r.stage_snapshot()
+        assert set(snap) == {"a", "b"}
+        assert snap["a"]["count"] == snap["b"]["count"] == 1
+
+    def test_render_prometheus_exposition(self):
+        r = MetricsRegistry()
+        r.counter("comments_processed").add(7)
+        r.gauge("mfu_estimate").set(0.42)
+        r.timer("fetch_latency").observe(0.25)
+        r.stage_histogram("forward").observe(0.02)
+        text = r.render_prometheus()
+        assert text.endswith("\n")
+        assert "# TYPE svoc_comments_processed_total counter" in text
+        assert "svoc_comments_processed_total 7" in text
+        assert "svoc_mfu_estimate 0.42" in text
+        assert "svoc_fetch_latency_seconds_count 1" in text
+        assert "svoc_fetch_latency_seconds_sum 0.25" in text
+        assert "# TYPE svoc_stage_seconds histogram" in text
+        assert 'svoc_stage_seconds_bucket{stage="forward",le="+Inf"} 1' in text
+        assert 'svoc_stage_seconds_count{stage="forward"} 1' in text
+        # cumulative le series: later bounds never decrease
+        counts = [
+            int(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith("svoc_stage_seconds_bucket")
+        ]
+        assert counts == sorted(counts)
+
+    def test_prometheus_name_sanitization(self):
+        r = MetricsRegistry()
+        r.counter("weird.name-with/chars").add(1)
+        text = r.render_prometheus()
+        assert "svoc_weird_name_with_chars_total 1" in text
+
+    def test_thread_safety_under_concurrent_observers(self):
+        """16 threads hammer one histogram + counter + spans; every
+        observation must land (no lost updates, no double counts)."""
+        r = MetricsRegistry()
+        t = Tracer(r)
+        n_threads, per_thread = 16, 200
+        barrier = threading.Barrier(n_threads)
+
+        def work():
+            barrier.wait()
+            for i in range(per_thread):
+                r.counter("hits").add(1)
+                r.histogram("lat").observe(0.001 * (i % 7 + 1))
+                with t.span("stage_x"):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        total = n_threads * per_thread
+        assert r.counter("hits").count == total
+        assert r.histogram("lat").count == total
+        assert r.stage_histogram("stage_x").count == total
+        # exposition renders while nothing is mutating — and parses
+        text = r.render_prometheus()
+        assert f"svoc_hits_total {total}" in text
+
+    def test_concurrent_series_creation_returns_one_object(self):
+        """Racing first-use of the same name must converge on ONE
+        histogram (a lost construction would drop observations)."""
+        r = MetricsRegistry()
+        results = []
+        barrier = threading.Barrier(8)
+
+        def grab():
+            barrier.wait()
+            h = r.histogram("contended")
+            h.observe(0.01)
+            results.append(id(h))
+
+        threads = [threading.Thread(target=grab) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len(set(results)) == 1
+        assert r.histogram("contended").count == 8
+
+
+def test_set_mfu_gauge_uses_flop_model():
+    r = MetricsRegistry()
+    # 1 TFLOP step in 0.1 s on a 100-TFLOP/s chip => 10% MFU
+    mfu = set_mfu_gauge(0.1, 1e12, 100e12, reg=r)
+    assert mfu == pytest.approx(0.1)
+    assert r.gauge("mfu_estimate").get() == pytest.approx(0.1)
+    assert set_mfu_gauge(0.1, 1e12, None, reg=r) is None  # CPU: unknown peak
+
+
+def test_gauge_set_add_get():
+    g = Gauge()
+    g.set(3.5)
+    g.add(1.5)
+    assert g.get() == 5.0
+
+
+def test_sample_runtime_gauges_reports_live_device_bytes():
+    """With a live backend and at least one device array, the sampler
+    must fill per-device live-bytes gauges (and never raise)."""
+    import jax.numpy as jnp
+
+    from svoc_tpu.utils.metrics import sample_runtime_gauges
+
+    keep = jnp.ones((16, 16), jnp.float32) + 1  # ensure a live array
+    r = MetricsRegistry()
+    out = sample_runtime_gauges(r)
+    assert any(k.startswith("device_live_bytes") for k in out), out
+    assert r.gauge("device_live_arrays").get() >= 1
+    assert sum(
+        g.get()
+        for key, g in r.gauges.items()
+        if key.startswith("device_live_bytes")
+    ) >= keep.nbytes
+    # rendering includes the device-labeled gauge family
+    assert 'svoc_device_live_bytes{device="' in r.render_prometheus()
+
+
+def test_sample_runtime_gauges_zeroes_vanished_devices():
+    """A device whose live arrays were all freed must read 0 on the
+    next sample — not its last-seen bytes forever (code-review: the
+    phantom-leak contradiction with device_live_arrays)."""
+    import jax.numpy as jnp
+
+    from svoc_tpu.utils.metrics import sample_runtime_gauges
+
+    jnp.zeros(1) + 1  # backend live so the sampler runs
+    r = MetricsRegistry()
+    stale = r.gauge("device_live_bytes", labels={"device": "FakeDevice(99)"})
+    stale.set(1e9)
+    out = sample_runtime_gauges(r)
+    key = 'device_live_bytes{device="FakeDevice(99)"}'
+    assert stale.get() == 0.0
+    assert out[key] == 0.0

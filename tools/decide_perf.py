@@ -298,12 +298,42 @@ def main(argv=None) -> int:
         print("[decide_perf] no qualifying TPU measurements — nothing written")
         return 3
 
+    merged = {**prior_decisions, **decisions}
+    merged_evidence = {**prior_evidence, **evidence}
+    # A merged record must not contradict itself (advisor round 5): a
+    # PRIOR flagship_variant routed through packed_flash while the
+    # merged flash_numerics verdict excludes it (a fresh "diverged"
+    # verdict derived without fresh flagship measurements would
+    # otherwise carry the stale routing forward).  Re-derive the
+    # routing from the current results with the exclusion applied —
+    # decide() already did exactly that — and when that produced no
+    # flagship decision, DROP the key so bench.py's default routing
+    # (never packed_flash) takes over.
+    if (
+        merged.get("flash_numerics")
+        and merged["flash_numerics"] != "rounding-equivalent"
+        and merged.get("flagship_variant") == "packed_flash"
+    ):
+        merged.pop("flagship_variant")
+        merged_evidence["flagship_variant"] = {
+            "dropped": (
+                "prior flagship_variant 'packed_flash' contradicts the "
+                f"merged flash_numerics verdict "
+                f"{merged['flash_numerics']!r} and no qualifying "
+                "measurement re-derived a routing"
+            ),
+            "prior": prior_evidence.get("flagship_variant"),
+        }
+        print(
+            "[decide_perf] dropped prior flagship_variant=packed_flash: "
+            "excluded by the merged flash_numerics verdict"
+        )
+
     record = {
-        **prior_decisions,
-        **decisions,
+        **merged,
         "decided_at": time.strftime("%Y-%m-%d %H:%M:%S"),
         "rules": "tools/decide_perf.py (fixed; see module docstring)",
-        "evidence": {**prior_evidence, **evidence},
+        "evidence": merged_evidence,
     }
     print(json.dumps(record, indent=1))
     if not args.dry_run:

@@ -190,6 +190,12 @@ def main(argv=None) -> int:
             next_snap += args.snapshot_every
             fetch_t = registry.timer("fetch_latency")
             commit_t = registry.timer("commit_latency")
+            # Percentiles come from the SAME per-stage histograms the
+            # session's spans feed and /metrics exposes
+            # (svoc_tpu/utils/metrics.py) — the soak artifact and live
+            # telemetry are one data set, never two clocks.
+            fetch_h = registry.stage_histogram("fetch")
+            commit_h = registry.stage_histogram("commit")
             snap = {
                 "elapsed_s": round(time.time() - t0, 1),
                 "rss_mb": round(rss_mb(), 1),
@@ -199,8 +205,12 @@ def main(argv=None) -> int:
                 "fetches": fetch_t.n,
                 "fetch_mean_ms": round(fetch_t.mean_s * 1e3, 1),
                 "fetch_max_ms": round(fetch_t.max_s * 1e3, 1),
+                "fetch_p50_ms": round(fetch_h.percentile(50) * 1e3, 1),
+                "fetch_p95_ms": round(fetch_h.percentile(95) * 1e3, 1),
+                "fetch_p99_ms": round(fetch_h.percentile(99) * 1e3, 1),
                 "commits": commit_t.n,
                 "commit_mean_ms": round(commit_t.mean_s * 1e3, 1),
+                "commit_p95_ms": round(commit_h.percentile(95) * 1e3, 1),
                 "comments_processed": registry.counter(
                     "comments_processed"
                 ).count,
@@ -267,6 +277,9 @@ def main(argv=None) -> int:
             "snapshots": len(snaps),
             "fetches": registry.timer("fetch_latency").n,
             "commits": commits,
+            # End-of-run stage percentiles from the shared registry —
+            # the same series a live /metrics scrape would have shown.
+            "stage_seconds": registry.stage_snapshot(),
             "comments_processed": registry.counter(
                 "comments_processed"
             ).count,
