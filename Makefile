@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web test test_fast presnapshot bench campaign native metrics-smoke clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast verify presnapshot bench campaign native metrics-smoke clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -29,6 +29,13 @@ run_scraper:
 web:
 	$(PY) -m svoc_tpu.apps.web
 
+# Static analysis (docs/STATIC_ANALYSIS.md): the AST-based JAX hazard
+# gate — trace purity, host-sync, recompile, donation, fixed-point and
+# shared-state rules.  Imports no JAX, runs in ~2 s, exits non-zero on
+# any non-baselined finding or stale baseline entry.
+lint:
+	$(PY) tools/svoclint.py svoc_tpu tools
+
 # Hermetic suite on the 8-device virtual CPU mesh.
 test:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -40,10 +47,15 @@ test_fast:
 	$(PY) -m pytest tests/test_fixedpoint.py tests/test_sort.py \
 	tests/test_consensus_kernel.py tests/test_state.py tests/test_apps.py -q
 
-# End-of-round gate: the driver-contract guards FIRST (fast, loud —
-# round 4 shipped a red test_graft_entry pinning a stale dryrun section
-# list), then the full hermetic suite.  Run before EVERY snapshot.
+# The default verify path: the cheap static gate first, then the suite.
+verify: lint test
+
+# End-of-round gate: lint + the driver-contract guards FIRST (fast,
+# loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
+# section list), then the full hermetic suite.  Run before EVERY
+# snapshot.
 presnapshot:
+	$(MAKE) lint
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_graft_entry.py tests/test_bench.py -q
 	$(MAKE) test

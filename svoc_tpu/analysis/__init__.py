@@ -1,0 +1,56 @@
+"""svoclint — repo-specific static analysis for the TPU hot paths.
+
+The jit/pjit dispatch paths only hit TPU speed-of-light while they stay
+pure, sync-free, and compile-stable — properties PR 1's observability
+can *measure* after the fact but nothing *enforces* before merge.  Every
+probe round (DISPATCH_PROBE*, FLASH_PROBE) re-discovered the same hazard
+classes by hand; this package turns those recurring audits into a
+mechanical pass, the way large JAX/RLHF stacks guard their dispatch
+boundaries (HybridFlow arXiv:2409.19256, G-Core arXiv:2507.22789).
+
+Pure ``ast`` + ``tokenize`` — analyzing the package never imports JAX
+(or anything from the analyzed modules), so ``make lint`` runs on a
+CPU-only box in well under a second.
+
+Rules (docs/STATIC_ANALYSIS.md has bad/good examples for each):
+
+- **SVOC001 host-sync-in-hot-path** — ``.item()`` / ``float()`` /
+  ``np.asarray`` / ``jax.device_get`` / ``block_until_ready`` inside a
+  jit body or a dispatch-path ``stage_span(...)`` body.
+- **SVOC002 impure-jit-body** — print / logging / metrics-registry
+  observation / ``global`` / ``self`` mutation inside a traced body.
+- **SVOC003 recompile-hazard** — ``jax.jit`` built inside a loop,
+  f-string / dict-literal args to jitted callees, shape-derived Python
+  scalars at non-static positions.
+- **SVOC004 donation-reuse** — an argument used after being passed
+  through ``donate_argnums``.
+- **SVOC005 fixed-point-contract** — float literals / ``astype(float)``
+  / true division / foreign Q-scales inside wsad integer paths.
+- **SVOC006 unlocked-shared-state** — module-level mutable state
+  mutated without a lock in the thread-entry modules.
+
+Entry points: :func:`svoc_tpu.analysis.engine.analyze_paths` (the CLI
+``tools/svoclint.py`` wraps it) and
+:func:`svoc_tpu.analysis.engine.analyze_source` (what the tests feed
+fixture snippets through).
+"""
+
+from svoc_tpu.analysis.findings import Baseline, Finding
+from svoc_tpu.analysis.engine import (
+    AnalysisReport,
+    analyze_paths,
+    analyze_source,
+    iter_python_files,
+)
+from svoc_tpu.analysis.rules import ALL_RULES, RULE_DOCS
+
+__all__ = [
+    "ALL_RULES",
+    "AnalysisReport",
+    "Baseline",
+    "Finding",
+    "RULE_DOCS",
+    "analyze_paths",
+    "analyze_source",
+    "iter_python_files",
+]

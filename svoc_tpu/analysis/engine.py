@@ -1,0 +1,210 @@
+"""File walking, per-module analysis, and report assembly.
+
+``analyze_paths`` is the whole pipeline minus baseline policy (the CLI
+owns that): discover ``*.py`` files, parse each, build its
+:class:`~svoc_tpu.analysis.jitmap.JitMap`, run every rule, drop
+suppressed findings, and return an :class:`AnalysisReport`.
+
+Import cost discipline: this module (and everything it pulls in) must
+import neither JAX nor the analyzed code — ``make lint`` runs on boxes
+with no accelerator stack warmed up, and a lint that pays XLA init
+would be slower than the tests it gates.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import time
+from typing import Iterable, Iterator, List, Optional, Set, Tuple
+
+from svoc_tpu.analysis.findings import Finding, SuppressionIndex
+from svoc_tpu.analysis.jitmap import JitMap
+from svoc_tpu.analysis.rules import ALL_RULES
+
+SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "_build"}
+
+
+@dataclasses.dataclass
+class ModuleUnit:
+    """One parsed module, ready for the rules."""
+
+    path: str  # posix, relative to the analysis root
+    source: str
+    lines: List[str]
+    tree: ast.Module
+    jitmap: JitMap
+    suppressions: SuppressionIndex
+
+    @property
+    def tags(self) -> Set[str]:
+        return self.suppressions.tags
+
+
+@dataclasses.dataclass
+class AnalysisReport:
+    """Everything one run produced, pre-baseline."""
+
+    findings: List[Finding]
+    files: int
+    suppressed: int
+    duration_s: float
+    parse_errors: List[Finding] = dataclasses.field(default_factory=list)
+    #: rel paths of every analyzed file — baseline rewrites use this to
+    #: preserve entries for files OUTSIDE the analyzed subset
+    analyzed_paths: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def all_findings(self) -> List[Finding]:
+        """Rule findings plus parse errors (a file svoclint cannot read
+        is a finding, not a silent skip — CI must fail loudly)."""
+        return sorted(
+            self.parse_errors + self.findings,
+            key=lambda f: (f.path, f.line, f.col, f.rule),
+        )
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    # Dedup by realpath: overlapping path args ("tools tools/x.py")
+    # must not analyze a file twice — duplicate findings would consume
+    # the baseline multiset and fail a clean tree.
+    seen: Set[str] = set()
+
+    def emit(path: str) -> Iterator[str]:
+        real = os.path.realpath(path)
+        if real not in seen:
+            seen.add(real)
+            yield path
+
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield from emit(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in SKIP_DIRS and not d.startswith(".")
+            )
+            for fname in sorted(filenames):
+                if fname.endswith(".py"):
+                    yield from emit(os.path.join(dirpath, fname))
+
+
+def _relpath(path: str, root: Optional[str]) -> str:
+    if root:
+        try:
+            rel = os.path.relpath(path, root)
+            if not rel.startswith(".."):
+                path = rel
+        except ValueError:
+            pass
+    return path.replace(os.sep, "/")
+
+
+def analyze_module(path: str, source: str) -> List[Finding]:
+    """Run every rule over one module's source; suppressions applied."""
+    unit = _build_unit(path, source)
+    if isinstance(unit, Finding):
+        return [unit]
+    findings, _suppressed = _run_rules(unit)
+    return findings
+
+
+def _build_unit(path: str, source: str):
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return Finding(
+            rule="SVOC000",
+            severity="error",
+            path=path,
+            line=e.lineno or 1,
+            col=e.offset or 0,
+            message=f"file does not parse: {e.msg}",
+            hint="svoclint analyzes the AST — fix the syntax error first",
+            snippet=(e.text or "").strip(),
+        )
+    return ModuleUnit(
+        path=path,
+        source=source,
+        lines=source.splitlines(),
+        tree=tree,
+        jitmap=JitMap(tree),
+        suppressions=SuppressionIndex(source),
+    )
+
+
+def _run_rules(unit: ModuleUnit) -> Tuple[List[Finding], int]:
+    """``(kept findings, suppressed count)`` for one module."""
+    raw: List[Finding] = []
+    for rule in ALL_RULES:
+        raw.extend(rule(unit))
+    # Overlapping scopes (nested spans, re-wrapped defs) can visit a
+    # node twice — report each (rule, line, col, message) once.
+    seen = set()
+    out: List[Finding] = []
+    for f in sorted(raw, key=lambda f: (f.line, f.col, f.rule, f.message)):
+        key = (f.rule, f.line, f.col, f.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(f)
+    kept = [
+        f for f in out if not unit.suppressions.is_suppressed(f.rule, f.line)
+    ]
+    return kept, len(out) - len(kept)
+
+
+def analyze_source(source: str, path: str = "fixture.py") -> List[Finding]:
+    """Test/tooling entry point: analyze one source string."""
+    return analyze_module(path, source)
+
+
+def analyze_paths(
+    paths: Iterable[str], root: Optional[str] = None
+) -> AnalysisReport:
+    """Analyze every ``*.py`` under ``paths``; paths in findings are
+    relative to ``root`` (default: the current working directory)."""
+    root = root or os.getcwd()
+    t0 = time.perf_counter()
+    findings: List[Finding] = []
+    parse_errors: List[Finding] = []
+    analyzed: List[str] = []
+    suppressed = 0
+    files = 0
+    for fpath in iter_python_files(paths):
+        files += 1
+        rel = _relpath(fpath, root)
+        analyzed.append(rel)
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            parse_errors.append(
+                Finding(
+                    rule="SVOC000",
+                    severity="error",
+                    path=rel,
+                    line=1,
+                    col=0,
+                    message=f"unreadable file: {e}",
+                    hint="",
+                )
+            )
+            continue
+        unit = _build_unit(rel, source)
+        if isinstance(unit, Finding):
+            parse_errors.append(unit)
+            continue
+        kept, n_suppressed = _run_rules(unit)
+        findings.extend(kept)
+        suppressed += n_suppressed
+    return AnalysisReport(
+        findings=findings,
+        files=files,
+        suppressed=suppressed,
+        duration_s=time.perf_counter() - t0,
+        parse_errors=parse_errors,
+        analyzed_paths=analyzed,
+    )

@@ -313,15 +313,17 @@ class Session:
             with stage_span("consensus"):
                 # The host conversions below are the existing fetch of
                 # the fleet/preview results — the span times dispatch +
-                # that fetch without adding any device sync of its own.
+                # that fetch without adding any device sync of its own
+                # (hence the svoclint SVOC001 suppressions: the sync IS
+                # this span's documented purpose).
                 mean, median, ranks = _preview_stats(values)
-                predictions = np.asarray(values, dtype=np.float64)
+                predictions = np.asarray(values, dtype=np.float64)  # svoclint: disable=SVOC001
                 preview = {
                     "values": predictions,
-                    "mean": np.asarray(mean),
-                    "median": np.asarray(median),
-                    "normalized_ranks": np.asarray(ranks),
-                    "honest": np.asarray(honest),
+                    "mean": np.asarray(mean),  # svoclint: disable=SVOC001
+                    "median": np.asarray(median),  # svoclint: disable=SVOC001
+                    "normalized_ranks": np.asarray(ranks),  # svoclint: disable=SVOC001
+                    "honest": np.asarray(honest),  # svoclint: disable=SVOC001
                     "n_comments": len(comments),
                 }
             metrics.counter("comments_processed").add(len(comments))

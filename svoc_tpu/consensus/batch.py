@@ -94,7 +94,14 @@ def _one_prefix_margins(values: jnp.ndarray, cfg: ConsensusConfig) -> PrefixMarg
     return PrefixMargins(rel1, rel2, a1, a2, jnp.min(variances), gap)
 
 
-@partial(jax.jit, static_argnums=(3,))
+# static_argnames (not argnums): audited against the call sites —
+# ``cfg`` is the only non-array argument, the name survives signature
+# refactors that renumber positions, and JAX resolves it for positional
+# callers too (consensus/state.py calls positionally).  ``ks`` stays a
+# DYNAMIC array: its *values* never shape the program, only its length
+# does, and callers bucket that length (state.py pads to a power of
+# two) so distinct commit-batch sizes don't each pay a fresh compile.
+@partial(jax.jit, static_argnames=("cfg",))
 def prefix_margins_sweep(
     old_values: jnp.ndarray,  # [N, M] block before the batch
     new_values: jnp.ndarray,  # [N, M] block after every tx applied

@@ -356,12 +356,23 @@ class OracleConsensusContract:
             # past the bands (eps(16)·ulp² still clears them ~10×).
             if float(max(np.max(np.abs(old)), np.max(np.abs(new)))) > 16.0:
                 return uncertified("value magnitude beyond f32 guard bands")
+            # Bucket the prefix count to a power of two (min 8) by
+            # repeating the final prefix: K is the vmapped sweep's
+            # leading shape, so tracking the raw batch length would
+            # recompile the fused program for every distinct commit
+            # batch size (SVOC003 recompile-hazard).  A duplicated
+            # prefix evaluates to identical margins, so the all()
+            # over `safe` below is unchanged.
+            k_bucket = 8
+            while k_bucket < len(inter_ks):
+                k_bucket *= 2
+            padded_ks = inter_ks + [inter_ks[-1]] * (k_bucket - len(inter_ks))
             margins = dev.prefix_margins_sweep(
                 jnp.asarray(old),
                 jnp.asarray(new),
                 jnp.asarray(pos),
                 cfg,
-                jnp.asarray(inter_ks, dtype=jnp.int32),
+                jnp.asarray(padded_ks, dtype=jnp.int32),
             )
             safe = dev.certify(margins, cfg, self.strict_interval)
             if not bool(np.all(safe)):

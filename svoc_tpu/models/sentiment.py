@@ -285,9 +285,10 @@ class SentimentPipeline:
                     for a in chunk
                 ]
             # The span covers dispatch + the np.asarray host fetch that
-            # was already here — no added device sync.
+            # was already here — no added device sync (deliberate
+            # SVOC001 exception).
             with stage_span("forward"):
-                vecs = np.asarray(forward(self.params, *chunk), dtype=np.float64)
+                vecs = np.asarray(forward(self.params, *chunk), dtype=np.float64)  # svoclint: disable=SVOC001
             valid = batch.seg_valid[sl] > 0
             out[batch.owner[sl][valid]] = vecs[:n_real][valid]
         return out
@@ -313,8 +314,9 @@ class SentimentPipeline:
             # No explicit device_put: the jitted forward's in_shardings
             # place the raw numpy batch shard-wise in one transfer.
             # The span covers dispatch + the np.asarray host fetch that
-            # was already here — no added device sync.
+            # was already here — no added device sync (deliberate
+            # SVOC001 exception).
             with stage_span("forward"):
                 vecs = self._forward(self.params, ids, mask)
-                out.append(np.asarray(vecs[:n_real], dtype=np.float64))
+                out.append(np.asarray(vecs[:n_real], dtype=np.float64))  # svoclint: disable=SVOC001
         return np.concatenate(out, axis=0) if out else np.zeros((0, self.dimension))

@@ -1,0 +1,1103 @@
+"""svoclint: per-rule fixtures, suppressions, baseline, CI contract.
+
+Covers the docs/STATIC_ANALYSIS.md contract: one positive + one
+negative fixture per rule, inline-suppression handling, baseline
+round-trip (including stale-entry detection — baselines only shrink),
+a whole-package run asserting zero non-baselined findings, and the CLI
+exit codes the Makefile's ``lint`` target relies on.
+
+Everything here runs without JAX (and asserts that importing the
+analyzer cannot pull it in) — svoclint is the one tier-1 surface that
+must stay cheap on a box with no accelerator stack.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from svoc_tpu.analysis import (  # noqa: E402
+    Baseline,
+    RULE_DOCS,
+    analyze_paths,
+    analyze_source,
+)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def src(text):
+    return textwrap.dedent(text)
+
+
+# ---------------------------------------------------------------------------
+# SVOC001 — host-sync-in-hot-path
+# ---------------------------------------------------------------------------
+
+
+def test_svoc001_flags_host_sync_in_jit_body():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.asarray(x)
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC001"]
+    assert "np.asarray" in findings[0].message
+
+
+def test_svoc001_flags_item_in_dispatch_span():
+    findings = analyze_source(
+        src(
+            """
+            from svoc_tpu.utils.metrics import stage_span
+
+            def g(v):
+                with stage_span("consensus"):
+                    return v.item()
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC001"]
+    assert 'span "consensus"' in findings[0].message
+
+
+def test_svoc001_negative_pure_jit_and_host_stage_span():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from svoc_tpu.utils.metrics import stage_span
+
+            @jax.jit
+            def f(x):
+                return jnp.sum(x) * 2.0
+
+            def g(texts):
+                # tokenize is a HOST stage — numpy there is the point
+                with stage_span("tokenize"):
+                    return np.asarray(texts)
+            """
+        )
+    )
+    assert findings == []
+
+
+def test_svoc001_span_scan_skips_nested_defs_that_only_define():
+    # a callback DEFINED (not called) inside a dispatch span runs
+    # later, outside the span — not a span-body sync
+    findings = analyze_source(
+        src(
+            """
+            import numpy as np
+            from svoc_tpu.utils.metrics import stage_span
+
+            def g(v, schedule):
+                with stage_span("forward"):
+                    def cb(r):
+                        return np.asarray(r)
+                    schedule(cb)
+            """
+        )
+    )
+    assert findings == []
+
+
+def test_svoc001_covers_jit_wrapper_call_and_lambda():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+
+            def body(x):
+                return x.block_until_ready()
+
+            step = jax.jit(body)
+            other = jax.jit(lambda v: float(v))
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC001"]
+    assert len(findings) == 2
+
+
+# ---------------------------------------------------------------------------
+# SVOC002 — impure-jit-body
+# ---------------------------------------------------------------------------
+
+
+def test_svoc002_flags_print_metrics_and_self_mutation():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            from svoc_tpu.utils.metrics import registry as metrics
+
+            @jax.jit
+            def f(x):
+                print("tracing", x)
+                metrics.counter("steps").add(1)
+                return x
+
+            class Engine:
+                def build(self):
+                    @jax.jit
+                    def step(x):
+                        self.last = x
+                        return x
+                    return step
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC002"]
+    assert len(findings) == 3
+
+
+def test_svoc002_bare_log_is_math_not_logging():
+    # `from jax.numpy import log` — calling it inside jit is pure math;
+    # only method calls on log/logger roots (or the logging module) are
+    # logging.
+    clean = analyze_source(
+        src(
+            """
+            import jax
+            from jax.numpy import log
+
+            @jax.jit
+            def f(x):
+                return log(x) + 1
+            """
+        )
+    )
+    assert clean == []
+    flagged = analyze_source(
+        src(
+            """
+            import jax
+            import logging
+
+            logger = logging.getLogger(__name__)
+
+            @jax.jit
+            def f(x):
+                logger.info("step %s", x)
+                return x
+            """
+        )
+    )
+    assert rules_of(flagged) == ["SVOC002"]
+
+
+def test_svoc002_negative_effects_outside_trace():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            from svoc_tpu.utils.metrics import registry as metrics
+
+            @jax.jit
+            def f(x):
+                return x + 1
+
+            def drive(x):
+                out = f(x)
+                metrics.counter("steps").add(1)
+                print("done")
+                return out
+            """
+        )
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SVOC003 — recompile-hazard
+# ---------------------------------------------------------------------------
+
+
+def test_svoc003_flags_jit_in_loop():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+
+            def sweep(xs):
+                outs = []
+                for x in xs:
+                    f = jax.jit(lambda v: v + 1)
+                    outs.append(f(x))
+                return outs
+            """
+        )
+    )
+    assert "SVOC003" in rules_of(findings)
+    assert "inside a loop" in findings[0].message
+
+
+def test_svoc003_flags_dotted_pjit_in_loop():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+
+            def sweep(xs):
+                return [jax.experimental.pjit.pjit(lambda v: v)(x) for x in xs]
+            """
+        )
+    )
+    assert "SVOC003" in rules_of(findings)
+
+
+def test_svoc003_flags_per_request_jit_construction():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+
+            def handle(request):
+                return jax.jit(lambda v: v * 2)(request)
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC003"]
+    assert "per-request" in findings[0].message
+
+
+def test_svoc003_negative_factory_and_module_level_invocation():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            import jax.numpy as jnp
+
+            def make_step(cfg):
+                # the factory pattern: build once, return the callable
+                return jax.jit(lambda v: v * cfg)
+
+            # module level runs once at import — not per-request
+            warmup = jax.jit(lambda v: v + 1)(jnp.zeros(4))
+            """
+        )
+    )
+    assert findings == []
+
+
+def test_svoc003_flags_fstring_and_nonstatic_shape_arg():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("mode",))
+            def f(x, mode):
+                return x
+
+            @jax.jit
+            def g(x, n):
+                return x[:2]
+
+            def drive(v, k):
+                a = f(v, mode=f"mode-{k}")
+                b = g(v, v.shape[0])
+                return a, b
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC003"]
+    msgs = " | ".join(f.message for f in findings)
+    assert "f-string" in msgs and "shape-derived" in msgs
+    assert len(findings) == 2
+
+
+def test_svoc003_negative_static_declarations_match():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("n",))
+            def g(x, n):
+                return x[:n]
+
+            @partial(jax.jit, static_argnums=(1,))
+            def h(x, n):
+                return x[:n]
+
+            f = jax.jit(lambda v: v * 2)
+
+            def drive(v):
+                a = g(v, n=v.shape[0])   # declared static by name
+                b = g(v, v.shape[0])     # static position via argnames
+                c = h(v, v.shape[0])     # declared static by position
+                return a, b, c, f(v)
+            """
+        )
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SVOC004 — donation-reuse
+# ---------------------------------------------------------------------------
+
+
+def test_svoc004_flags_use_after_donation():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state, dx):
+                return state + dx
+
+            def run(state, dx):
+                out = step(state, dx)
+                return state + out
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC004"]
+    assert "DONATED" in findings[0].message
+
+
+def test_svoc004_flags_loop_without_rebind():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state, dx):
+                return state + dx
+
+            def run(state, dxs):
+                outs = []
+                for dx in dxs:
+                    outs.append(step(state, dx))
+                return outs
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC004"]
+    assert "loop" in findings[0].message
+
+
+def test_svoc004_flags_same_line_use_outside_the_call():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state, dx):
+                return state + dx
+
+            def run(state, dx):
+                return step(state, dx) + state
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC004"]
+
+
+def test_svoc004_flags_load_on_the_rebind_line_itself():
+    # `x = x + 1` after donation: the load happens BEFORE the store, so
+    # it reads the invalidated buffer — a rebind protects only lines
+    # strictly after it.
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state, dx):
+                return state + dx
+
+            def run(state, dx):
+                out = step(state, dx)
+                state = state + 1
+                return out
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC004"]
+
+
+def test_svoc004_negative_rebind_over_donated_name():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(state, dx):
+                return state + dx
+
+            def run(state, dxs):
+                for dx in dxs:
+                    state = step(state, dx)
+                return state
+            """
+        )
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SVOC005 — fixed-point-contract
+# ---------------------------------------------------------------------------
+
+
+def test_svoc005_flags_float_div_and_foreign_scale():
+    findings = analyze_source(
+        src(
+            """
+            # svoclint: tag=fixedpoint-path
+
+            def wsad_half(a: int) -> int:
+                return int(a * 0.5)
+
+            def wsad_ratio(a: int, b: int) -> int:
+                return a / b
+
+            def wsad_rescale(a: int) -> int:
+                return a * 1000000000
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC005"]
+    msgs = " | ".join(f.message for f in findings)
+    assert "float literal" in msgs
+    assert "true division" in msgs
+    assert "foreign Q-scale" in msgs
+
+
+def test_svoc005_negative_boundary_functions_and_untagged_modules():
+    clean = src(
+        """
+        WSAD = 1_000_000
+
+        def wsad_mul(a: int, b: int) -> int:
+            return (a * b + WSAD // 2) // WSAD
+
+        def from_wsad(x: int) -> float:
+            return float(x) * 1e-6
+        """
+    )
+    # tagged: boundary (-> float) functions and int-clean Q-paths pass
+    assert analyze_source("# svoclint: tag=fixedpoint-path\n" + clean) == []
+    # untagged module: rule does not apply at all
+    assert analyze_source("def wsad_x(a: int) -> int:\n    return int(a * 0.5)\n") == []
+
+
+def test_svoc005_applies_to_real_fixedpoint_module_by_path():
+    findings = analyze_source(
+        "def wsad_x(a: int) -> int:\n    return int(a * 0.5)\n",
+        path="svoc_tpu/ops/fixedpoint.py",
+    )
+    assert rules_of(findings) == ["SVOC005"]
+
+
+# ---------------------------------------------------------------------------
+# SVOC006 — unlocked-shared-state
+# ---------------------------------------------------------------------------
+
+
+def test_svoc006_flags_unlocked_mutation_in_thread_entry_module():
+    findings = analyze_source(
+        src(
+            """
+            # svoclint: tag=thread-entry
+            _streams = {}
+
+            def handler(key, value):
+                _streams[key] = value
+                _streams.pop(key, None)
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC006"]
+    assert len(findings) == 2
+
+
+def test_svoc006_negative_locked_mutation_and_untagged_module():
+    locked = src(
+        """
+        # svoclint: tag=thread-entry
+        import threading
+
+        _streams = {}
+        _lock = threading.Lock()
+
+        def handler(key, value):
+            with _lock:
+                _streams[key] = value
+        """
+    )
+    assert analyze_source(locked) == []
+    unguarded_elsewhere = src(
+        """
+        _cache = {}
+
+        def remember(k, v):
+            _cache[k] = v
+        """
+    )
+    assert analyze_source(unguarded_elsewhere) == []
+
+
+def test_svoc006_lock_match_is_identifier_segment_not_substring():
+    # `with block:` is NOT a lock even though "block" contains "lock";
+    # RLock()/sse_lock ARE.
+    flagged = analyze_source(
+        src(
+            """
+            # svoclint: tag=thread-entry
+            import threading
+
+            _streams = {}
+            block = threading.Semaphore()
+
+            def handler(key, value):
+                with block:
+                    _streams[key] = value
+            """
+        )
+    )
+    assert rules_of(flagged) == ["SVOC006"]
+    clean = analyze_source(
+        src(
+            """
+            # svoclint: tag=thread-entry
+            import threading
+
+            _streams = {}
+            sse_lock = threading.RLock()
+
+            def handler(key, value):
+                with sse_lock:
+                    _streams[key] = value
+            """
+        )
+    )
+    assert clean == []
+
+
+def test_svoc006_applies_to_web_module_by_path():
+    findings = analyze_source(
+        "_streams = {}\n\ndef h(k, v):\n    _streams[k] = v\n",
+        path="svoc_tpu/apps/web.py",
+    )
+    assert rules_of(findings) == ["SVOC006"]
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression_silences_one_rule_on_one_line():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                a = np.asarray(x)  # svoclint: disable=SVOC001
+                b = np.asarray(x)
+                return a + b
+            """
+        )
+    )
+    assert len(findings) == 1  # only the un-suppressed line remains
+    assert findings[0].snippet == "b = np.asarray(x)"
+
+
+def test_inline_suppression_tolerates_spaces_in_rule_list():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                print(np.asarray(x))  # svoclint: disable=SVOC001, SVOC002
+                return x
+            """
+        )
+    )
+    assert findings == []
+
+
+def test_inline_suppression_disable_all_and_multiple_rules():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                print(np.asarray(x))  # svoclint: disable=SVOC001,SVOC002
+                return x
+
+            @jax.jit
+            def g(x):
+                print(np.asarray(x))  # svoclint: disable=all
+                return x
+            """
+        )
+    )
+    assert findings == []
+
+
+def test_trailing_suppression_covers_interior_lines_of_the_statement():
+    # findings can anchor on an interior line of a multi-line literal;
+    # the trailing disable covers the whole logical statement
+    findings = analyze_source(
+        src(
+            """
+            import numpy as np
+            from svoc_tpu.utils.metrics import stage_span
+
+            def g(mean, median):
+                with stage_span("consensus"):
+                    return {
+                        "mean": np.asarray(mean),
+                        "median": np.asarray(median),
+                    }  # svoclint: disable=SVOC001
+            """
+        )
+    )
+    assert findings == []
+
+
+def test_jit_wrapping_does_not_contaminate_the_raw_function_name():
+    # `fast = jax.jit(step, donate_argnums=(0,))`: only calls of `fast`
+    # donate — a plain Python `step(...)` call does not.
+    findings = analyze_source(
+        src(
+            """
+            import jax
+
+            def step(state, dx):
+                return state + dx
+
+            fast = jax.jit(step, donate_argnums=(0,))
+
+            def raw(state, dx):
+                out = step(state, dx)
+                return state + out
+
+            def jitted(state, dx):
+                out = fast(state, dx)
+                return state + out
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC004"]
+    assert len(findings) == 1
+    assert "`fast`" in findings[0].message
+
+
+def test_trailing_suppression_on_multiline_statement_covers_its_first_line():
+    # The finding reports at the statement's first line; the disable
+    # trails the closing paren — logical-line mapping must connect them.
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.asarray(
+                    x,
+                    dtype=np.float64,
+                )  # svoclint: disable=SVOC001
+            """
+        )
+    )
+    assert findings == []
+
+
+def test_file_level_suppression():
+    findings = analyze_source(
+        src(
+            """
+            # svoclint: disable-file=SVOC001
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.asarray(x)
+            """
+        )
+    )
+    assert findings == []
+
+
+def test_suppression_comment_inside_string_is_not_honored():
+    findings = analyze_source(
+        src(
+            '''
+            import jax
+            import numpy as np
+
+            NOTE = """ svoclint: disable-file=SVOC001 """
+
+            @jax.jit
+            def f(x):
+                return np.asarray(x)
+            '''
+        )
+    )
+    assert rules_of(findings) == ["SVOC001"]
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+
+_BASELINE_FIXTURE = """
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    return np.asarray(x)
+"""
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = analyze_source(_BASELINE_FIXTURE, path="pkg/mod.py")
+    assert len(findings) == 1
+    bl_path = str(tmp_path / "baseline.json")
+    Baseline.from_findings(findings, reason="grandfathered in test").dump(bl_path)
+
+    loaded = Baseline.load(bl_path)
+    new, baselined, stale = loaded.split(
+        analyze_source(_BASELINE_FIXTURE, path="pkg/mod.py")
+    )
+    assert new == [] and stale == []
+    assert len(baselined) == 1
+    # entries keep their reason through the round trip
+    assert json.load(open(bl_path))["entries"][0]["reason"] == "grandfathered in test"
+
+
+def test_baseline_is_line_drift_tolerant_but_edit_sensitive(tmp_path):
+    findings = analyze_source(_BASELINE_FIXTURE, path="pkg/mod.py")
+    bl_path = str(tmp_path / "baseline.json")
+    Baseline.from_findings(findings).dump(bl_path)
+    loaded = Baseline.load(bl_path)
+
+    # unrelated lines added above: same snippet, still baselined
+    drifted = "import os\nimport sys\n" + _BASELINE_FIXTURE
+    new, baselined, stale = loaded.split(analyze_source(drifted, path="pkg/mod.py"))
+    assert new == [] and len(baselined) == 1 and stale == []
+
+    # the flagged line itself edited: no longer covered, old entry stale
+    edited = _BASELINE_FIXTURE.replace(
+        "return np.asarray(x)", "return np.asarray(x * 2)"
+    )
+    new, baselined, stale = loaded.split(analyze_source(edited, path="pkg/mod.py"))
+    assert len(new) == 1 and baselined == [] and len(stale) == 1
+
+
+def test_baseline_context_blocks_lookalike_new_findings(tmp_path):
+    # A dead grandfather entry must not absorb a NEW finding whose
+    # flagged line happens to have identical text but different
+    # surroundings — the next-line context disambiguates.
+    original = src(
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.asarray(x)
+        """
+    )
+    findings = analyze_source(original, path="pkg/mod.py")
+    bl_path = str(tmp_path / "baseline.json")
+    Baseline.from_findings(findings).dump(bl_path)
+
+    lookalike = src(
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def g(y):
+            return np.asarray(x)
+            # different statement, same flagged-line text
+        """
+    )
+    new, baselined, stale = Baseline.load(bl_path).split(
+        analyze_source(lookalike, path="pkg/mod.py")
+    )
+    assert len(new) == 1 and baselined == [] and len(stale) == 1
+
+
+def test_write_baseline_preserves_curated_reasons(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n")
+    bl = tmp_path / "bl.json"
+    proc = _run_cli([str(bad), "--baseline", str(bl), "--write-baseline"])
+    assert proc.returncode == 0
+    data = json.load(open(bl))
+    data["entries"][0]["reason"] = "curated explanation"
+    json.dump(data, open(bl, "w"))
+    proc = _run_cli([str(bad), "--baseline", str(bl), "--write-baseline"])
+    assert proc.returncode == 0
+    assert json.load(open(bl))["entries"][0]["reason"] == "curated explanation"
+
+
+def test_stale_baseline_entry_reported_when_finding_fixed(tmp_path):
+    findings = analyze_source(_BASELINE_FIXTURE, path="pkg/mod.py")
+    bl_path = str(tmp_path / "baseline.json")
+    Baseline.from_findings(findings).dump(bl_path)
+    new, baselined, stale = Baseline.load(bl_path).split([])
+    assert new == [] and baselined == []
+    assert len(stale) == 1  # baselines only shrink — CI flags leftovers
+
+
+# ---------------------------------------------------------------------------
+# whole-package run + CLI contract
+# ---------------------------------------------------------------------------
+
+
+def test_whole_package_run_is_clean_and_fast():
+    report = analyze_paths(
+        [os.path.join(REPO_ROOT, "svoc_tpu"), os.path.join(REPO_ROOT, "tools")],
+        root=REPO_ROOT,
+    )
+    assert report.parse_errors == []
+    baseline = Baseline.load(os.path.join(REPO_ROOT, "tools", "svoclint_baseline.json"))
+    new, _baselined, stale = baseline.split(report.all_findings)
+    assert new == [], "non-baselined svoclint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    assert stale == [], f"stale baseline entries (remove them): {stale}"
+    # acceptance: whole-package lint completes in < 10 s on CPU
+    assert report.duration_s < 10.0
+
+
+def test_every_documented_rule_has_a_registered_doc():
+    assert sorted(RULE_DOCS) == [f"SVOC00{i}" for i in range(1, 7)]
+    for doc in RULE_DOCS.values():
+        assert doc["severity"] in ("error", "warning")
+
+
+def _run_cli(args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "svoclint.py"), *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        timeout=120,
+    )
+
+
+def test_cli_repo_run_exits_zero_json():
+    proc = _run_cli(["svoc_tpu", "tools", "--format", "json"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["counts"]["new"] == 0
+    assert payload["counts"]["files"] > 50
+
+
+_INJECTED = {
+    "SVOC001": "import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n",
+    "SVOC002": "import jax\n\n@jax.jit\ndef f(x):\n    print(x)\n    return x\n",
+    "SVOC003": (
+        "import jax\n\ndef sweep(xs):\n    return [jax.jit(lambda v: v)(x)"
+        " for x in xs]\n"
+    ),
+    "SVOC004": (
+        "import jax\nfrom functools import partial\n\n"
+        "@partial(jax.jit, donate_argnums=(0,))\ndef step(s, d):\n"
+        "    return s + d\n\ndef run(s, d):\n    out = step(s, d)\n"
+        "    return s + out\n"
+    ),
+    "SVOC005": (
+        "# svoclint: tag=fixedpoint-path\n\ndef wsad_bad(a: int) -> int:\n"
+        "    return int(a * 0.5)\n"
+    ),
+    "SVOC006": (
+        "# svoclint: tag=thread-entry\n_state = {}\n\ndef h(k, v):\n"
+        "    _state[k] = v\n"
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_INJECTED))
+def test_cli_exits_nonzero_on_injected_violation(rule, tmp_path):
+    bad = tmp_path / f"bad_{rule.lower()}.py"
+    bad.write_text(_INJECTED[rule])
+    proc = _run_cli([str(bad), "--no-baseline"])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert rule in proc.stdout
+
+
+def test_cli_honors_checked_in_baseline_from_any_cwd(tmp_path):
+    # The default baseline + root are anchored to the repo, not the
+    # CWD: the grandfathered flash_probe findings stay baselined.
+    proc = _run_cli(
+        [os.path.join(REPO_ROOT, "svoc_tpu"), os.path.join(REPO_ROOT, "tools")],
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "6 baselined" in proc.stdout
+
+
+def test_overlapping_paths_analyze_each_file_once():
+    # "tools tools/flash_probe.py" must not double-analyze the probe —
+    # duplicate findings would exhaust the baseline multiset.
+    proc = _run_cli(
+        [
+            os.path.join(REPO_ROOT, "tools"),
+            os.path.join(REPO_ROOT, "tools", "flash_probe.py"),
+        ]
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "6 baselined" in proc.stdout
+
+
+def test_cli_list_rules():
+    proc = _run_cli(["--list-rules"])
+    assert proc.returncode == 0
+    for rule in _INJECTED:
+        assert rule in proc.stdout
+
+
+def test_cli_default_paths_work_from_any_cwd(tmp_path):
+    proc = _run_cli([], cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "6 baselined" in proc.stdout
+
+
+def test_cli_bad_path_is_usage_error():
+    proc = _run_cli(["definitely/not/a/path"])
+    assert proc.returncode == 2
+
+
+def test_write_baseline_over_a_subset_keeps_other_paths_entries(tmp_path):
+    # regenerating over one tree must not drop another tree's
+    # grandfathered entries (or their curated reasons)
+    sub_a = tmp_path / "a"
+    sub_b = tmp_path / "b"
+    sub_a.mkdir(), sub_b.mkdir()
+    bad = "import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n"
+    (sub_a / "mod_a.py").write_text(bad)
+    (sub_b / "mod_b.py").write_text(bad)
+    bl = tmp_path / "bl.json"
+    proc = _run_cli(
+        [str(sub_a), str(sub_b), "--baseline", str(bl), "--write-baseline",
+         "--root", str(tmp_path)],
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.load(open(bl))
+    assert len(data["entries"]) == 2
+    for e in data["entries"]:
+        e["reason"] = "curated " + e["path"]
+    json.dump(data, open(bl, "w"))
+    # rewrite analyzing ONLY sub_a: sub_b's entry must survive verbatim
+    proc = _run_cli(
+        [str(sub_a), "--baseline", str(bl), "--write-baseline",
+         "--root", str(tmp_path)],
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    entries = json.load(open(bl))["entries"]
+    assert len(entries) == 2
+    assert {e["reason"] for e in entries} == {
+        "curated a/mod_a.py",
+        "curated b/mod_b.py",
+    }
+    # and the full run is still green against the rewritten baseline
+    proc = _run_cli(
+        [str(sub_a), str(sub_b), "--baseline", str(bl),
+         "--root", str(tmp_path)],
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_write_baseline_refuses_to_grandfather_parse_errors(tmp_path):
+    # A file the linter cannot parse must never become permanently
+    # green via the baseline.
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    bl = tmp_path / "bl.json"
+    proc = _run_cli(
+        [str(tmp_path), "--baseline", str(bl), "--write-baseline"]
+    )
+    assert proc.returncode == 1
+    assert "refused" in proc.stderr
+    assert all(
+        e["rule"] != "SVOC000" for e in json.load(open(bl))["entries"]
+    )
+    # and the next gated run still fails on the parse error
+    proc = _run_cli([str(tmp_path), "--baseline", str(bl)])
+    assert proc.returncode == 1
+    assert "SVOC000" in proc.stdout
+
+
+def test_syntax_error_becomes_svoc000_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    proc = _run_cli([str(bad), "--no-baseline"])
+    assert proc.returncode == 1
+    assert "SVOC000" in proc.stdout
+
+
+def test_linting_never_imports_jax():
+    """The CI gate must run on accelerator-free boxes: importing the
+    analyzer and linting the whole package may not pull in jax."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            (
+                "import sys; sys.path.insert(0, '.');"
+                "from svoc_tpu.analysis import analyze_paths;"
+                "r = analyze_paths(['svoc_tpu', 'tools']);"
+                "assert r.files > 50;"
+                "assert 'jax' not in sys.modules, 'lint imported jax';"
+                "assert 'numpy' not in sys.modules, 'lint imported numpy'"
+            ),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

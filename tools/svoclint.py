@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""svoclint — the repo's JAX-hazard static analyzer, as a CI gate.
+
+Usage::
+
+    python tools/svoclint.py svoc_tpu tools                # text report
+    python tools/svoclint.py svoc_tpu tools --format json  # machine form
+    python tools/svoclint.py svoc_tpu --write-baseline     # grandfather
+    python tools/svoclint.py --list-rules
+
+Exit codes: **0** clean (every finding fixed, suppressed, or baselined),
+**1** non-baselined findings (or stale baseline entries — baselines only
+shrink), **2** usage/internal error.  ``make lint`` runs this over
+``svoc_tpu tools`` with the checked-in ``tools/svoclint_baseline.json``.
+
+No JAX import anywhere on this path (enforced by
+tests/test_svoclint.py): linting must cost sub-seconds on a CPU-only
+box.  Rules and the suppression/baseline workflow are documented in
+docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from svoc_tpu.analysis import (  # noqa: E402 (path bootstrap above)
+    Baseline,
+    RULE_DOCS,
+    analyze_paths,
+)
+
+# Anchored to the repo (not the CWD): running the linter from another
+# directory must still honor the checked-in baseline.
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "svoclint_baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="svoclint", description=__doc__.splitlines()[0]
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        # repo-anchored like DEFAULT_BASELINE: the bare invocation must
+        # work from any CWD
+        default=[
+            os.path.join(REPO_ROOT, "svoc_tpu"),
+            os.path.join(REPO_ROOT, "tools"),
+        ],
+        help="files/directories to analyze (default: the repo's "
+        "svoc_tpu and tools trees)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="report format (default: text)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        help=f"baseline JSON path (default: {DEFAULT_BASELINE} when present)",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore any baseline file (report every finding)",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write all current findings to the baseline file and exit 0",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="print the rule table and exit"
+    )
+    p.add_argument(
+        "--root",
+        default=REPO_ROOT,
+        help="path findings are reported relative to (default: the repo "
+        "root, so baseline path keys are stable across CWDs)",
+    )
+    return p
+
+
+def _list_rules() -> int:
+    for rule_id in sorted(RULE_DOCS):
+        doc = RULE_DOCS[rule_id]
+        print(f"{rule_id}  {doc['name']:24s} [{doc['severity']}] {doc['summary']}")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        return _list_rules()
+
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"svoclint: path does not exist: {path}", file=sys.stderr)
+            return 2
+
+    report = analyze_paths(args.paths, root=args.root)
+    findings = report.all_findings
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None
+    )
+
+    if args.write_baseline:
+        out_path = args.baseline or DEFAULT_BASELINE
+        # Never grandfather SVOC000: a file the linter cannot parse is
+        # analyzed by NO rule, and baselining that would turn "CI must
+        # fail loudly" (engine.py) into a permanent silent skip.
+        writable = [f for f in findings if f.rule != "SVOC000"]
+        skipped = len(findings) - len(writable)
+        # Regenerating must not clobber the rest of the baseline: carry
+        # curated reasons forward for keys that still match, and keep
+        # entries VERBATIM for files outside the analyzed subset (a
+        # `--write-baseline` over one tree must not drop another
+        # tree's grandfathered entries).
+        analyzed = set(report.analyzed_paths)
+        old_reasons = {}
+        kept_entries = []
+        if os.path.exists(out_path):
+            try:
+                for e in Baseline.load(out_path).entries:
+                    if e.get("path") not in analyzed:
+                        kept_entries.append(e)
+                        continue
+                    key = (
+                        str(e.get("rule", "")),
+                        str(e.get("path", "")),
+                        str(e.get("snippet", "")),
+                        str(e.get("context", "")),
+                    )
+                    old_reasons.setdefault(key, e.get("reason", ""))
+            except (OSError, ValueError):
+                pass
+        merged = Baseline()
+        for e in kept_entries:
+            merged.add(e)
+        for f in writable:
+            merged.add(
+                {
+                    "rule": f.rule,
+                    "path": f.path,
+                    "snippet": f.snippet,
+                    "context": f.context,
+                    "reason": old_reasons.get(f.baseline_key())
+                    or "grandfathered by --write-baseline; triage me",
+                }
+            )
+        merged.dump(out_path)
+        print(
+            f"svoclint: wrote {len(writable)} finding(s) "
+            f"(+{len(kept_entries)} kept for unanalyzed paths) to "
+            f"{out_path} ({report.files} files, {report.duration_s:.2f}s)"
+        )
+        if skipped:
+            print(
+                f"svoclint: refused to baseline {skipped} SVOC000 "
+                "parse-error finding(s) — fix the syntax errors",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+
+    stale = []
+    baselined = []
+    if baseline_path and not args.no_baseline:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"svoclint: bad baseline {baseline_path}: {e}", file=sys.stderr)
+            return 2
+        findings, baselined, stale = baseline.split(findings)
+
+    if args.format == "json":
+        payload = {
+            "findings": [f.to_dict() for f in findings],
+            "counts": {
+                "new": len(findings),
+                "baselined": len(baselined),
+                "suppressed": report.suppressed,
+                "stale_baseline_entries": len(stale),
+                "files": report.files,
+            },
+            "stale_baseline_entries": stale,
+            "duration_s": round(report.duration_s, 3),
+        }
+        print(json.dumps(payload, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        for entry in stale:
+            print(
+                f"stale baseline entry (finding no longer present — remove "
+                f"it): {entry['rule']} {entry['path']} | {entry['snippet']}"
+            )
+        status = "clean" if not findings and not stale else "FAILED"
+        print(
+            f"svoclint: {status} — {len(findings)} new, {len(baselined)} "
+            f"baselined, {report.suppressed} suppressed, {len(stale)} stale "
+            f"baseline entr{'y' if len(stale) == 1 else 'ies'} "
+            f"({report.files} files in {report.duration_s:.2f}s)"
+        )
+
+    return 1 if findings or stale else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
