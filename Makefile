@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web lint test test_fast verify presnapshot bench campaign native metrics-smoke clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast verify presnapshot bench campaign native metrics-smoke chaos-smoke clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -47,15 +47,24 @@ test_fast:
 	$(PY) -m pytest tests/test_fixedpoint.py tests/test_sort.py \
 	tests/test_consensus_kernel.py tests/test_state.py tests/test_apps.py -q
 
-# The default verify path: the cheap static gate first, then the suite.
-verify: lint test
+# Convergence-under-faults gate (docs/RESILIENCE.md): the seeded chaos
+# scenario over the local backend, run twice — bit-identical replay,
+# full commit via resume, persistent offender voted out.  Seconds, no
+# device work.
+chaos-smoke:
+	$(PY) tools/chaos_smoke.py
+
+# The default verify path: the cheap static gate first, then the chaos
+# convergence gate, then the suite.
+verify: lint chaos-smoke test
 
 # End-of-round gate: lint + the driver-contract guards FIRST (fast,
 # loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
-# section list), then the full hermetic suite.  Run before EVERY
-# snapshot.
+# section list), then the chaos gate, then the full hermetic suite.
+# Run before EVERY snapshot.
 presnapshot:
 	$(MAKE) lint
+	$(MAKE) chaos-smoke
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_graft_entry.py tests/test_bench.py -q
 	$(MAKE) test

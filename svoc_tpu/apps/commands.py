@@ -42,6 +42,8 @@ HELP = """Commands:
     - metrics [prom|trace] (throughput / latency / stage percentiles;
       'prom' dumps the Prometheus text exposition the /metrics
       endpoint serves; 'trace' lists the most recent stage spans)
+    - resilience (circuit-breaker state, per-slot oracle health
+      scores, quarantine set, replacement count)
     - multimodal [K|auto] (mixture analysis of the last fetch;
       default K=2, 'auto' selects K by BIC)
 
@@ -189,7 +191,9 @@ class CommandConsole:
                 if len(args) != 1:
                     emit("Unexpected number of arguments.")
                     return out
-                self.session.auto_fetch = on_off_to_bool(args[0])
+                # set_auto_flags bumps state_version: the web UI's push
+                # stream surfaces flag toggles live, not on next fetch.
+                self.session.set_auto_flags(fetch=on_off_to_bool(args[0]))
                 if self.session.auto_fetch:
                     emit("Auto-Fetch: ENABLED")
                     self._start_auto_fetch()
@@ -199,7 +203,7 @@ class CommandConsole:
                 if len(args) != 1:
                     emit("Unexpected number of arguments.")
                     return out
-                self.session.auto_commit = on_off_to_bool(args[0])
+                self.session.set_auto_flags(commit=on_off_to_bool(args[0]))
                 emit(
                     "Auto-Commit: "
                     + ("ENABLED" if self.session.auto_commit else "DISABLED")
@@ -208,7 +212,7 @@ class CommandConsole:
                 if len(args) != 1:
                     emit("Unexpected number of arguments.")
                     return out
-                self.session.auto_resume = on_off_to_bool(args[0])
+                self.session.set_auto_flags(resume=on_off_to_bool(args[0]))
                 emit(
                     "Auto-Resume: "
                     + ("ENABLED" if self.session.auto_resume else "DISABLED")
@@ -401,6 +405,22 @@ class CommandConsole:
                     lines = _metrics.report()
                     for line in lines or ["no metrics recorded yet"]:
                         emit(line)
+            elif cmd == "resilience":
+                snap = self.session.resilience_snapshot()
+                emit(f"breaker: {snap['breaker']}")
+                health = snap["health"]
+                if health:
+                    emit("oracle health (slot: score):")
+                    for slot in sorted(health, key=int):
+                        flag = (
+                            "  QUARANTINED"
+                            if int(slot) in snap["quarantined"]
+                            else ""
+                        )
+                        emit(f"  {slot}: {health[slot]:.3f}{flag}")
+                else:
+                    emit("no health scores yet (no supervised commits)")
+                emit(f"replacements: {snap['replacements']}")
             elif cmd == "multimodal":
                 # Beyond-reference: mixture-model analysis of the LAST
                 # fetched fleet (the scenario documentation/README.md:
@@ -479,14 +499,12 @@ class CommandConsole:
                     return out
                 if on_off_to_bool(args[0]):
                     source_name = self._start_scraper() or "unchanged"
-                    self.session.auto_commit = True
-                    self.session.auto_fetch = True
+                    self.session.set_auto_flags(fetch=True, commit=True)
                     self._start_auto_fetch()
                     emit(f"Live mode: ENABLED (scraper={source_name}, "
                          "auto_fetch+auto_commit on)")
                 else:
-                    self.session.auto_fetch = False
-                    self.session.auto_commit = False
+                    self.session.set_auto_flags(fetch=False, commit=False)
                     self._stop_scraper()
                     emit("Live mode: DISABLED")
             else:
@@ -513,6 +531,7 @@ class CommandConsole:
             import time
 
             from svoc_tpu.apps.session import EmptyStoreError
+            from svoc_tpu.resilience.breaker import CircuitOpenError
 
             while (
                 gen == self._auto_fetch_gen
@@ -527,10 +546,34 @@ class CommandConsole:
                     # session lock.
                     self.session.fetch()
                     if self.session.auto_commit:
-                        self.session.commit()
-                        if self.session.auto_resume:
-                            self.session.adapter.resume()
-                            self.session.bump_state()
+                        breaker_open = False
+                        try:
+                            # Resilient path: backoff + resume of
+                            # partial fleets + breaker — a flaky chain
+                            # degrades this loop, it never kills it.
+                            self.session.commit_resilient()
+                            if self.session.auto_resume:
+                                self.session.adapter.resume()
+                                self.session.bump_state()
+                        except CircuitOpenError:
+                            # Chain declared down: skip this cycle
+                            # cheaply; the breaker half-opens after its
+                            # reset window and the next cycle probes.
+                            breaker_open = True
+                            from svoc_tpu.utils.metrics import registry as _m
+
+                            _m.counter("auto_fetch_breaker_skips").add(1)
+                        finally:
+                            # Health fold runs on every commit cycle,
+                            # success or tx-level failure — quarantine
+                            # decisions need BOTH kinds of evidence.
+                            # Never raises (Session.supervisor_step).
+                            # EXCEPT on a breaker-open skip: the step's
+                            # own chain reads would hang against the
+                            # very backend the breaker just declared
+                            # dead, re-wedging the loop the skip freed.
+                            if not breaker_open:
+                                self.session.supervisor_step()
                 except EmptyStoreError:
                     # Not an error in a composite loop: live mode starts
                     # the scraper and this loop together, so early
@@ -650,5 +693,5 @@ class CommandConsole:
                 self._scraper_stop.set()
 
     def stop(self) -> None:
-        self.session.auto_fetch = False
+        self.session.set_auto_flags(fetch=False)
         self._stop_scraper()

@@ -36,6 +36,16 @@ from svoc_tpu.io.comment_store import (
     CommentStore,
 )
 from svoc_tpu.ops.stats import rank_array
+from svoc_tpu.resilience.breaker import CircuitBreaker, CircuitOpenError
+from svoc_tpu.resilience.retry import (
+    CommitOutcome,
+    RetryPolicy,
+    commit_fleet_with_resume,
+)
+from svoc_tpu.resilience.supervisor import (
+    FleetHealthSupervisor,
+    SupervisorConfig,
+)
 from svoc_tpu.sim.oracle import gen_oracle_predictions
 from svoc_tpu.utils.metrics import registry as metrics
 from svoc_tpu.utils.metrics import stage_span
@@ -84,6 +94,19 @@ class SessionConfig:
     #: Deployment info (``data/contract_info.json`` fields).
     declared_address: Optional[str] = None
     deployed_address: Optional[str] = None
+    #: Resilience layer (docs/RESILIENCE.md).  The retry policy drives
+    #: ``commit_resilient`` (the auto loop's commit: decorrelated-jitter
+    #: backoff + resume of partial fleets); both dataclasses are frozen,
+    #: so they are safe as field defaults.
+    commit_retry: RetryPolicy = RetryPolicy()
+    supervisor: SupervisorConfig = SupervisorConfig()
+    #: Fleet health supervision in the auto loop (False = observe-only
+    #: sessions: scores still accrue, no automatic replacement votes).
+    supervise_fleet: bool = True
+    #: Chain circuit breaker: consecutive-failure trip threshold and
+    #: the open→half-open reset window.
+    breaker_failure_threshold: int = 5
+    breaker_reset_s: float = 30.0
 
 
 def _default_contract(cfg: SessionConfig) -> OracleConsensusContract:
@@ -135,6 +158,22 @@ class Session:
         self.adapter = adapter or ChainAdapter(
             LocalChainBackend(_default_contract(self.config))
         )
+        #: Per-backend circuit breaker: the auto loop's commits consult
+        #: it, so a dead chain degrades to cheap short-circuits instead
+        #: of a retry storm (state lives in /metrics as
+        #: ``circuit_breaker_state{backend="chain"}``).
+        self.breaker = CircuitBreaker(
+            "chain",
+            failure_threshold=self.config.breaker_failure_threshold,
+            reset_timeout_s=self.config.breaker_reset_s,
+            registry=metrics,
+        )
+        #: Fleet health supervisor: commit-failure history + on-chain
+        #: reliability → hysteresis scores → automatic replacement votes
+        #: (the paper's admin mechanism, driven instead of manual).
+        self.supervisor = FleetHealthSupervisor(
+            self.adapter, self.config.supervisor, registry=metrics
+        )
         self.predictions: Optional[np.ndarray] = None
         self.last_preview: Optional[Dict] = None
         #: Bumped on every state change the UI renders (fetch, commit,
@@ -145,9 +184,13 @@ class Session:
         self.simulation_step: int = 0
         self.auto_fetch: bool = False
         #: fetch ⇒ commit (help text web_interface.py:22; unimplemented
-        #: in the reference, functional here).
+        #: in the reference).  Functional here through
+        #: :meth:`commit_resilient` — backoff + resume + breaker, so a
+        #: flaky chain degrades the loop instead of killing it.
         self.auto_commit: bool = False
-        #: commit ⇒ resume (help text web_interface.py:23).
+        #: commit ⇒ resume (help text web_interface.py:23; also
+        #: unimplemented in the reference).  Toggle via
+        #: :meth:`set_auto_flags` so the web UI sees the change live.
         self.auto_resume: bool = False
         self.application_on: bool = True
         #: Lazy: creating a PRNG key initializes the jax backend, which
@@ -369,8 +412,112 @@ class Session:
             except ChainCommitError as e:
                 metrics.counter("chain_transactions").add(e.committed)
                 metrics.counter("chain_commit_failures").add(1)
+                # Interactive failures feed the health scores too — the
+                # supervisor folds ALL commit-failure history.
+                self.supervisor.record_commit_failure(e.failed_oracle, e.cause)
                 self.bump_state()  # partial txs changed chain state
                 raise
         metrics.counter("chain_transactions").add(n)
         self.bump_state()
         return n
+
+    def commit_resilient(self) -> CommitOutcome:
+        """The auto loop's commit: retry with decorrelated-jitter
+        backoff, RESUME partial fleets (re-send only the stranded
+        suffix — ``ChainCommitError.committed`` accounting), consult
+        the circuit breaker per attempt, and report every per-oracle
+        failure to the health supervisor.
+
+        Same locking shape as :meth:`commit` (snapshot under the
+        session lock, submit under ``_commit_lock`` only) — the retry
+        loop runs INSIDE the whole-fleet atomicity, so two concurrent
+        resilient commits still cannot interleave their txs.
+
+        Returns the :class:`CommitOutcome`; a degraded cycle (some
+        oracles stranded after their attempt budget) is a *successful
+        return* with ``outcome.stranded`` non-empty — the loop stays
+        alive and the supervisor owns the replacement decision.  Raises
+        :class:`CircuitOpenError` when the breaker short-circuits and
+        :class:`ChainCommitError` only when the overall retry deadline
+        expires mid-fleet.
+        """
+        with self.lock:
+            if self.predictions is None:
+                raise RuntimeError("fetch before commit")
+            predictions = self.predictions
+        with self._commit_lock, metrics.timer("commit_latency").time():
+            try:
+                outcome = commit_fleet_with_resume(
+                    self.adapter,
+                    predictions,
+                    self.config.commit_retry,
+                    breaker=self.breaker,
+                    on_oracle_failure=self.supervisor.record_commit_failure,
+                )
+            except ChainCommitError as e:
+                # resilient_sent is the TRUE landed-tx count (committed
+                # is a fleet index that counts skipped/stranded slots).
+                metrics.counter("chain_transactions").add(
+                    getattr(e, "resilient_sent", e.committed)
+                )
+                metrics.counter("chain_commit_failures").add(1)
+                self.bump_state()
+                raise
+            except CircuitOpenError as e:
+                metrics.counter("chain_transactions").add(e.sent)
+                metrics.counter("commit_short_circuits").add(1)
+                if e.sent:
+                    self.bump_state()
+                raise
+        metrics.counter("chain_transactions").add(outcome.sent)
+        if outcome.stranded:
+            # The cycle landed degraded — count it like the single-shot
+            # path counts its failures, so soak accounting stays one
+            # series.
+            metrics.counter("chain_commit_failures").add(1)
+        self.bump_state()
+        return outcome
+
+    def supervisor_step(self) -> Optional[Dict]:
+        """One fleet-health fold (auto loop cadence).  Never raises —
+        a supervisor problem (faulted chain read mid-chaos, vote race)
+        must not take down the serving loop."""
+        if not self.config.supervise_fleet:
+            return None
+        try:
+            report = self.supervisor.step()
+        except Exception:
+            metrics.counter("supervisor_errors").add(1)
+            return None
+        if report.get("replaced"):
+            self.bump_state()  # the fleet roster changed
+        return report
+
+    def set_auto_flags(
+        self,
+        *,
+        fetch: Optional[bool] = None,
+        commit: Optional[bool] = None,
+        resume: Optional[bool] = None,
+    ) -> None:
+        """Toggle the auto flags and bump ``state_version`` so the web
+        UI surfaces them live (the reference documents the flags but
+        never implements them, ``web_interface.py:22-23``)."""
+        with self.lock:
+            if fetch is not None:
+                self.auto_fetch = fetch
+            if commit is not None:
+                self.auto_commit = commit
+            if resume is not None:
+                self.auto_resume = resume
+            self.state_version += 1
+
+    def resilience_snapshot(self) -> Dict:
+        """Breaker + fleet-health state for the UI and soak artifacts.
+        Cheap: no chain I/O (the supervisor reads its cached scores)."""
+        return {
+            "breaker": self.breaker.state(),
+            "health": self.supervisor.health_snapshot(),
+            "quarantined": self.supervisor.quarantined_slots(),
+            "replacements": len(self.supervisor.replacements),
+        }

@@ -58,6 +58,7 @@ _PAGE = """<!DOCTYPE html>
      trend <canvas id="rel2spark" width="160" height="16"
             style="vertical-align:middle"></canvas>
      <span id="rel2warn" style="color:#e55"></span></div>
+<div id="resil" style="color:#9ab; margin:.3rem 0"></div>
 <div id="plots"></div>
 <button id="replace-btn">Oracle Replacement</button>
 <div id="replace-menu" style="display:none; border:1px solid #345; padding:.5rem; margin:.5rem 0">
@@ -179,6 +180,19 @@ async function refresh(s) {
   }
   document.getElementById('rel2warn').textContent =
     s.rel2_falling ? '⚠ falling' : '';
+  // Resilience status line: auto flags, breaker state, fleet health
+  // (docs/RESILIENCE.md) — toggling a flag bumps state_version, so
+  // this repaints live through the same push channel as everything.
+  const rs = s.resilience || {};
+  const onoff = v => v ? 'on' : 'off';
+  const quarantined = (rs.quarantined || []).join(',');
+  document.getElementById('resil').textContent =
+    'auto fetch:' + onoff(s.auto_fetch)
+    + ' commit:' + onoff(s.auto_commit)
+    + ' resume:' + onoff(s.auto_resume)
+    + ' · breaker: ' + (rs.breaker || 'n/a')
+    + ' · replacements: ' + (rs.replacements || 0)
+    + (quarantined ? ' · quarantined slots: ' + quarantined : '');
   updateReplacementMenu(s);
   const plots = document.getElementById('plots');
   plots.innerHTML = '';
@@ -333,6 +347,11 @@ class _Handler(BaseHTTPRequestHandler):
             payload = {
                 "state_version": state_version,
                 "auto_fetch": session.auto_fetch,
+                "auto_commit": session.auto_commit,
+                "auto_resume": session.auto_resume,
+                # breaker / fleet-health state (docs/RESILIENCE.md);
+                # cheap — no chain I/O behind it.
+                "resilience": session.resilience_snapshot(),
                 "reliability_first_pass": state.get("reliability_first_pass"),
                 "reliability_second_pass": state.get("reliability_second_pass"),
                 # trajectory, not just level: capture is invisible in

@@ -460,6 +460,19 @@ class ChainAdapter:
         self.rel2_history.append((time.monotonic(), v))
         return v
 
+    @_atomic
+    def peek_second_pass_reliability(self) -> float:
+        """Same read as :meth:`call_second_pass_consensus_reliability`
+        but WITHOUT feeding the rel₂ trajectory ring (or the cache) —
+        for high-frequency machine readers like the fleet supervisor at
+        auto-loop cadence.  The ring is sized for ~1-per-minute
+        operator reads (``REL2_HISTORY``); a 5 s supervision loop
+        appending to it would shrink the 30-minute capture-slide alarm
+        window to minutes and mask a slow coordinated slide."""
+        return fwsad_to_float(
+            self.backend.call("get_second_pass_consensus_reliability")
+        )
+
     def rel2_trend(self, window_s: float = 1800.0) -> Dict[str, Any]:
         """Trajectory summary of the second-pass reliability over the
         trailing ``window_s``: ``delta`` (latest − window start),
@@ -571,10 +584,15 @@ class ChainAdapter:
     BATCH_COMMIT_THRESHOLD = 64
 
     def update_all_the_predictions(
-        self, predictions: Sequence, *, batch: Optional[bool] = None
+        self,
+        predictions: Sequence,
+        *,
+        batch: Optional[bool] = None,
+        start: int = 0,
     ) -> int:
         """One signed tx per oracle, in oracle-list order
-        (``client/contract.py:200-208``); returns tx count.
+        (``client/contract.py:200-208``); returns the tx count *sent by
+        this call*.
 
         Each account signs sequentially (its nonce space advances one tx
         at a time; the next oracle's tx is only submitted after the
@@ -582,29 +600,45 @@ class ChainAdapter:
         :class:`ChainCommitError` with the partial-commit count — the
         earlier transactions are on chain and are NOT rolled back.
 
+        ``start`` resumes a partially-committed fleet: oracles before
+        ``start`` are skipped (their txs are already on chain — see
+        :func:`svoc_tpu.resilience.retry.commit_fleet_with_resume`).
+        ``ChainCommitError.committed`` is always ABSOLUTE (the failed
+        oracle's fleet index, counting the resumed prefix), so
+        ``start=e.committed`` re-sends exactly the stranded suffix and
+        never duplicates a landed tx.
+
         ``batch=None`` auto-selects the backend's batched fleet commit
         (same sequential semantics, O(1) golden recomputes — see
         :meth:`svoc_tpu.consensus.state.OracleConsensusContract.update_predictions_batch`)
-        for fleets ≥ ``BATCH_COMMIT_THRESHOLD``; ``True``/``False``
-        force it on/off.
+        when the remaining suffix is ≥ ``BATCH_COMMIT_THRESHOLD``;
+        ``True``/``False`` force it on/off.
         """
         from svoc_tpu.utils.metrics import stage_span
 
         with stage_span("commit"):
-            return self._update_all_the_predictions(predictions, batch=batch)
+            return self._update_all_the_predictions(
+                predictions, batch=batch, start=start
+            )
 
     def _update_all_the_predictions(
-        self, predictions: Sequence, *, batch: Optional[bool] = None
+        self,
+        predictions: Sequence,
+        *,
+        batch: Optional[bool] = None,
+        start: int = 0,
     ) -> int:
         oracles = self.call_oracle_list()
         total = min(len(oracles), len(predictions))
+        if not 0 <= start <= total:
+            raise ValueError(f"start={start} outside [0, {total}]")
         batched_invoke = getattr(
             self.backend, "invoke_update_predictions_batch", None
         )
         if batch is None:
             batch = (
                 batched_invoke is not None
-                and total >= self.BATCH_COMMIT_THRESHOLD
+                and total - start >= self.BATCH_COMMIT_THRESHOLD
             )
         if batch:
             if batched_invoke is None:
@@ -616,10 +650,12 @@ class ChainAdapter:
 
             # Per-tx codec semantics: a malformed prediction (NaN, junk)
             # is THAT tx's failure after the prefix commits, exactly as
-            # in the per-tx loop — not a whole-batch abort.
+            # in the per-tx loop — not a whole-batch abort.  Indices
+            # here are ABSOLUTE fleet positions (the resumed prefix
+            # counts), matching ChainCommitError's accounting.
             felts = []
             codec_failure = None
-            for t, p in enumerate(predictions[:total]):
+            for t, p in enumerate(predictions[start:total], start=start):
                 try:
                     felts.append(encode_vector(p))
                 except Exception as e:
@@ -635,10 +671,12 @@ class ChainAdapter:
             fell_through = False
             with self._lock:
                 try:
-                    committed = batched_invoke(oracles[: len(felts)], felts)
+                    committed = batched_invoke(
+                        oracles[start : start + len(felts)], felts
+                    )
                 except BatchTxError as e:
                     raise ChainCommitError(
-                        committed=e.index,
+                        committed=start + e.index,
                         total=total,
                         failed_oracle=e.oracle_address,
                         cause=e.cause,
@@ -649,21 +687,21 @@ class ChainAdapter:
                 if codec_failure is not None:
                     t, cause = codec_failure
                     raise ChainCommitError(
-                        committed=committed,
+                        committed=start + committed,
                         total=total,
                         failed_oracle=oracles[t],
                         cause=cause,
                     ) from cause
                 return committed
         n = 0
-        for oracle, prediction in zip(oracles, predictions):
+        for oracle, prediction in zip(oracles[start:total], predictions[start:total]):
             try:
                 self.invoke_update_prediction(oracle, prediction)
             except ChainCommitError:
                 raise
             except Exception as e:
                 raise ChainCommitError(
-                    committed=n,
+                    committed=start + n,
                     total=total,
                     failed_oracle=oracle,
                     cause=e,

@@ -44,6 +44,7 @@ class PrefetchPipeline:
         seq_len: int,
         depth: int = 2,
         device_put: Optional[Callable] = None,
+        join_timeout_s: float = 5.0,
     ):
         self._source = iter(source)
         self._tokenizer = tokenizer
@@ -52,6 +53,10 @@ class PrefetchPipeline:
         self._device_put = device_put
         self._error: Optional[BaseException] = None
         self._stop = threading.Event()
+        self._join_timeout_s = join_timeout_s
+        self._close_lock = threading.Lock()
+        self._closed = False
+        self._producer_leaked = False
         # Bottleneck instrumentation: where a timed loop's wall clock
         # actually goes is unknowable from throughput alone — these
         # counters split it into host produce time (tokenize + pack +
@@ -115,26 +120,53 @@ class PrefetchPipeline:
         return item
 
     def stats(self) -> dict:
-        """``{produced, produce_s, consumer_wait_s}`` — produce time is
-        the producer thread's busy time per item (tokenize + pack +
+        """``{produced, produce_s, consumer_wait_s, closed,
+        producer_leaked, producer_error}`` — produce time is the
+        producer thread's busy time per item (tokenize + pack +
         device_put); consumer wait is time the consumer spent blocked on
         an empty queue (≈0 when the device is the bottleneck, ≈the gap
-        when the host is)."""
+        when the host is).  ``producer_leaked`` means the last
+        ``close()`` gave up joining the producer (wedged in a blocking
+        tokenizer/device_put) — the thread is daemon-dead weight, not
+        silently forgotten; ``producer_error`` surfaces a crashed
+        producer even when nothing iterates far enough to re-raise it."""
         return {
             "produced": self._produced,
             "produce_s": round(self._produce_s, 4),
             "consumer_wait_s": round(self._consumer_wait_s, 4),
+            "closed": self._closed,
+            "producer_leaked": self._producer_leaked,
+            "producer_error": (
+                repr(self._error) if self._error is not None else None
+            ),
         }
 
     def close(self) -> None:
-        self._stop.set()
-        # Drain so the producer's blocked put can observe the stop.
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        self._thread.join(timeout=5)
+        """Stop the producer and reap it.  Idempotent: safe to call any
+        number of times (``__exit__`` + explicit close + teardown); a
+        re-close after a timed-out join re-joins, so a producer that
+        eventually unwedges clears the leak flag."""
+        with self._close_lock:
+            self._closed = True
+            self._stop.set()
+            # Drain so the producer's blocked put can observe the stop.
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            if not self._thread.is_alive():
+                self._producer_leaked = False  # reaped since last close
+                return
+            self._thread.join(timeout=self._join_timeout_s)
+            leaked = self._thread.is_alive()
+            if leaked and not self._producer_leaked:
+                # Count the leak once per wedge (a later successful
+                # close clears the flag, so a re-wedge counts again).
+                from svoc_tpu.utils.metrics import registry as _metrics
+
+                _metrics.counter("pipeline_producer_leaks").add(1)
+            self._producer_leaked = leaked
 
     def __enter__(self) -> "PrefetchPipeline":
         return self

@@ -1,0 +1,75 @@
+"""Resilience layer: deterministic fault injection, retry/resume,
+circuit breakers, and the fleet health supervisor.
+
+The paper's whole premise is consensus that survives failing oracles —
+the reference injects k deliberately-failing oracles and the Cairo
+contract carries an admin voting mechanism to replace them — but its
+off-chain stack has no fault story: a mid-loop commit failure strands
+k partial transactions (``ChainCommitError``), the ``auto_commit`` /
+``auto_resume`` flags are stubbed, and the replacement vote is driven
+by hand.  This package closes that loop with the fault-tolerance
+discipline of large distributed trainers (G-Core's degraded-but-alive
+scheduling, HybridFlow's explicit failure-domain separation —
+PAPERS.md):
+
+- :mod:`svoc_tpu.resilience.faults` — seeded :class:`FaultPlan`
+  schedules (transient errors, timeouts, stalls) and the
+  :class:`FaultInjectingBackend` chaos wrapper, exactly replayable;
+- :mod:`svoc_tpu.resilience.retry` — :class:`RetryPolicy`
+  (decorrelated-jitter backoff, attempt/overall deadlines) and
+  :func:`commit_fleet_with_resume`, the idempotency-aware resume of
+  partial fleet commits (re-sends only stranded oracles);
+- :mod:`svoc_tpu.resilience.breaker` — per-backend
+  closed/open/half-open :class:`CircuitBreaker`, exported as a gauge;
+- :mod:`svoc_tpu.resilience.supervisor` —
+  :class:`FleetHealthSupervisor`: reliability signals + commit-failure
+  history → hysteresis health scores → automatic replacement votes;
+- :mod:`svoc_tpu.resilience.chaos` — the seeded end-to-end chaos
+  scenario (``make chaos-smoke`` and the replay tests).
+
+See docs/RESILIENCE.md for semantics and metric series.
+"""
+
+from svoc_tpu.resilience.breaker import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from svoc_tpu.resilience.faults import (
+    FaultInjectingBackend,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    InjectedTimeout,
+)
+from svoc_tpu.resilience.retry import (
+    CommitOutcome,
+    RetryPolicy,
+    call_with_retry,
+    commit_fleet_with_resume,
+)
+from svoc_tpu.resilience.supervisor import (
+    FleetHealthSupervisor,
+    SupervisorConfig,
+)
+
+__all__ = [
+    "BREAKER_CLOSED",
+    "BREAKER_HALF_OPEN",
+    "BREAKER_OPEN",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "CommitOutcome",
+    "FaultInjectingBackend",
+    "FaultPlan",
+    "FaultSpec",
+    "FleetHealthSupervisor",
+    "InjectedFault",
+    "InjectedTimeout",
+    "RetryPolicy",
+    "SupervisorConfig",
+    "call_with_retry",
+    "commit_fleet_with_resume",
+]

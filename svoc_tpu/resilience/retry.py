@@ -1,0 +1,276 @@
+"""Retry policies and idempotency-aware resume of partial fleet commits.
+
+Two layers:
+
+- :func:`call_with_retry` — the generic wrapper: exponential backoff
+  with *decorrelated jitter* (``sleep = min(cap, U(base, 3·prev))`` —
+  the AWS-architecture variant that avoids thundering-herd
+  synchronization without the full-jitter's occasional zero waits),
+  bounded by ``max_attempts`` and an overall deadline.
+
+- :func:`commit_fleet_with_resume` — the fleet-commit specialization.
+  The chain has no rollback: a failure after k transactions leaves k
+  predictions on chain (``ChainCommitError.committed``), so a naive
+  whole-fleet retry would DOUBLE-SEND the committed prefix (burning
+  nonces and gas, and on the local simulator re-running consensus
+  transitions no fetch produced).  Resume instead restarts the loop at
+  the failed oracle (``start=e.committed`` — commit order is
+  oracle-list order, so the absolute committed count IS the failure
+  index), re-sending only the stranded suffix.  An oracle that keeps
+  failing past its per-oracle attempt budget is *skipped* (recorded in
+  ``CommitOutcome.stranded``) so one dead signer cannot starve the
+  rest of the fleet — G-Core's degraded-but-alive discipline; the
+  health supervisor then decides whether to vote it out.
+
+Metric series (shared registry, PR 1): ``retries_total{op=...}``,
+``commit_resumes_total``, ``commit_stranded_total``.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from svoc_tpu.io.chain import ChainAdapter, ChainCommitError
+from svoc_tpu.resilience.breaker import CircuitBreaker, CircuitOpenError
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_registry
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Backoff and deadline configuration.
+
+    ``max_attempts`` bounds *consecutive* failures of one operation
+    (for fleet commits: per oracle — the budget before that oracle is
+    stranded).  ``attempt_deadline_s`` is the per-attempt time budget:
+    a failed attempt that already overran it skips the backoff sleep
+    (the stall itself was the backoff).  ``overall_deadline_s`` bounds
+    the whole retried operation; when the next backoff would cross it,
+    the last error propagates.  ``jitter_seed`` pins the jitter RNG for
+    deterministic chaos replay (None = nondeterministic, production).
+    """
+
+    max_attempts: int = 4
+    base_s: float = 0.05
+    cap_s: float = 2.0
+    attempt_deadline_s: Optional[float] = None
+    overall_deadline_s: Optional[float] = None
+    jitter_seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if self.base_s < 0 or self.cap_s < self.base_s:
+            raise ValueError("need 0 <= base_s <= cap_s")
+
+    def delays(self) -> Iterator[float]:
+        """The decorrelated-jitter backoff sequence."""
+        rng = random.Random(self.jitter_seed)
+        prev = self.base_s
+        while True:
+            prev = min(self.cap_s, rng.uniform(self.base_s, prev * 3))
+            yield prev
+
+
+def call_with_retry(
+    fn: Callable[[], Any],
+    policy: RetryPolicy = RetryPolicy(),
+    *,
+    op: str = "call",
+    retry_on: Tuple[type, ...] = (Exception,),
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
+    registry: Optional[MetricsRegistry] = None,
+):
+    """Run ``fn`` under the policy; re-raises the last error on
+    exhaustion (never wraps — callers keep their typed exceptions and,
+    for :class:`ChainCommitError`, the partial-commit accounting)."""
+    reg = registry or _default_registry
+    deadline = (
+        clock() + policy.overall_deadline_s
+        if policy.overall_deadline_s is not None
+        else None
+    )
+    delays = policy.delays()
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = clock()
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt >= policy.max_attempts:
+                raise
+            delay = next(delays)
+            if (
+                policy.attempt_deadline_s is not None
+                and clock() - t0 > policy.attempt_deadline_s
+            ):
+                delay = 0.0  # the attempt itself overran — don't stack waits
+            if deadline is not None and clock() + delay > deadline:
+                raise
+            reg.counter("retries", labels={"op": op}).add(1)
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            sleep(delay)
+
+
+@dataclass(frozen=True)
+class CommitOutcome:
+    """What a resilient fleet commit actually did.
+
+    ``sent`` counts transactions that reached the chain this cycle
+    (each oracle at most once — resume never re-sends a committed
+    prefix); ``stranded`` the oracle addresses skipped after exhausting
+    their per-oracle attempt budget; ``attempts`` the commit-loop
+    passes (1 = clean single pass).
+    """
+
+    sent: int
+    total: int
+    stranded: Tuple[Any, ...] = ()
+    attempts: int = 1
+
+    @property
+    def complete(self) -> bool:
+        return not self.stranded and self.sent == self.total
+
+
+def commit_fleet_with_resume(
+    adapter: ChainAdapter,
+    predictions: Sequence,
+    policy: RetryPolicy = RetryPolicy(),
+    *,
+    breaker: Optional[CircuitBreaker] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    on_oracle_failure: Optional[Callable[[Any, ChainCommitError], None]] = None,
+    registry: Optional[MetricsRegistry] = None,
+) -> CommitOutcome:
+    """Commit the whole fleet, resuming across partial failures.
+
+    Invariants:
+
+    - **No duplicate transactions.**  Each resume restarts at the
+      absolute failure index (``ChainCommitError.committed`` — commit
+      order is oracle-list order), so an oracle whose tx succeeded is
+      never re-sent (the chaos replay test counts per-oracle sends to
+      prove it).
+    - **Degraded beats dead.**  ``policy.max_attempts`` consecutive
+      failures of ONE oracle strand that oracle (skipped, recorded,
+      reported to ``on_oracle_failure`` each attempt) and the loop
+      moves on; the supervisor owns the replacement decision.
+    - **The breaker is consulted per attempt and credited by
+      progress.**  An OPEN breaker raises :class:`CircuitOpenError`
+      carrying the partial ``sent`` count.  An attempt that LANDED
+      transactions before failing records breaker *success* — the
+      backend is demonstrably alive, and a few flaky signers must not
+      open the whole chain's breaker (that would be a total commit
+      outage on a healthy backend); only zero-progress failures count
+      toward the trip threshold.
+
+    The caller is expected to hold whatever whole-fleet atomicity lock
+    it uses for plain commits (``Session._commit_lock``) — this
+    function adds retries *inside* that atomicity, it does not replace
+    it.
+    """
+    reg = registry or _default_registry
+    deadline = (
+        clock() + policy.overall_deadline_s
+        if policy.overall_deadline_s is not None
+        else None
+    )
+    delays = policy.delays()
+    start = 0
+    sent = 0
+    attempts = 0
+    consecutive: Dict[int, int] = {}
+    stranded: List[Any] = []
+    while True:
+        if breaker is not None and not breaker.allow():
+            raise CircuitOpenError(
+                breaker.name, breaker.retry_after_s(), sent=sent
+            )
+        attempts += 1
+        t0 = clock()
+        try:
+            n = adapter.update_all_the_predictions(predictions, start=start)
+        except ChainCommitError as e:
+            if breaker is not None:
+                # Progress credit: an attempt that LANDED txs before
+                # failing proves the backend alive — record success, or
+                # a handful of flaky SIGNERS would trip the BACKEND
+                # breaker and turn a degraded fleet into a total commit
+                # outage.  Only zero-progress failures count.
+                if e.committed > start:
+                    breaker.record_success()
+                else:
+                    breaker.record_failure()
+            if on_oracle_failure is not None:
+                on_oracle_failure(e.failed_oracle, e)
+            sent += e.committed - start  # txs that landed this attempt
+            j = e.committed  # absolute index of the failed oracle
+            consecutive[j] = consecutive.get(j, 0) + 1
+            if consecutive[j] >= policy.max_attempts:
+                # This oracle exhausted its budget — strand it and keep
+                # the rest of the fleet alive.
+                stranded.append(e.failed_oracle)
+                reg.counter("commit_stranded").add(1)
+                start = j + 1
+                if start >= e.total:
+                    if breaker is not None and sent > 0:
+                        # The BACKEND is alive (other signers landed);
+                        # one dead oracle is the supervisor's problem,
+                        # not a reason to open the backend breaker.
+                        breaker.record_success()
+                    return CommitOutcome(
+                        sent=sent,
+                        total=e.total,
+                        stranded=tuple(stranded),
+                        attempts=attempts,
+                    )
+                reg.counter("retries", labels={"op": "commit"}).add(1)
+                reg.counter("commit_resumes").add(1)
+                continue  # no backoff: the budget burn was the wait
+            start = j
+            delay = next(delays)
+            if (
+                policy.attempt_deadline_s is not None
+                and clock() - t0 > policy.attempt_deadline_s
+            ):
+                delay = 0.0
+            if deadline is not None and clock() + delay > deadline:
+                # e.committed is the FLEET INDEX of the failure (it
+                # counts stranded positions that were skipped, never
+                # sent) — carry the true landed-tx count alongside so
+                # callers' chain_transactions accounting stays honest.
+                e.resilient_sent = sent
+                raise
+            reg.counter("retries", labels={"op": "commit"}).add(1)
+            if start > 0:
+                reg.counter("commit_resumes").add(1)
+            sleep(delay)
+        except Exception:
+            # Not a tx-level failure: the commit's own chain READ (the
+            # oracle-list fetch is the first RPC of every attempt) or a
+            # codec/programming error.  Record it on the breaker —
+            # otherwise a full transport outage would bypass the trip
+            # logic entirely (and a claimed half-open probe slot would
+            # leak, wedging the breaker half-open forever).
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        else:
+            if breaker is not None:
+                breaker.record_success()
+            sent += n
+            return CommitOutcome(
+                sent=sent,
+                total=start + n,
+                stranded=tuple(stranded),
+                attempts=attempts,
+            )

@@ -168,13 +168,28 @@ def restore_simulation(path: str, session) -> None:
     dropped when the dimension changed)."""
     from svoc_tpu.apps.session import SessionConfig
     from svoc_tpu.io.chain import ChainAdapter, LocalChainBackend
+    from svoc_tpu.resilience.retry import RetryPolicy
+    from svoc_tpu.resilience.supervisor import SupervisorConfig
 
     with open(path) as f:
         payload = json.load(f)
     contract = contract_from_dict(payload["contract"])
-    restored_config = SessionConfig(**payload["config"])
+    cfg_dict = dict(payload["config"])
+    # dataclasses.asdict flattened the nested resilience dataclasses to
+    # plain dicts in the JSON — rebuild them, or the restored session's
+    # first resilient commit dies on dict.delays().
+    if isinstance(cfg_dict.get("commit_retry"), dict):
+        cfg_dict["commit_retry"] = RetryPolicy(**cfg_dict["commit_retry"])
+    if isinstance(cfg_dict.get("supervisor"), dict):
+        cfg_dict["supervisor"] = SupervisorConfig(**cfg_dict["supervisor"])
+    restored_config = SessionConfig(**cfg_dict)
     if restored_config.dimension != session.config.dimension:
         session._vectorizer = None
     session.config = restored_config
     session.adapter = ChainAdapter(LocalChainBackend(contract))
+    # The supervisor watches THE session's adapter — rebind it to the
+    # restored one, or health folds and replacement votes would keep
+    # acting on the discarded pre-restore contract.
+    session.supervisor.adapter = session.adapter
+    session.supervisor.config = restored_config.supervisor
     session.simulation_step = payload["simulation_step"]

@@ -520,6 +520,22 @@ class MetricsRegistry:
         """The shared per-stage series every span feeds."""
         return self.histogram("stage_seconds", labels={"stage": stage})
 
+    def family_total(self, name: str) -> float:
+        """Sum of every counter series in the family ``name``, labels
+        folded — e.g. ``family_total("faults_injected")`` totals the
+        per-kind chaos counters for soak artifacts."""
+        # Snapshot under the registry lock: other threads INSERT new
+        # labeled series under it (first retry, first injected fault),
+        # and iterating the live dict would race those inserts.
+        with self._lock:
+            items = list(self.counters.items())
+        total = 0.0
+        for key, c in items:
+            fam, _labels = self._labels.get(key, (key, {}))
+            if fam == name:
+                total += c.count
+        return total
+
     def stage_snapshot(self) -> Dict[str, Dict[str, float]]:
         """``{stage: {count, sum, p50, p95, p99, ...}}`` for every stage
         observed so far — the block BENCH/SOAK artifacts embed."""

@@ -96,6 +96,31 @@ class TestSimulationCheckpoint:
         assert s2.adapter.call_consensus_active() is True
         assert s2.adapter.call_consensus() == consensus
 
+    def test_restore_rehydrates_resilience_wiring(self, tmp_path):
+        """asdict flattens the nested RetryPolicy/SupervisorConfig to
+        dicts in the JSON; a restored session must get real dataclasses
+        back (its resilient commit path calls policy.delays()), and the
+        supervisor must be rebound to the RESTORED adapter, not keep
+        watching the discarded pre-restore contract."""
+        from svoc_tpu.resilience.retry import RetryPolicy
+        from svoc_tpu.resilience.supervisor import SupervisorConfig
+
+        s = self.make_session()
+        s.fetch()
+        s.commit()
+        path = str(tmp_path / "sim.json")
+        save_simulation(path, s)
+
+        s2 = self.make_session()
+        restore_simulation(path, s2)
+        assert isinstance(s2.config.commit_retry, RetryPolicy)
+        assert isinstance(s2.config.supervisor, SupervisorConfig)
+        assert s2.supervisor.adapter is s2.adapter
+        # the whole resilient loop works post-restore
+        s2.fetch()
+        assert s2.commit_resilient().complete
+        assert s2.supervisor_step()["replaced"] == []
+
 
 def test_fleet_scale_simulation_roundtrip(tmp_path):
     """A 1024-oracle session (batched-commit state) snapshots and

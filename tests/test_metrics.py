@@ -223,6 +223,15 @@ class TestRegistry:
         assert set(snap) == {"a", "b"}
         assert snap["a"]["count"] == snap["b"]["count"] == 1
 
+    def test_family_total_folds_labels(self):
+        r = MetricsRegistry()
+        r.counter("faults_injected", labels={"kind": "error"}).add(3)
+        r.counter("faults_injected", labels={"kind": "timeout"}).add(2)
+        r.counter("retries", labels={"op": "commit"}).add(5)
+        assert r.family_total("faults_injected") == 5
+        assert r.family_total("retries") == 5
+        assert r.family_total("absent") == 0
+
     def test_render_prometheus_exposition(self):
         r = MetricsRegistry()
         r.counter("comments_processed").add(7)

@@ -48,6 +48,71 @@ def test_error_propagates():
             next(iter(pipe))
 
 
+def test_close_is_idempotent_and_flags_leaked_producer():
+    """A producer wedged in a blocking tokenizer past the join timeout
+    is RECORDED (stats + metric), not silently leaked; a later close
+    that reaps it clears the flag (ISSUE 3 hardening)."""
+    import threading
+
+    from svoc_tpu.utils.metrics import registry
+
+    release = threading.Event()
+    entered = threading.Event()
+
+    def blocking_tok(texts, seq_len):
+        entered.set()
+        release.wait(10)  # ignores the pipeline's stop event
+        return np.zeros((len(texts), 8), np.int32), np.zeros(
+            (len(texts), 8), np.int32
+        )
+
+    pipe = PrefetchPipeline(
+        [["a"], ["b"]], blocking_tok, seq_len=8, join_timeout_s=0.1
+    )
+    try:
+        assert entered.wait(5)
+        before = registry.counter("pipeline_producer_leaks").count
+        pipe.close()
+        s = pipe.stats()
+        assert s["closed"] and s["producer_leaked"]
+        assert registry.counter("pipeline_producer_leaks").count == before + 1
+        pipe.close()  # idempotent; the still-wedged leak counts once
+        assert pipe.stats()["producer_leaked"]
+        assert registry.counter("pipeline_producer_leaks").count == before + 1
+    finally:
+        release.set()
+    pipe._thread.join(timeout=5)
+    pipe.close()  # producer reaped now — the leak flag clears
+    assert not pipe.stats()["producer_leaked"]
+
+
+def test_close_idempotent_on_clean_pipeline():
+    batches = [["a"] * 2]
+    tok = HashingTokenizer(1024)
+    pipe = PrefetchPipeline(batches, tok, seq_len=8)
+    list(pipe)
+    pipe.close()
+    pipe.close()
+    s = pipe.stats()
+    assert s["closed"] and not s["producer_leaked"]
+    assert s["producer_error"] is None
+
+
+def test_stats_surface_producer_error():
+    """A crashed producer is visible in stats() even when nothing
+    iterates far enough to re-raise it."""
+
+    def bad_tok(texts, seq_len):
+        raise ValueError("tokenizer died")
+
+    pipe = PrefetchPipeline([["a"]], bad_tok, seq_len=8)
+    pipe._thread.join(timeout=5)
+    assert "tokenizer died" in pipe.stats()["producer_error"]
+    with pytest.raises(ValueError, match="tokenizer died"):
+        next(iter(pipe))
+    pipe.close()
+
+
 def test_window_source_reads_store():
     store = CommentStore()
     store.save(SyntheticSource(batch=120)())

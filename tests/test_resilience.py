@@ -1,0 +1,897 @@
+"""Resilience layer: fault plans, retry/resume, breaker, supervisor,
+and the deterministic chaos replay (ISSUE 3 acceptance scenario)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from svoc_tpu.apps.session import Session, SessionConfig
+from svoc_tpu.consensus.state import OracleConsensusContract
+from svoc_tpu.io.chain import ChainAdapter, ChainCommitError, LocalChainBackend
+from svoc_tpu.io.comment_store import CommentStore
+from svoc_tpu.io.scraper import SyntheticSource
+from svoc_tpu.resilience import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+    FaultInjectingBackend,
+    FaultPlan,
+    FaultSpec,
+    FleetHealthSupervisor,
+    InjectedFault,
+    InjectedTimeout,
+    RetryPolicy,
+    SupervisorConfig,
+    call_with_retry,
+    commit_fleet_with_resume,
+)
+from svoc_tpu.resilience.chaos import RecordingBackend, run_chaos_scenario
+from svoc_tpu.utils.metrics import MetricsRegistry
+
+from conftest import fake_sentiment_vectorizer  # noqa: E402
+
+ADMINS = [0xA0, 0xA1, 0xA2]
+ORACLES = [0x10 + i for i in range(7)]
+
+
+def make_contract(**kwargs):
+    defaults = dict(
+        admins=ADMINS,
+        oracles=ORACLES,
+        required_majority=2,
+        n_failing_oracles=2,
+        constrained=True,
+        dimension=6,
+    )
+    defaults.update(kwargs)
+    return OracleConsensusContract(**defaults)
+
+
+def fleet_predictions(seed=0, n=7, dim=6):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(0.05, 0.95, size=(n, dim))
+
+
+def fast_policy(**kwargs):
+    defaults = dict(max_attempts=4, base_s=0.0, cap_s=0.0, jitter_seed=0)
+    defaults.update(kwargs)
+    return RetryPolicy(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_same_seed_same_schedule(self):
+        specs = [
+            FaultSpec(op="invoke:update_prediction", target=1, probability=0.4),
+            FaultSpec(op="invoke:update_prediction", target=2, probability=0.4),
+        ]
+
+        def drive(plan):
+            decisions = []
+            for count in range(50):
+                for target in (1, 2):
+                    decisions.append(
+                        plan.decide("invoke:update_prediction", target)
+                        is not None
+                    )
+            return decisions
+
+        reg = MetricsRegistry()
+        a = drive(FaultPlan(11, specs, registry=reg))
+        b = drive(FaultPlan(11, specs, registry=reg))
+        c = drive(FaultPlan(12, specs, registry=reg))
+        assert a == b
+        assert a != c  # a different seed reshuffles the schedule
+        assert any(a) and not all(a)  # fractional probability both ways
+
+    def test_schedule_independent_of_target_interleaving(self):
+        """Per-(spec, target) counters: another target's traffic must
+        not shift this target's schedule — the property that makes
+        threaded chaos runs replayable."""
+        spec = FaultSpec(
+            op="invoke:update_prediction", target=1, probability=0.5
+        )
+        reg = MetricsRegistry()
+        solo = FaultPlan(3, [spec], registry=reg)
+        solo_seq = [
+            solo.decide("invoke:update_prediction", 1) is not None
+            for _ in range(30)
+        ]
+        mixed = FaultPlan(3, [spec], registry=reg)
+        mixed_seq = []
+        for i in range(30):
+            # interleave unrelated traffic
+            mixed.decide("invoke:update_prediction", 99)
+            mixed_seq.append(
+                mixed.decide("invoke:update_prediction", 1) is not None
+            )
+        assert solo_seq == mixed_seq
+
+    def test_after_and_max_fires(self):
+        plan = FaultPlan(
+            0,
+            [FaultSpec(op="op", after=2, max_fires=3)],
+            registry=MetricsRegistry(),
+        )
+        fired = [plan.decide("op") is not None for _ in range(10)]
+        assert fired == [False, False, True, True, True] + [False] * 5
+
+    def test_wildcard_op_and_kinds(self):
+        reg = MetricsRegistry()
+        plan = FaultPlan(
+            0,
+            [FaultSpec(op="call:*", kind="timeout", max_fires=1)],
+            registry=reg,
+        )
+        with pytest.raises(InjectedTimeout):
+            plan.fire("call:get_consensus_value")
+        assert plan.decide("invoke:update_prediction") is None
+        assert (
+            reg.counter("faults_injected", labels={"kind": "timeout"}).count
+            == 1
+        )
+
+    def test_stall_sleeps_instead_of_raising(self):
+        slept = []
+        plan = FaultPlan(
+            0,
+            [FaultSpec(op="op", kind="stall", stall_s=1.5, max_fires=1)],
+            registry=MetricsRegistry(),
+        )
+        plan.fire("op", sleep=slept.append)
+        assert slept == [1.5]
+
+    def test_fingerprint_replays(self):
+        specs = [FaultSpec(op="op", probability=0.5)]
+        reg = MetricsRegistry()
+
+        def drive(plan):
+            for _ in range(40):
+                plan.decide("op")
+            return plan.fingerprint()
+
+        assert drive(FaultPlan(5, specs, registry=reg)) == drive(
+            FaultPlan(5, specs, registry=reg)
+        )
+        assert drive(FaultPlan(5, specs, registry=reg)) != drive(
+            FaultPlan(6, specs, registry=reg)
+        )
+
+
+class TestFaultInjectingBackend:
+    def test_injects_on_invoke_and_passes_through(self):
+        contract = make_contract()
+        reg = MetricsRegistry()
+        plan = FaultPlan(
+            0,
+            [
+                FaultSpec(
+                    op="invoke:update_prediction",
+                    target=ORACLES[0],
+                    max_fires=1,
+                )
+            ],
+            registry=reg,
+        )
+        backend = FaultInjectingBackend(LocalChainBackend(contract), plan)
+        adapter = ChainAdapter(backend)
+        with pytest.raises(ChainCommitError) as e:
+            adapter.update_all_the_predictions(fleet_predictions())
+        assert e.value.committed == 0
+        assert isinstance(e.value.cause, InjectedFault)
+        # second pass: the max_fires budget is spent, the fleet commits
+        assert adapter.update_all_the_predictions(fleet_predictions()) == 7
+        assert contract.consensus_active
+
+    def test_reads_faultable_too(self):
+        plan = FaultPlan(
+            0,
+            [FaultSpec(op="call:get_admin_list", max_fires=1)],
+            registry=MetricsRegistry(),
+        )
+        adapter = ChainAdapter(
+            FaultInjectingBackend(LocalChainBackend(make_contract()), plan)
+        )
+        with pytest.raises(InjectedFault):
+            adapter.call_admin_list()
+        assert adapter.call_admin_list() == ADMINS
+
+
+# ---------------------------------------------------------------------------
+# Retry / resume
+# ---------------------------------------------------------------------------
+
+
+class TestCallWithRetry:
+    def test_succeeds_after_transients_and_counts(self):
+        reg = MetricsRegistry()
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise ValueError("transient")
+            return "ok"
+
+        out = call_with_retry(
+            flaky,
+            fast_policy(),
+            op="probe",
+            sleep=lambda s: None,
+            registry=reg,
+        )
+        assert out == "ok" and len(attempts) == 3
+        assert reg.counter("retries", labels={"op": "probe"}).count == 2
+
+    def test_exhaustion_reraises_original(self):
+        with pytest.raises(ValueError, match="always"):
+            call_with_retry(
+                lambda: (_ for _ in ()).throw(ValueError("always")),
+                fast_policy(max_attempts=3),
+                sleep=lambda s: None,
+                registry=MetricsRegistry(),
+            )
+
+    def test_overall_deadline_cuts_retries_short(self):
+        clock_now = [0.0]
+
+        def clock():
+            return clock_now[0]
+
+        def sleep(s):
+            clock_now[0] += s
+
+        calls = []
+
+        def failing():
+            calls.append(1)
+            clock_now[0] += 1.0  # each attempt costs 1s
+            raise ValueError("down")
+
+        with pytest.raises(ValueError):
+            call_with_retry(
+                failing,
+                RetryPolicy(
+                    max_attempts=50,
+                    base_s=1.0,
+                    cap_s=1.0,
+                    overall_deadline_s=3.0,
+                    jitter_seed=0,
+                ),
+                sleep=sleep,
+                clock=clock,
+                registry=MetricsRegistry(),
+            )
+        assert len(calls) < 50  # deadline, not attempts, stopped it
+
+    def test_decorrelated_jitter_bounded_and_seeded(self):
+        policy = RetryPolicy(base_s=0.1, cap_s=2.0, jitter_seed=42)
+        gen = policy.delays()
+        seq = [next(gen) for _ in range(64)]
+        assert all(0.1 <= d <= 2.0 for d in seq)
+        gen2 = RetryPolicy(base_s=0.1, cap_s=2.0, jitter_seed=42).delays()
+        assert seq == [next(gen2) for _ in range(64)]
+
+
+class FlakyOracleBackend:
+    """LocalChainBackend wrapper failing specific oracles a fixed
+    number of times (simpler than a plan when the test wants exact
+    failure counts)."""
+
+    def __init__(self, contract, fail_counts):
+        self.inner = LocalChainBackend(contract)
+        self.remaining = dict(fail_counts)
+
+    def call(self, fn):
+        return self.inner.call(fn)
+
+    def call_as(self, caller, fn):
+        return self.inner.call_as(caller, fn)
+
+    def invoke(self, caller, fn, /, **kwargs):
+        left = self.remaining.get(caller, 0)
+        if fn == "update_prediction" and left:
+            self.remaining[caller] = left - 1
+            raise RuntimeError(f"rpc down for {caller:#x}")
+        return self.inner.invoke(caller, fn, **kwargs)
+
+
+class TestCommitFleetWithResume:
+    def test_resume_resends_only_stranded_suffix(self):
+        contract = make_contract()
+        # the flake sits INSIDE the recorder so only landed txs count
+        recorder = RecordingBackend(
+            FlakyOracleBackend(contract, {ORACLES[3]: 2})
+        )
+        adapter = ChainAdapter(recorder)
+        reg = MetricsRegistry()
+        recorder.begin_cycle(0)
+        outcome = commit_fleet_with_resume(
+            adapter,
+            fleet_predictions(),
+            fast_policy(),
+            sleep=lambda s: None,
+            registry=reg,
+        )
+        assert outcome.complete and outcome.sent == 7
+        assert outcome.attempts == 3  # two failures at oracle 3
+        assert reg.counter("commit_resumes").count == 2
+        # no oracle's tx landed twice
+        assert recorder.duplicate_txs == 0
+        assert contract.consensus_active
+
+    def test_persistent_offender_is_stranded_not_fatal(self):
+        contract = make_contract()
+        backend = FlakyOracleBackend(contract, {ORACLES[6]: 10**9})
+        adapter = ChainAdapter(backend)
+        reg = MetricsRegistry()
+        outcome = commit_fleet_with_resume(
+            adapter,
+            fleet_predictions(),
+            fast_policy(max_attempts=3),
+            sleep=lambda s: None,
+            registry=reg,
+        )
+        assert not outcome.complete
+        assert outcome.sent == 6
+        assert outcome.stranded == (ORACLES[6],)
+        assert reg.counter("commit_stranded").count == 1
+        # activation gate: 6/7 committed, consensus must stay inactive
+        assert not contract.consensus_active
+
+    def test_mid_fleet_offender_does_not_starve_tail(self):
+        contract = make_contract()
+        adapter = ChainAdapter(
+            FlakyOracleBackend(contract, {ORACLES[2]: 10**9})
+        )
+        outcome = commit_fleet_with_resume(
+            adapter,
+            fleet_predictions(),
+            fast_policy(max_attempts=2),
+            sleep=lambda s: None,
+            registry=MetricsRegistry(),
+        )
+        assert outcome.stranded == (ORACLES[2],)
+        assert outcome.sent == 6  # oracles 3..6 still committed
+
+    def test_resume_roundtrip_matches_clean_run(self):
+        """Partial-commit + resume must land the EXACT contract state a
+        clean uninterrupted run produces."""
+        predictions = fleet_predictions(seed=9)
+        clean = make_contract()
+        ChainAdapter(LocalChainBackend(clean)).update_all_the_predictions(
+            predictions
+        )
+        chaotic = make_contract()
+        adapter = ChainAdapter(
+            FlakyOracleBackend(
+                chaotic, {ORACLES[1]: 1, ORACLES[4]: 2, ORACLES[6]: 3}
+            )
+        )
+        outcome = commit_fleet_with_resume(
+            adapter,
+            predictions,
+            fast_policy(max_attempts=5),
+            sleep=lambda s: None,
+            registry=MetricsRegistry(),
+        )
+        assert outcome.complete
+        assert chaotic.get_consensus_value() == clean.get_consensus_value()
+        assert (
+            chaotic.get_second_pass_consensus_reliability()
+            == clean.get_second_pass_consensus_reliability()
+        )
+        assert [o.reliable for o in chaotic.oracles] == [
+            o.reliable for o in clean.oracles
+        ]
+        assert [o.value for o in chaotic.oracles] == [
+            o.value for o in clean.oracles
+        ]
+
+    def test_flaky_signers_do_not_open_the_backend_breaker(self):
+        """Progress credit: a persistent offender plus transient flakes
+        must never trip the BACKEND breaker while other txs land —
+        otherwise a degraded fleet becomes a total commit outage
+        (code-review finding, reproduced pre-fix with session defaults:
+        threshold 5 + max_attempts 4 opened the breaker at cycle 3)."""
+        contract = make_contract()
+        breaker = CircuitBreaker(
+            "chain", failure_threshold=5, reset_timeout_s=1e9,
+            registry=MetricsRegistry(),
+        )
+        for cycle in range(6):
+            backend = FlakyOracleBackend(
+                contract, {ORACLES[1]: 1, ORACLES[6]: 10**9}
+            )
+            outcome = commit_fleet_with_resume(
+                ChainAdapter(backend),
+                fleet_predictions(seed=cycle),
+                fast_policy(max_attempts=4),
+                breaker=breaker,
+                sleep=lambda s: None,
+                registry=MetricsRegistry(),
+            )
+            assert outcome.sent == 6, f"cycle {cycle} wedged"
+            assert breaker.state() == BREAKER_CLOSED
+
+    def test_open_breaker_short_circuits_with_accounting(self):
+        contract = make_contract()
+        adapter = ChainAdapter(
+            FlakyOracleBackend(contract, {ORACLES[0]: 10**9})
+        )
+        breaker = CircuitBreaker(
+            "t", failure_threshold=2, reset_timeout_s=1e9,
+            registry=MetricsRegistry(),
+        )
+        with pytest.raises(CircuitOpenError) as e:
+            commit_fleet_with_resume(
+                adapter,
+                fleet_predictions(),
+                fast_policy(max_attempts=10),
+                breaker=breaker,
+                sleep=lambda s: None,
+                registry=MetricsRegistry(),
+            )
+        assert breaker.state() == BREAKER_OPEN
+        assert e.value.sent == 0
+
+    def test_read_failure_records_on_breaker(self):
+        """A transport outage surfaces as a READ failure (the commit's
+        first RPC is the oracle-list fetch) — it must count toward the
+        breaker trip, and a claimed half-open probe must be resolved."""
+
+        class DeadBackend:
+            def call(self, fn):
+                raise ConnectionError("rpc down")
+
+            def call_as(self, caller, fn):
+                raise ConnectionError("rpc down")
+
+            def invoke(self, caller, fn, /, **kwargs):
+                raise ConnectionError("rpc down")
+
+        breaker = CircuitBreaker(
+            "t", failure_threshold=2, reset_timeout_s=1e9,
+            registry=MetricsRegistry(),
+        )
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                commit_fleet_with_resume(
+                    ChainAdapter(DeadBackend()),
+                    fleet_predictions(),
+                    fast_policy(),
+                    breaker=breaker,
+                    sleep=lambda s: None,
+                    registry=MetricsRegistry(),
+                )
+        assert breaker.state() == BREAKER_OPEN
+
+    def test_chain_adapter_start_offset_accounting(self):
+        """`start=` slices the suffix and keeps ChainCommitError's
+        committed count ABSOLUTE — the resume invariant."""
+        contract = make_contract()
+        adapter = ChainAdapter(
+            FlakyOracleBackend(contract, {ORACLES[5]: 1})
+        )
+        predictions = fleet_predictions()
+        with pytest.raises(ChainCommitError) as e:
+            adapter.update_all_the_predictions(predictions, start=2)
+        assert e.value.committed == 5  # absolute index, not 3
+        assert e.value.total == 7
+        # resume from the absolute index commits the rest
+        assert adapter.update_all_the_predictions(
+            predictions, start=e.value.committed
+        ) == 2
+        committed = [o.enabled for o in contract.oracles]
+        assert committed == [False, False, True, True, True, True, True]
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def make(self, **kwargs):
+        self.now = [0.0]
+        reg = MetricsRegistry()
+        defaults = dict(
+            failure_threshold=3,
+            reset_timeout_s=10.0,
+            clock=lambda: self.now[0],
+            registry=reg,
+        )
+        defaults.update(kwargs)
+        return CircuitBreaker("t", **defaults), reg
+
+    def test_opens_after_threshold_and_half_opens_after_reset(self):
+        b, reg = self.make()
+        assert b.state() == BREAKER_CLOSED
+        for _ in range(3):
+            assert b.allow()
+            b.record_failure()
+        assert b.state() == BREAKER_OPEN
+        assert not b.allow()
+        assert b.retry_after_s() == pytest.approx(10.0)
+        gauge = reg.gauge("circuit_breaker_state", labels={"backend": "t"})
+        assert gauge.get() == 1
+        self.now[0] = 10.0
+        assert b.allow()  # the half-open probe
+        assert b.state() == BREAKER_HALF_OPEN
+        assert gauge.get() == 2
+        assert not b.allow()  # probe budget is 1
+        b.record_success()
+        assert b.state() == BREAKER_CLOSED
+        assert gauge.get() == 0
+
+    def test_half_open_failure_reopens(self):
+        b, _ = self.make()
+        for _ in range(3):
+            b.record_failure()
+        self.now[0] = 10.0
+        assert b.allow()
+        b.record_failure()
+        assert b.state() == BREAKER_OPEN
+        assert not b.allow()
+        self.now[0] = 20.0
+        assert b.allow()  # fresh reset window from the re-open
+
+    def test_success_resets_consecutive_count(self):
+        b, _ = self.make()
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        b.record_failure()
+        assert b.state() == BREAKER_CLOSED
+
+    def test_half_open_probe_slot_heals_after_a_lost_verdict(self):
+        """A probe claimed by a caller that died without recording a
+        verdict must not wedge the breaker half-open forever — after a
+        full reset window the probe budget reopens."""
+        b, _ = self.make()
+        for _ in range(3):
+            b.record_failure()
+        self.now[0] = 10.0
+        assert b.allow()  # probe claimed... and the caller vanishes
+        assert not b.allow()
+        self.now[0] = 20.0  # a whole reset window with no verdict
+        assert b.allow()
+        b.record_success()
+        assert b.state() == BREAKER_CLOSED
+
+    def test_guard_context(self):
+        b, _ = self.make(failure_threshold=1)
+        with pytest.raises(ValueError):
+            with b.guard():
+                raise ValueError("boom")
+        assert b.state() == BREAKER_OPEN
+        with pytest.raises(CircuitOpenError):
+            with b.guard():
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+
+
+class TestSupervisor:
+    def test_commit_failures_quarantine_and_replace(self):
+        contract = make_contract()
+        adapter = ChainAdapter(LocalChainBackend(contract))
+        reg = MetricsRegistry()
+        sup = FleetHealthSupervisor(adapter, registry=reg)
+        offender = ORACLES[6]
+        replaced = None
+        for _step in range(6):
+            for _ in range(4):  # a stranded cycle's failure volume
+                sup.record_commit_failure(offender)
+            report = sup.step()
+            if report["replaced"]:
+                replaced = report["replaced"][0]
+                break
+        assert replaced is not None, "supervisor never replaced the offender"
+        assert replaced["old"] == hex(offender)
+        assert replaced["slot"] == 6
+        assert offender not in contract.get_oracle_list()
+        new_addr = contract.get_oracle_list()[6]
+        assert new_addr not in ORACLES
+        assert reg.counter("oracle_replacements").count == 1
+        # slot-keyed health gauges exist and the new identity is fresh
+        assert reg.gauge("oracle_health", labels={"slot": "6"}).get() >= 0
+        assert sup.health_snapshot()["6"] == 1.0
+
+    def test_healthy_fleet_untouched(self):
+        contract = make_contract()
+        sup = FleetHealthSupervisor(
+            ChainAdapter(LocalChainBackend(contract)),
+            registry=MetricsRegistry(),
+        )
+        for _ in range(5):
+            report = sup.step()
+            assert report["quarantined"] == []
+            assert report["replaced"] == []
+        assert contract.get_oracle_list() == ORACLES
+        assert all(v == 1.0 for v in sup.health_snapshot().values())
+
+    def test_hysteresis_recovery_without_replacement(self):
+        contract = make_contract()
+        sup = FleetHealthSupervisor(
+            ChainAdapter(LocalChainBackend(contract)),
+            SupervisorConfig(auto_replace=False),
+            registry=MetricsRegistry(),
+        )
+        target = ORACLES[2]
+        for _ in range(4):
+            sup.record_commit_failure(target)
+            sup.record_commit_failure(target)
+            sup.step()
+        assert sup.quarantined_slots() == [2]
+        assert contract.get_oracle_list() == ORACLES  # observe-only
+        # clean steps: the score must climb past healthy_threshold and
+        # clear the quarantine (hysteresis, not a single boundary)
+        for _ in range(4):
+            sup.step()
+        assert sup.quarantined_slots() == []
+
+    def test_replacement_disabled_contract_downgrades_gracefully(self):
+        contract = make_contract(enable_oracle_replacement=False)
+        sup = FleetHealthSupervisor(
+            ChainAdapter(LocalChainBackend(contract)),
+            registry=MetricsRegistry(),
+        )
+        for _ in range(5):
+            sup.record_commit_failure(ORACLES[0])
+            sup.record_commit_failure(ORACLES[0])
+            sup.step()
+        assert contract.get_oracle_list() == ORACLES
+        assert sup.replacements == []
+        assert sup._replace_disabled  # stopped trying
+
+    def test_step_does_not_flood_the_rel2_trajectory_ring(self):
+        """The supervisor reads rel₂ at auto-loop cadence (seconds);
+        it must peek, not feed the ~1-per-minute operator trajectory
+        ring the capture-slide alarm windows over."""
+        contract = make_contract()
+        adapter = ChainAdapter(LocalChainBackend(contract))
+        sup = FleetHealthSupervisor(adapter, registry=MetricsRegistry())
+        adapter.update_all_the_predictions(fleet_predictions())
+        before = len(adapter.rel2_history)
+        for _ in range(20):
+            sup.step()
+        assert len(adapter.rel2_history) == before
+
+    def test_default_factory_refuses_non_local_backends(self):
+        """The default replacement-address factory mints SYNTHETIC
+        addresses — voting one onto a real chain would create a slot
+        nobody can sign for.  A backend that doesn't bottom out in the
+        local simulator downgrades the supervisor to observe-only."""
+
+        class OpaqueBackend:
+            # mimics a remote backend: no .backend/.inner chain to walk
+            def __init__(self, b):
+                self._b = b
+
+            def call(self, fn):
+                return self._b.call(fn)
+
+            def call_as(self, caller, fn):
+                return self._b.call_as(caller, fn)
+
+            def invoke(self, caller, fn, /, **kwargs):
+                return self._b.invoke(caller, fn, **kwargs)
+
+        contract = make_contract()
+        adapter = ChainAdapter(OpaqueBackend(LocalChainBackend(contract)))
+        sup = FleetHealthSupervisor(adapter, registry=MetricsRegistry())
+        for _ in range(5):
+            for _ in range(4):
+                sup.record_commit_failure(ORACLES[6])
+            sup.step()
+        assert contract.get_oracle_list() == ORACLES  # no synthetic vote
+        assert sup.replacements == []
+        assert sup._replace_disabled
+        # ... while an explicit operator-supplied factory IS honored
+        sup2 = FleetHealthSupervisor(
+            ChainAdapter(OpaqueBackend(LocalChainBackend(make_contract()))),
+            new_address_factory=lambda existing: 0xBEEF,
+            registry=MetricsRegistry(),
+        )
+        for _ in range(5):
+            for _ in range(4):
+                sup2.record_commit_failure(ORACLES[6])
+            if sup2.step()["replaced"]:
+                break
+        assert len(sup2.replacements) == 1
+        assert sup2.replacements[0]["new"] == "0xbeef"
+
+    def test_on_chain_unreliable_flags_feed_scores(self):
+        """An oracle the consensus flags unreliable every cycle drifts
+        below 1.0 even with perfect commit infrastructure."""
+        contract = make_contract()
+        adapter = ChainAdapter(LocalChainBackend(contract))
+        sup = FleetHealthSupervisor(
+            adapter,
+            SupervisorConfig(auto_replace=False),
+            registry=MetricsRegistry(),
+        )
+        # a fleet with one wild outlier: always flagged by the two-pass
+        predictions = fleet_predictions(seed=1)
+        predictions[3] = 0.99
+        for _ in range(3):
+            adapter.update_all_the_predictions(predictions)
+            sup.step()
+        snapshot = sup.health_snapshot()
+        assert snapshot["3"] < 1.0
+        # slot 1 stays reliable in this fleet (n_failing=2 masks the
+        # two most deviant — slots 0 and 3 here)
+        assert snapshot["1"] == 1.0
+        assert snapshot["1"] > snapshot["3"]
+
+
+# ---------------------------------------------------------------------------
+# Session integration
+# ---------------------------------------------------------------------------
+
+
+def make_resilient_session(backend_wrap=None, **cfg_kwargs):
+    cfg_kwargs.setdefault(
+        "commit_retry",
+        RetryPolicy(max_attempts=3, base_s=0.0, cap_s=0.0, jitter_seed=0),
+    )
+    config = SessionConfig(**cfg_kwargs)
+    contract = make_contract()
+    backend = LocalChainBackend(contract)
+    if backend_wrap is not None:
+        backend = backend_wrap(contract, backend)
+    store = CommentStore()
+    store.save(SyntheticSource(batch=200)())
+    session = Session(
+        config=config,
+        store=store,
+        vectorizer=fake_sentiment_vectorizer,
+        adapter=ChainAdapter(backend),
+    )
+    return session, contract
+
+
+class TestSessionResilience:
+    def test_set_auto_flags_bumps_state_version(self):
+        session, _ = make_resilient_session()
+        v0 = session.state_version
+        session.set_auto_flags(commit=True)
+        assert session.auto_commit and session.state_version == v0 + 1
+        session.set_auto_flags(resume=True, fetch=True)
+        assert session.auto_resume and session.auto_fetch
+        assert session.state_version == v0 + 2
+
+    def test_console_flag_commands_bump_state_version(self):
+        from svoc_tpu.apps.commands import CommandConsole
+
+        session, _ = make_resilient_session()
+        console = CommandConsole(session)
+        v0 = session.state_version
+        assert console.query("auto_commit on") == ["Auto-Commit: ENABLED"]
+        assert console.query("auto_resume on") == ["Auto-Resume: ENABLED"]
+        assert session.state_version >= v0 + 2
+        console.query("auto_commit off")
+        assert not session.auto_commit
+
+    def test_commit_resilient_resumes_and_completes(self):
+        session, contract = make_resilient_session(
+            backend_wrap=lambda contract, backend: FlakyOracleBackend(
+                contract, {ORACLES[2]: 1, ORACLES[5]: 1}
+            )
+        )
+        session.fetch()
+        outcome = session.commit_resilient()
+        assert outcome.complete and outcome.sent == 7
+        assert contract.consensus_active
+
+    def test_commit_resilient_strands_then_supervisor_replaces(self):
+        session, contract = make_resilient_session(
+            backend_wrap=lambda contract, backend: FlakyOracleBackend(
+                contract, {ORACLES[6]: 10**9}
+            )
+        )
+        replaced = False
+        for _cycle in range(6):
+            session.fetch()
+            outcome = session.commit_resilient()
+            report = session.supervisor_step()
+            if report and report["replaced"]:
+                replaced = True
+                break
+            assert outcome.stranded == (ORACLES[6],)
+        assert replaced
+        assert ORACLES[6] not in contract.get_oracle_list()
+        # the replacement address signs cleanly: next cycle completes
+        session.fetch()
+        assert session.commit_resilient().complete
+        assert contract.consensus_active
+
+    def test_resilience_snapshot_shape(self):
+        session, _ = make_resilient_session()
+        snap = session.resilience_snapshot()
+        assert snap["breaker"] == BREAKER_CLOSED
+        assert snap["replacements"] == 0
+        assert snap["quarantined"] == []
+        assert isinstance(snap["health"], dict)
+
+    def test_console_resilience_command(self):
+        from svoc_tpu.apps.commands import CommandConsole
+
+        session, _ = make_resilient_session()
+        console = CommandConsole(session)
+        out = console.query("resilience")
+        assert out[0] == "breaker: closed"
+        assert out[-1] == "replacements: 0"
+
+
+# ---------------------------------------------------------------------------
+# Chaos replay (the acceptance scenario)
+# ---------------------------------------------------------------------------
+
+
+class TestChaosReplay:
+    def test_same_seed_bit_identical_and_converged(self):
+        first = run_chaos_scenario(4, registry=MetricsRegistry())
+        second = run_chaos_scenario(4, registry=MetricsRegistry())
+        assert first["fingerprint"] == second["fingerprint"]
+        assert first["faults_fired"] == second["faults_fired"] > 0
+        assert first["consensus_active"]
+        assert first["final_cycle_complete"]
+        assert first["offender_replaced"]
+        assert first["replacements"] == 1
+        assert first["duplicate_txs"] == 0
+
+    def test_different_seed_differs(self):
+        a = run_chaos_scenario(4, cycles=6, registry=MetricsRegistry())
+        b = run_chaos_scenario(5, cycles=6, registry=MetricsRegistry())
+        assert a["fingerprint"] != b["fingerprint"]
+
+    def test_resume_only_resends_stranded(self):
+        """Transient faults fired, yet every cycle's landed txs are
+        unique per oracle — the no-duplicate invariant under chaos."""
+        result = run_chaos_scenario(4, registry=MetricsRegistry())
+        assert result["faults_fired"] > 12  # transients beyond offender
+        assert result["duplicate_txs"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Threaded sanity: shared supervisor state under concurrent reports
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_failure_reports_do_not_corrupt_scores():
+    contract = make_contract()
+    sup = FleetHealthSupervisor(
+        ChainAdapter(LocalChainBackend(contract)),
+        SupervisorConfig(auto_replace=False),
+        registry=MetricsRegistry(),
+    )
+    n_threads, n_reports = 8, 200
+    barrier = threading.Barrier(n_threads)
+
+    def hammer():
+        barrier.wait()
+        for i in range(n_reports):
+            sup.record_commit_failure(ORACLES[i % len(ORACLES)])
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(sup._pending_failures.values())
+    assert total == n_threads * n_reports
+    sup.step()  # folds without blowing up
+    assert sup._pending_failures == {}

@@ -181,6 +181,33 @@ class TestLiveRefresh:
         assert "setInterval" in page
         assert "state_version" in page
 
+    def test_state_reports_resilience_and_auto_flags(self, server):
+        """The resilience surface (ISSUE 3): all three auto flags, the
+        breaker state, and fleet-health live in /api/state, and the
+        page renders the status line from them."""
+        base, console = server
+        state = json.loads(get(base, "/api/state"))
+        assert state["auto_commit"] is False
+        assert state["auto_resume"] is False
+        assert state["resilience"]["breaker"] == "closed"
+        assert state["resilience"]["replacements"] == 0
+        assert state["resilience"]["quarantined"] == []
+        v0 = state["state_version"]
+        # toggling a flag is a LIVE state change (bumps state_version)
+        post(base, "auto_commit on")
+        state = json.loads(get(base, "/api/state"))
+        assert state["auto_commit"] is True
+        assert state["state_version"] > v0
+        page = get(base, "/").decode()
+        assert "resil" in page and "breaker" in page
+
+    def test_metrics_exposes_breaker_gauge(self, server):
+        """circuit_breaker_state exists from session start — before any
+        incident (acceptance: breaker state in GET /metrics)."""
+        base, _ = server
+        text = get(base, "/metrics").decode()
+        assert 'svoc_circuit_breaker_state{backend="chain"} 0' in text
+
     def test_state_reports_auto_fetch_flag(self, server):
         base, console = server
         assert json.loads(get(base, "/api/state"))["auto_fetch"] is False

@@ -1,0 +1,91 @@
+"""Seeded chaos smoke: convergence-under-faults as a CI gate.
+
+Runs the ISSUE-3 acceptance scenario
+(:func:`svoc_tpu.resilience.chaos.run_chaos_scenario`) TWICE with the
+same seed and asserts:
+
+- **replayable** — the two runs produce bit-identical final contract
+  state, replacement history, and fired-fault schedules (the
+  fingerprint digest);
+- **converged** — the run ends with an active, certified consensus and
+  a fully-committed final cycle;
+- **no duplicate txs** — resume never re-sent a landed transaction;
+- **offender replaced** — the supervisor voted the persistent offender
+  out through the contract's replacement flow (exactly once).
+
+Wired into ``make chaos-smoke`` / ``presnapshot`` / ``verify``.  Runs
+off-TPU and in seconds: the 7-oracle fleet stays on the per-tx path
+(no device work) and all retry timing is virtual.
+
+Usage::
+
+    python tools/chaos_smoke.py [--seed 7] [--cycles 12]
+        [--out CHAOS_SMOKE.json]
+"""
+
+from __future__ import annotations
+
+import os
+
+# Off-TPU by construction (the axon sitecustomize pins the platform, so
+# go through jax.config too — tools/soak.py measurement postmortem).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=4)
+    p.add_argument("--cycles", type=int, default=12)
+    p.add_argument("--out", default="CHAOS_SMOKE.json")
+    args = p.parse_args(argv)
+
+    from svoc_tpu.resilience.chaos import run_chaos_scenario
+
+    first = run_chaos_scenario(args.seed, cycles=args.cycles)
+    second = run_chaos_scenario(args.seed, cycles=args.cycles)
+
+    checks = {
+        "replayable": first["fingerprint"] == second["fingerprint"],
+        "consensus_active": bool(first["consensus_active"]),
+        "final_cycle_complete": bool(first["final_cycle_complete"]),
+        "no_duplicate_txs": first["duplicate_txs"] == 0,
+        "offender_replaced": bool(first["offender_replaced"]),
+        "exactly_one_replacement": first["replacements"] == 1,
+        "faults_actually_fired": first["faults_fired"] > 0,
+    }
+    ok = all(checks.values())
+    artifact = {
+        "seed": args.seed,
+        "cycles": args.cycles,
+        "checks": checks,
+        "ok": ok,
+        "run": first,
+        "replay_fingerprint": second["fingerprint"],
+    }
+    with open(args.out + ".tmp", "w") as f:
+        json.dump(artifact, f, indent=1)
+    os.replace(args.out + ".tmp", args.out)
+    print(
+        json.dumps(
+            {
+                "chaos_smoke": "ok" if ok else "FAILED",
+                "seed": args.seed,
+                "checks": checks,
+                "faults_fired": first["faults_fired"],
+                "replacements": first["replacement_history"],
+                "fingerprint": first["fingerprint"][:16],
+            }
+        ),
+        flush=True,
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

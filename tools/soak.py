@@ -107,6 +107,16 @@ def main(argv=None) -> int:
     p.add_argument("--oracles", type=int, default=7)
     p.add_argument("--failing", type=int, default=2)
     p.add_argument("--out", default="SOAK_r04.json")
+    p.add_argument(
+        "--chaos-seed",
+        type=int,
+        default=None,
+        help=(
+            "enable deterministic fault injection on the chain backend "
+            "(transient commit faults on 2 oracles + one persistent "
+            "offender the supervisor must vote out — docs/RESILIENCE.md)"
+        ),
+    )
     args = p.parse_args(argv)
 
     from svoc_tpu.apps.commands import CommandConsole
@@ -140,15 +150,44 @@ def main(argv=None) -> int:
         mixed = 0.7 * v + 0.3 * noise
         return mixed / mixed.sum(axis=1, keepdims=True)
 
+    config = SessionConfig(
+        refresh_rate_s=args.refresh,
+        scraper_rate_s=args.scraper_rate,
+        n_oracles=args.oracles,
+        n_failing=args.failing,
+    )
+    adapter = None
+    if args.chaos_seed is not None:
+        # Chaos soak: the session's local backend wrapped in the seeded
+        # fault injector (the same spec mix `make chaos-smoke` replays),
+        # so the long run exercises retry/resume/breaker/supervisor.
+        from svoc_tpu.apps.session import _default_contract
+        from svoc_tpu.io.chain import ChainAdapter, LocalChainBackend
+        from svoc_tpu.resilience.faults import (
+            FaultInjectingBackend,
+            FaultPlan,
+            standard_fault_specs,
+        )
+
+        oracle_addrs = [0x10 + i for i in range(args.oracles)]
+        plan = FaultPlan(
+            args.chaos_seed,
+            standard_fault_specs(
+                transient=oracle_addrs[: min(2, args.oracles - 1)],
+                persistent=oracle_addrs[-1:],
+            ),
+        )
+        adapter = ChainAdapter(
+            FaultInjectingBackend(
+                LocalChainBackend(_default_contract(config)), plan
+            )
+        )
+
     session = Session(
-        config=SessionConfig(
-            refresh_rate_s=args.refresh,
-            scraper_rate_s=args.scraper_rate,
-            n_oracles=args.oracles,
-            n_failing=args.failing,
-        ),
+        config=config,
         store=CommentStore(),  # empty: the scraper is the only ingest
         vectorizer=conditioned_vectorizer,
+        adapter=adapter,
     )
     console_lines = []
     console = CommandConsole(session, write=console_lines.append)
@@ -223,6 +262,17 @@ def main(argv=None) -> int:
                 "chain_commit_failures": registry.counter(
                     "chain_commit_failures"
                 ).count,
+                # Resilience series (docs/RESILIENCE.md): the same
+                # counters/gauges GET /metrics exposes.
+                "faults_injected": registry.family_total("faults_injected"),
+                "retries": registry.family_total("retries"),
+                "commit_resumes": registry.counter("commit_resumes").count,
+                "commit_stranded": registry.counter("commit_stranded").count,
+                "oracle_replacements": registry.counter(
+                    "oracle_replacements"
+                ).count,
+                "breaker_state": session.breaker.state(),
+                "quarantined_slots": session.supervisor.quarantined_slots(),
                 "consensus_active": bool(
                     session.adapter.cache.get("consensus_active")
                 ),
@@ -246,21 +296,17 @@ def main(argv=None) -> int:
         q = max(1, len(snaps) // 4)
         rss_first = median([s["rss_mb"] for s in snaps[:q]])
         rss_last = median([s["rss_mb"] for s in snaps[-q:]])
-        # Error taxonomy: a ChainCommitError in the auto loop is the
-        # contract faithfully rejecting a degenerate fleet (the
-        # reference chain panics on the same tx — interval error /
-        # division by zero); anything else is an UNEXPECTED framework
-        # error.  Classify from the COUNTERS (the console deduplicates
-        # repeated identical error messages, so lines undercount):
-        # every panic bumps chain_commit_failures AND auto_fetch_errors,
-        # so the difference is the unexpected class.
+        # Error taxonomy: with the resilient commit path (PR 3) chain
+        # panics and flaky txs are handled INSIDE commit_resilient —
+        # retried, resumed, or stranded — and show up as
+        # chain_commit_failures (degraded cycles), never as auto-loop
+        # errors.  auto_fetch_errors is therefore the pure UNEXPECTED
+        # class now (framework bugs, deadline-expired commits).
         error_lines = [
             line for line in console_lines if line.startswith("auto_fetch error")
         ]
         chain_panics = int(registry.counter("chain_commit_failures").count)
-        unexpected = int(
-            registry.counter("auto_fetch_errors").count - chain_panics
-        )
+        unexpected = int(registry.counter("auto_fetch_errors").count)
         commits = registry.timer("commit_latency").n
         panic_rate = chain_panics / max(commits, 1)
         recovered = soak_recovered(snaps)
@@ -271,6 +317,13 @@ def main(argv=None) -> int:
         clean_exit = (
             wind_down_threads <= baseline_threads + 2
             and session.application_on is False
+        )
+        # Chaos soaks deliberately degrade commits until the supervisor
+        # replaces the persistent offender: budget the early degraded
+        # cycles, and require the replacement actually happened.
+        panic_budget = 0.02 if args.chaos_seed is None else 0.25
+        chaos_ok = args.chaos_seed is None or (
+            registry.counter("oracle_replacements").count >= 1
         )
         artifact["summary"] = {
             "elapsed_s": round(time.time() - t0, 1),
@@ -290,6 +343,18 @@ def main(argv=None) -> int:
             "chain_panics": chain_panics,
             "chain_panic_rate": round(panic_rate, 4),
             "recovered_after_panics": recovered,
+            # Resilience totals (docs/RESILIENCE.md): fault/retry/
+            # replacement accounting for the whole run.
+            "faults_injected": registry.family_total("faults_injected"),
+            "retries": registry.family_total("retries"),
+            "commit_resumes": registry.counter("commit_resumes").count,
+            "commit_stranded": registry.counter("commit_stranded").count,
+            "oracle_replacements": registry.counter(
+                "oracle_replacements"
+            ).count,
+            "breaker_state": session.breaker.state(),
+            "replacement_history": list(session.supervisor.replacements),
+            "chaos_seed": args.chaos_seed,
             "rss_mb_first_quarter_median": rss_first,
             "rss_mb_last_quarter_median": rss_last,
             "rss_stable": rss_stable,
@@ -298,7 +363,8 @@ def main(argv=None) -> int:
             "ok": bool(
                 enough_snaps
                 and unexpected == 0
-                and panic_rate <= 0.02
+                and panic_rate <= panic_budget
+                and chaos_ok
                 and recovered
                 and rss_stable
                 and clean_exit
