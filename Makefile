@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web lint test test_fast verify presnapshot bench campaign native metrics-smoke chaos-smoke clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -36,8 +36,15 @@ web:
 lint:
 	$(PY) tools/svoclint.py svoc_tpu tools
 
-# Hermetic suite on the 8-device virtual CPU mesh.
+# Hermetic suite on the 8-device virtual CPU mesh — the tier-1 lane
+# (heavyweight Monte-Carlo / interpret-mode-Pallas / trainer tests are
+# marked @pytest.mark.slow and run in test_all; VERDICT r5 item 6).
 test:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	$(PY) -m pytest tests/ -q -m 'not slow'
+
+# Everything, slow lane included.
+test_all:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/ -q
 
@@ -54,9 +61,22 @@ test_fast:
 chaos-smoke:
 	$(PY) tools/chaos_smoke.py
 
+# Byzantine robustness gate (docs/ROBUSTNESS.md): tiny breakdown grid
+# for both consensus configs + the seeded Byzantine scenario run twice
+# (fingerprint-identical, all malformed vectors quarantined, colluders
+# voted out).  Seconds on CPU.
+robustness-smoke:
+	$(PY) tools/robustness_cert.py --smoke
+
+# The full empirical breakdown-point certificate →
+# ROBUSTNESS_CERT.json (tolerated colluder fraction per attack, both
+# configs, calibrated against the benign-replacement control).
+robustness-cert:
+	$(PY) tools/robustness_cert.py
+
 # The default verify path: the cheap static gate first, then the chaos
-# convergence gate, then the suite.
-verify: lint chaos-smoke test
+# convergence gates (I/O-plane, then data-plane), then the suite.
+verify: lint chaos-smoke robustness-smoke test
 
 # End-of-round gate: lint + the driver-contract guards FIRST (fast,
 # loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
@@ -65,6 +85,7 @@ verify: lint chaos-smoke test
 presnapshot:
 	$(MAKE) lint
 	$(MAKE) chaos-smoke
+	$(MAKE) robustness-smoke
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_graft_entry.py tests/test_bench.py -q
 	$(MAKE) test

@@ -421,6 +421,23 @@ class CommandConsole:
                 else:
                     emit("no health scores yet (no supervised commits)")
                 emit(f"replacements: {snap['replacements']}")
+                quarantine = snap.get("input_quarantine")
+                if quarantine is None:
+                    emit("input quarantine: no gated fetch yet")
+                elif not quarantine["quarantined"]:
+                    emit(
+                        "input quarantine: clean "
+                        f"({quarantine['admitted']}/{quarantine['total']} "
+                        "admitted)"
+                    )
+                else:
+                    emit(
+                        "input quarantine: "
+                        + ", ".join(
+                            f"slot {q['slot']} ({q['reason']})"
+                            for q in quarantine["quarantined"]
+                        )
+                    )
             elif cmd == "multimodal":
                 # Beyond-reference: mixture-model analysis of the LAST
                 # fetched fleet (the scenario documentation/README.md:

@@ -46,6 +46,12 @@ from svoc_tpu.resilience.supervisor import (
     FleetHealthSupervisor,
     SupervisorConfig,
 )
+from svoc_tpu.robustness.sanitize import (
+    QuarantinedInputError,
+    QuarantineGate,
+    QuarantineReport,
+    SanitizeConfig,
+)
 from svoc_tpu.sim.oracle import gen_oracle_predictions
 from svoc_tpu.utils.metrics import registry as metrics
 from svoc_tpu.utils.metrics import stage_span
@@ -107,6 +113,12 @@ class SessionConfig:
     #: the open→half-open reset window.
     breaker_failure_threshold: int = 5
     breaker_reset_s: float = 30.0
+    #: Input-integrity quarantine gate ahead of the commit path
+    #: (docs/ROBUSTNESS.md): NaN/Inf, value-domain and wsad/felt-codec
+    #: checks on every fetched fleet block.  The faithful ``commit``
+    #: refuses a dirty block outright; ``commit_resilient`` skips the
+    #: quarantined slots and charges them to the oracle's health.
+    quarantine_gate: bool = True
 
 
 def _default_contract(cfg: SessionConfig) -> OracleConsensusContract:
@@ -174,6 +186,16 @@ class Session:
         self.supervisor = FleetHealthSupervisor(
             self.adapter, self.config.supervisor, registry=metrics
         )
+        #: Input-integrity gate (docs/ROBUSTNESS.md): bounds derived
+        #: from the consensus model — the contract's [0,1] interval for
+        #: constrained sessions, codec-window-only for unconstrained.
+        self.gate = QuarantineGate(
+            SanitizeConfig.for_consensus(self.config.constrained),
+            registry=metrics,
+        )
+        #: Last gate verdict over the fetched fleet (written with the
+        #: predictions it describes, under the session lock).
+        self.last_quarantine: Optional[QuarantineReport] = None
         self.predictions: Optional[np.ndarray] = None
         self.last_preview: Optional[Dict] = None
         #: Bumped on every state change the UI renders (fetch, commit,
@@ -361,6 +383,14 @@ class Session:
                 # this span's documented purpose).
                 mean, median, ranks = _preview_stats(values)
                 predictions = np.asarray(values, dtype=np.float64)  # svoclint: disable=SVOC001
+                # The gate verdict travels WITH the block it describes
+                # (one count-bearing inspection per fetch; commits
+                # re-check their own snapshot without counting).
+                quarantine = (
+                    self.gate.inspect(predictions)
+                    if self.config.quarantine_gate
+                    else None
+                )
                 preview = {
                     "values": predictions,
                     "mean": np.asarray(mean),  # svoclint: disable=SVOC001
@@ -368,6 +398,9 @@ class Session:
                     "normalized_ranks": np.asarray(ranks),  # svoclint: disable=SVOC001
                     "honest": np.asarray(honest),  # svoclint: disable=SVOC001
                     "n_comments": len(comments),
+                    "quarantine": (
+                        quarantine.as_dict() if quarantine is not None else None
+                    ),
                 }
             metrics.counter("comments_processed").add(len(comments))
             with self.lock:
@@ -376,6 +409,7 @@ class Session:
                 if claim > self._fetch_published:
                     self._fetch_published = claim
                     self.predictions = predictions
+                    self.last_quarantine = quarantine
                     self.last_preview = preview
                     self.bump_state()
         return preview
@@ -395,6 +429,12 @@ class Session:
         On a mid-loop failure the partial tx count is still recorded
         (those transactions are on chain) before the
         :class:`ChainCommitError` propagates to the command layer.
+
+        A fleet block the quarantine gate flagged refuses to commit AT
+        ALL (:class:`QuarantinedInputError`, before any tx): the
+        faithful path has no degraded mode, and sending the dirty tx
+        would only trade a clear refusal for a felt-codec crash or an
+        on-chain interval panic mid-fleet.
         """
         # Snapshot under the session lock, then submit under the COMMIT
         # lock only: a Sepolia RPC can stall indefinitely and must not
@@ -406,6 +446,10 @@ class Session:
             if self.predictions is None:
                 raise RuntimeError("fetch before commit")
             predictions = self.predictions
+        if self.config.quarantine_gate:
+            report = self.gate.inspect(predictions, count=False)
+            if not report.clean:
+                raise QuarantinedInputError(report)
         with self._commit_lock, metrics.timer("commit_latency").time():
             try:
                 n = self.adapter.update_all_the_predictions(predictions)
@@ -445,6 +489,22 @@ class Session:
             if self.predictions is None:
                 raise RuntimeError("fetch before commit")
             predictions = self.predictions
+        # Quarantine gate (docs/ROBUSTNESS.md): refused slots never
+        # produce a tx; each refusal charges the slot's oracle exactly
+        # like a commit failure, so a persistent garbage emitter walks
+        # the same health→quarantine→replacement path as a dead signer.
+        skip: tuple = ()
+        if self.config.quarantine_gate:
+            report = self.gate.inspect(predictions, count=False)
+            if not report.clean:
+                skip = tuple(report.quarantined_slots)
+                oracles = self.adapter.call_oracle_list()
+                for slot in report.quarantined_slots:
+                    if slot < len(oracles):
+                        self.supervisor.record_quarantine(
+                            oracles[slot], report.reasons[slot]
+                        )
+                metrics.counter("commit_skipped_quarantined").add(len(skip))
         with self._commit_lock, metrics.timer("commit_latency").time():
             try:
                 outcome = commit_fleet_with_resume(
@@ -452,6 +512,7 @@ class Session:
                     predictions,
                     self.config.commit_retry,
                     breaker=self.breaker,
+                    skip=skip,
                     on_oracle_failure=self.supervisor.record_commit_failure,
                 )
             except ChainCommitError as e:
@@ -515,9 +576,16 @@ class Session:
     def resilience_snapshot(self) -> Dict:
         """Breaker + fleet-health state for the UI and soak artifacts.
         Cheap: no chain I/O (the supervisor reads its cached scores)."""
+        with self.lock:
+            quarantine = self.last_quarantine
         return {
             "breaker": self.breaker.state(),
             "health": self.supervisor.health_snapshot(),
             "quarantined": self.supervisor.quarantined_slots(),
             "replacements": len(self.supervisor.replacements),
+            # Input-integrity gate verdict over the LAST fetched fleet
+            # (docs/ROBUSTNESS.md) — None until the first gated fetch.
+            "input_quarantine": (
+                quarantine.as_dict() if quarantine is not None else None
+            ),
         }

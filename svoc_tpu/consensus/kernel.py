@@ -104,6 +104,15 @@ def consensus_step(values: jnp.ndarray, cfg: ConsensusConfig) -> ConsensusOutput
     kurt = stats.masked_kurtosis(values, reliable, means, variances)
 
     valid = jnp.logical_and(stats.interval_ok(rel1), stats.interval_ok(rel2))
+    # A "consensus" of fewer than two reliable oracles is no consensus:
+    # the smooth median averages sorted[m/2-1] and sorted[m/2], which at
+    # m<=1 reads clipped/sentinel rows — the n_failing >= N-1 degenerate
+    # block must surface as invalid, never as a confident essence built
+    # from +inf sentinels (rel2 even evaluates to a clean 1.0 at m=0:
+    # the masked mean of an empty risk set is 0).  n_failing is static,
+    # so this folds to a constant in the common case.
+    if n - cfg.n_failing < 2:
+        valid = jnp.logical_and(valid, False)
 
     return ConsensusOutput(
         essence=essence2,
@@ -124,6 +133,84 @@ def consensus_step_batched(
     """vmap of :func:`consensus_step` over a leading batch axis ``[B, N, M]``
     — the Monte-Carlo / multi-window form."""
     return jax.vmap(lambda v: consensus_step(v, cfg))(values)
+
+
+def consensus_step_gated(
+    values: jnp.ndarray, ok: jnp.ndarray, cfg: ConsensusConfig
+) -> ConsensusOutput:
+    """Two-pass consensus over the ADMITTED subset of an oracle block.
+
+    ``ok [N]`` is the input-integrity quarantine mask from
+    :mod:`svoc_tpu.robustness.sanitize` (True = admitted): quarantined
+    oracles are excluded from the first-pass median, carry a sentinel
+    risk so the reliability ranking always drops them first, and can
+    never enter the reliable set — a single NaN/Inf vector therefore
+    cannot poison any reduction (the contract gets this for free by
+    panicking the offending tx; the jittable kernel must mask instead).
+    Fewer than two admitted — or two reliable — oracles flags
+    ``interval_valid=False`` (no consensus), mirroring the degenerate
+    ``n_failing >= N-1`` guard of :func:`consensus_step`.
+
+    Semantics with ``ok = ones(N)`` are identical to
+    :func:`consensus_step` (equivalence-tested in
+    ``tests/test_robustness.py``).
+    """
+    n, dim = values.shape
+    # Neutral fill: quarantined rows are masked out of every reduction
+    # below, but masked reductions multiply by 0 rather than select, and
+    # 0 * NaN is NaN — the fill must happen before any arithmetic.
+    safe = jnp.where(ok[:, None], values, 0.0)
+    safe = jnp.where(jnp.isfinite(safe), safe, 0.0)
+    n_ok = jnp.sum(ok.astype(jnp.int32))
+
+    # ---- FIRST PASS over the admitted subset ----
+    essence1 = stats.masked_smooth_median(safe, ok, cfg.smooth_mode)
+    qr_raw = stats.quadratic_risk(safe, essence1)
+    qr_ok = jnp.where(ok, qr_raw, 0.0)
+    rel1 = _reliability(cfg, stats.masked_scalar_mean(qr_ok, ok), dim)
+    reliable = sort_ops.gated_reliability_mask(qr_raw, ok, n_ok, cfg.n_failing)
+
+    # ---- SECOND PASS (same essence₁-centered risk quirk) ----
+    if cfg.constrained:
+        essence2 = stats.masked_smooth_median(safe, reliable, cfg.smooth_mode)
+    else:
+        essence2 = stats.masked_mean(safe, reliable)
+    rel2 = _reliability(cfg, stats.masked_scalar_mean(qr_ok, reliable), dim)
+
+    means = stats.masked_mean(safe, reliable)
+    variances = stats.masked_component_variance(safe, reliable, means)
+    skew = stats.masked_skewness(safe, reliable, means, variances)
+    kurt = stats.masked_kurtosis(safe, reliable, means, variances)
+
+    n_rel = jnp.sum(reliable.astype(jnp.int32))
+    valid = jnp.logical_and(stats.interval_ok(rel1), stats.interval_ok(rel2))
+    valid = jnp.logical_and(valid, n_ok >= 2)
+    valid = jnp.logical_and(valid, n_rel >= 2)
+    # An all-quarantined (or single-survivor) block reports a FINITE
+    # essence alongside its invalid flag — +inf sort sentinels must not
+    # leak to callers that render before checking validity.
+    essence2 = jnp.where(jnp.isfinite(essence2), essence2, 0.0)
+    essence1 = jnp.where(jnp.isfinite(essence1), essence1, 0.0)
+
+    return ConsensusOutput(
+        essence=essence2,
+        essence_first_pass=essence1,
+        reliability_first_pass=rel1,
+        reliability_second_pass=rel2,
+        reliable=reliable,
+        quadratic_risk=qr_raw,
+        skewness=skew,
+        kurtosis=kurt,
+        interval_valid=valid,
+    )
+
+
+def consensus_step_gated_batched(
+    values: jnp.ndarray, ok: jnp.ndarray, cfg: ConsensusConfig
+) -> ConsensusOutput:
+    """vmap of :func:`consensus_step_gated` over ``[B, N, M]`` blocks
+    with per-block masks ``[B, N]``."""
+    return jax.vmap(lambda v, m: consensus_step_gated(v, m, cfg))(values, ok)
 
 
 def jit_consensus(cfg: ConsensusConfig):

@@ -57,11 +57,24 @@ class ChainCommitError(RuntimeError):
     guessing from a traceback.
     """
 
-    def __init__(self, committed: int, total: int, failed_oracle, cause):
+    def __init__(
+        self, committed: int, total: int, failed_oracle, cause,
+        sent_count: Optional[int] = None,
+    ):
         self.committed = committed
         self.total = total
         self.failed_oracle = failed_oracle
         self.cause = cause
+        #: Transactions actually landed by the failing ATTEMPT.  Equals
+        #: ``committed - start`` on the plain path, but diverges when
+        #: quarantine ``skip`` slots sit inside the attempted range
+        #: (``committed`` is a fleet INDEX for resume; skipped slots
+        #: advance it without sending a tx).  ``None`` when the raiser
+        #: did not supply it — consumers must fall back to the index
+        #: delta (``committed - start``), NEVER to ``committed``, which
+        #: on a resumed attempt would overstate landed txs and credit
+        #: a zero-progress failure as breaker progress.
+        self.sent_count = sent_count
         super().__init__(
             f"commit failed at oracle {failed_oracle!r} after "
             f"{committed}/{total} transactions: {cause}"
@@ -589,6 +602,7 @@ class ChainAdapter:
         *,
         batch: Optional[bool] = None,
         start: int = 0,
+        skip: Sequence[int] = (),
     ) -> int:
         """One signed tx per oracle, in oracle-list order
         (``client/contract.py:200-208``); returns the tx count *sent by
@@ -608,6 +622,15 @@ class ChainAdapter:
         ``start=e.committed`` re-sends exactly the stranded suffix and
         never duplicates a landed tx.
 
+        ``skip`` holds ABSOLUTE fleet indices whose tx must not be
+        sent at all (the quarantine gate's refusals,
+        docs/ROBUSTNESS.md): skipped slots are passed over without a
+        transaction and WITHOUT counting into the returned tx count,
+        while ``committed``/resume indices keep counting them as fleet
+        positions — ``start=e.committed`` resume semantics are
+        unchanged.  A non-empty ``skip`` forces the per-tx loop (the
+        batched entrypoint commits a contiguous caller range).
+
         ``batch=None`` auto-selects the backend's batched fleet commit
         (same sequential semantics, O(1) golden recomputes — see
         :meth:`svoc_tpu.consensus.state.OracleConsensusContract.update_predictions_batch`)
@@ -618,7 +641,7 @@ class ChainAdapter:
 
         with stage_span("commit"):
             return self._update_all_the_predictions(
-                predictions, batch=batch, start=start
+                predictions, batch=batch, start=start, skip=skip
             )
 
     def _update_all_the_predictions(
@@ -627,18 +650,28 @@ class ChainAdapter:
         *,
         batch: Optional[bool] = None,
         start: int = 0,
+        skip: Sequence[int] = (),
     ) -> int:
         oracles = self.call_oracle_list()
         total = min(len(oracles), len(predictions))
         if not 0 <= start <= total:
             raise ValueError(f"start={start} outside [0, {total}]")
+        skip_set = frozenset(int(i) for i in skip)
+        if skip_set and not all(0 <= i < total for i in skip_set):
+            raise ValueError(f"skip indices {sorted(skip_set)} outside [0, {total})")
         batched_invoke = getattr(
             self.backend, "invoke_update_predictions_batch", None
         )
         if batch is None:
             batch = (
-                batched_invoke is not None
+                not skip_set
+                and batched_invoke is not None
                 and total - start >= self.BATCH_COMMIT_THRESHOLD
+            )
+        if batch and skip_set:
+            raise ValueError(
+                "batch commit cannot skip slots (contiguous caller "
+                "range) — use batch=False with skip"
             )
         if batch:
             if batched_invoke is None:
@@ -680,6 +713,7 @@ class ChainAdapter:
                         total=total,
                         failed_oracle=e.oracle_address,
                         cause=e.cause,
+                        sent_count=e.index,
                     ) from e
                 except BatchNotCertified:
                     fell_through = True  # exact per-tx loop below
@@ -691,20 +725,25 @@ class ChainAdapter:
                         total=total,
                         failed_oracle=oracles[t],
                         cause=cause,
+                        sent_count=committed,
                     ) from cause
                 return committed
         n = 0
-        for oracle, prediction in zip(oracles[start:total], predictions[start:total]):
+        for idx in range(start, total):
+            if idx in skip_set:
+                continue  # quarantined slot: no tx, no count
+            oracle, prediction = oracles[idx], predictions[idx]
             try:
                 self.invoke_update_prediction(oracle, prediction)
             except ChainCommitError:
                 raise
             except Exception as e:
                 raise ChainCommitError(
-                    committed=start + n,
+                    committed=idx,
                     total=total,
                     failed_oracle=oracle,
                     cause=e,
+                    sent_count=n,
                 ) from e
             n += 1
         return n

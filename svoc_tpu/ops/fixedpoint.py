@@ -41,8 +41,23 @@ FELT_PRIME: int = (
 )
 #: Largest value decoded as positive (client/contract.py:36).
 I128_MAX: int = 2**127 - 1
+#: Most negative representable wsad value (Cairo ``i128`` lower bound).
+I128_MIN: int = -(2**127)
 
 MAX_SQRT_ITERATIONS: int = 50
+
+
+class FeltRangeError(ValueError):
+    """A felt252 outside the two's-complement i128 window.
+
+    The wire encoding maps signed wsad ints onto ``[0, I128_MAX]``
+    (non-negative) and ``[FELT_PRIME + I128_MIN, FELT_PRIME)``
+    (negative).  Everything between those windows — and anything
+    outside ``[0, FELT_PRIME)`` — is not the encoding of ANY i128, so
+    decoding it silently (as the seed's ``felt_to_wsad`` did by
+    wrapping) manufactures a value no oracle ever signed.  The decode
+    boundary raises instead (docs/ROBUSTNESS.md §input integrity).
+    """
 
 
 def div_trunc(a: int, b: int) -> int:
@@ -65,6 +80,38 @@ def wsad_mul(a: int, b: int) -> int:
 def wsad_div(a: int, b: int) -> int:
     """Rounded fixed-point divide (``signed_decimal.cairo:114-116``)."""
     return div_trunc(a * WSAD + div_trunc(b, 2), b)
+
+
+def _saturate(value: int, op: str) -> int:
+    """Clamp an exact result into the i128 window, counting overflows.
+
+    The host engine computes in unbounded Python ints, so — unlike the
+    Cairo i128 — an overflow here would neither panic nor wrap; it
+    would silently leave the representable range and only blow up at
+    the felt encode boundary.  The ``*_sat`` variants make the i128
+    contract explicit: saturate at ``I128_MIN``/``I128_MAX`` (sign
+    preserved — saturation can NEVER wrap a positive overflow to a
+    negative value the way two's-complement wrapping would) and count
+    the event into ``wsad_overflows{op=}`` (docs/OBSERVABILITY.md).
+    """
+    if I128_MIN <= value <= I128_MAX:
+        return value
+    from svoc_tpu.utils.metrics import registry as _metrics
+
+    _metrics.counter("wsad_overflows", labels={"op": op}).add(1)
+    return I128_MAX if value > 0 else I128_MIN
+
+
+def wsad_add_sat(a: int, b: int) -> int:
+    """i128-checked add: exact sum, saturated into the i128 window."""
+    return _saturate(a + b, "add")
+
+
+def wsad_mul_sat(a: int, b: int) -> int:
+    """:func:`wsad_mul` with the product saturated into the i128 window
+    (same +HALF_WSAD rounding bias, then clamp instead of silent
+    out-of-range growth)."""
+    return _saturate(wsad_mul(a, b), "mul")
 
 
 def wsad_sqrt(value: int) -> int:
@@ -105,8 +152,13 @@ def float_to_fwsad(x: float) -> int:
 
 
 def fwsad_to_float(x: int) -> float:
-    """felt252-encoded wsad → float (``client/contract.py:41-45``)."""
-    return float(x - FELT_PRIME if x > I128_MAX else x) * 1e-6
+    """felt252-encoded wsad → float (``client/contract.py:41-45``).
+
+    Validated decode: out-of-window calldata raises
+    :class:`FeltRangeError` instead of wrapping (see
+    :func:`felt_to_wsad`) — an RPC answering garbage must fail the
+    read, not poison downstream statistics with a fabricated value."""
+    return float(felt_to_wsad(int(x))) * 1e-6
 
 
 def wsad_to_string(value: int, n_digits: int = 3) -> str:
@@ -136,8 +188,24 @@ def wsad_to_felt(x: int) -> int:
 
 
 def felt_to_wsad(x: int) -> int:
-    """felt252 → signed wsad int (two's complement around the prime)."""
-    return x - FELT_PRIME if x > I128_MAX else x
+    """felt252 → signed wsad int (two's complement around the prime).
+
+    Raises :class:`FeltRangeError` for calldata outside the i128
+    encoding windows: the seed accepted any integer here and wrapped,
+    so a felt ≥ ``FELT_PRIME`` (or one from the dead zone between the
+    positive and negative windows) decoded to a value that was never
+    an i128 on chain — exactly the malformed input the quarantine gate
+    exists to refuse (docs/ROBUSTNESS.md)."""
+    if not 0 <= x < FELT_PRIME:
+        raise FeltRangeError(f"felt {x} outside [0, FELT_PRIME)")
+    if x <= I128_MAX:
+        return x
+    decoded = x - FELT_PRIME
+    if decoded < I128_MIN:
+        raise FeltRangeError(
+            f"felt {x} decodes below i128 range (no oracle can sign it)"
+        )
+    return decoded
 
 
 # ---------------------------------------------------------------------------

@@ -85,3 +85,36 @@ def reliability_mask(risk: jnp.ndarray, n_failing) -> jnp.ndarray:
     order = argsort_cairo(risk)
     rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
     return rank < (n - n_failing)
+
+
+def gated_reliability_mask(
+    risk: jnp.ndarray, ok: jnp.ndarray, n_ok, n_failing
+) -> jnp.ndarray:
+    """:func:`reliability_mask` over the ADMITTED subset of a block.
+
+    Drops the worst ``n_failing`` OF THE ADMITTED (``ok``) oracles:
+    quarantined oracles carry a ``+inf`` sentinel risk so they sort
+    strictly last (no FINITE sentinel dominates every admissible risk
+    — the unconstrained gate admits values up to the codec window,
+    whose quadratic risks reach ~1e64 — and a sentinel that loses the
+    sort would eat part of the admitted budget; ``+inf`` is safe here
+    because the sentinel feeds ONLY the argsort, never a masked
+    product), and the cut counts from ``n_ok`` — quarantine must not
+    absorb the mask budget, because a Byzantine oracle whose values
+    are syntactically valid is admitted and the risk ranking is the
+    defense that still has to catch it.  Cairo tie order is preserved
+    among real risks.  Shared by
+    :func:`svoc_tpu.consensus.kernel.consensus_step_gated` and the
+    sharded consensus body (one implementation, one tie semantics).
+    ``n_ok``/``n_failing`` may be traced scalars (``n_ok`` must be
+    integer-typed).
+    """
+    n = risk.shape[0]
+    ranked = jnp.where(ok, risk, jnp.inf)
+    order = argsort_cairo(ranked)
+    rank = (
+        jnp.zeros(n, dtype=jnp.int32)
+        .at[order]
+        .set(jnp.arange(n, dtype=jnp.int32))
+    )
+    return jnp.logical_and(rank < n_ok - n_failing, ok)

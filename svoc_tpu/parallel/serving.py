@@ -275,13 +275,24 @@ def fleet_step_fn(
     *,
     subset_size: int = 10,
     axis: str = "data",
+    gate=None,
 ):
     """Standalone jitted ``(key, window) → (ConsensusOutput, honest)``
     on the serving mesh — the drain step for the pipelined serving
-    loop (and a direct window-consensus entry point)."""
+    loop (and a direct window-consensus entry point).
+
+    ``gate=(lo, hi)`` enables the in-graph input-integrity quarantine
+    on the generated fleet values (docs/ROBUSTNESS.md): a corrupted
+    window (NaN from a poisoned forward, out-of-domain vectors) can
+    then never reach the consensus reductions — the step returns
+    ``(ConsensusOutput, honest, admitted)`` and flags
+    ``interval_valid=False`` when fewer than two oracles survive.
+    """
     return _traced_dispatch(
         jax.jit(
-            fleet_consensus_shard_map(mesh, ccfg, n_oracles, subset_size, axis)
+            fleet_consensus_shard_map(
+                mesh, ccfg, n_oracles, subset_size, axis, gate
+            )
         ),
         "fleet",
     )

@@ -38,6 +38,7 @@ except ImportError:  # pragma: no cover — older jax
 from svoc_tpu.consensus.kernel import ConsensusConfig, ConsensusOutput
 from svoc_tpu.ops import sort as sort_ops
 from svoc_tpu.ops import stats
+from svoc_tpu.robustness.sanitize import quarantine_mask_jax
 
 
 def consensus_out_specs(axis: str) -> ConsensusOutput:
@@ -56,10 +57,19 @@ def consensus_out_specs(axis: str) -> ConsensusOutput:
     )
 
 
-def _consensus_body(cfg: ConsensusConfig, axis: str):
-    """shard_map body: ``values_local [N/d, M]`` → sharded/replicated outs."""
+def _consensus_body(cfg: ConsensusConfig, axis: str, gate=None):
+    """shard_map body: ``values_local [N/d, M]`` → sharded/replicated outs.
 
-    def body(values_local: jnp.ndarray) -> ConsensusOutput:
+    ``gate=(lo, hi)`` adds the in-graph input-integrity quarantine
+    (docs/ROBUSTNESS.md): the admission mask is computed on the
+    gathered block (no extra collective — the median gather already
+    ships all values), quarantined oracles are excluded from both
+    passes and carry a sentinel rank risk, and the body additionally
+    returns the local admission mask.  ``gate=None`` compiles to the
+    exact ungated program.
+    """
+
+    def body(values_local: jnp.ndarray):
         n_local, dim = values_local.shape
         d = jax.lax.psum(1, axis)
         n = n_local * d
@@ -68,14 +78,40 @@ def _consensus_body(cfg: ConsensusConfig, axis: str):
         # Global view for the medians: [N, M], a few KB — one ICI hop.
         values = jax.lax.all_gather(values_local, axis, tiled=True)
 
-        # ---- FIRST PASS ----
         all_mask = jnp.ones(n, dtype=bool)
-        essence1 = stats.masked_smooth_median(values, all_mask, cfg.smooth_mode)
+        if gate is not None:
+            ok = quarantine_mask_jax(values, gate[0], gate[1])
+            # Neutral-fill BEFORE any arithmetic: masked reductions
+            # multiply by 0, and 0 * NaN is NaN.
+            values = jnp.where(
+                jnp.logical_and(ok[:, None], jnp.isfinite(values)),
+                values,
+                0.0,
+            )
+            ok_local = jax.lax.dynamic_slice_in_dim(
+                ok, ax * n_local, n_local
+            )
+            values_local = jax.lax.dynamic_slice_in_dim(
+                values, ax * n_local, n_local
+            )
+            base_mask = ok
+            okf_local = ok_local.astype(values.dtype)
+            n_ok = jax.lax.psum(jnp.sum(okf_local), axis)
+        else:
+            base_mask = all_mask
+
+        # ---- FIRST PASS (over the admitted subset when gated) ----
+        essence1 = stats.masked_smooth_median(values, base_mask, cfg.smooth_mode)
 
         # Per-shard risks; scalar mean via psum (no second gather needed
         # for the reliability estimate).
         qr_local = stats.quadratic_risk(values_local, essence1)
-        mean_qr = jax.lax.psum(jnp.sum(qr_local), axis) / n
+        if gate is not None:
+            mean_qr = jax.lax.psum(
+                jnp.sum(qr_local * okf_local), axis
+            ) / jnp.maximum(n_ok, 1.0)
+        else:
+            mean_qr = jax.lax.psum(jnp.sum(qr_local), axis) / n
         if cfg.constrained:
             rel1 = 1.0 - 2.0 * jnp.sqrt(mean_qr / dim)
         else:
@@ -83,7 +119,12 @@ def _consensus_body(cfg: ConsensusConfig, axis: str):
 
         # Global rank mask needs all N risks: gather N scalars.
         qr = jax.lax.all_gather(qr_local, axis, tiled=True)
-        reliable = sort_ops.reliability_mask(qr, cfg.n_failing)
+        if gate is not None:
+            reliable = sort_ops.gated_reliability_mask(
+                qr, base_mask, n_ok.astype(jnp.int32), cfg.n_failing
+            )
+        else:
+            reliable = sort_ops.reliability_mask(qr, cfg.n_failing)
 
         # ---- SECOND PASS ----
         if cfg.constrained:
@@ -124,8 +165,22 @@ def _consensus_body(cfg: ConsensusConfig, axis: str):
         kurt = (t1 - t2) / jnp.maximum((n_rel - 2.0) * (n_rel - 3.0), 1.0)
 
         valid = jnp.logical_and(stats.interval_ok(rel1), stats.interval_ok(rel2))
+        # Degenerate-block guard, MIRRORING kernel.consensus_step: a
+        # "consensus" of fewer than two reliable oracles is no
+        # consensus (n_failing >= N-1 must surface invalid, never a
+        # confident one-oracle essence).  n is static, so the ungated
+        # case folds to a constant.
+        if n - cfg.n_failing < 2:
+            valid = jnp.logical_and(valid, False)
+        if gate is not None:
+            # No consensus from fewer than two admitted — or two
+            # reliable — oracles (kernel.consensus_step_gated parity).
+            valid = jnp.logical_and(valid, n_ok >= 2.0)
+            valid = jnp.logical_and(valid, n_rel >= 2.0)
+            essence1 = jnp.where(jnp.isfinite(essence1), essence1, 0.0)
+            essence2 = jnp.where(jnp.isfinite(essence2), essence2, 0.0)
 
-        return ConsensusOutput(
+        out = ConsensusOutput(
             essence=essence2,
             essence_first_pass=essence1,
             reliability_first_pass=rel1,
@@ -136,6 +191,9 @@ def _consensus_body(cfg: ConsensusConfig, axis: str):
             kurtosis=kurt,
             interval_valid=valid,
         )
+        if gate is not None:
+            return out, ok_local
+        return out
 
     return body
 
@@ -210,27 +268,45 @@ def fleet_consensus_shard_map(
     n_oracles: int,
     subset_size: int = 10,
     axis: str = "oracle",
+    gate=None,
 ):
     """UNJITTED shard_mapped ``(key, window) → (ConsensusOutput,
     honest)`` — the composable fleet+consensus building block
     (:func:`sharded_fleet_step_fn` jits it standalone;
     :mod:`svoc_tpu.parallel.serving` fuses it after the data-parallel
-    forward)."""
+    forward).
+
+    ``gate=(lo, hi)`` wires the in-graph input-integrity quarantine
+    into the consensus body (the serving fleet evaluation's defense
+    against a poisoned window / corrupt forward — docs/ROBUSTNESS.md);
+    the step then returns ``(ConsensusOutput, honest, admitted)`` with
+    ``admitted [N]`` sharded over ``axis``.
+    """
     n_dev = mesh.devices.size
     if n_oracles % n_dev:
         raise ValueError(f"n_oracles={n_oracles} not divisible by mesh size {n_dev}")
     gen = _fleet_body(n_oracles, cfg.n_failing, subset_size, axis)
-    consensus = _consensus_body(cfg, axis)
+    consensus = _consensus_body(cfg, axis, gate)
 
-    def step(key, window):
-        values_local, honest_local = gen(key, window)
-        return consensus(values_local), honest_local
+    if gate is not None:
+        def step(key, window):
+            values_local, honest_local = gen(key, window)
+            out, ok_local = consensus(values_local)
+            return out, honest_local, ok_local
+
+        out_specs = (consensus_out_specs(axis), P(axis), P(axis))
+    else:
+        def step(key, window):
+            values_local, honest_local = gen(key, window)
+            return consensus(values_local), honest_local
+
+        out_specs = (consensus_out_specs(axis), P(axis))
 
     return shard_map(
         step,
         mesh=mesh,
         in_specs=(P(), P()),
-        out_specs=(consensus_out_specs(axis), P(axis)),
+        out_specs=out_specs,
         check_rep=False,
     )
 
@@ -241,14 +317,16 @@ def sharded_fleet_step_fn(
     n_oracles: int,
     subset_size: int = 10,
     axis: str = "oracle",
+    gate=None,
 ):
     """Jitted end-to-end simulation step: sentiment window → sharded
     bootstrap fleet → sharded consensus.
 
     ``(key, window [W, M]) → (ConsensusOutput, honest_mask [N])`` with
     the fleet materialized only as device-local shards — the 1024-oracle
-    pod-sim configuration of BASELINE.json.
+    pod-sim configuration of BASELINE.json.  ``gate`` as in
+    :func:`fleet_consensus_shard_map` (adds the ``admitted`` output).
     """
     return jax.jit(
-        fleet_consensus_shard_map(mesh, cfg, n_oracles, subset_size, axis)
+        fleet_consensus_shard_map(mesh, cfg, n_oracles, subset_size, axis, gate)
     )

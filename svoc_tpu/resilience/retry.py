@@ -140,12 +140,24 @@ class CommitOutcome:
         return not self.stranded and self.sent == self.total
 
 
+def _landed(e: ChainCommitError, start: int) -> int:
+    """Txs the failing attempt actually landed: ``sent_count`` when the
+    raiser supplied it (it diverges from the index delta whenever
+    quarantine skips sit inside the attempted range), else the
+    attempt-relative index delta — never ``committed`` itself, which on
+    a resumed attempt counts the already-landed prefix (pre-PR-4
+    pickles and third-party raisers may lack the attribute)."""
+    sent_count = getattr(e, "sent_count", None)
+    return sent_count if sent_count is not None else e.committed - start
+
+
 def commit_fleet_with_resume(
     adapter: ChainAdapter,
     predictions: Sequence,
     policy: RetryPolicy = RetryPolicy(),
     *,
     breaker: Optional[CircuitBreaker] = None,
+    skip: Sequence[int] = (),
     sleep: Callable[[float], None] = time.sleep,
     clock: Callable[[], float] = time.monotonic,
     on_oracle_failure: Optional[Callable[[Any, ChainCommitError], None]] = None,
@@ -177,6 +189,13 @@ def commit_fleet_with_resume(
     it uses for plain commits (``Session._commit_lock``) — this
     function adds retries *inside* that atomicity, it does not replace
     it.
+
+    ``skip`` (absolute fleet indices) forwards the quarantine gate's
+    refusals to the commit loop (docs/ROBUSTNESS.md): skipped slots
+    never produce a tx and are excluded from ``sent``/``total`` — a
+    cycle whose only anomalies were quarantined vectors still reports
+    ``complete=True`` (the gate's health accounting, not the commit
+    outcome, carries the refusal).
     """
     reg = registry or _default_registry
     deadline = (
@@ -185,6 +204,7 @@ def commit_fleet_with_resume(
         else None
     )
     delays = policy.delays()
+    skip_set = frozenset(int(i) for i in skip)
     start = 0
     sent = 0
     attempts = 0
@@ -198,21 +218,27 @@ def commit_fleet_with_resume(
         attempts += 1
         t0 = clock()
         try:
-            n = adapter.update_all_the_predictions(predictions, start=start)
+            n = adapter.update_all_the_predictions(
+                predictions, start=start, skip=skip
+            )
         except ChainCommitError as e:
             if breaker is not None:
                 # Progress credit: an attempt that LANDED txs before
                 # failing proves the backend alive — record success, or
                 # a handful of flaky SIGNERS would trip the BACKEND
                 # breaker and turn a degraded fleet into a total commit
-                # outage.  Only zero-progress failures count.
-                if e.committed > start:
+                # outage.  Only zero-progress failures count — judged
+                # by LANDED txs (``sent_count``), not the index delta:
+                # a quarantine-skipped slot between ``start`` and the
+                # failure advances the index without proving anything
+                # about the backend.
+                if _landed(e, start) > 0:
                     breaker.record_success()
                 else:
                     breaker.record_failure()
             if on_oracle_failure is not None:
                 on_oracle_failure(e.failed_oracle, e)
-            sent += e.committed - start  # txs that landed this attempt
+            sent += _landed(e, start)
             j = e.committed  # absolute index of the failed oracle
             consecutive[j] = consecutive.get(j, 0) + 1
             if consecutive[j] >= policy.max_attempts:
@@ -229,7 +255,11 @@ def commit_fleet_with_resume(
                         breaker.record_success()
                     return CommitOutcome(
                         sent=sent,
-                        total=e.total,
+                        # Eligible slots only: quarantine skips are
+                        # excluded from ``total`` exactly as from
+                        # ``sent`` (docstring) — stranded slots stay
+                        # counted, they are what marks incompleteness.
+                        total=e.total - len(skip_set),
                         stranded=tuple(stranded),
                         attempts=attempts,
                     )
@@ -268,9 +298,16 @@ def commit_fleet_with_resume(
             if breaker is not None:
                 breaker.record_success()
             sent += n
+            # The final attempt covered [start, fleet_total) and sent
+            # ``n`` txs, passing over the skipped slots ≥ start — so
+            # fleet_total = start + n + |skip ≥ start|, and the
+            # eligible total excludes EVERY skipped slot (a resume past
+            # a skipped slot must not report the cycle incomplete: the
+            # refusal is the gate's accounting, not the commit's).
+            fleet_total = start + n + sum(1 for i in skip_set if i >= start)
             return CommitOutcome(
                 sent=sent,
-                total=start + n,
+                total=fleet_total - len(skip_set),
                 stranded=tuple(stranded),
                 attempts=attempts,
             )

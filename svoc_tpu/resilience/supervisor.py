@@ -69,6 +69,13 @@ class SupervisorConfig:
     unreliable_weight: float = 0.6
     #: consecutive below-threshold steps before quarantine.
     quarantine_after: int = 2
+    #: health-failure equivalents per input-integrity quarantine event
+    #: (docs/ROBUSTNESS.md): a vector the gate refuses counts like an
+    #: EXHAUSTED commit cycle — the retry layer records
+    #: ``RetryPolicy.max_attempts`` (default 4) failures for a
+    #: persistent offender, and a garbage emitter must be voted out on
+    #: the same clock as a dead signer, not 4× slower.
+    quarantine_penalty: int = 4
     #: drive the replacement vote (False = observe/alert only).
     auto_replace: bool = True
     #: lifetime replacement budget (runaway-vote backstop).
@@ -83,6 +90,8 @@ class SupervisorConfig:
             raise ValueError("decay must be in (0, 1)")
         if self.quarantine_after < 1:
             raise ValueError("quarantine_after must be >= 1")
+        if self.quarantine_penalty < 1:
+            raise ValueError("quarantine_penalty must be >= 1")
 
 
 def _default_address_factory(existing: Set[Any]) -> int:
@@ -155,6 +164,25 @@ class FleetHealthSupervisor:
             self._pending_failures[oracle_address] = (
                 self._pending_failures.get(oracle_address, 0) + 1
             )
+
+    def record_quarantine(self, oracle_address: Any, reason: str) -> None:
+        """One input-integrity quarantine for this oracle (the gate in
+        :mod:`svoc_tpu.robustness.sanitize` calls this when it refuses
+        a vector).  Feeds the SAME pending-failure channel as
+        :meth:`record_commit_failure` — a quarantined vector counts
+        against the oracle exactly like commit failures, scaled by
+        ``quarantine_penalty`` so one refused vector per cycle matches
+        the signal strength of an exhausted commit budget.  Counted
+        into ``oracle_quarantine{reason=}`` (the gate counts its own
+        series too; this one is scoped to SUPERVISED refusals)."""
+        with self._lock:
+            self._pending_failures[oracle_address] = (
+                self._pending_failures.get(oracle_address, 0)
+                + self.config.quarantine_penalty
+            )
+        self._registry.counter(
+            "oracle_quarantine_supervised", labels={"reason": reason}
+        ).add(1)
 
     # -- the supervision step ----------------------------------------------
 

@@ -1,0 +1,37 @@
+"""Byzantine-oracle hardening: attacks, breakdown certification, and
+the input-integrity quarantine gate (docs/ROBUSTNESS.md).
+
+PR 3's resilience layer hardened the I/O plane (faults, retries,
+breakers, supervision); this package is its data-plane twin:
+
+- :mod:`svoc_tpu.robustness.attacks` — parametric, seeded,
+  jit/vmap-compatible Byzantine oracle strategies layered onto the
+  simulator's fleets;
+- :mod:`svoc_tpu.robustness.certify` — the empirical breakdown-point
+  sweep (one batched pass over the attack × ε × magnitude grid) behind
+  ``make robustness-cert`` / ``ROBUSTNESS_CERT.json``;
+- :mod:`svoc_tpu.robustness.sanitize` — the quarantine gate ahead of
+  the consensus kernel and the chain commit path: NaN/Inf detection,
+  wsad-range / felt-boundary checks, per-oracle quarantine masks that
+  feed :class:`~svoc_tpu.resilience.supervisor.FleetHealthSupervisor`
+  health exactly like commit failures.
+"""
+
+from svoc_tpu.robustness.attacks import (  # noqa: F401
+    ATTACK_NAMES,
+    apply_attack,
+)
+from svoc_tpu.robustness.certify import (  # noqa: F401
+    BreakdownCell,
+    breakdown_sweep,
+    certificate,
+)
+from svoc_tpu.robustness.sanitize import (  # noqa: F401
+    QUARANTINE_REASONS,
+    QuarantinedInputError,
+    QuarantineGate,
+    QuarantineReport,
+    SanitizeConfig,
+    quarantine_reasons_jax,
+    quarantine_mask_jax,
+)

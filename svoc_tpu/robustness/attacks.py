@@ -1,0 +1,161 @@
+"""Parametric, seeded, jit/vmap-compatible Byzantine oracle strategies.
+
+The reference's "failing" oracle is benign by construction — an
+independent ``uniform(0,1)^M`` draw (``client/oracle_scheduler.py:
+73-92``) is symmetric about the honest mass and cannot displace a
+median.  These strategies model the adversaries that CAN: ``k``
+colluders (a traced count, so the colluder-fraction ε axis vmaps) who
+see the honest values and coordinate.  Every strategy is a pure
+fixed-shape function of ``(key, values, colluder_mask, magnitude,
+round_frac)``, dispatched by a traced attack id through
+``lax.switch`` — the whole (attack × ε × magnitude) certification grid
+of :mod:`svoc_tpu.robustness.certify` therefore evaluates as ONE
+batched XLA computation, the vmapped-grid idiom of large-scale TPU
+batched linear algebra (arXiv:2112.09017, PAPERS.md).
+
+Threat model (docs/ROBUSTNESS.md): adversaries are omniscient about
+the current round's honest values (worst case — they can compute the
+honest center exactly) but must emit values the input-integrity gate
+admits (finite, in-domain): a NaN bomb is handled by
+:mod:`svoc_tpu.robustness.sanitize`, not by the estimator, so the
+certified surface is attacks that are *undetectable by syntax*.
+
+The taxonomy:
+
+- ``cluster`` — the whole coalition plants one tight cluster at
+  ``center + magnitude·direction`` (maximum pull per colluder; also
+  maximally visible to the risk ranking);
+- ``shift`` — each colluder keeps its honest-looking draw but adds the
+  same coordinated offset toward the target essence (preserves the
+  coalition's dispersion — harder to out-rank);
+- ``sign_flip`` — colluders mirror their values about the honest
+  center (the classic gradient-inversion analogue);
+- ``straddle`` — colluders sit AT the reliability-mask boundary: the
+  radius of the ``(N - n_failing)``-th ranked honest oracle, half a
+  band inside, half outside — engineered to flip which oracles the
+  mask drops while staying inside the honest hull's edge;
+- ``drift`` — the shift attack scaled by ``round_frac`` ∈ [0,1]: a
+  slow coordinated slide across rounds, the attack the rel₂ TREND
+  alarm (``ChainAdapter.rel2_trend``) exists to surface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from svoc_tpu.ops import stats
+
+ATTACK_NAMES: Tuple[str, ...] = (
+    "cluster",
+    "shift",
+    "sign_flip",
+    "straddle",
+    "drift",
+)
+
+
+def _direction(center: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    """Unit vector from the honest center toward the target essence."""
+    d = target - center
+    return d / jnp.maximum(jnp.linalg.norm(d), 1e-12)
+
+
+def apply_attack(
+    key,
+    values: jnp.ndarray,
+    colluder_mask: jnp.ndarray,
+    attack_id,
+    magnitude,
+    n_failing: int,
+    *,
+    target: Optional[jnp.ndarray] = None,
+    round_frac=1.0,
+    smooth_mode: str = "cairo",
+    clip: Optional[Tuple[float, float]] = (0.0, 1.0),
+) -> jnp.ndarray:
+    """Overwrite the masked slots of an honest fleet with colluder values.
+
+    Args:
+      key: PRNG key (intra-coalition jitter — a bit-identical cluster
+        would be trivially fingerprintable, and exact value ties would
+        leave the outcome to sort tie-order rather than statistics).
+      values: ``[N, M]`` honest fleet block (e.g. from
+        :mod:`svoc_tpu.sim.generators` with ``n_failing=0``).
+      colluder_mask: ``[N]`` bool — True slots are coalition members.
+        May encode a TRACED colluder count (``rank < k``), so ε sweeps
+        vmap without recompiling.
+      attack_id: traced int index into :data:`ATTACK_NAMES`.
+      magnitude: attack strength in real units (``cluster``/``shift``/
+        ``drift``: offset length along the target direction;
+        ``straddle``: relative width of the boundary band).
+      n_failing: the defense's static mask budget (the ``straddle``
+        geometry needs the cut rank).
+      target: ``[M]`` target essence (default: the all-ones corner —
+        the constrained domain's extreme point).
+      round_frac: ``drift`` progress through its schedule, 0 → 1.
+      clip: admission bounds — colluders must emit values the
+        quarantine gate admits, so attacks clip into the value domain
+        (None for unconstrained fleets).
+
+    Returns the attacked ``[N, M]`` block.
+    """
+    n, m = values.shape
+    if target is None:
+        target = jnp.ones((m,), values.dtype)
+    honest_mask = jnp.logical_not(colluder_mask)
+    # Omniscient adversary: the exact component-wise center of the
+    # honest (non-coalition) mass, via the same smooth median the
+    # defense uses.
+    center = stats.masked_smooth_median(values, honest_mask, smooth_mode)
+    direction = _direction(center, jnp.asarray(target, values.dtype))
+    # Tiny seeded jitter shared by the strategies (see ``key`` above).
+    noise = 1e-3 * jax.random.uniform(key, (n, m), values.dtype, -1.0, 1.0)
+    # Colluder rank within the coalition (0, 1, ... for masked slots) —
+    # drives the straddle's inside/outside alternation.
+    rank = jnp.cumsum(colluder_mask.astype(jnp.int32)) - 1
+
+    def cluster(_):
+        point = center[None, :] + magnitude * direction[None, :]
+        return point + noise
+
+    def shift(_):
+        return values + magnitude * direction[None, :] + noise
+
+    def sign_flip(_):
+        return 2.0 * center[None, :] - values + noise
+
+    def straddle(_):
+        # The mask keeps the (N - n_failing) lowest-risk oracles; the
+        # boundary radius is the honest risk at that cut (computed over
+        # the honest slots only, colluders pushed out of the ranking).
+        # The cut is clamped INTO the honest subset: with k colluders
+        # only n-k finite entries exist, and for k > n_failing the
+        # all-slots rank would index the +inf tail — the isfinite
+        # fallback would then park the whole coalition at the center
+        # (a no-op attack) and the certificate rows above the design
+        # budget would be vacuous.
+        qr = stats.quadratic_risk(values, center)
+        qr_ranked = jnp.where(honest_mask, qr, jnp.inf)
+        n_honest = jnp.sum(honest_mask.astype(jnp.int32))
+        cut = jnp.clip(n - n_failing - 1, 0, jnp.maximum(n_honest - 1, 0))
+        r_cut = jnp.sqrt(jnp.sort(qr_ranked)[cut])
+        r_cut = jnp.where(jnp.isfinite(r_cut), r_cut, 0.0)
+        # Alternate just inside / just outside the boundary band.
+        side = jnp.where(rank % 2 == 0, -1.0, 1.0)
+        radius = r_cut * (1.0 + side * magnitude)
+        return center[None, :] + radius[:, None] * direction[None, :] + noise
+
+    def drift(_):
+        return values + round_frac * magnitude * direction[None, :] + noise
+
+    colluder_vals = jax.lax.switch(
+        jnp.asarray(attack_id, jnp.int32),
+        [cluster, shift, sign_flip, straddle, drift],
+        operand=None,
+    )
+    if clip is not None:
+        colluder_vals = jnp.clip(colluder_vals, clip[0], clip[1])
+    return jnp.where(colluder_mask[:, None], colluder_vals, values)

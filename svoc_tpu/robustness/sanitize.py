@@ -1,0 +1,246 @@
+"""Input-integrity quarantine gate ahead of the consensus kernel.
+
+The on-chain contract refuses malformed predictions transactionally:
+``nd_interval_check`` panics the offending tx (``contract.cairo:
+589-593``) and the felt codec cannot even represent a NaN.  The TPU
+fast path has neither protection — ``consensus_step`` happily folds a
+NaN through every reduction, and a single non-finite component poisons
+the block's medians, risks and moments.  This gate restores the
+contract's refusal semantics at the float boundary:
+
+- **detection** (:func:`quarantine_reasons_jax` /
+  :class:`QuarantineGate`): per-oracle masks for non-finite components
+  (NaN/Inf), values outside the consensus value domain (``[lo, hi]``
+  real units — the contract's interval check for the constrained
+  model), and values that cannot survive the wsad/felt codec
+  (``|x| * 1e6`` beyond the i128 window — the felt-prime boundary the
+  seed's decoder silently wrapped);
+- **refusal**: quarantined vectors never reach the kernel
+  (:func:`svoc_tpu.consensus.kernel.consensus_step_gated`) nor the
+  chain (``Session.commit_resilient`` skips the tx), and each event
+  counts against the oracle's health exactly like a commit failure
+  (:meth:`FleetHealthSupervisor.record_quarantine`) — a persistent
+  garbage emitter is voted out through the same replacement flow as a
+  dead signer;
+- **observability**: ``oracle_quarantine{reason=}`` counters plus the
+  per-slot report in ``Session.resilience_snapshot()`` → ``/api/state``
+  and the ``resilience`` console command (docs/OBSERVABILITY.md).
+
+Reason precedence is fixed (nan > inf > range > codec) so a vector
+failing several checks reports one stable reason — metrics series and
+replay fingerprints must not depend on float comparison quirks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from svoc_tpu.ops.fixedpoint import I128_MAX
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_registry
+
+#: Largest real-unit magnitude the wsad/felt codec can represent
+#: (``I128_MAX / 1e6``) — beyond it ``to_wsad`` leaves the i128 window
+#: and the encode boundary would manufacture an unsignable felt.
+WSAD_LIMIT: float = float(I128_MAX) * 1e-6
+
+#: Quarantine reasons, in precedence order (first match wins).
+QUARANTINE_REASONS: Tuple[str, ...] = ("nan", "inf", "range", "codec")
+
+
+@dataclasses.dataclass(frozen=True)
+class SanitizeConfig:
+    """Value-domain bounds for the gate.
+
+    ``lo``/``hi`` bound the consensus value domain in real units; the
+    codec bound is always enforced on top (it is what the chain itself
+    would refuse).  ``None`` disables the corresponding domain check —
+    the unconstrained model has no [0,1] interval, only the codec
+    window and a practical spread.
+    """
+
+    lo: Optional[float] = 0.0
+    hi: Optional[float] = 1.0
+
+    def __post_init__(self):
+        if self.lo is not None and self.hi is not None and self.lo > self.hi:
+            raise ValueError(f"need lo <= hi, got [{self.lo}, {self.hi}]")
+
+    @classmethod
+    def for_consensus(cls, constrained: bool):
+        """The gate matching a consensus configuration: the contract's
+        [0,1] interval for the constrained model; codec-window-only for
+        the unconstrained one (``max_spread`` bounds the *estimator*,
+        not the value domain — ``contract.cairo:365-368`` — so it plays
+        no part in admission)."""
+        if constrained:
+            return cls(lo=0.0, hi=1.0)
+        return cls(lo=None, hi=None)
+
+
+class QuarantineMasks(NamedTuple):
+    """Per-oracle [N] bool masks, one per reason (jit-friendly form)."""
+
+    nan: Any
+    inf: Any
+    range: Any
+    codec: Any
+
+    @property
+    def quarantined(self):
+        import jax.numpy as jnp
+
+        return jnp.logical_or(
+            jnp.logical_or(self.nan, self.inf),
+            jnp.logical_or(self.range, self.codec),
+        )
+
+
+def quarantine_reasons_jax(values, lo: Optional[float], hi: Optional[float]):
+    """Per-oracle reason masks for ``values [N, M]`` (traceable).
+
+    Comparisons are written so a NaN component can only ever trip the
+    ``nan`` mask: ``x < lo`` and ``x > hi`` are False for NaN, and the
+    codec check runs on a NaN-neutralized copy.
+    """
+    import jax.numpy as jnp
+
+    nan = jnp.any(jnp.isnan(values), axis=-1)
+    inf = jnp.any(jnp.isinf(values), axis=-1)
+    finite = jnp.where(jnp.isfinite(values), values, 0.0)
+    out_of_range = jnp.zeros(values.shape[0], dtype=bool)
+    if lo is not None:
+        out_of_range = jnp.logical_or(
+            out_of_range, jnp.any(values < lo, axis=-1)
+        )
+    if hi is not None:
+        out_of_range = jnp.logical_or(
+            out_of_range, jnp.any(values > hi, axis=-1)
+        )
+    codec = jnp.any(jnp.abs(finite) > WSAD_LIMIT, axis=-1)
+    # Precedence: a non-finite vector is "nan"/"inf", never "range".
+    out_of_range = jnp.logical_and(
+        out_of_range, jnp.logical_not(jnp.logical_or(nan, inf))
+    )
+    codec = jnp.logical_and(
+        codec,
+        jnp.logical_not(
+            jnp.logical_or(jnp.logical_or(nan, inf), out_of_range)
+        ),
+    )
+    return QuarantineMasks(nan=nan, inf=inf, range=out_of_range, codec=codec)
+
+
+def quarantine_mask_jax(values, lo: Optional[float], hi: Optional[float]):
+    """Admission mask ``ok [N]`` (True = clean) — the mask
+    :func:`svoc_tpu.consensus.kernel.consensus_step_gated` consumes."""
+    import jax.numpy as jnp
+
+    masks = quarantine_reasons_jax(values, lo, hi)
+    return jnp.logical_not(masks.quarantined)
+
+
+@dataclasses.dataclass
+class QuarantineReport:
+    """One gate pass over a fleet block (host side).
+
+    ``reasons[slot]`` is the precedence-first reason for each
+    quarantined fleet slot; ``ok`` the admission mask.
+    """
+
+    ok: np.ndarray  # [N] bool, True = admitted
+    reasons: Dict[int, str]
+
+    @property
+    def quarantined_slots(self) -> List[int]:
+        return sorted(self.reasons)
+
+    @property
+    def clean(self) -> bool:
+        return not self.reasons
+
+    def as_dict(self) -> Dict[str, Any]:
+        """JSON-friendly form for ``/api/state`` and soak artifacts."""
+        return {
+            "quarantined": [
+                {"slot": slot, "reason": self.reasons[slot]}
+                for slot in self.quarantined_slots
+            ],
+            "admitted": int(np.sum(self.ok)),
+            "total": int(self.ok.shape[0]),
+        }
+
+
+class QuarantinedInputError(RuntimeError):
+    """A commit was refused because the gate quarantined fleet slots.
+
+    Raised by the FAITHFUL commit path (``Session.commit``), which has
+    no degraded mode: the reference's per-tx loop would stop at the
+    first panicking tx anyway, so refusing BEFORE any tx is strictly
+    more informative (no partial commit to account for).  The
+    resilient path never raises this — it skips the refused slots and
+    lets the supervisor own the consequence.
+    """
+
+    def __init__(self, report: "QuarantineReport"):
+        self.report = report
+        detail = ", ".join(
+            f"slot {s}: {report.reasons[s]}" for s in report.quarantined_slots
+        )
+        super().__init__(f"quarantined fleet slots refuse commit ({detail})")
+
+
+class QuarantineGate:
+    """Host-side gate: inspect → report → count (docs/ROBUSTNESS.md).
+
+    Pure numpy (the blocks it sees on the commit path are tiny —
+    ``[N, M]`` with N a fleet, not a batch); the device-side twin for
+    in-graph gating is :func:`quarantine_reasons_jax`.
+    """
+
+    def __init__(
+        self,
+        config: Optional[SanitizeConfig] = None,
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        self.config = config or SanitizeConfig()
+        self._registry = registry or _default_registry
+
+    def inspect(self, values: Sequence, *, count: bool = True) -> QuarantineReport:
+        """Classify every fleet slot; ``count=True`` (the once-per-fetch
+        call) feeds ``oracle_quarantine{reason=}`` — re-inspections of
+        the same block (the commit path's recheck of its snapshot) pass
+        ``count=False`` so the series stays one-event-one-count."""
+        arr = np.asarray(values, dtype=np.float64)
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        cfg = self.config
+        reasons: Dict[int, str] = {}
+        ok = np.ones(arr.shape[0], dtype=bool)
+        for slot in range(arr.shape[0]):
+            reason = self._classify(arr[slot], cfg)
+            if reason is not None:
+                reasons[slot] = reason
+                ok[slot] = False
+                if count:
+                    self._registry.counter(
+                        "oracle_quarantine", labels={"reason": reason}
+                    ).add(1)
+        return QuarantineReport(ok=ok, reasons=reasons)
+
+    @staticmethod
+    def _classify(vec: np.ndarray, cfg: SanitizeConfig) -> Optional[str]:
+        if np.any(np.isnan(vec)):
+            return "nan"
+        if np.any(np.isinf(vec)):
+            return "inf"
+        if cfg.lo is not None and np.any(vec < cfg.lo):
+            return "range"
+        if cfg.hi is not None and np.any(vec > cfg.hi):
+            return "range"
+        if np.any(np.abs(vec) > WSAD_LIMIT):
+            return "codec"
+        return None

@@ -24,6 +24,29 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Derandomized hypothesis profile for CI (ISSUE 4 satellite): property
+# failures must reproduce from the test id alone — a CI-only flake from
+# a rotating random seed is unactionable.  Registered here (conftest
+# imports before any test module) so module-level `settings(...)`
+# objects inherit `derandomize` from the active profile.  Opt out for
+# exploratory fuzzing with HYPOTHESIS_PROFILE=default.  Import-gated:
+# the hermetic image may lack hypothesis (test_properties.py then skips
+# collection under --continue-on-collection-errors, as seeded).
+try:
+    from hypothesis import HealthCheck, settings as _hyp_settings
+
+    _hyp_settings.register_profile(
+        "svoc-ci",
+        derandomize=True,
+        deadline=None,
+        suppress_health_check=[HealthCheck.too_slow],
+    )
+    _hyp_settings.load_profile(
+        os.environ.get("HYPOTHESIS_PROFILE", "svoc-ci")
+    )
+except ImportError:  # pragma: no cover — bare image
+    pass
+
 
 def fake_sentiment_vectorizer(texts):
     """Cheap deterministic stand-in for the sentiment pipeline —

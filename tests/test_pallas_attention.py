@@ -247,6 +247,7 @@ class TestFlashSegments:
         assert np.all(np.asarray(dv)[0, pad] == 0)
 
 
+@pytest.mark.slow  # interpret-mode Pallas accuracy study (VERDICT r5 item 6); the parity + backward tests stay tier-1
 def test_flash_is_more_accurate_than_dense_reference_in_bf16():
     """The flash-numerics adjudication's core claim, pinned on the
     interpret path (same dtype chain as Mosaic, different op order):

@@ -190,6 +190,146 @@ class TestPackingProperties:
                 )
 
 
+class TestQuarantineGateProperties:
+    """Gate invariants (ISSUE 4): honest finite in-range fleets are
+    NEVER quarantined; any NaN/Inf/out-of-range component ALWAYS is."""
+
+    @COMMON
+    @given(
+        st.integers(2, 12),
+        st.integers(1, 6),
+        st.integers(0, 2**31 - 1),
+    )
+    def test_honest_fleets_never_quarantined(self, n, m, seed):
+        from svoc_tpu.robustness.sanitize import (
+            QuarantineGate,
+            SanitizeConfig,
+        )
+        from svoc_tpu.utils.metrics import MetricsRegistry
+
+        rng = np.random.default_rng(seed)
+        block = rng.uniform(0.0, 1.0, (n, m))
+        report = QuarantineGate(
+            SanitizeConfig(0.0, 1.0), MetricsRegistry()
+        ).inspect(block)
+        assert report.clean
+        assert report.ok.all()
+
+    @COMMON
+    @given(
+        st.integers(2, 12),
+        st.integers(1, 6),
+        st.integers(0, 2**31 - 1),
+        st.data(),
+    )
+    def test_any_bad_component_always_quarantined(self, n, m, seed, data):
+        from svoc_tpu.robustness.sanitize import (
+            WSAD_LIMIT,
+            QuarantineGate,
+            SanitizeConfig,
+            quarantine_mask_jax,
+        )
+        from svoc_tpu.utils.metrics import MetricsRegistry
+
+        rng = np.random.default_rng(seed)
+        block = rng.uniform(0.0, 1.0, (n, m))
+        slot = data.draw(st.integers(0, n - 1))
+        comp = data.draw(st.integers(0, m - 1))
+        bad = data.draw(
+            st.sampled_from(
+                [
+                    float("nan"),
+                    float("inf"),
+                    float("-inf"),
+                    -0.25,
+                    1.25,
+                    WSAD_LIMIT * 2,
+                ]
+            )
+        )
+        block[slot, comp] = bad
+        report = QuarantineGate(
+            SanitizeConfig(0.0, 1.0), MetricsRegistry()
+        ).inspect(block)
+        assert slot in report.reasons
+        assert not report.ok[slot]
+        # Only the poisoned slot is refused (no collateral quarantine),
+        # and the in-graph twin agrees with the host gate exactly.
+        assert report.quarantined_slots == [slot]
+        dev_mask = np.asarray(
+            quarantine_mask_jax(jnp.asarray(block), 0.0, 1.0)
+        )
+        np.testing.assert_array_equal(report.ok, dev_mask)
+
+
+class TestSaturatingWsadProperties:
+    """Saturating-op invariants (ISSUE 4): results live in the i128
+    window, saturation NEVER wraps sign, and in-range results are
+    bit-identical to the exact ops."""
+
+    huge_ints = st.integers(min_value=-(2**140), max_value=2**140)
+
+    @COMMON
+    @given(huge_ints, huge_ints)
+    def test_add_sat_is_clamped_exact_sum(self, a, b):
+        from svoc_tpu.ops.fixedpoint import I128_MAX, I128_MIN, wsad_add_sat
+
+        got = wsad_add_sat(a, b)
+        exact = a + b
+        assert got == min(max(exact, I128_MIN), I128_MAX)
+        assert I128_MIN <= got <= I128_MAX
+        # Saturation never wraps sign: the clamped result agrees in
+        # sign with the exact value (zero is sign-neutral).
+        if exact != 0:
+            assert (got >= 0) == (exact >= 0)
+
+    @COMMON
+    @given(huge_ints, huge_ints)
+    def test_mul_sat_is_clamped_exact_product(self, a, b):
+        from svoc_tpu.ops.fixedpoint import (
+            I128_MAX,
+            I128_MIN,
+            wsad_mul,
+            wsad_mul_sat,
+        )
+
+        got = wsad_mul_sat(a, b)
+        exact = wsad_mul(a, b)
+        assert got == min(max(exact, I128_MIN), I128_MAX)
+        if exact != 0:
+            assert (got >= 0) == (exact >= 0)
+
+    @COMMON
+    @given(wsad_ints, wsad_ints)
+    def test_in_range_operands_match_exact_ops(self, a, b):
+        from svoc_tpu.ops.fixedpoint import wsad_add_sat, wsad_mul, wsad_mul_sat
+
+        assert wsad_add_sat(a, b) == a + b
+        assert wsad_mul_sat(a, b) == wsad_mul(a, b)
+
+
+class TestFeltBoundaryProperties:
+    @COMMON
+    @given(st.integers(min_value=2**127, max_value=2**200))
+    def test_dead_zone_and_oversized_felts_always_raise(self, x):
+        """Everything between the positive window and the negative
+        window — and ≥ the prime — must refuse to decode (the seed
+        silently wrapped these into fabricated values)."""
+        from svoc_tpu.ops.fixedpoint import (
+            FELT_PRIME,
+            FeltRangeError,
+            felt_to_wsad,
+        )
+
+        assume(x < FELT_PRIME - 2**127 or x >= FELT_PRIME)
+        try:
+            felt_to_wsad(x)
+            raised = False
+        except FeltRangeError:
+            raised = True
+        assert raised
+
+
 class TestConsensusProperties:
     @COMMON
     @given(

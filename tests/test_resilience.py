@@ -833,7 +833,18 @@ class TestSessionResilience:
         console = CommandConsole(session)
         out = console.query("resilience")
         assert out[0] == "breaker: closed"
-        assert out[-1] == "replacements: 0"
+        assert "replacements: 0" in out
+        # PR 4: the gate verdict line (no fetch has run yet).
+        assert out[-1] == "input quarantine: no gated fetch yet"
+
+    def test_console_resilience_quarantine_line(self):
+        from svoc_tpu.apps.commands import CommandConsole
+
+        session, _ = make_resilient_session()
+        console = CommandConsole(session)
+        session.fetch()
+        out = console.query("resilience")
+        assert out[-1].startswith("input quarantine: clean (")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,674 @@
+"""Byzantine-oracle hardening suite (docs/ROBUSTNESS.md).
+
+Covers the ISSUE-4 surface end to end: attack strategies, the batched
+breakdown sweep + certificate, the quarantine gate (host and in-graph
+twins), the gated consensus kernel/shard_map, the gated commit path
+(skip slots, health accounting, faithful refusal), felt decode
+boundaries, saturating wsad ops, and the seeded Byzantine chaos
+scenario's replay/acceptance invariants.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from svoc_tpu.consensus.kernel import (
+    ConsensusConfig,
+    consensus_step,
+    consensus_step_gated,
+    consensus_step_gated_batched,
+)
+from svoc_tpu.robustness.attacks import ATTACK_NAMES, apply_attack
+from svoc_tpu.robustness.certify import breakdown_sweep, certificate
+from svoc_tpu.robustness.sanitize import (
+    WSAD_LIMIT,
+    QuarantinedInputError,
+    QuarantineGate,
+    SanitizeConfig,
+    quarantine_mask_jax,
+    quarantine_reasons_jax,
+)
+from svoc_tpu.utils.metrics import MetricsRegistry
+
+CFG = ConsensusConfig(n_failing=2, constrained=True)
+
+
+def _fleet(seed=0, n=8, m=6, lo=0.1, hi=0.9):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.uniform(lo, hi, (n, m)), jnp.float32)
+
+
+class TestAttacks:
+    @pytest.mark.parametrize("attack_id", range(len(ATTACK_NAMES)))
+    def test_attacks_touch_only_colluder_slots(self, attack_id):
+        values = _fleet()
+        mask = jnp.asarray([True, False, True, False] + [False] * 4)
+        out = apply_attack(
+            jax.random.PRNGKey(1), values, mask, attack_id, 0.4, 2
+        )
+        changed = np.any(
+            np.asarray(out) != np.asarray(values), axis=-1
+        )
+        np.testing.assert_array_equal(changed, np.asarray(mask))
+
+    @pytest.mark.parametrize("attack_id", range(len(ATTACK_NAMES)))
+    def test_attacks_emit_gate_admissible_values(self, attack_id):
+        """Clipped attacks stay syntactically valid — the whole point
+        of the taxonomy is adversaries the gate CANNOT catch."""
+        out = apply_attack(
+            jax.random.PRNGKey(2),
+            _fleet(),
+            jnp.asarray([True] * 4 + [False] * 4),
+            attack_id,
+            5.0,  # absurd magnitude: the clip must still hold
+            2,
+        )
+        ok = quarantine_mask_jax(out, 0.0, 1.0)
+        assert bool(jnp.all(ok))
+
+    def test_cluster_attack_is_masked_at_design_fraction(self):
+        """n_failing colluders planted far off-center are exactly the
+        oracles the two-pass mask drops."""
+        values = _fleet(lo=0.4, hi=0.6)
+        mask = jnp.asarray([True, True] + [False] * 6)
+        attacked = apply_attack(
+            jax.random.PRNGKey(3), values, mask, ATTACK_NAMES.index("cluster"),
+            0.9, 2,
+        )
+        out = consensus_step(attacked, CFG)
+        reliable = np.asarray(out.reliable)
+        assert not reliable[0] and not reliable[1]
+        assert reliable[2:].all()
+
+    def test_straddle_attacks_above_the_design_budget(self):
+        """k > n_failing colluders: the straddle cut must clamp into
+        the honest subset — the all-slots rank would hit the +inf tail
+        and the isfinite fallback would park the whole coalition at
+        the honest center (a no-op attack masquerading as tolerated)."""
+        values = _fleet(lo=0.4, hi=0.6)
+        aid = ATTACK_NAMES.index("straddle")
+        for k in (3, 4):  # both above n_failing=2
+            mask = jnp.asarray([True] * k + [False] * (8 - k))
+            attacked = apply_attack(
+                jax.random.PRNGKey(5), values, mask, aid, 0.4, 2
+            )
+            center = np.median(np.asarray(values)[k:], axis=0)
+            dist = np.linalg.norm(
+                np.asarray(attacked)[:k] - center[None, :], axis=-1
+            )
+            # Colluders sit on a real boundary band, not at jitter
+            # distance (the 1e-3 noise) from the center.
+            assert (dist > 0.02).all(), dist
+
+    def test_drift_scales_with_round_frac(self):
+        values = _fleet()
+        mask = jnp.asarray([True] + [False] * 7)
+        aid = ATTACK_NAMES.index("drift")
+        key = jax.random.PRNGKey(4)
+        early = apply_attack(
+            key, values, mask, aid, 0.6, 2, round_frac=0.1, clip=None
+        )
+        late = apply_attack(
+            key, values, mask, aid, 0.6, 2, round_frac=1.0, clip=None
+        )
+        d_early = float(jnp.linalg.norm(early[0] - values[0]))
+        d_late = float(jnp.linalg.norm(late[0] - values[0]))
+        assert d_late > d_early * 5
+
+
+class TestCertify:
+    @pytest.fixture(scope="class")
+    def sweep(self):
+        return breakdown_sweep(
+            jax.random.PRNGKey(0),
+            CFG,
+            n_oracles=8,
+            colluder_counts=[0, 1, 2, 3],
+            magnitudes=[0.45],
+            n_trials=8,
+        )
+
+    def test_zero_colluders_zero_deviation(self, sweep):
+        for cell in sweep["cells"]:
+            if cell.colluders == 0:
+                assert cell.mean_deviation == 0.0
+                assert cell.mean_capture == 0.0
+
+    def test_grid_is_complete(self, sweep):
+        assert len(sweep["cells"]) == len(ATTACK_NAMES) * 4 * 1
+        assert set(sweep["benign_deviation"]) == {0, 1, 2, 3}
+
+    def test_certificate_tolerates_design_fraction(self, sweep):
+        cert = certificate(sweep)
+        assert cert["certified"]
+        for attack, entry in cert["attacks"].items():
+            assert (
+                entry["tolerated_fraction"] >= cert["design_fraction"]
+            ), attack
+
+    def test_certificate_is_prefix_monotone(self):
+        """A passing count ABOVE a failing one must not extend the
+        certificate."""
+        sweep = breakdown_sweep(
+            jax.random.PRNGKey(1),
+            CFG,
+            n_oracles=8,
+            colluder_counts=[0, 1, 2],
+            magnitudes=[0.45],
+            attacks=("cluster",),
+            n_trials=4,
+        )
+        # Forge a gap: count 1 fails, count 2 passes.
+        for cell in sweep["cells"]:
+            if cell.colluders == 1:
+                object.__setattr__(cell, "mean_deviation", 99.0)
+        cert = certificate(sweep)
+        assert cert["attacks"]["cluster"]["tolerated_colluders"] == 0
+
+    def test_attack_subset_uses_global_taxonomy_ids(self):
+        """A sweep over a non-prefix attack SUBSET must evaluate that
+        attack, not whatever sits at the subset position in the global
+        ``lax.switch`` table (straddle at subset position 0 must not
+        silently run cluster)."""
+        kw = dict(
+            n_oracles=8,
+            colluder_counts=[2],
+            magnitudes=[0.45],
+            n_trials=8,
+        )
+        full = breakdown_sweep(jax.random.PRNGKey(0), CFG, **kw)
+        sub = breakdown_sweep(
+            jax.random.PRNGKey(0), CFG, attacks=("straddle",), **kw
+        )
+        ref = {
+            (c.attack, c.colluders, c.magnitude): c.mean_deviation
+            for c in full["cells"]
+        }
+        (cell,) = sub["cells"]
+        assert cell.attack == "straddle"
+        # Attack keys fold in the CELL index, so the 1e-3 intra-
+        # coalition jitter differs between the two grids — agreement
+        # is to jitter tolerance, which still cleanly separates
+        # straddle (~0.02 here) from cluster (~10x that).
+        assert cell.mean_deviation == pytest.approx(
+            ref[("straddle", 2, 0.45)], rel=0.02
+        )
+
+    def test_drift_cells_cover_the_schedule_not_the_endpoint(self, sweep):
+        """Drift trials run at round_frac=(i+1)/T — a drift cell's mean
+        deviation must sit strictly BELOW its shift twin's (which hits
+        full magnitude every trial), or the schedule isn't being
+        exercised and drift degenerates into a shift duplicate."""
+        by = {
+            (c.attack, c.colluders): c.mean_deviation
+            for c in sweep["cells"]
+            if c.magnitude == 0.45
+        }
+        for k in (2, 3):
+            assert by[("drift", k)] < by[("shift", k)] * 0.999
+
+
+class TestQuarantineGate:
+    def test_reasons_and_precedence(self):
+        gate = QuarantineGate(SanitizeConfig(0.0, 1.0), MetricsRegistry())
+        block = np.full((5, 3), 0.5)
+        block[1, 0] = np.nan
+        block[2, 1] = np.inf
+        block[3, 2] = 1.5
+        report = gate.inspect(block)
+        assert report.reasons == {1: "nan", 2: "inf", 3: "range"}
+        np.testing.assert_array_equal(
+            report.ok, [True, False, False, False, True]
+        )
+        # NaN wins over a simultaneous range violation.
+        both = np.full((1, 3), 2.0)
+        both[0, 1] = np.nan
+        assert gate.inspect(both).reasons == {0: "nan"}
+
+    def test_codec_reason_unconstrained(self):
+        gate = QuarantineGate(SanitizeConfig(None, None), MetricsRegistry())
+        block = np.full((2, 3), 1e20)
+        block[1, 0] = WSAD_LIMIT * 2
+        report = gate.inspect(block)
+        assert report.reasons == {1: "codec"}
+
+    def test_jax_twin_matches_host_gate(self):
+        rng = np.random.default_rng(5)
+        block = rng.uniform(-0.5, 1.5, (16, 6))
+        block[3, 0] = np.nan
+        block[7, 5] = -np.inf
+        gate = QuarantineGate(SanitizeConfig(0.0, 1.0), MetricsRegistry())
+        host = gate.inspect(block)
+        dev = np.asarray(quarantine_mask_jax(jnp.asarray(block), 0.0, 1.0))
+        np.testing.assert_array_equal(host.ok, dev)
+
+    def test_jax_reason_masks_are_disjoint(self):
+        block = np.full((4, 2), 0.5)
+        block[0, 0] = np.nan
+        block[1, 0] = np.inf
+        block[2, 0] = -3.0
+        masks = quarantine_reasons_jax(jnp.asarray(block), 0.0, 1.0)
+        stacked = np.stack(
+            [np.asarray(m) for m in masks]
+        )
+        assert (stacked.sum(axis=0) <= 1).all()
+
+    def test_metrics_counted_once(self):
+        reg = MetricsRegistry()
+        gate = QuarantineGate(SanitizeConfig(0.0, 1.0), reg)
+        block = np.full((2, 2), 0.5)
+        block[0, 0] = np.nan
+        gate.inspect(block)
+        gate.inspect(block, count=False)
+        assert reg.family_total("oracle_quarantine") == 1
+
+
+class TestGatedKernel:
+    def test_all_ones_mask_equals_plain_step(self):
+        values = _fleet()
+        plain = consensus_step(values, CFG)
+        gated = consensus_step_gated(values, jnp.ones(8, bool), CFG)
+        for name in plain._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(plain, name)),
+                np.asarray(getattr(gated, name)),
+                atol=1e-6,
+                err_msg=name,
+            )
+
+    def test_nan_vector_never_poisons_and_never_reliable(self):
+        values = _fleet().at[3].set(jnp.nan)
+        ok = quarantine_mask_jax(values, 0.0, 1.0)
+        out = consensus_step_gated(values, ok, CFG)
+        assert not bool(out.reliable[3])
+        for leaf in (out.essence, out.skewness, out.kurtosis,
+                     out.reliability_first_pass, out.reliability_second_pass):
+            assert np.all(np.isfinite(np.asarray(leaf)))
+
+    def test_mask_budget_drops_worst_of_admitted(self):
+        """Quarantine must not absorb the n_failing budget: with one
+        quarantined and two Byzantine-but-admitted outliers, the
+        outliers still get dropped."""
+        values = _fleet(lo=0.45, hi=0.55)
+        values = values.at[0].set(0.95).at[1].set(0.95)
+        values = values.at[2].set(jnp.nan)
+        ok = quarantine_mask_jax(values, 0.0, 1.0)
+        out = consensus_step_gated(values, ok, CFG)
+        reliable = np.asarray(out.reliable)
+        assert not reliable[0] and not reliable[1] and not reliable[2]
+        assert reliable.sum() == 5  # 7 admitted - n_failing
+
+    def test_all_quarantined_block_is_invalid_not_nan(self):
+        values = jnp.full((6, 4), jnp.nan)
+        out = consensus_step_gated(values, jnp.zeros(6, bool), CFG)
+        assert not bool(out.interval_valid)
+        assert np.all(np.isfinite(np.asarray(out.essence)))
+        assert np.all(np.isfinite(np.asarray(out.skewness)))
+
+    def test_batched_form_matches_loop(self):
+        rng = np.random.default_rng(7)
+        blocks = jnp.asarray(rng.uniform(0.1, 0.9, (3, 8, 6)), jnp.float32)
+        blocks = blocks.at[1, 2].set(jnp.nan)
+        ok = jax.vmap(lambda v: quarantine_mask_jax(v, 0.0, 1.0))(blocks)
+        batched = consensus_step_gated_batched(blocks, ok, CFG)
+        for b in range(3):
+            single = consensus_step_gated(blocks[b], ok[b], CFG)
+            np.testing.assert_allclose(
+                np.asarray(batched.essence[b]),
+                np.asarray(single.essence),
+                atol=1e-6,
+            )
+
+
+class TestDegenerateKernel:
+    """Satellite: n_failing >= N-1 must yield interval_valid=False."""
+
+    @pytest.mark.parametrize("n_failing", [7, 8, 20])
+    def test_plain_step_degenerate_is_invalid(self, n_failing):
+        out = consensus_step(_fleet(), ConsensusConfig(n_failing=n_failing))
+        assert not bool(out.interval_valid)
+        for leaf in (out.skewness, out.kurtosis):
+            assert not np.any(np.isnan(np.asarray(leaf)))
+
+    def test_plain_step_minimum_viable_block_stays_valid(self):
+        out = consensus_step(_fleet(), ConsensusConfig(n_failing=6))
+        # 2 reliable oracles: still a (thin) consensus.
+        assert bool(out.interval_valid)
+
+
+class TestGatedCommitPath:
+    def _session(self, registry=None):
+        from conftest import fake_sentiment_vectorizer
+
+        from svoc_tpu.apps.session import Session, SessionConfig
+        from svoc_tpu.io.comment_store import CommentStore
+        from svoc_tpu.io.scraper import SyntheticSource
+
+        store = CommentStore()
+        store.save(SyntheticSource(batch=120)())
+        return Session(
+            config=SessionConfig(),
+            store=store,
+            vectorizer=fake_sentiment_vectorizer,
+        )
+
+    def test_clean_fetch_reports_clean_gate(self):
+        session = self._session()
+        preview = session.fetch()
+        assert preview["quarantine"]["quarantined"] == []
+        assert preview["quarantine"]["admitted"] == 7
+        snap = session.resilience_snapshot()
+        assert snap["input_quarantine"]["quarantined"] == []
+
+    def test_faithful_commit_refuses_dirty_block(self):
+        session = self._session()
+        session.fetch()
+        with session.lock:
+            session.predictions[2, 0] = np.nan
+        with pytest.raises(QuarantinedInputError) as e:
+            session.commit()
+        assert e.value.report.reasons == {2: "nan"}
+        # No tx reached the chain.
+        assert not session.adapter.call_consensus_active()
+
+    def test_resilient_commit_skips_and_charges_health(self):
+        session = self._session()
+        session.fetch()
+        with session.lock:
+            session.predictions[4, 1] = np.inf
+        outcome = session.commit_resilient()
+        assert outcome.sent == 6
+        assert outcome.complete  # skips are not failures
+        # The skipped oracle never committed: consensus (which needs
+        # every oracle) is still inactive, and the supervisor holds a
+        # pending quarantine penalty for slot 4's address.
+        assert not session.adapter.call_consensus_active()
+        addr = session.adapter.call_oracle_list()[4]
+        assert (
+            session.supervisor._pending_failures[addr]
+            == session.config.supervisor.quarantine_penalty
+        )
+
+    def test_skip_indices_excluded_from_chain_loop(self):
+        from svoc_tpu.resilience.retry import (
+            RetryPolicy,
+            commit_fleet_with_resume,
+        )
+
+        session = self._session()
+        session.fetch()
+        outcome = commit_fleet_with_resume(
+            session.adapter,
+            session.predictions,
+            RetryPolicy(max_attempts=2, base_s=0.0, cap_s=0.0, jitter_seed=0),
+            skip=(0, 3),
+            sleep=lambda s: None,
+        )
+        assert outcome.sent == 5
+        assert outcome.complete
+
+    def test_resume_past_skipped_slot_still_complete(self):
+        """A transient failure AFTER a quarantine-skipped slot: the
+        resumed cycle must land every eligible tx and report
+        complete=True — skipped slots are excluded from ``total``
+        exactly as from ``sent``, even across a resume — and the
+        zero-progress breaker accounting must count LANDED txs, not
+        the skip-advanced index delta."""
+        from svoc_tpu.resilience.breaker import CircuitBreaker
+        from svoc_tpu.resilience.retry import (
+            RetryPolicy,
+            commit_fleet_with_resume,
+        )
+        from test_resilience import FlakyOracleBackend
+
+        from svoc_tpu.consensus.state import OracleConsensusContract
+        from svoc_tpu.io.chain import ChainAdapter
+
+        contract = OracleConsensusContract(
+            admins=[0xA0, 0xA1, 0xA2],
+            oracles=[0x10 + i for i in range(7)],
+            required_majority=2,
+            n_failing_oracles=2,
+            constrained=True,
+            dimension=3,
+        )
+        # Slot 2 fails once (transient); slot 0 is quarantine-skipped.
+        backend = FlakyOracleBackend(contract, {0x12: 1})
+        adapter = ChainAdapter(backend)
+        breaker = CircuitBreaker(failure_threshold=2, registry=None)
+        predictions = np.full((7, 3), 0.5)
+        outcome = commit_fleet_with_resume(
+            adapter,
+            predictions,
+            RetryPolicy(max_attempts=3, base_s=0.0, cap_s=0.0, jitter_seed=0),
+            breaker=breaker,
+            skip=(0,),
+            sleep=lambda s: None,
+        )
+        assert outcome.sent == 6
+        assert outcome.total == 6
+        assert outcome.complete
+        assert outcome.stranded == ()
+
+    def test_zero_progress_failure_behind_skip_counts_on_breaker(self):
+        """Slot 0 skipped, slot 1 (the first attempted tx) hard-down:
+        both attempts land ZERO txs, so both must record breaker
+        failures even though the failure index (1) is past start (0)
+        — with threshold 2 the breaker OPENS before the stranded
+        resume can proceed.  (The index-delta accounting would have
+        credited attempt 1 as progress and never tripped.)"""
+        from svoc_tpu.resilience.breaker import CircuitBreaker
+        from svoc_tpu.resilience.retry import (
+            CircuitOpenError,
+            RetryPolicy,
+            commit_fleet_with_resume,
+        )
+        from svoc_tpu.utils.metrics import MetricsRegistry
+        from test_resilience import FlakyOracleBackend
+
+        from svoc_tpu.consensus.state import OracleConsensusContract
+        from svoc_tpu.io.chain import ChainAdapter
+
+        contract = OracleConsensusContract(
+            admins=[0xA0, 0xA1, 0xA2],
+            oracles=[0x10 + i for i in range(7)],
+            required_majority=2,
+            n_failing_oracles=2,
+            constrained=True,
+            dimension=3,
+        )
+        backend = FlakyOracleBackend(contract, {0x11: 10**9})
+        adapter = ChainAdapter(backend)
+        breaker = CircuitBreaker(
+            failure_threshold=2, registry=MetricsRegistry()
+        )
+        with pytest.raises(CircuitOpenError):
+            commit_fleet_with_resume(
+                adapter,
+                np.full((7, 3), 0.5),
+                RetryPolicy(
+                    max_attempts=2, base_s=0.0, cap_s=0.0, jitter_seed=0
+                ),
+                breaker=breaker,
+                skip=(0,),
+                sleep=lambda s: None,
+            )
+
+    def test_chain_skip_validates_indices(self):
+        session = self._session()
+        session.fetch()
+        with pytest.raises(ValueError):
+            session.adapter.update_all_the_predictions(
+                session.predictions, skip=(99,)
+            )
+        with pytest.raises(ValueError):
+            session.adapter.update_all_the_predictions(
+                session.predictions, batch=True, skip=(1,)
+            )
+
+
+class TestByzantineScenario:
+    @pytest.fixture(scope="class")
+    def runs(self):
+        from svoc_tpu.resilience.chaos import run_byzantine_scenario
+
+        return run_byzantine_scenario(0), run_byzantine_scenario(0)
+
+    def test_replay_is_bit_identical(self, runs):
+        first, second = runs
+        assert first["fingerprint"] == second["fingerprint"]
+
+    def test_all_injections_quarantined_zero_false(self, runs):
+        first, _ = runs
+        assert first["injections"] > 0
+        assert first["missed_injections"] == 0
+        assert first["false_quarantines"] == 0
+
+    def test_offenders_voted_out_and_consensus_holds(self, runs):
+        first, _ = runs
+        assert first["colluders_voted_out"]
+        assert first["injector_voted_out"]
+        assert first["consensus_active"]
+        assert first["essence_in_band"]
+        assert first["duplicate_txs"] == 0
+
+
+class TestFeltBoundaries:
+    """Satellite: felt decode must refuse out-of-window calldata."""
+
+    def test_valid_windows_roundtrip(self):
+        from svoc_tpu.ops.fixedpoint import (
+            felt_to_wsad,
+            wsad_to_felt,
+        )
+
+        for w in (0, 1, -1, 10**18, -(10**18), 2**127 - 1, -(2**127)):
+            assert felt_to_wsad(wsad_to_felt(w)) == w
+
+    @pytest.mark.parametrize(
+        "felt",
+        [
+            -1,
+            2**127,  # dead zone start (I128_MAX + 1)
+            2**200,  # deep dead zone
+            # one below the negative window
+            3618502788666131213697322783095070105623107215331596699973092056135872020481
+            - 2**127
+            - 1,
+            # the prime itself and beyond
+            3618502788666131213697322783095070105623107215331596699973092056135872020481,
+            3618502788666131213697322783095070105623107215331596699973092056135872020481
+            + 5,
+        ],
+    )
+    def test_out_of_window_felts_raise(self, felt):
+        from svoc_tpu.ops.fixedpoint import FeltRangeError, felt_to_wsad
+
+        with pytest.raises(FeltRangeError):
+            felt_to_wsad(felt)
+
+    def test_decode_vector_validates(self):
+        from svoc_tpu.ops.fixedpoint import FeltRangeError, decode_vector
+
+        with pytest.raises(FeltRangeError):
+            decode_vector([500_000, 2**127])
+
+    def test_window_edges_decode(self):
+        from svoc_tpu.ops.fixedpoint import (
+            FELT_PRIME,
+            I128_MAX,
+            I128_MIN,
+            felt_to_wsad,
+        )
+
+        assert felt_to_wsad(I128_MAX) == I128_MAX
+        assert felt_to_wsad(FELT_PRIME + I128_MIN) == I128_MIN
+
+
+class TestSaturatingOps:
+    def test_add_saturates_never_wraps(self):
+        from svoc_tpu.ops.fixedpoint import (
+            I128_MAX,
+            I128_MIN,
+            wsad_add_sat,
+        )
+
+        assert wsad_add_sat(I128_MAX, 1) == I128_MAX
+        assert wsad_add_sat(I128_MIN, -1) == I128_MIN
+        assert wsad_add_sat(5, 7) == 12
+        assert wsad_add_sat(I128_MAX, I128_MAX) == I128_MAX
+
+    def test_mul_saturates_and_counts(self):
+        from svoc_tpu.ops.fixedpoint import I128_MAX, I128_MIN, wsad_mul, wsad_mul_sat
+        from svoc_tpu.utils.metrics import registry
+
+        before = registry.family_total("wsad_overflows")
+        big = 2**100
+        assert wsad_mul_sat(big, big) == I128_MAX
+        assert wsad_mul_sat(big, -big) == I128_MIN
+        assert registry.family_total("wsad_overflows") == before + 2
+        # In-range products match the exact op bit for bit.
+        assert wsad_mul_sat(1_500_000, 2_000_000) == wsad_mul(
+            1_500_000, 2_000_000
+        )
+
+
+class TestGatedSharding:
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        from svoc_tpu.parallel.serving import serving_mesh
+
+        return serving_mesh()
+
+    def test_ungated_sharded_degenerate_block_is_invalid(self, mesh):
+        """Kernel parity for the n_failing >= N-1 guard: the UNGATED
+        sharded body must flag the degenerate config invalid too, not
+        report a confident one-oracle essence."""
+        from svoc_tpu.parallel.sharded import sharded_consensus_fn
+
+        n = 8
+        deg = ConsensusConfig(n_failing=n - 1, constrained=True)
+        fn = sharded_consensus_fn(mesh, deg, axis="data")
+        vals = _fleet(n=n)
+        out = fn(vals)
+        assert not bool(np.asarray(out.interval_valid))
+        ref = consensus_step(vals, deg)
+        assert not bool(np.asarray(ref.interval_valid))
+
+    def test_gated_matches_ungated_on_clean_window(self, mesh):
+        from svoc_tpu.parallel.serving import fleet_step_fn
+
+        rng = np.random.default_rng(9)
+        window = jnp.asarray(rng.uniform(0.2, 0.8, (50, 6)), jnp.float32)
+        key = jax.random.PRNGKey(0)
+        plain = fleet_step_fn(mesh, CFG, 16)
+        gated = fleet_step_fn(mesh, CFG, 16, gate=(0.0, 1.0))
+        out_p, honest_p = plain(key, window)
+        out_g, honest_g, admitted = gated(key, window)
+        assert np.asarray(admitted).all()
+        np.testing.assert_array_equal(
+            np.asarray(honest_p), np.asarray(honest_g)
+        )
+        for name in out_p._fields:
+            np.testing.assert_allclose(
+                np.asarray(getattr(out_p, name)),
+                np.asarray(getattr(out_g, name)),
+                atol=1e-6,
+                err_msg=name,
+            )
+
+    def test_poisoned_window_is_contained(self, mesh):
+        from svoc_tpu.parallel.serving import fleet_step_fn
+
+        rng = np.random.default_rng(10)
+        window = jnp.asarray(rng.uniform(0.2, 0.8, (50, 6)), jnp.float32)
+        wbad = window.at[:, 0].set(jnp.nan)
+        gated = fleet_step_fn(mesh, CFG, 16, gate=(0.0, 1.0))
+        out, _honest, admitted = gated(jax.random.PRNGKey(0), wbad)
+        # Every bootstrap averages some poisoned comment → only the
+        # uniform "failing" oracles survive the gate; the step must
+        # flag itself invalid and stay finite, never NaN-poisoned.
+        assert not bool(out.interval_valid)
+        assert np.all(np.isfinite(np.asarray(out.essence)))
+        assert int(np.asarray(admitted).sum()) < 16

@@ -112,6 +112,7 @@ def test_identify_failing_matches_reference_rule():
     assert np.asarray(guess).tolist() == [True, True, True, False, False]
 
 
+@pytest.mark.slow  # 3000-trial Monte-Carlo published-table cell (VERDICT r5 item 6; load-flaky under the full suite)
 @pytest.mark.parametrize(
     "a,expected_success,expected_reliability,tol_s,tol_r",
     [
@@ -132,6 +133,7 @@ def test_montecarlo_matches_published_7_2(
     assert r["reliability_pct"] == pytest.approx(expected_reliability, abs=tol_r)
 
 
+@pytest.mark.slow  # 3000-trial Monte-Carlo published-table cell (VERDICT r5 item 6; load-flaky under the full suite)
 @pytest.mark.parametrize(
     "a,expected_success,tol_s",
     [(10.0, 26.0, 6.0), (100.0, 78.33, 6.0)],
@@ -148,6 +150,7 @@ def test_montecarlo_matches_published_20_2(a, expected_success, tol_s):
     )
 
 
+@pytest.mark.slow  # 2000-trial Monte-Carlo (VERDICT r5 item 6)
 def test_montecarlo_adversarial_75pct_stays_reliable():
     """documentation/README.md:318-319: N=20 with 15 failing (75%
     adversarial) keeps reliability ~90%."""
@@ -177,6 +180,7 @@ def test_montecarlo_kernel_detection_close_to_reference_rule():
 GAUSS_FIXTURE = dict(mu=(20.0, 12.0), sigma=(3.0, 2.0))
 
 
+@pytest.mark.slow  # 3000-trial Monte-Carlo published-table cell (VERDICT r5 item 6; load-flaky under the full suite)
 @pytest.mark.parametrize(
     "use_kernel,expected_success,expected_reliability",
     [(False, 48.9, 91.5), (True, 48.1, 91.2)],
@@ -229,6 +233,7 @@ def test_montecarlo_unconstrained_tight_sigma_identifies_failures():
     assert r["mean_estimator_error"] < 0.05
 
 
+@pytest.mark.slow  # N=1024 Monte-Carlo fleet-scale table (docs/ALGORITHM.md §5; the robustness cert gate covers breakdown in tier-1)
 class TestFleetScale:
     """Fleet-scale (N=1024) acceptance — docs/ALGORITHM.md §5 table,
     at sampling tolerance (K=40 here vs the table's K=200)."""

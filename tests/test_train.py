@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from svoc_tpu.models.configs import TINY_TEST
 from svoc_tpu.models.encoder import SentimentEncoder, init_params
@@ -173,6 +174,7 @@ def test_zero1_packed_step_runs_and_shards():
     )
 
 
+@pytest.mark.slow  # heavyweight trainer parity (VERDICT r5 item 6); tier-1 keeps the basic loss-reduction + sharded-parity steps
 def test_flash_train_step_matches_dense():
     """attention='flash' now trains (FlashAttention-2 custom VJP):
     gradients through the flash encoder must match the dense encoder's
@@ -243,6 +245,7 @@ def _packed_pair(n_texts=12, seq=24, seed=5):
     return cfg, batch, packed
 
 
+@pytest.mark.slow  # heavyweight trainer parity (VERDICT r5 item 6); tier-1 keeps the basic loss-reduction + sharded-parity steps
 def test_packed_train_step_matches_unpacked():
     """A packed update must equal an unpacked update on the same
     comments+labels: the masked segment-mean loss IS the batch mean.
@@ -288,6 +291,7 @@ def test_packed_train_step_matches_unpacked():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+@pytest.mark.slow  # heavyweight trainer parity (VERDICT r5 item 6); tier-1 keeps the basic loss-reduction + sharded-parity steps
 def test_sharded_packed_train_step_matches_unsharded():
     from svoc_tpu.train.trainer import (
         make_packed_train_step,
@@ -355,6 +359,7 @@ def test_packed_trainer_rejects_unknown_attention():
         )
 
 
+@pytest.mark.slow  # heavyweight trainer parity (VERDICT r5 item 6); tier-1 keeps the basic loss-reduction + sharded-parity steps
 def test_sharded_flash_train_step_matches_unsharded():
     """attention='flash' trains SHARDED too: the flash VJP under GSPMD
     data x model shardings must match the unsharded step (the round-3
@@ -388,6 +393,7 @@ def test_sharded_flash_train_step_matches_unsharded():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-5)
 
 
+@pytest.mark.slow  # heavyweight trainer parity (VERDICT r5 item 6); tier-1 keeps the basic loss-reduction + sharded-parity steps
 def test_sp_train_step_matches_dense():
     """Long-context sequence-parallel fine-tuning: one SP train step
     (ring-attention custom VJP over the 8-way seq mesh) must match the

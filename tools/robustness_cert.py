@@ -1,0 +1,164 @@
+"""Byzantine robustness certification: ``ROBUSTNESS_CERT.json`` as a gate.
+
+Runs the empirical breakdown-point sweep
+(:mod:`svoc_tpu.robustness.certify`) for BOTH consensus configurations
+and the seeded Byzantine chaos scenario
+(:func:`svoc_tpu.resilience.chaos.run_byzantine_scenario`) twice, then
+asserts the ISSUE-4 acceptance surface:
+
+- every implemented attack strategy tolerates a colluder fraction
+  ≥ ``n_failing/N`` at bounded essence deviation (constrained AND
+  unconstrained estimators);
+- the Byzantine scenario replays fingerprint-identically, quarantines
+  every injected malformed vector with zero false quarantines, never
+  duplicates a tx, and votes the colluding cluster + the injector out
+  through the contract's replacement flow.
+
+``--smoke`` shrinks the grid to a seconds-scale CI gate
+(``make robustness-smoke``, wired into presnapshot/verify);
+the default grid is the full certificate (``make robustness-cert``).
+
+Usage::
+
+    python tools/robustness_cert.py [--smoke] [--seed 0]
+        [--out ROBUSTNESS_CERT.json]
+"""
+
+from __future__ import annotations
+
+import os
+
+# Off-TPU by construction (the axon sitecustomize pins the platform, so
+# go through jax.config too — tools/soak.py measurement postmortem).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import argparse  # noqa: E402
+import dataclasses  # noqa: E402
+import json  # noqa: E402
+import sys  # noqa: E402
+import time  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _jsonable_sweep(sweep):
+    out = dict(sweep)
+    out["cells"] = [dataclasses.asdict(c) for c in sweep["cells"]]
+    out["benign_deviation"] = {
+        str(k): v for k, v in sweep["benign_deviation"].items()
+    }
+    return out
+
+
+def run(seed: int, smoke: bool) -> dict:
+    import jax
+
+    from svoc_tpu.consensus.kernel import ConsensusConfig
+    from svoc_tpu.resilience.chaos import run_byzantine_scenario
+    from svoc_tpu.robustness.certify import breakdown_sweep, certificate
+
+    n_oracles, n_failing = 8, 2
+    counts = list(range(0, 5))  # 0 … N/2 colluders
+    if smoke:
+        trials, magnitudes_c, magnitudes_u = 8, [0.45], [5.0]
+    else:
+        trials = 64
+        #: real-unit offsets along the target direction: inside the
+        #: honest spread, at the hull edge, and saturating the domain.
+        magnitudes_c = [0.2, 0.45, 0.9]
+        magnitudes_u = [2.5, 5.0, 10.0]  # fractions of max_spread=10
+
+    key = jax.random.PRNGKey(seed)
+    k_con, k_unc = jax.random.split(key)
+    sweeps = {}
+    certs = {}
+    for name, cfg, mags, bound in (
+        (
+            "constrained",
+            ConsensusConfig(n_failing=n_failing, constrained=True),
+            magnitudes_c,
+            0.05,
+        ),
+        (
+            "unconstrained",
+            ConsensusConfig(
+                n_failing=n_failing, constrained=False, max_spread=10.0
+            ),
+            magnitudes_u,
+            0.5,
+        ),
+    ):
+        sweep = breakdown_sweep(
+            k_con if cfg.constrained else k_unc,
+            cfg,
+            n_oracles=n_oracles,
+            colluder_counts=counts,
+            magnitudes=mags,
+            n_trials=trials,
+        )
+        sweeps[name] = sweep
+        certs[name] = certificate(sweep, bound_abs=bound)
+
+    byz = run_byzantine_scenario(seed)
+    byz_replay = run_byzantine_scenario(seed)
+
+    checks = {
+        "constrained_certified": certs["constrained"]["certified"],
+        "unconstrained_certified": certs["unconstrained"]["certified"],
+        "byzantine_replayable": byz["fingerprint"] == byz_replay["fingerprint"],
+        "all_injections_quarantined": byz["missed_injections"] == 0
+        and byz["injections"] > 0,
+        "zero_false_quarantines": byz["false_quarantines"] == 0,
+        "quarantine_reasons_as_expected": byz["reason_mismatches"] == 0,
+        "colluders_voted_out": byz["colluders_voted_out"],
+        "injector_voted_out": byz["injector_voted_out"],
+        "no_duplicate_txs": byz["duplicate_txs"] == 0,
+        "consensus_held": byz["consensus_active"] and byz["essence_in_band"],
+    }
+    return {
+        "seed": seed,
+        "mode": "smoke" if smoke else "full",
+        "checks": checks,
+        "ok": all(checks.values()),
+        "certificates": certs,
+        "byzantine": byz,
+        "byzantine_replay_fingerprint": byz_replay["fingerprint"],
+        "sweeps": {k: _jsonable_sweep(v) for k, v in sweeps.items()},
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--smoke", action="store_true")
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+    out_path = args.out or (
+        "ROBUSTNESS_SMOKE.json" if args.smoke else "ROBUSTNESS_CERT.json"
+    )
+
+    t0 = time.monotonic()
+    artifact = run(args.seed, args.smoke)
+    artifact["elapsed_s"] = round(time.monotonic() - t0, 2)
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(artifact, f, indent=1)
+    os.replace(out_path + ".tmp", out_path)
+    summary = {
+        "robustness_cert": "ok" if artifact["ok"] else "FAILED",
+        "mode": artifact["mode"],
+        "checks": artifact["checks"],
+        "tolerated": {
+            name: {
+                a: d["tolerated_fraction"]
+                for a, d in cert["attacks"].items()
+            }
+            for name, cert in artifact["certificates"].items()
+        },
+        "elapsed_s": artifact["elapsed_s"],
+    }
+    print(json.dumps(summary), flush=True)
+    return 0 if artifact["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
