@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -74,9 +74,18 @@ robustness-smoke:
 robustness-cert:
 	$(PY) tools/robustness_cert.py
 
+# Flight-recorder gate (docs/OBSERVABILITY.md §events): the seeded
+# Byzantine scenario twice with byte-identical journal fingerprints,
+# the verdict→charge→replacement audit linkage on one lineage id, and
+# a complete postmortem bundle from a seeded mini-session.  Seconds on
+# CPU, no transformer builds.
+obs-smoke:
+	$(PY) tools/obs_smoke.py
+
 # The default verify path: the cheap static gate first, then the chaos
-# convergence gates (I/O-plane, then data-plane), then the suite.
-verify: lint chaos-smoke robustness-smoke test
+# convergence gates (I/O-plane, then data-plane), then the flight
+# recorder, then the suite.
+verify: lint chaos-smoke robustness-smoke obs-smoke test
 
 # End-of-round gate: lint + the driver-contract guards FIRST (fast,
 # loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
@@ -86,6 +95,7 @@ presnapshot:
 	$(MAKE) lint
 	$(MAKE) chaos-smoke
 	$(MAKE) robustness-smoke
+	$(MAKE) obs-smoke
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_graft_entry.py tests/test_bench.py -q
 	$(MAKE) test

@@ -2430,6 +2430,14 @@ def main(argv=None) -> int:
         stage_hists = _obs.stage_snapshot()
         if stage_hists:
             result["detail"]["stage_seconds"] = stage_hists
+        # Flight-recorder digest (docs/OBSERVABILITY.md §events): what
+        # happened during the run — event counts by type, the last
+        # alert-class events, the stream fingerprint — so a BENCH
+        # artifact can answer "did anything go wrong" without a rerun.
+        from svoc_tpu.utils.events import journal as _journal
+
+        if _journal.last_seq():
+            result["detail"]["journal"] = _journal.summary()
         if fallback_reason:
             result["detail"]["backend_fallback"] = fallback_reason
         if small:

@@ -28,6 +28,9 @@ Rules (docs/STATIC_ANALYSIS.md has bad/good examples for each):
   / true division / foreign Q-scales inside wsad integer paths.
 - **SVOC006 unlocked-shared-state** — module-level mutable state
   mutated without a lock in the thread-entry modules.
+- **SVOC007 event-in-traced-body** — flight-recorder emission
+  (``emit_event`` / ``journal.emit``) inside a jit-traced body; events
+  are host-side only (``svoc_tpu/utils/events.py``).
 
 Entry points: :func:`svoc_tpu.analysis.engine.analyze_paths` (the CLI
 ``tools/svoclint.py`` wraps it) and

@@ -117,6 +117,15 @@ RULE_DOCS: Dict[str, Dict[str, str]] = {
             "thread-entry module"
         ),
     },
+    "SVOC007": {
+        "name": "event-in-traced-body",
+        "severity": "error",
+        "summary": (
+            "event-journal emission (emit_event / journal.emit) inside "
+            "a jit-traced body — fires at trace time only, never per "
+            "execution"
+        ),
+    },
 }
 
 
@@ -865,6 +874,58 @@ def rule_svoc006(unit) -> List[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# SVOC007 — event-in-traced-body
+# ---------------------------------------------------------------------------
+
+#: Identifiers that name the event journal at callsites (the default
+#: instance, scenario-local instances, and the conventional aliases).
+_EVENT_ROOTS = {"journal", "event_journal", "events", "_journal", "_events"}
+
+
+def rule_svoc007(unit) -> List[Finding]:
+    """Event emission / journal writes are HOST-side only (same
+    detection plumbing as SVOC002's metrics scan): inside a jit-traced
+    body an ``emit_event``/``journal.emit`` call runs once at trace
+    time — the flight recorder would record one phantom event per
+    compile instead of one per execution, and its lock/file I/O has no
+    business in a traced computation."""
+    out: List[Finding] = []
+    jm: JitMap = unit.jitmap
+    for fn, info in jm.traced_roots():
+        label = info.name or "<lambda>"
+        for node in _walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func) or ""
+            root = _call_root(node)
+            is_emit = (
+                fname == "emit_event"
+                or fname.endswith(".emit_event")
+                or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in {"emit", "subscribe", "set_trace_file"}
+                    and root in _EVENT_ROOTS
+                )
+            )
+            if is_emit:
+                out.append(
+                    _finding(
+                        unit,
+                        "SVOC007",
+                        node,
+                        f"event-journal call inside jit-traced `{label}` "
+                        "records at trace time only (cached executions "
+                        "emit nothing) and drags lock/file I/O into the "
+                        "traced body",
+                        "emit around the dispatch on the host — events "
+                        "are host-side only (docs/OBSERVABILITY.md "
+                        "§events)",
+                    )
+                )
+    return out
+
+
 ALL_RULES: Sequence[Callable] = (
     rule_svoc001,
     rule_svoc002,
@@ -872,4 +933,5 @@ ALL_RULES: Sequence[Callable] = (
     rule_svoc004,
     rule_svoc005,
     rule_svoc006,
+    rule_svoc007,
 )

@@ -44,6 +44,11 @@ HELP = """Commands:
       endpoint serves; 'trace' lists the most recent stage spans)
     - resilience (circuit-breaker state, per-slot oracle health
       scores, quarantine set, replacement count)
+    - events [N] (the flight recorder's newest N journal events;
+      default 10)
+    - audit [lineage] (per-block audit record — events, spans, and a
+      summary joined on one lineage id; default: the last fetch)
+    - slo (declarative objectives as fast/slow burn rates)
     - multimodal [K|auto] (mixture analysis of the last fetch;
       default K=2, 'auto' selects K by BIC)
 
@@ -438,6 +443,90 @@ class CommandConsole:
                             for q in quarantine["quarantined"]
                         )
                     )
+            elif cmd == "events":
+                from svoc_tpu.utils.events import journal as _journal
+
+                if len(args) > 1:
+                    emit("Usage: events [N]")
+                    return out
+                n = int(args[0]) if args else 10
+                records = _journal.recent(n)
+                if not records:
+                    emit("no events recorded yet")
+                for rec in records:
+                    data = " ".join(
+                        f"{k}={v}" for k, v in sorted(rec.data.items())
+                    )
+                    emit(
+                        f"#{rec.seq} {rec.type}"
+                        + (f" [{rec.lineage}]" if rec.lineage else "")
+                        + (f" {data}" if data else "")
+                    )
+            elif cmd == "audit":
+                if len(args) > 1:
+                    emit("Usage: audit [lineage]")
+                    return out
+                record = self.session.audit(args[0] if args else None)
+                if not record.get("found"):
+                    emit(
+                        "no audit record"
+                        + (
+                            f" for {record['lineage']}"
+                            if record.get("lineage")
+                            else " — run 'fetch' first"
+                        )
+                    )
+                    return out
+                emit(f"audit {record['lineage']}:")
+                s = record["summary"]
+                quarantined = s.get("quarantined") or {}
+                emit(
+                    f"  quarantined: {len(quarantined)}"
+                    + (
+                        " ("
+                        + ", ".join(
+                            f"slot {slot}: {reason}"
+                            for slot, reason in sorted(quarantined.items())
+                        )
+                        + ")"
+                        if quarantined
+                        else ""
+                    )
+                )
+                emit(
+                    f"  commit: sent={s.get('commit_sent', 0)}"
+                    f" skipped={s.get('commit_skipped', 0)}"
+                    f" retries={s.get('commit_retries', 0)}"
+                    f" failures={len(s.get('commit_failures') or [])}"
+                )
+                if s.get("charged"):
+                    emit("  charged: " + ", ".join(s["charged"]))
+                for rep in s.get("replacements") or []:
+                    emit(
+                        f"  replaced slot {rep.get('slot')}: "
+                        f"{rep.get('old')} -> {rep.get('new')}"
+                    )
+                breaker_line = (
+                    " -> ".join(s["breaker_transitions"])
+                    if s.get("breaker_transitions")
+                    else "stayed " + self.session.breaker.state()
+                )
+                emit(f"  breaker: {breaker_line}")
+                emit(
+                    f"  events: {len(record['events'])}, "
+                    f"spans: {len(record['spans'])}"
+                )
+            elif cmd == "slo":
+                snap = self.session.slo_snapshot()
+                for name in sorted(snap):
+                    s = snap[name]
+                    emit(
+                        f"{name} (objective {s['objective']:.0%}): "
+                        f"fast burn {s['fast']['burn']:.2f}x, "
+                        f"slow burn {s['slow']['burn']:.2f}x"
+                        + ("  ALERTING" if s["alerting"] else "")
+                    )
+                    emit(f"  {s['description']}: {s['good']:g}/{s['total']:g} good")
             elif cmd == "multimodal":
                 # Beyond-reference: mixture-model analysis of the LAST
                 # fetched fleet (the scenario documentation/README.md:
@@ -591,6 +680,11 @@ class CommandConsole:
                             # dead, re-wedging the loop the skip freed.
                             if not breaker_open:
                                 self.session.supervisor_step()
+                            # Burn-rate fold (docs/OBSERVABILITY.md
+                            # §slo): registry-only, no chain I/O, so it
+                            # runs even on breaker-open cycles — an
+                            # outage is exactly when burn rates matter.
+                            self.session.slo_step()
                 except EmptyStoreError:
                     # Not an error in a composite loop: live mode starts
                     # the scraper and this loop together, so early

@@ -53,8 +53,10 @@ from svoc_tpu.robustness.sanitize import (
     SanitizeConfig,
 )
 from svoc_tpu.sim.oracle import gen_oracle_predictions
+from svoc_tpu.utils.events import audit_record, lineage_scope, mint_lineage
+from svoc_tpu.utils.events import journal as event_journal
 from svoc_tpu.utils.metrics import registry as metrics
-from svoc_tpu.utils.metrics import stage_span
+from svoc_tpu.utils.metrics import stage_span, tracer
 
 
 class EmptyStoreError(RuntimeError):
@@ -196,8 +198,22 @@ class Session:
         #: Last gate verdict over the fetched fleet (written with the
         #: predictions it describes, under the session lock).
         self.last_quarantine: Optional[QuarantineReport] = None
+        #: Lineage id of the last PUBLISHED fleet block (minted per
+        #: fetch claim, ``svoc_tpu.utils.events.mint_lineage``) — the
+        #: key every event/span of that block carries, and what the
+        #: console's ``audit`` command defaults to.  Prefixed with a
+        #: process-unique session scope: several sessions share one
+        #: process journal, and without the scope each would mint
+        #: ``blk-000001`` for its first fetch and their audit records
+        #: would merge.
+        self.last_lineage: Optional[str] = None
+        self._lineage_prefix = f"blk{lineage_scope()}"
         self.predictions: Optional[np.ndarray] = None
         self.last_preview: Optional[Dict] = None
+        #: Lazy SLO evaluator (``svoc_tpu.utils.slo``) over the shared
+        #: registry — built on first use so sessions that never ask for
+        #: burn rates pay nothing.
+        self._slo = None
         #: Bumped on every state change the UI renders (fetch, commit,
         #: resume) — the web UI's poll loop redraws only when this
         #: changes, so auto_fetch/auto_commit/auto_resume surface live
@@ -349,11 +365,26 @@ class Session:
                 )
                 self._fetch_claim += 1
                 claim = self._fetch_claim
+                step = self.simulation_step
+            # The block's lineage id, minted from the claim token (so
+            # seeded replays mint identical ids) and annotated onto the
+            # OPEN fetch span — every child span (vectorize/tokenize/
+            # forward/fleet/consensus) inherits it, and every event
+            # below carries it, making the whole block auditable as one
+            # record (docs/OBSERVABILITY.md §lineage).
+            lineage = mint_lineage(claim, prefix=self._lineage_prefix)
+            tracer.annotate_lineage(lineage)
             if not comments:
                 raise EmptyStoreError(
                     "comment store is empty — run the scraper (or seed the "
                     "store) before fetching"
                 )
+            event_journal.emit(
+                "block.fetched",
+                lineage=lineage,
+                n_comments=len(comments),
+                cursor=step,
+            )
             # Resolved only now: an empty store must fail in
             # milliseconds, not after a transformer build.
             vectorize = self.vectorizer
@@ -385,24 +416,43 @@ class Session:
                 predictions = np.asarray(values, dtype=np.float64)  # svoclint: disable=SVOC001
                 # The gate verdict travels WITH the block it describes
                 # (one count-bearing inspection per fetch; commits
-                # re-check their own snapshot without counting).
+                # re-check their own snapshot without counting).  The
+                # gate emits the block's quarantine.verdict event.
                 quarantine = (
-                    self.gate.inspect(predictions)
+                    self.gate.inspect(predictions, lineage=lineage)
                     if self.config.quarantine_gate
                     else None
                 )
+                ranks_np = np.asarray(ranks)  # svoclint: disable=SVOC001
                 preview = {
                     "values": predictions,
                     "mean": np.asarray(mean),  # svoclint: disable=SVOC001
                     "median": np.asarray(median),  # svoclint: disable=SVOC001
-                    "normalized_ranks": np.asarray(ranks),  # svoclint: disable=SVOC001
+                    "normalized_ranks": ranks_np,
                     "honest": np.asarray(honest),  # svoclint: disable=SVOC001
                     "n_comments": len(comments),
+                    "lineage": lineage,
                     "quarantine": (
                         quarantine.as_dict() if quarantine is not None else None
                     ),
                 }
             metrics.counter("comments_processed").add(len(comments))
+            admitted = (
+                int(np.sum(quarantine.ok))
+                if quarantine is not None
+                else int(predictions.shape[0])
+            )
+            event_journal.emit(
+                "consensus.result",
+                lineage=lineage,
+                n_oracles=int(predictions.shape[0]),
+                admitted=admitted,
+                # The gated kernel's validity bound (docs/ROBUSTNESS.md):
+                # below 2 admitted oracles no interval is meaningful —
+                # the postmortem monitor auto-bundles on False.
+                interval_valid=admitted >= 2,
+                suspects=int(np.sum(ranks_np <= 0.2)),
+            )
             with self.lock:
                 # Publish only if no LATER claim already did — a slow
                 # fetch of an older window must not regress the state.
@@ -410,6 +460,7 @@ class Session:
                     self._fetch_published = claim
                     self.predictions = predictions
                     self.last_quarantine = quarantine
+                    self.last_lineage = lineage
                     self.last_preview = preview
                     self.bump_state()
         return preview
@@ -446,22 +497,43 @@ class Session:
             if self.predictions is None:
                 raise RuntimeError("fetch before commit")
             predictions = self.predictions
+            lineage = self.last_lineage
         if self.config.quarantine_gate:
             report = self.gate.inspect(predictions, count=False)
             if not report.clean:
+                event_journal.emit(
+                    "commit.failed",
+                    lineage=lineage,
+                    reason="quarantined",
+                    slots=report.quarantined_slots,
+                )
                 raise QuarantinedInputError(report)
         with self._commit_lock, metrics.timer("commit_latency").time():
             try:
-                n = self.adapter.update_all_the_predictions(predictions)
+                n = self.adapter.update_all_the_predictions(
+                    predictions, lineage=lineage
+                )
             except ChainCommitError as e:
                 metrics.counter("chain_transactions").add(e.committed)
                 metrics.counter("chain_commit_failures").add(1)
                 # Interactive failures feed the health scores too — the
                 # supervisor folds ALL commit-failure history.
                 self.supervisor.record_commit_failure(e.failed_oracle, e.cause)
+                event_journal.emit(
+                    "commit.failed",
+                    lineage=lineage,
+                    reason="chain",
+                    index=e.committed,
+                    oracle=e.failed_oracle,
+                    cause=str(e.cause),
+                )
                 self.bump_state()  # partial txs changed chain state
                 raise
         metrics.counter("chain_transactions").add(n)
+        event_journal.emit(
+            "commit.sent", lineage=lineage, sent=n, total=n, attempts=1,
+            stranded=0,
+        )
         self.bump_state()
         return n
 
@@ -489,6 +561,7 @@ class Session:
             if self.predictions is None:
                 raise RuntimeError("fetch before commit")
             predictions = self.predictions
+            lineage = self.last_lineage
         # Quarantine gate (docs/ROBUSTNESS.md): refused slots never
         # produce a tx; each refusal charges the slot's oracle exactly
         # like a commit failure, so a persistent garbage emitter walks
@@ -501,8 +574,12 @@ class Session:
                 oracles = self.adapter.call_oracle_list()
                 for slot in report.quarantined_slots:
                     if slot < len(oracles):
+                        # The charge event carries the block lineage —
+                        # the audit link from this verdict to the
+                        # replacement clock it advanced.
                         self.supervisor.record_quarantine(
-                            oracles[slot], report.reasons[slot]
+                            oracles[slot], report.reasons[slot],
+                            lineage=lineage,
                         )
                 metrics.counter("commit_skipped_quarantined").add(len(skip))
         with self._commit_lock, metrics.timer("commit_latency").time():
@@ -514,6 +591,7 @@ class Session:
                     breaker=self.breaker,
                     skip=skip,
                     on_oracle_failure=self.supervisor.record_commit_failure,
+                    lineage=lineage,
                 )
             except ChainCommitError as e:
                 # resilient_sent is the TRUE landed-tx count (committed
@@ -546,7 +624,12 @@ class Session:
         if not self.config.supervise_fleet:
             return None
         try:
-            report = self.supervisor.step()
+            # The fold's events carry the lineage of the block whose
+            # commit cycle drove it — the replacement-vote leg of that
+            # block's audit record.
+            with self.lock:
+                lineage = self.last_lineage
+            report = self.supervisor.step(lineage=lineage)
         except Exception:
             metrics.counter("supervisor_errors").add(1)
             return None
@@ -578,6 +661,7 @@ class Session:
         Cheap: no chain I/O (the supervisor reads its cached scores)."""
         with self.lock:
             quarantine = self.last_quarantine
+            lineage = self.last_lineage
         return {
             "breaker": self.breaker.state(),
             "health": self.supervisor.health_snapshot(),
@@ -588,4 +672,43 @@ class Session:
             "input_quarantine": (
                 quarantine.as_dict() if quarantine is not None else None
             ),
+            # The last published block's lineage id — the key for
+            # ``GET /api/audit/<block>`` / the console's ``audit``.
+            "lineage": lineage,
         }
+
+    # -- flight recorder views (docs/OBSERVABILITY.md §events) --------------
+
+    def audit(self, lineage: Optional[str] = None) -> Dict:
+        """The per-block audit record (events + spans + summary) for
+        ``lineage`` — default: the last published block."""
+        if lineage is None:
+            with self.lock:
+                lineage = self.last_lineage
+        if lineage is None:
+            return {"lineage": None, "found": False, "events": [],
+                    "spans": [], "summary": {}}
+        return audit_record(lineage)
+
+    def _slo_evaluator(self):
+        if self._slo is None:
+            from svoc_tpu.utils.slo import SLOEvaluator, default_slos
+
+            self._slo = SLOEvaluator(default_slos(metrics), registry=metrics)
+        return self._slo
+
+    def slo_snapshot(self) -> Dict:
+        """Evaluate the declarative SLOs (commit success ratio, p99
+        consensus latency, quarantine admission) as fast/slow burn
+        rates; exports the ``slo_burn_rate`` gauges and emits
+        ``slo.alert`` events on threshold crossings."""
+        return self._slo_evaluator().evaluate()
+
+    def slo_step(self) -> Optional[Dict]:
+        """The auto loop's SLO fold — never raises (a broken evaluator
+        must not take down serving)."""
+        try:
+            return self.slo_snapshot()
+        except Exception:
+            metrics.counter("slo_errors").add(1)
+            return None

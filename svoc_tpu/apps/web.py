@@ -192,7 +192,8 @@ async function refresh(s) {
     + ' resume:' + onoff(s.auto_resume)
     + ' · breaker: ' + (rs.breaker || 'n/a')
     + ' · replacements: ' + (rs.replacements || 0)
-    + (quarantined ? ' · quarantined slots: ' + quarantined : '');
+    + (quarantined ? ' · quarantined slots: ' + quarantined : '')
+    + (rs.lineage ? ' · block: ' + rs.lineage : '');
   updateReplacementMenu(s);
   const plots = document.getElementById('plots');
   plots.innerHTML = '';
@@ -379,8 +380,23 @@ class _Handler(BaseHTTPRequestHandler):
                 },
             }
             self._send(200, json.dumps(payload).encode(), "application/json")
-        elif self.path == "/api/events":
+        elif self.path == "/api/events" or self.path.startswith("/api/events?"):
             self._serve_events()
+        elif self.path.startswith("/api/audit/"):
+            # Per-block audit record (docs/OBSERVABILITY.md §lineage):
+            # events + spans + summary joined on one lineage id.
+            lineage = self.path[len("/api/audit/") :].split("?", 1)[0]
+            record = self.console.session.audit(lineage or None)
+            if not record.get("found"):
+                self._send(
+                    404,
+                    json.dumps(record).encode(),
+                    "application/json",
+                )
+            else:
+                self._send(
+                    200, json.dumps(record).encode(), "application/json"
+                )
         elif self.path == "/metrics":
             # Prometheus text exposition of the shared registry.  The
             # runtime gauges (live-array bytes per device, compile
@@ -407,8 +423,20 @@ class _Handler(BaseHTTPRequestHandler):
         flag ``serve``'s closer sets), a 15 s heartbeat comment bounds
         how long a silent dead connection lingers, and concurrent
         streams are capped at ``MAX_SSE_STREAMS`` (503 + Retry-After
-        beyond it — the page's poll fallback covers rejected clients)."""
+        beyond it — the page's poll fallback covers rejected clients).
+
+        ``?journal=1`` opts the stream into TYPED event frames: every
+        new flight-recorder event (``svoc_tpu.utils.events``) arrives
+        as a named ``event: journal`` SSE frame alongside the unnamed
+        ``state_version`` frames (which are unchanged — the page's
+        ``onmessage`` handler and old clients never see named frames).
+        Frames per tick are capped so a journal burst cannot wedge the
+        write loop."""
         import time as _time
+
+        want_journal = "journal=1" in (
+            self.path.split("?", 1)[1] if "?" in self.path else ""
+        )
 
         # Admission under the server-wide lock: racing opens must not
         # both pass the check and overshoot the cap.
@@ -434,6 +462,13 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             last_version = None
             last_write = 0.0
+            last_seq = 0
+            if want_journal:
+                from svoc_tpu.utils.events import journal as _journal
+
+                # Stream only NEW events — a reconnecting tab must not
+                # replay the whole ring through its own frames.
+                last_seq = _journal.last_seq()
             while not getattr(self.server, "svoc_shutting_down", False):
                 with session.lock:
                     version = session.state_version
@@ -447,6 +482,20 @@ class _Handler(BaseHTTPRequestHandler):
                     self.wfile.write(b": keepalive\n\n")  # SSE comment
                     self.wfile.flush()
                     last_write = now
+                if want_journal:
+                    # ≤ 50 typed frames per tick: a journal burst drains
+                    # over a few ticks instead of wedging this write
+                    # loop (the busy-loop guard the cap test pins).
+                    wrote = False
+                    for rec in _journal.since(last_seq, limit=50):
+                        self.wfile.write(
+                            f"event: journal\ndata: {rec.to_json()}\n\n".encode()
+                        )
+                        last_seq = rec.seq
+                        wrote = True
+                    if wrote:
+                        self.wfile.flush()
+                        last_write = now
                 _time.sleep(0.25)
         except (OSError, ValueError):
             # Client went away (BrokenPipe/Reset) or the handler's

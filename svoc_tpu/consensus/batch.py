@@ -143,12 +143,16 @@ class CertifyMargins:
 def certify(
     m: PrefixMargins, cfg: ConsensusConfig, strict_interval: bool,
     bands: CertifyMargins = CertifyMargins(),
+    lineage=None,
 ) -> np.ndarray:
     """Per-prefix bool: ``True`` ⇒ the exact engine provably completes
-    this recompute without a panic (within the guard bands)."""
+    this recompute without a panic (within the guard bands).
+    ``lineage`` tags the certification span with the committing block's
+    lineage id (``svoc_tpu.utils.events``); under a lineage-annotated
+    ``commit`` span it is inherited automatically."""
     from svoc_tpu.utils.metrics import stage_span
 
-    with stage_span("consensus_certify"):
+    with stage_span("consensus_certify", lineage=lineage):
         return _certify(m, cfg, strict_interval, bands)
 
 

@@ -603,6 +603,7 @@ class ChainAdapter:
         batch: Optional[bool] = None,
         start: int = 0,
         skip: Sequence[int] = (),
+        lineage: Optional[str] = None,
     ) -> int:
         """One signed tx per oracle, in oracle-list order
         (``client/contract.py:200-208``); returns the tx count *sent by
@@ -636,10 +637,14 @@ class ChainAdapter:
         :meth:`svoc_tpu.consensus.state.OracleConsensusContract.update_predictions_batch`)
         when the remaining suffix is ≥ ``BATCH_COMMIT_THRESHOLD``;
         ``True``/``False`` force it on/off.
+
+        ``lineage`` tags the ``commit`` stage span with the fleet
+        block's lineage id (``svoc_tpu.utils.events``) so the span is
+        joinable into the block's audit record.
         """
         from svoc_tpu.utils.metrics import stage_span
 
-        with stage_span("commit"):
+        with stage_span("commit", lineage=lineage):
             return self._update_all_the_predictions(
                 predictions, batch=batch, start=start, skip=skip
             )

@@ -45,10 +45,17 @@ class PrefetchPipeline:
         depth: int = 2,
         device_put: Optional[Callable] = None,
         join_timeout_s: float = 5.0,
+        lineage: Optional[str] = None,
     ):
         self._source = iter(source)
         self._tokenizer = tokenizer
         self._seq_len = seq_len
+        #: Block lineage (``svoc_tpu.utils.events``): span lineage
+        #: inheritance is thread-local and the producer runs on its own
+        #: thread, so the caller passes the id explicitly and the
+        #: producer's tokenize/h2d spans (and any producer_error event)
+        #: stay joinable with the block that spawned the pipeline.
+        self._lineage = lineage
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._device_put = device_put
         self._error: Optional[BaseException] = None
@@ -81,10 +88,10 @@ class PrefetchPipeline:
                 if self._tokenizer is None:  # raw mode — item is ready
                     batch = texts
                 else:
-                    with stage_span("tokenize"):
+                    with stage_span("tokenize", lineage=self._lineage):
                         batch = self._tokenizer(list(texts), self._seq_len)
                 if self._device_put is not None:
-                    with stage_span("h2d"):
+                    with stage_span("h2d", lineage=self._lineage):
                         batch = self._device_put(batch)
                 self._produced += 1
                 self._produce_s += time.perf_counter() - t0
@@ -96,6 +103,18 @@ class PrefetchPipeline:
                         continue
         except BaseException as e:  # surfaced on the consumer side
             self._error = e
+            # Flight-recorder record (docs/OBSERVABILITY.md §events): a
+            # crashed producer is a first-class incident — the
+            # postmortem monitor auto-bundles on it — not just a stats()
+            # field nobody reads until the consumer re-raises.
+            from svoc_tpu.utils.events import journal as _journal
+
+            _journal.emit(
+                "pipeline.producer_error",
+                lineage=self._lineage,
+                error=repr(e),
+                produced=self._produced,
+            )
         finally:
             while not self._stop.is_set():
                 try:

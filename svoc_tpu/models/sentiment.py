@@ -252,18 +252,26 @@ class SentimentPipeline:
         return jax.jit(body)
 
     def call_packed(
-        self, texts: Sequence[str], max_segments: int = 8
+        self,
+        texts: Sequence[str],
+        max_segments: int = 8,
+        lineage: Optional[str] = None,
     ) -> np.ndarray:
         """Packed equivalent of ``__call__``: same ``[len(texts), M]``
         result, ~packing-factor fewer forward rows.  Row count is padded
-        to ``batch_size`` multiples so jit shapes stay fixed."""
+        to ``batch_size`` multiples so jit shapes stay fixed.
+
+        ``lineage`` tags the stage spans with a block lineage id
+        (``svoc_tpu.utils.events``); inside a ``fetch`` span the id is
+        inherited automatically, so only detached callers (serving
+        loops, tools) need to pass it."""
         from svoc_tpu.models.packing import pack_tokens_auto, strip_padding
 
         if not len(texts):
             return np.zeros((0, self.dimension))
-        with stage_span("tokenize"):
+        with stage_span("tokenize", lineage=lineage):
             ids, mask = self.tokenizer(list(texts), self.seq_len)
-        with stage_span("pack"):
+        with stage_span("pack", lineage=lineage):
             token_lists = strip_padding(ids, mask)
             batch, n = pack_tokens_auto(
                 token_lists, self.seq_len, max_segments, self.tokenizer.pad_id
@@ -287,7 +295,7 @@ class SentimentPipeline:
             # The span covers dispatch + the np.asarray host fetch that
             # was already here — no added device sync (deliberate
             # SVOC001 exception).
-            with stage_span("forward"):
+            with stage_span("forward", lineage=lineage):
                 vecs = np.asarray(forward(self.params, *chunk), dtype=np.float64)  # svoclint: disable=SVOC001
             valid = batch.seg_valid[sl] > 0
             out[batch.owner[sl][valid]] = vecs[:n_real][valid]
@@ -298,25 +306,28 @@ class SentimentPipeline:
             self._packed_cache = self.packed_forward_fn()
         return self._packed_cache
 
-    def __call__(self, texts: Sequence[str]) -> np.ndarray:
+    def __call__(
+        self, texts: Sequence[str], lineage: Optional[str] = None
+    ) -> np.ndarray:
         """``sentiment_analysis`` equivalent: pad to full batches, run
-        the jitted forward per chunk, return ``[len(texts), M]``."""
+        the jitted forward per chunk, return ``[len(texts), M]``.
+        ``lineage`` as in :meth:`call_packed`."""
         if self.packed:
-            return self.call_packed(texts, self.max_segments)
+            return self.call_packed(texts, self.max_segments, lineage=lineage)
         out = []
         b = self.batch_size
         for i in range(0, len(texts), b):
             chunk = list(texts[i : i + b])
             n_real = len(chunk)
             chunk += [""] * (b - n_real)  # fixed shapes — no recompiles
-            with stage_span("tokenize"):
+            with stage_span("tokenize", lineage=lineage):
                 ids, mask = self.tokenizer(chunk, self.seq_len)
             # No explicit device_put: the jitted forward's in_shardings
             # place the raw numpy batch shard-wise in one transfer.
             # The span covers dispatch + the np.asarray host fetch that
             # was already here — no added device sync (deliberate
             # SVOC001 exception).
-            with stage_span("forward"):
+            with stage_span("forward", lineage=lineage):
                 vecs = self._forward(self.params, ids, mask)
                 out.append(np.asarray(vecs[:n_real], dtype=np.float64))  # svoclint: disable=SVOC001
         return np.concatenate(out, axis=0) if out else np.zeros((0, self.dimension))

@@ -44,7 +44,7 @@ from svoc_tpu.parallel.sharded import fleet_consensus_shard_map
 from svoc_tpu.utils.metrics import stage_span
 
 
-def _traced_dispatch(fn, stage: str):
+def _traced_dispatch(fn, stage: str, lineage=None):
     """Wrap a jitted step so each call records a ``stage_seconds`` span.
 
     The span closes when dispatch returns — it measures host dispatch
@@ -53,11 +53,15 @@ def _traced_dispatch(fn, stage: str):
     loop's run-ahead.  Per-call overhead is sub-microsecond against a
     multi-ms step; end-to-end device throughput stays on the bench's
     host-fetch protocol (honest timing — ``bench.py`` module docs).
+
+    ``lineage`` tags every span from this wrapper with a block lineage
+    id (``svoc_tpu.utils.events``) — a factory-level constant, so the
+    hot path pays nothing beyond the span it already recorded.
     """
 
     @functools.wraps(fn)  # also sets __wrapped__ = fn for unwrapping
     def dispatch(*args, **kwargs):
-        with stage_span(stage):
+        with stage_span(stage, lineage=lineage):
             return fn(*args, **kwargs)
 
     return dispatch

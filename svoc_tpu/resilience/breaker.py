@@ -63,6 +63,7 @@ class CircuitBreaker:
         half_open_max_probes: int = 1,
         clock: Callable[[], float] = time.monotonic,
         registry: Optional[MetricsRegistry] = None,
+        journal=None,
     ):
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
@@ -72,6 +73,16 @@ class CircuitBreaker:
         self.half_open_max_probes = half_open_max_probes
         self._clock = clock
         self._registry = registry or _default_registry
+        #: Event journal (``svoc_tpu.utils.events``): every transition
+        #: emits ``breaker.transition`` — the flight-recorder twin of
+        #: the gauge, joinable with the commit events around it.  None
+        #: = process default journal.
+        self._journal = journal
+        #: Transitions recorded under the lock, emitted AFTER release:
+        #: journal subscribers (the postmortem trigger) may read
+        #: breaker state back, and emitting under ``self._lock`` would
+        #: deadlock that re-entry.
+        self._pending_events: list = []
         self._lock = threading.Lock()
         self._state = BREAKER_CLOSED
         self._consecutive_failures = 0
@@ -91,11 +102,33 @@ class CircuitBreaker:
     def _transition(self, state: str) -> None:
         if state == self._state:
             return
+        from_state = self._state
         self._state = state
         self._gauge.set(_STATE_VALUES[state])
         self._registry.counter(
             "breaker_transitions", labels={"backend": self.name, "to": state}
         ).add(1)
+        self._pending_events.append(
+            {
+                "backend": self.name,
+                "from": from_state,
+                "to": state,
+                "consecutive_failures": self._consecutive_failures,
+            }
+        )
+
+    def _flush_events(self) -> None:
+        """Emit queued transition events — callers must NOT hold
+        ``self._lock`` (journal subscribers may read breaker state)."""
+        with self._lock:
+            pending, self._pending_events = self._pending_events, []
+        if not pending:
+            return
+        j = self._journal
+        if j is None:
+            from svoc_tpu.utils.events import journal as j
+        for data in pending:
+            j.emit("breaker.transition", **data)
 
     # -- the public protocol ------------------------------------------------
 
@@ -107,32 +140,35 @@ class CircuitBreaker:
         """May the caller attempt the operation now?  Half-open probe
         slots are *claimed* by this call — a True answer must be
         followed by exactly one ``record_success``/``record_failure``."""
-        with self._lock:
-            if self._state == BREAKER_CLOSED:
-                return True
-            if self._state == BREAKER_OPEN:
-                if self._clock() - self._opened_at >= self.reset_timeout_s:
-                    self._transition(BREAKER_HALF_OPEN)
+        try:
+            with self._lock:
+                if self._state == BREAKER_CLOSED:
+                    return True
+                if self._state == BREAKER_OPEN:
+                    if self._clock() - self._opened_at >= self.reset_timeout_s:
+                        self._transition(BREAKER_HALF_OPEN)
+                        self._probes_in_flight = 0
+                        self._half_open_since = self._clock()
+                    else:
+                        return False
+                # half-open: admit up to the probe budget.  A probe whose
+                # caller died between allow() and record_* would otherwise
+                # wedge the breaker half-open with zero budget forever —
+                # after a full reset window with no verdict, reopen the
+                # probe window.
+                if (
+                    self._probes_in_flight >= self.half_open_max_probes
+                    and self._clock() - self._half_open_since
+                    >= self.reset_timeout_s
+                ):
                     self._probes_in_flight = 0
                     self._half_open_since = self._clock()
-                else:
-                    return False
-            # half-open: admit up to the probe budget.  A probe whose
-            # caller died between allow() and record_* would otherwise
-            # wedge the breaker half-open with zero budget forever —
-            # after a full reset window with no verdict, reopen the
-            # probe window.
-            if (
-                self._probes_in_flight >= self.half_open_max_probes
-                and self._clock() - self._half_open_since
-                >= self.reset_timeout_s
-            ):
-                self._probes_in_flight = 0
-                self._half_open_since = self._clock()
-            if self._probes_in_flight < self.half_open_max_probes:
-                self._probes_in_flight += 1
-                return True
-            return False
+                if self._probes_in_flight < self.half_open_max_probes:
+                    self._probes_in_flight += 1
+                    return True
+                return False
+        finally:
+            self._flush_events()
 
     def retry_after_s(self) -> float:
         """Seconds until the next half-open probe window (0 when the
@@ -149,6 +185,7 @@ class CircuitBreaker:
             self._consecutive_failures = 0
             self._probes_in_flight = 0
             self._transition(BREAKER_CLOSED)
+        self._flush_events()
 
     def record_failure(self) -> None:
         with self._lock:
@@ -164,6 +201,7 @@ class CircuitBreaker:
             ):
                 self._opened_at = self._clock()
                 self._transition(BREAKER_OPEN)
+        self._flush_events()
 
     def guard(self):
         """``with breaker.guard():`` — raises :class:`CircuitOpenError`

@@ -42,6 +42,7 @@ from svoc_tpu.resilience.supervisor import (
     FleetHealthSupervisor,
     SupervisorConfig,
 )
+from svoc_tpu.utils.events import EventJournal, mint_lineage
 from svoc_tpu.utils.metrics import MetricsRegistry
 
 
@@ -122,9 +123,12 @@ def run_chaos_scenario(
     #: that would (correctly, but out of scenario scope) quarantine it.
     transient_probability: float = 0.25,
     registry: Optional[MetricsRegistry] = None,
+    journal: Optional[EventJournal] = None,
 ) -> Dict[str, Any]:
     """Run the acceptance scenario once; returns the result summary
-    (``fingerprint`` is the replay witness)."""
+    (``fingerprint`` is the replay witness — since PR 5 it also folds
+    in the event-stream digest, so a replay must reproduce not just the
+    final state but the whole typed event journal, block by block)."""
     admins = [0xA0 + i for i in range(3)]
     oracles = [0x10 + i for i in range(n_oracles)]
     offender = oracles[-1]
@@ -154,23 +158,31 @@ def run_chaos_scenario(
     ticks = iter(range(10**9))
     clock = lambda: float(next(ticks))  # noqa: E731 — tiny local clock
     no_sleep = lambda s: None  # noqa: E731
+    # A FRESH journal per run (unless the caller supplies one): the
+    # event stream starts at seq 1, so two replays of one seed digest
+    # byte-identically — the flight-recorder acceptance criterion.
+    if journal is None:
+        journal = EventJournal(registry=registry)
     breaker = CircuitBreaker(
         "chaos",
         failure_threshold=10_000,
         reset_timeout_s=0.0,
         clock=clock,
         registry=registry,
+        journal=journal,
     )
     policy = RetryPolicy(
         max_attempts=4, base_s=0.0, cap_s=0.0, jitter_seed=seed
     )
     supervisor = FleetHealthSupervisor(
-        adapter, SupervisorConfig(), registry=registry
+        adapter, SupervisorConfig(), registry=registry, journal=journal
     )
 
     rng = np.random.default_rng(seed)
     outcomes: List[Dict[str, Any]] = []
     for cycle in range(cycles):
+        # One lineage id per commit cycle — the scenario's "block".
+        lineage = mint_lineage(cycle, prefix="cyc")
         predictions = rng.uniform(0.05, 0.95, size=(n_oracles, dimension))
         recorder.begin_cycle(cycle)
         outcome = commit_fleet_with_resume(
@@ -182,8 +194,10 @@ def run_chaos_scenario(
             clock=clock,
             on_oracle_failure=supervisor.record_commit_failure,
             registry=registry,
+            journal=journal,
+            lineage=lineage,
         )
-        report = supervisor.step()
+        report = supervisor.step(lineage=lineage)
         outcomes.append(
             {
                 "cycle": cycle,
@@ -196,6 +210,7 @@ def run_chaos_scenario(
         )
 
     final_oracles = contract.get_oracle_list()
+    journal_fingerprint = journal.fingerprint()
     return {
         "seed": seed,
         "cycles": cycles,
@@ -207,7 +222,12 @@ def run_chaos_scenario(
         "replacement_history": list(supervisor.replacements),
         "duplicate_txs": recorder.duplicate_txs,
         "faults_fired": len(plan.history()),
-        "fingerprint": _contract_fingerprint(contract, supervisor, plan),
+        "journal_events": journal.last_seq(),
+        "journal_fingerprint": journal_fingerprint,
+        "fingerprint": _contract_fingerprint(
+            contract, supervisor, plan,
+            extra={"journal": journal_fingerprint},
+        ),
     }
 
 
@@ -250,6 +270,7 @@ def run_byzantine_scenario(
     dimension: int = 6,
     injector_probability: float = 0.6,
     registry: Optional[MetricsRegistry] = None,
+    journal: Optional[EventJournal] = None,
 ) -> Dict[str, Any]:
     """The ISSUE-4 acceptance scenario: coordinated Byzantine values +
     a malformed-input injector against the full data-plane defense
@@ -297,11 +318,17 @@ def run_byzantine_scenario(
     )
     recorder = RecordingBackend(LocalChainBackend(contract))
     adapter = ChainAdapter(recorder)
-    gate = QuarantineGate(SanitizeConfig(lo=0.0, hi=1.0), registry=registry)
+    # Fresh journal per run (replay identity — see run_chaos_scenario).
+    if journal is None:
+        journal = EventJournal(registry=registry)
+    gate = QuarantineGate(
+        SanitizeConfig(lo=0.0, hi=1.0), registry=registry, journal=journal
+    )
     supervisor = FleetHealthSupervisor(
         adapter,
         SupervisorConfig(unhealthy_threshold=0.4),
         registry=registry,
+        journal=journal,
     )
     policy = RetryPolicy(max_attempts=4, base_s=0.0, cap_s=0.0, jitter_seed=seed)
     no_sleep = lambda s: None  # noqa: E731
@@ -318,6 +345,7 @@ def run_byzantine_scenario(
     outcomes: List[Dict[str, Any]] = []
 
     for cycle in range(cycles):
+        lineage = mint_lineage(cycle, prefix="cyc")
         fleet = adapter.call_oracle_list()
         predictions = np.zeros((len(fleet), dimension), dtype=np.float64)
         injected_slots: Dict[int, str] = {}
@@ -354,13 +382,16 @@ def run_byzantine_scenario(
                             "expected_reason": _EXPECTED_REASON[kind],
                         }
                     )
-        report = gate.inspect(predictions)
+        report = gate.inspect(predictions, lineage=lineage)
         for slot in report.quarantined_slots:
             reason = report.reasons[slot]
             quarantine_log.append(
                 {"cycle": cycle, "slot": slot, "reason": reason}
             )
-            supervisor.record_quarantine(fleet[slot], reason)
+            # The charge carries the cycle's lineage — the audit link
+            # the obs-smoke acceptance asserts (verdict → charge →
+            # replacement, one lineage id).
+            supervisor.record_quarantine(fleet[slot], reason, lineage=lineage)
             if slot not in injected_slots:
                 false_quarantines += 1
             elif reason != _EXPECTED_REASON[injected_slots[slot]]:
@@ -378,8 +409,10 @@ def run_byzantine_scenario(
             clock=clock,
             on_oracle_failure=supervisor.record_commit_failure,
             registry=registry,
+            journal=journal,
+            lineage=lineage,
         )
-        report_sup = supervisor.step()
+        report_sup = supervisor.step(lineage=lineage)
         if contract.consensus_active:
             essence = [from_wsad(x) for x in contract.get_consensus_value()]
             if not all(0.3 <= e <= 0.7 for e in essence):
@@ -396,7 +429,12 @@ def run_byzantine_scenario(
         )
 
     final_oracles = contract.get_oracle_list()
-    extra = {"injections": injection_log, "quarantines": quarantine_log}
+    journal_fingerprint = journal.fingerprint()
+    extra = {
+        "injections": injection_log,
+        "quarantines": quarantine_log,
+        "journal": journal_fingerprint,
+    }
     return {
         "seed": seed,
         "cycles": cycles,
@@ -413,6 +451,8 @@ def run_byzantine_scenario(
         "replacements": len(supervisor.replacements),
         "replacement_history": list(supervisor.replacements),
         "duplicate_txs": recorder.duplicate_txs,
+        "journal_events": journal.last_seq(),
+        "journal_fingerprint": journal_fingerprint,
         "fingerprint": _contract_fingerprint(
             contract, supervisor, extra=extra
         ),

@@ -162,6 +162,8 @@ def commit_fleet_with_resume(
     clock: Callable[[], float] = time.monotonic,
     on_oracle_failure: Optional[Callable[[Any, ChainCommitError], None]] = None,
     registry: Optional[MetricsRegistry] = None,
+    journal=None,
+    lineage: Optional[str] = None,
 ) -> CommitOutcome:
     """Commit the whole fleet, resuming across partial failures.
 
@@ -196,8 +198,16 @@ def commit_fleet_with_resume(
     cycle whose only anomalies were quarantined vectors still reports
     ``complete=True`` (the gate's health accounting, not the commit
     outcome, carries the refusal).
+
+    ``journal``/``lineage`` (``svoc_tpu.utils.events``): the commit's
+    story lands in the flight recorder as ``commit.sent`` /
+    ``commit.retried`` / ``commit.skipped`` / ``commit.failed`` events
+    tagged with the block lineage — the audit record's commit leg.
     """
     reg = registry or _default_registry
+    if journal is None:
+        from svoc_tpu.utils.events import journal
+
     deadline = (
         clock() + policy.overall_deadline_s
         if policy.overall_deadline_s is not None
@@ -205,6 +215,13 @@ def commit_fleet_with_resume(
     )
     delays = policy.delays()
     skip_set = frozenset(int(i) for i in skip)
+    if skip_set:
+        journal.emit(
+            "commit.skipped",
+            lineage=lineage,
+            reason="quarantine",
+            slots=sorted(skip_set),
+        )
     start = 0
     sent = 0
     attempts = 0
@@ -212,6 +229,13 @@ def commit_fleet_with_resume(
     stranded: List[Any] = []
     while True:
         if breaker is not None and not breaker.allow():
+            journal.emit(
+                "commit.failed",
+                lineage=lineage,
+                reason="circuit_open",
+                backend=breaker.name,
+                sent=sent,
+            )
             raise CircuitOpenError(
                 breaker.name, breaker.retry_after_s(), sent=sent
             )
@@ -219,7 +243,7 @@ def commit_fleet_with_resume(
         t0 = clock()
         try:
             n = adapter.update_all_the_predictions(
-                predictions, start=start, skip=skip
+                predictions, start=start, skip=skip, lineage=lineage
             )
         except ChainCommitError as e:
             if breaker is not None:
@@ -238,7 +262,8 @@ def commit_fleet_with_resume(
                     breaker.record_failure()
             if on_oracle_failure is not None:
                 on_oracle_failure(e.failed_oracle, e)
-            sent += _landed(e, start)
+            landed = _landed(e, start)
+            sent += landed
             j = e.committed  # absolute index of the failed oracle
             consecutive[j] = consecutive.get(j, 0) + 1
             if consecutive[j] >= policy.max_attempts:
@@ -246,6 +271,14 @@ def commit_fleet_with_resume(
                 # the rest of the fleet alive.
                 stranded.append(e.failed_oracle)
                 reg.counter("commit_stranded").add(1)
+                journal.emit(
+                    "commit.skipped",
+                    lineage=lineage,
+                    reason="stranded",
+                    index=j,
+                    oracle=e.failed_oracle,
+                    attempts=consecutive[j],
+                )
                 start = j + 1
                 if start >= e.total:
                     if breaker is not None and sent > 0:
@@ -253,6 +286,14 @@ def commit_fleet_with_resume(
                         # one dead oracle is the supervisor's problem,
                         # not a reason to open the backend breaker.
                         breaker.record_success()
+                    journal.emit(
+                        "commit.sent",
+                        lineage=lineage,
+                        sent=sent,
+                        total=e.total - len(skip_set),
+                        attempts=attempts,
+                        stranded=len(stranded),
+                    )
                     return CommitOutcome(
                         sent=sent,
                         # Eligible slots only: quarantine skips are
@@ -279,8 +320,26 @@ def commit_fleet_with_resume(
                 # sent) — carry the true landed-tx count alongside so
                 # callers' chain_transactions accounting stays honest.
                 e.resilient_sent = sent
+                journal.emit(
+                    "commit.failed",
+                    lineage=lineage,
+                    reason="deadline",
+                    index=j,
+                    oracle=e.failed_oracle,
+                    sent=sent,
+                    cause=str(e.cause),
+                )
                 raise
             reg.counter("retries", labels={"op": "commit"}).add(1)
+            journal.emit(
+                "commit.retried",
+                lineage=lineage,
+                index=j,
+                oracle=e.failed_oracle,
+                attempt=consecutive[j],
+                landed=landed,
+                cause=str(e.cause),
+            )
             if start > 0:
                 reg.counter("commit_resumes").add(1)
             sleep(delay)
@@ -293,6 +352,12 @@ def commit_fleet_with_resume(
             # leak, wedging the breaker half-open forever).
             if breaker is not None:
                 breaker.record_failure()
+            journal.emit(
+                "commit.failed",
+                lineage=lineage,
+                reason="transport",
+                sent=sent,
+            )
             raise
         else:
             if breaker is not None:
@@ -305,6 +370,14 @@ def commit_fleet_with_resume(
             # a skipped slot must not report the cycle incomplete: the
             # refusal is the gate's accounting, not the commit's).
             fleet_total = start + n + sum(1 for i in skip_set if i >= start)
+            journal.emit(
+                "commit.sent",
+                lineage=lineage,
+                sent=sent,
+                total=fleet_total - len(skip_set),
+                attempts=attempts,
+                stranded=len(stranded),
+            )
             return CommitOutcome(
                 sent=sent,
                 total=fleet_total - len(skip_set),

@@ -139,11 +139,16 @@ class FleetHealthSupervisor:
         *,
         new_address_factory: Callable[[Set[Any]], Any] = _default_address_factory,
         registry: Optional[MetricsRegistry] = None,
+        journal=None,
     ):
         self.adapter = adapter
         self.config = config or SupervisorConfig()
         self._new_address_factory = new_address_factory
         self._registry = registry or _default_registry
+        #: Event journal (``svoc_tpu.utils.events``): health folds,
+        #: quarantine charges, and replacement votes become typed
+        #: events joinable by block lineage.  None = process default.
+        self._journal = journal
         self._lock = threading.Lock()
         self._scores: Dict[Any, float] = {}
         self._streaks: Dict[Any, int] = {}
@@ -165,7 +170,17 @@ class FleetHealthSupervisor:
                 self._pending_failures.get(oracle_address, 0) + 1
             )
 
-    def record_quarantine(self, oracle_address: Any, reason: str) -> None:
+    def _emit(self, event_type: str, lineage: Optional[str] = None, **data):
+        """Journal emission — callers must not hold ``self._lock``
+        (subscribers may read supervisor snapshots back)."""
+        j = self._journal
+        if j is None:
+            from svoc_tpu.utils.events import journal as j
+        j.emit(event_type, lineage=lineage, **data)
+
+    def record_quarantine(
+        self, oracle_address: Any, reason: str, lineage: Optional[str] = None
+    ) -> None:
         """One input-integrity quarantine for this oracle (the gate in
         :mod:`svoc_tpu.robustness.sanitize` calls this when it refuses
         a vector).  Feeds the SAME pending-failure channel as
@@ -174,7 +189,10 @@ class FleetHealthSupervisor:
         ``quarantine_penalty`` so one refused vector per cycle matches
         the signal strength of an exhausted commit budget.  Counted
         into ``oracle_quarantine{reason=}`` (the gate counts its own
-        series too; this one is scoped to SUPERVISED refusals)."""
+        series too; this one is scoped to SUPERVISED refusals) and
+        journaled as ``supervisor.charge`` carrying the block lineage
+        that triggered it — the audit-record link between a quarantine
+        verdict and the replacement clock it advanced."""
         with self._lock:
             self._pending_failures[oracle_address] = (
                 self._pending_failures.get(oracle_address, 0)
@@ -183,14 +201,23 @@ class FleetHealthSupervisor:
         self._registry.counter(
             "oracle_quarantine_supervised", labels={"reason": reason}
         ).add(1)
+        self._emit(
+            "supervisor.charge",
+            lineage=lineage,
+            oracle=_addr_label(oracle_address),
+            reason=reason,
+            penalty=self.config.quarantine_penalty,
+        )
 
     # -- the supervision step ----------------------------------------------
 
-    def step(self) -> Dict[str, Any]:
+    def step(self, lineage: Optional[str] = None) -> Dict[str, Any]:
         """One fold: read chain signals, update scores + hysteresis,
         quarantine, and (when enabled) drive replacement votes.  Chain
         I/O happens OUTSIDE the score lock — a slow RPC must not block
-        ``record_commit_failure`` from the commit path."""
+        ``record_commit_failure`` from the commit path.  ``lineage``
+        tags the emitted ``supervisor.health`` / ``.replacement``
+        events with the block cycle that drove this fold."""
         adapter = self.adapter
         admins = adapter.call_admin_list()
         oracles = adapter.call_oracle_list()
@@ -268,16 +295,26 @@ class FleetHealthSupervisor:
 
         replaced: List[Dict[str, Any]] = []
         for old_addr in to_replace:
-            record = self._replace_oracle(old_addr)
+            record = self._replace_oracle(old_addr, lineage=lineage)
             if record is not None:
                 replaced.append(record)
-        return {
+        report = {
             "step": self._steps,
             "rel2": rel2,
             "scores": self.health_snapshot(),
             "quarantined": [_addr_label(a) for a in quarantined],
             "replaced": replaced,
         }
+        self._emit(
+            "supervisor.health",
+            lineage=lineage,
+            step=report["step"],
+            rel2=round(rel2, 6),
+            min_score=min(report["scores"].values(), default=1.0),
+            quarantined=report["quarantined"],
+            replaced=len(replaced),
+        )
+        return report
 
     def _export_gauges(self, oracles: List[Any]) -> None:
         # Callers hold self._lock.
@@ -295,7 +332,9 @@ class FleetHealthSupervisor:
 
     # -- the replacement vote flow ------------------------------------------
 
-    def _replace_oracle(self, old_addr: Any) -> Optional[Dict[str, Any]]:
+    def _replace_oracle(
+        self, old_addr: Any, lineage: Optional[str] = None
+    ) -> Optional[Dict[str, Any]]:
         """Drive the contract's own replacement machinery: admin 0
         proposes (self-vote), remaining admins vote yes until the swap
         lands.  Returns the history record, or None when replacement is
@@ -359,6 +398,14 @@ class FleetHealthSupervisor:
             self._streaks.pop(old_addr, None)
             self._scores[new_addr] = 1.0
         self._registry.counter("oracle_replacements").add(1)
+        self._emit(
+            "supervisor.replacement",
+            lineage=lineage,
+            step=record["step"],
+            slot=record["slot"],
+            old=record["old"],
+            new=record["new"],
+        )
         return record
 
     # -- read-only views (web UI / soak artifacts) --------------------------

@@ -205,15 +205,38 @@ class QuarantineGate:
         self,
         config: Optional[SanitizeConfig] = None,
         registry: Optional[MetricsRegistry] = None,
+        journal=None,
     ):
         self.config = config or SanitizeConfig()
         self._registry = registry or _default_registry
+        #: Event journal (``svoc_tpu.utils.events``): counted
+        #: inspections emit one ``quarantine.verdict`` event carrying
+        #: the block lineage, so the audit record can answer "which
+        #: verdict got this oracle charged".  None = process default.
+        self._journal = journal
 
-    def inspect(self, values: Sequence, *, count: bool = True) -> QuarantineReport:
+    def _resolve_journal(self):
+        if self._journal is not None:
+            return self._journal
+        from svoc_tpu.utils.events import journal as default_journal
+
+        return default_journal
+
+    def inspect(
+        self,
+        values: Sequence,
+        *,
+        count: bool = True,
+        lineage: Optional[str] = None,
+    ) -> QuarantineReport:
         """Classify every fleet slot; ``count=True`` (the once-per-fetch
         call) feeds ``oracle_quarantine{reason=}`` — re-inspections of
         the same block (the commit path's recheck of its snapshot) pass
-        ``count=False`` so the series stays one-event-one-count."""
+        ``count=False`` so the series stays one-event-one-count.
+        Counted inspections also emit the block's
+        ``quarantine.verdict`` journal event (tagged ``lineage``) and
+        feed ``quarantine_slots_inspected`` (the SLO admission-ratio
+        denominator)."""
         arr = np.asarray(values, dtype=np.float64)
         if arr.ndim == 1:
             arr = arr[None, :]
@@ -229,7 +252,19 @@ class QuarantineGate:
                     self._registry.counter(
                         "oracle_quarantine", labels={"reason": reason}
                     ).add(1)
-        return QuarantineReport(ok=ok, reasons=reasons)
+        report = QuarantineReport(ok=ok, reasons=reasons)
+        if count:
+            self._registry.counter("quarantine_slots_inspected").add(
+                arr.shape[0]
+            )
+            self._resolve_journal().emit(
+                "quarantine.verdict",
+                lineage=lineage,
+                admitted=int(np.sum(ok)),
+                total=int(arr.shape[0]),
+                reasons={str(s): r for s, r in sorted(reasons.items())},
+            )
+        return report
 
     @staticmethod
     def _classify(vec: np.ndarray, cfg: SanitizeConfig) -> Optional[str]:

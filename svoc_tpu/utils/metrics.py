@@ -311,7 +311,12 @@ def _prom_name(name: str) -> str:
 
 @dataclass(frozen=True)
 class SpanRecord:
-    """One completed span — what the ring buffer and JSONL trace hold."""
+    """One completed span — what the ring buffer and JSONL trace hold.
+
+    ``lineage`` is the block-lineage id (``svoc_tpu.utils.events``)
+    this span belongs to — set explicitly or inherited from the
+    enclosing span, so every stage of one fetched block is joinable
+    into its audit record."""
 
     name: str
     start_s: float  # epoch seconds (wall clock, for cross-process merge)
@@ -320,19 +325,21 @@ class SpanRecord:
     parent_id: Optional[int]
     thread: str
     depth: int
+    lineage: Optional[str] = None
 
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "name": self.name,
-                "start_s": round(self.start_s, 6),
-                "duration_s": round(self.duration_s, 6),
-                "span_id": self.span_id,
-                "parent_id": self.parent_id,
-                "thread": self.thread,
-                "depth": self.depth,
-            }
-        )
+        payload = {
+            "name": self.name,
+            "start_s": round(self.start_s, 6),
+            "duration_s": round(self.duration_s, 6),
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "thread": self.thread,
+            "depth": self.depth,
+        }
+        if self.lineage is not None:
+            payload["lineage"] = self.lineage
+        return json.dumps(payload)
 
 
 class Tracer:
@@ -353,8 +360,21 @@ class Tracer:
 
     Nesting is tracked per thread: a ``forward`` span opened inside a
     ``fetch`` span records ``fetch``'s id as its parent, so the JSONL
-    reconstructs the stage tree.  Thread-safe; span bodies of different
-    threads interleave freely.
+    reconstructs the stage tree.  Lineage propagates the same way: a
+    child span with no explicit ``lineage=`` inherits the enclosing
+    span's (set via ``span(..., lineage=)`` or
+    :meth:`annotate_lineage`), so every stage of one fetched block
+    carries the block's id without any per-callsite plumbing.
+    Thread-safe; span bodies of different threads interleave freely
+    (lineage does NOT cross threads — producer threads pass it
+    explicitly, e.g. ``PrefetchPipeline(lineage=...)``).
+
+    JSONL export shares the size-capped rotating writer of
+    :mod:`svoc_tpu.utils.events` (``SVOC_TRACE_MAX_BYTES`` /
+    ``SVOC_TRACE_KEEP``), so spans and events land in one bounded
+    flight-recorder file.  Write failures are SURFACED — the
+    ``trace_write_errors`` counter plus a one-shot
+    ``trace.write_error`` journal event — never silently dropped.
     """
 
     #: Env var consulted (per completion, so tests can monkeypatch it
@@ -366,9 +386,8 @@ class Tracer:
         self._ring: deque = deque(maxlen=capacity)
         self._ids = itertools.count(1)
         self._local = threading.local()
-        self._lock = threading.Lock()  # ring + file writes
+        self._lock = threading.Lock()  # ring + error latch
         self._trace_path: Optional[str] = None
-        self._trace_file = None
         self._trace_error = False
 
     # -- configuration ------------------------------------------------------
@@ -376,19 +395,17 @@ class Tracer:
     def set_trace_file(self, path: Optional[str]) -> None:
         """Pin (or clear, with None) the JSONL destination, overriding
         the env var.  The file opens lazily on the first completed span
-        and appends — a long session's traces survive restarts."""
+        and appends — a long session's traces survive restarts.
+        Clears the write-error latch so a repaired path resumes export,
+        and releases the previous destination's pooled file handle."""
         with self._lock:
-            self._close_file_locked()
+            old = self._resolve_path()
             self._trace_path = path
             self._trace_error = False
+        if old and old != path:
+            from svoc_tpu.utils.events import release_writer
 
-    def _close_file_locked(self) -> None:
-        if self._trace_file is not None:
-            try:
-                self._trace_file.close()
-            except OSError:
-                pass
-            self._trace_file = None
+            release_writer(old)
 
     def _resolve_path(self) -> Optional[str]:
         return self._trace_path or os.environ.get(self.TRACE_ENV) or None
@@ -402,12 +419,16 @@ class Tracer:
         return stack
 
     @contextlib.contextmanager
-    def span(self, name: str) -> Iterator[int]:
-        """Time a host-side stage; yields the span id (for tests/tools)."""
+    def span(self, name: str, lineage: Optional[str] = None) -> Iterator[int]:
+        """Time a host-side stage; yields the span id (for tests/tools).
+        ``lineage=None`` inherits the enclosing span's lineage."""
         stack = self._stack()
         span_id = next(self._ids)
-        parent = stack[-1] if stack else None
-        stack.append(span_id)
+        parent = stack[-1][0] if stack else None
+        if lineage is None and stack:
+            lineage = stack[-1][1]
+        entry = [span_id, lineage]
+        stack.append(entry)
         start_wall = time.time()
         t0 = time.perf_counter()
         try:
@@ -424,8 +445,26 @@ class Tracer:
                     parent_id=parent,
                     thread=threading.current_thread().name,
                     depth=len(stack),
+                    lineage=entry[1],
                 )
             )
+
+    def annotate_lineage(self, lineage: Optional[str]) -> bool:
+        """Attach a lineage id to the CURRENT thread's innermost open
+        span (and, through inheritance, every child opened after this
+        call).  Used where the id is only minted inside the span — e.g.
+        ``Session.fetch`` claims its window cursor after opening the
+        ``fetch`` span.  Returns False when no span is open."""
+        stack = self._stack()
+        if not stack:
+            return False
+        stack[-1][1] = lineage
+        return True
+
+    def current_lineage(self) -> Optional[str]:
+        """The innermost open span's effective lineage on this thread."""
+        stack = self._stack()
+        return stack[-1][1] if stack else None
 
     def _complete(self, record: SpanRecord) -> None:
         if self._registry is not None:
@@ -436,22 +475,35 @@ class Tracer:
         with self._lock:
             self._ring.append(record)
             if path is None:
-                self._close_file_locked()
                 self._trace_error = False
                 return
-            if self._trace_file is None and not self._trace_error:
-                try:
-                    self._trace_file = open(path, "a", buffering=1)
-                except OSError:
-                    # A bad path must never take down the pipeline —
-                    # disable export (until reconfigured), keep spans.
-                    self._trace_error = True
-            if self._trace_file is not None:
-                try:
-                    self._trace_file.write(record.to_json() + "\n")
-                except (OSError, ValueError):
-                    self._close_file_locked()
-                    self._trace_error = True
+            if self._trace_error:
+                return
+        try:
+            # Shared size-capped writer (svoc_tpu.utils.events): spans
+            # and events rotate as one flight-recorder file.  Imported
+            # lazily — events.py imports this module at load time.
+            from svoc_tpu.utils.events import shared_writer
+
+            shared_writer(path).write_line(record.to_json())
+        except (OSError, ValueError) as e:
+            # A bad path must never take down the pipeline — but it
+            # must not VANISH either (satellite fix): latch export off
+            # (until reconfigured), count every latch, and emit one
+            # warning event so the journal records why the trace went
+            # quiet.
+            with self._lock:
+                self._trace_error = True
+            reg = self._registry or registry
+            reg.counter("trace_write_errors").add(1)
+            try:
+                from svoc_tpu.utils import events as _events
+
+                _events.journal.emit(
+                    "trace.write_error", path=path, error=repr(e)
+                )
+            except Exception:
+                pass  # the journal's own export failing must not recurse
 
     def recent(self, n: Optional[int] = None) -> List[SpanRecord]:
         """The newest ``n`` spans (all buffered when ``n`` is None)."""
@@ -464,9 +516,13 @@ class Tracer:
             self._ring.clear()
 
     def flush(self) -> None:
-        """Close the JSONL file so every written line is durable."""
-        with self._lock:
-            self._close_file_locked()
+        """Flush the shared JSONL writer so every line is durable."""
+        path = self._resolve_path()
+        if path is None:
+            return
+        from svoc_tpu.utils.events import shared_writer
+
+        shared_writer(path).flush()
 
 
 class MetricsRegistry:
@@ -638,11 +694,12 @@ registry = MetricsRegistry()
 tracer = Tracer(registry)
 
 
-def stage_span(name: str):
+def stage_span(name: str, lineage: Optional[str] = None):
     """``with stage_span("forward"):`` — the one-liner every hot-path
     callsite uses: a span on the default tracer, feeding the shared
-    ``stage_seconds{stage=name}`` histogram in the default registry."""
-    return tracer.span(name)
+    ``stage_seconds{stage=name}`` histogram in the default registry.
+    ``lineage=None`` inherits the enclosing span's block lineage."""
+    return tracer.span(name, lineage=lineage)
 
 
 # --------------------------------------------------------------------------

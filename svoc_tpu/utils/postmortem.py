@@ -1,0 +1,280 @@
+"""Debug-bundle assembly and the auto-trigger monitor.
+
+When something breaks at 2 a.m. the evidence is spread over four
+subsystems — the event journal, the span ring, the metrics registry,
+and the session's resilience/robustness snapshots — and most of it
+lives in bounded rings that the NEXT hour of traffic will overwrite.
+A **postmortem bundle** freezes all of it into one atomically-written
+JSON file at the moment of the incident:
+
+- the journal tail (typed events, newest last), its counts-by-type and
+  fingerprint,
+- the span ring tail (with lineage ids, joinable against the events),
+- the metrics registry (counters, gauges, per-stage percentiles),
+- the SLO evaluator's burn-rate snapshot (when wired),
+- the session's resilience snapshot + configuration,
+- the relevant environment (``SVOC_*`` / ``JAX_*`` / ``XLA_*``).
+
+:func:`build_bundle` assembles one on demand (the ``tools/postmortem``
+CLI, tests, soak teardown); :class:`PostmortemMonitor` subscribes to a
+journal and builds one automatically on incident-class events —
+breaker-open transitions, quarantine spikes, ``interval_valid=False``
+consensus results, producer crashes — rate-limited and bounded so an
+incident storm produces a handful of bundles, not a disk full.
+
+Writes are atomic (tmp + ``os.replace``): a bundle either exists whole
+or not at all — half a postmortem is worse than none.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from svoc_tpu.utils.events import EventJournal, EventRecord
+from svoc_tpu.utils.events import journal as _default_journal
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_registry
+from svoc_tpu.utils.metrics import tracer as _default_tracer
+
+BUNDLE_FORMAT = "svoc-postmortem-v1"
+
+#: Keys a complete bundle must carry (``make obs-smoke`` asserts them).
+BUNDLE_KEYS = (
+    "format",
+    "built_at",
+    "trigger",
+    "journal",
+    "spans",
+    "metrics",
+    "slo",
+    "resilience",
+    "config",
+    "env",
+)
+
+_bundle_counter = iter(range(1, 10**9))
+_bundle_counter_lock = threading.Lock()
+
+
+def _next_bundle_id() -> int:
+    with _bundle_counter_lock:
+        return next(_bundle_counter)
+
+
+def _config_dict(config: Any) -> Optional[Dict[str, Any]]:
+    if config is None:
+        return None
+    if dataclasses.is_dataclass(config):
+        from svoc_tpu.utils.events import _json_safe
+
+        return _json_safe(dataclasses.asdict(config))
+    return {"repr": repr(config)}
+
+
+def build_bundle(
+    path: Optional[str] = None,
+    *,
+    out_dir: str = ".",
+    trigger: str = "manual",
+    trigger_event: Optional[Dict[str, Any]] = None,
+    session=None,
+    registry: Optional[MetricsRegistry] = None,
+    tracer=None,
+    journal: Optional[EventJournal] = None,
+    slo=None,
+    events_tail: int = 512,
+    spans_tail: int = 256,
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Assemble and atomically write one bundle; returns its path.
+
+    Everything defaults to the process-wide singletons; pass a
+    ``session`` to include its resilience snapshot and configuration,
+    and an ``slo`` evaluator to freeze the burn rates.
+    """
+    reg = registry or _default_registry
+    t = tracer if tracer is not None else _default_tracer
+    j = journal if journal is not None else _default_journal
+
+    counters = {key: c.count for key, c in sorted(reg.counters.items())}
+    gauges = {key: g.get() for key, g in sorted(reg.gauges.items())}
+    bundle: Dict[str, Any] = {
+        "format": BUNDLE_FORMAT,
+        "built_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "trigger": trigger,
+        "trigger_event": trigger_event,
+        "journal": {
+            "counts_by_type": j.counts_by_type(),
+            "last_seq": j.last_seq(),
+            "fingerprint": j.fingerprint(),
+            "events": [e.as_dict() for e in j.recent(events_tail)],
+        },
+        "spans": [
+            {
+                "name": s.name,
+                "start_s": round(s.start_s, 6),
+                "duration_s": round(s.duration_s, 6),
+                "span_id": s.span_id,
+                "parent_id": s.parent_id,
+                "thread": s.thread,
+                "lineage": getattr(s, "lineage", None),
+            }
+            for s in t.recent(spans_tail)
+        ],
+        "metrics": {
+            "stage_seconds": reg.stage_snapshot(),
+            "counters": counters,
+            "gauges": gauges,
+        },
+        "slo": None,
+        "resilience": None,
+        "config": None,
+        "env": {
+            k: v
+            for k, v in sorted(os.environ.items())
+            if k.startswith(("SVOC_", "JAX_", "XLA_"))
+        },
+    }
+    if slo is not None:
+        try:
+            bundle["slo"] = slo.evaluate()
+        except Exception as e:
+            bundle["slo"] = {"error": repr(e)}
+    if session is not None:
+        try:
+            bundle["resilience"] = session.resilience_snapshot()
+        except Exception as e:
+            bundle["resilience"] = {"error": repr(e)}
+        bundle["config"] = _config_dict(getattr(session, "config", None))
+    if extra:
+        bundle["extra"] = extra
+
+    if path is None:
+        path = os.path.join(
+            out_dir,
+            f"postmortem-{trigger.replace('/', '_')}-{_next_bundle_id():03d}.json",
+        )
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(bundle, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+class PostmortemMonitor:
+    """Auto-trigger: subscribe to a journal and bundle on incidents.
+
+    Classification (docs/OBSERVABILITY.md §postmortem):
+
+    - ``breaker.transition`` with ``to="open"`` — the chain was just
+      declared down,
+    - ``quarantine.verdict`` refusing ≥ ``quarantine_spike`` slots in
+      one block — an upstream data incident,
+    - ``consensus.result`` with ``interval_valid=False`` — the block
+      could not produce a meaningful interval,
+    - ``pipeline.producer_error`` — the prefetch producer crashed,
+    - any ``crash`` event (emitters may report their own).
+
+    Rate-limited (``min_interval_s`` between bundles) and bounded
+    (``max_bundles`` lifetime) so an incident storm cannot fill the
+    disk; every bundle built is itself journaled as
+    ``postmortem.bundle`` (which the classifier ignores — no
+    recursion).  Callbacks run on the EMITTING thread, so bundle
+    assembly is bounded ring/registry reads only — no chain I/O.
+    """
+
+    def __init__(
+        self,
+        out_dir: str = ".",
+        *,
+        session=None,
+        registry: Optional[MetricsRegistry] = None,
+        tracer=None,
+        journal: Optional[EventJournal] = None,
+        slo=None,
+        quarantine_spike: int = 3,
+        min_interval_s: float = 60.0,
+        max_bundles: int = 8,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.out_dir = out_dir
+        self._session = session
+        self._registry = registry
+        self._tracer = tracer
+        self._journal = journal if journal is not None else _default_journal
+        self._slo = slo
+        self.quarantine_spike = quarantine_spike
+        self.min_interval_s = min_interval_s
+        self.max_bundles = max_bundles
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_built: Optional[float] = None
+        #: Paths of every bundle this monitor built (soak artifacts).
+        self.bundles: List[str] = []
+
+    def install(self) -> "PostmortemMonitor":
+        self._journal.subscribe(self._on_event)
+        return self
+
+    def uninstall(self) -> None:
+        self._journal.unsubscribe(self._on_event)
+
+    def classify(self, record: EventRecord) -> Optional[str]:
+        """The trigger name for an incident-class event, else None."""
+        if record.type == "breaker.transition" and record.data.get("to") == "open":
+            return "breaker_open"
+        if record.type == "quarantine.verdict":
+            refused = int(record.data.get("total", 0) or 0) - int(
+                record.data.get("admitted", 0) or 0
+            )
+            if refused >= self.quarantine_spike:
+                return "quarantine_spike"
+        if (
+            record.type == "consensus.result"
+            and record.data.get("interval_valid") is False
+        ):
+            return "interval_invalid"
+        if record.type == "pipeline.producer_error":
+            return "producer_error"
+        if record.type == "crash":
+            return "crash"
+        return None
+
+    def _on_event(self, record: EventRecord) -> None:
+        trigger = self.classify(record)
+        if trigger is None:
+            return
+        now = self._clock()
+        with self._lock:
+            if len(self.bundles) >= self.max_bundles:
+                return
+            if (
+                self._last_built is not None
+                and now - self._last_built < self.min_interval_s
+            ):
+                return
+            self._last_built = now
+        path = build_bundle(
+            out_dir=self.out_dir,
+            trigger=trigger,
+            trigger_event=record.as_dict(),
+            session=self._session,
+            registry=self._registry,
+            tracer=self._tracer,
+            journal=self._journal,
+            slo=self._slo,
+        )
+        with self._lock:
+            self.bundles.append(path)
+        (self._registry or _default_registry).counter(
+            "postmortem_bundles", labels={"trigger": trigger}
+        ).add(1)
+        self._journal.emit(
+            "postmortem.bundle", lineage=record.lineage,
+            trigger=trigger, path=path,
+        )

@@ -1,0 +1,726 @@
+"""Flight recorder: journal, rotation, lineage, SSE typed frames,
+postmortem bundles, SLO burn rates (docs/OBSERVABILITY.md §events).
+
+Covers the ISSUE-5 acceptance surface: journal thread-safety and
+bounded-ring semantics, replay-stable fingerprints (wall time never
+participates), the shared span/event JSONL rotation, lineage
+propagation end-to-end through a tiny pipeline (fetch → quarantine →
+resilient commit with one injected fault → audit record complete), the
+``/api/events?journal=1`` typed-frame stream and ``/api/audit``
+endpoint, bundle round-trips with the auto-trigger monitor, and the
+burn-rate math fixtures.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from svoc_tpu.utils.events import (
+    ALERT_TYPES,
+    EventJournal,
+    RotatingJsonlWriter,
+    audit_record,
+    mint_lineage,
+)
+from svoc_tpu.utils.metrics import MetricsRegistry, Tracer
+
+
+# ---------------------------------------------------------------------------
+# journal semantics
+# ---------------------------------------------------------------------------
+
+
+class TestEventJournal:
+    def test_emit_and_recent_with_filters(self):
+        j = EventJournal(MetricsRegistry())
+        j.emit("block.fetched", lineage="blk-000001", n_comments=30)
+        j.emit("commit.sent", lineage="blk-000001", sent=7)
+        j.emit("block.fetched", lineage="blk-000002", n_comments=31)
+        assert [e.seq for e in j.recent()] == [1, 2, 3]
+        assert [e.type for e in j.recent(type="block.fetched")] == [
+            "block.fetched",
+            "block.fetched",
+        ]
+        assert [e.seq for e in j.recent(lineage="blk-000001")] == [1, 2]
+        # the tail cut applies AFTER the filter
+        assert [e.seq for e in j.recent(1, lineage="blk-000001")] == [2]
+        assert j.last_seq() == 3
+        assert j.counts_by_type() == {"block.fetched": 2, "commit.sent": 1}
+
+    def test_since_is_a_cursor(self):
+        j = EventJournal(MetricsRegistry())
+        for i in range(5):
+            j.emit("x", i=i)
+        assert [e.seq for e in j.since(2)] == [3, 4, 5]
+        assert [e.seq for e in j.since(2, limit=2)] == [3, 4]
+        assert j.since(5) == []
+
+    def test_ring_is_bounded(self):
+        j = EventJournal(MetricsRegistry(), capacity=8)
+        for i in range(50):
+            j.emit("x", i=i)
+        events = j.recent()
+        assert len(events) == 8
+        assert events[-1].seq == 50
+
+    def test_data_is_json_safe(self):
+        j = EventJournal(MetricsRegistry())
+        rec = j.emit(
+            "x",
+            a=np.int64(3),
+            b=np.float32(0.5),
+            c=(1, 2),
+            d={"k": {4, 5}},
+            e=object(),
+        )
+        json.loads(rec.to_json())  # must not raise
+        assert rec.data["a"] == 3
+        assert rec.data["c"] == [1, 2]
+        assert rec.data["d"]["k"] == [4, 5]
+        assert isinstance(rec.data["e"], str)
+
+    def test_thread_safety_unique_seqs(self):
+        j = EventJournal(MetricsRegistry(), capacity=4096)
+        n_threads, per_thread = 8, 100
+
+        def worker(tid):
+            for i in range(per_thread):
+                j.emit("x", tid=tid, i=i)
+
+        threads = [
+            threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        events = j.recent()
+        assert len(events) == n_threads * per_thread
+        seqs = [e.seq for e in events]
+        assert len(set(seqs)) == len(seqs)  # no lost/duplicated seq
+        # STRICT ring ordering: seq allocation happens under the same
+        # lock hold as the append, or a preempted emitter could land a
+        # lower seq after a higher one and the SSE cursor
+        # (`since(last_seq)`) would re-send duplicate frames.
+        assert seqs == sorted(seqs)
+        assert j.counts_by_type() == {"x": n_threads * per_thread}
+
+    def test_fingerprint_ignores_wall_time(self, monkeypatch):
+        import svoc_tpu.utils.events as ev
+
+        j1 = EventJournal(MetricsRegistry())
+        j2 = EventJournal(MetricsRegistry())
+        times = iter([100.0, 200.0, 5000.0, 6000.0, 7000.0])
+        monkeypatch.setattr(ev.time, "time", lambda: next(times))
+        for j in (j1, j2):
+            j.emit("block.fetched", lineage="blk-000001", n=1)
+            j.emit("commit.sent", lineage="blk-000001", sent=7)
+        assert j1.recent()[0].ts != j2.recent()[0].ts
+        assert j1.fingerprint() == j2.fingerprint()
+        j2.emit("commit.failed")
+        assert j1.fingerprint() != j2.fingerprint()
+
+    def test_subscriber_runs_and_errors_are_contained(self):
+        reg = MetricsRegistry()
+        j = EventJournal(reg)
+        seen = []
+
+        def good(rec):
+            seen.append(rec.type)
+
+        def bad(rec):
+            raise RuntimeError("boom")
+
+        j.subscribe(bad)
+        j.subscribe(good)
+        j.emit("x")
+        assert seen == ["x"]
+        assert reg.counter("event_subscriber_errors").count == 1
+        j.unsubscribe(good)
+        j.emit("y")
+        assert seen == ["x"]
+
+    def test_summary_counts_alerts_fingerprint(self):
+        j = EventJournal(MetricsRegistry())
+        j.emit("block.fetched")
+        j.emit("slo.alert", slo="commit_success")
+        j.emit("breaker.transition", to="open", backend="chain")
+        j.emit("breaker.transition", to="closed", backend="chain")
+        s = j.summary(last_alerts=5)
+        assert s["events"] == 4
+        assert s["counts_by_type"]["breaker.transition"] == 2
+        alert_types = [a["event"] for a in s["alerts"]]
+        assert "slo.alert" in alert_types
+        # breaker transitions: only →open is alert-class
+        assert (
+            sum(1 for a in s["alerts"] if a["event"] == "breaker.transition")
+            == 1
+        )
+        assert s["fingerprint"] == j.fingerprint()
+        assert "slo.alert" in ALERT_TYPES
+
+
+# ---------------------------------------------------------------------------
+# rotation (shared by spans and events)
+# ---------------------------------------------------------------------------
+
+
+class TestRotation:
+    def test_writer_rotates_and_keeps_k_segments(self, tmp_path):
+        reg = MetricsRegistry()
+        path = str(tmp_path / "trace.jsonl")
+        w = RotatingJsonlWriter(path, max_bytes=200, keep=2, registry=reg)
+        for i in range(60):
+            w.write_line(json.dumps({"i": i, "pad": "x" * 24}))
+        segs = w.segments()
+        assert segs == [path, path + ".1", path + ".2"]
+        for seg in segs:
+            assert os.path.getsize(seg) <= 200 + 64
+        # No segment beyond keep.
+        assert not os.path.exists(path + ".3")
+        gauge = reg.gauge(
+            "trace_file_bytes", labels={"path": "trace.jsonl"}
+        )
+        assert gauge.get() == os.path.getsize(path)
+        # every surviving line still parses
+        for seg in segs:
+            for line in open(seg):
+                json.loads(line)
+
+    def test_writer_accounts_bytes_not_chars(self, tmp_path):
+        """Multibyte payloads must count their UTF-8 bytes — counting
+        str length would let a segment blow the documented byte cap
+        ~4× on CJK/emoji content."""
+        reg = MetricsRegistry()
+        path = str(tmp_path / "trace.jsonl")
+        w = RotatingJsonlWriter(path, max_bytes=400, keep=1, registry=reg)
+        line = json.dumps({"text": "你好世界" * 40}, ensure_ascii=False)
+        assert len(line) < 400 < len(line.encode("utf-8"))
+        for _ in range(6):
+            w.write_line(line)
+        for seg in w.segments():
+            assert os.path.getsize(seg) <= 400 + len(line.encode()) + 1
+
+    def test_set_trace_file_releases_old_writer_handle(self, tmp_path):
+        from svoc_tpu.utils.events import shared_writer
+
+        reg = MetricsRegistry()
+        t = Tracer(reg)
+        old = str(tmp_path / "old.jsonl")
+        t.set_trace_file(old)
+        with t.span("fetch"):
+            pass
+        writer = shared_writer(old)
+        assert writer._file is not None  # handle open after the write
+        t.set_trace_file(str(tmp_path / "new.jsonl"))
+        assert writer._file is None  # released; reopens lazily if written
+
+    def test_tracer_and_journal_share_rotating_file(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "flight.jsonl")
+        monkeypatch.setenv(Tracer.TRACE_ENV, path)
+        reg = MetricsRegistry()
+        t = Tracer(reg)
+        j = EventJournal(reg)
+        with t.span("fetch", lineage="blk-000001"):
+            pass
+        j.emit("block.fetched", lineage="blk-000001", n=1)
+        t.flush()
+        lines = [json.loads(line) for line in open(path)]
+        assert {"name" in rec or "event" in rec for rec in lines} == {True}
+        span_lines = [rec for rec in lines if "name" in rec]
+        event_lines = [rec for rec in lines if "event" in rec]
+        assert span_lines[0]["lineage"] == "blk-000001"
+        assert event_lines[0]["lineage"] == "blk-000001"
+
+    def test_trace_write_error_is_surfaced_not_silent(self, tmp_path):
+        """Satellite fix: a failing trace path bumps
+        ``trace_write_errors`` and emits one ``trace.write_error``
+        event instead of latching an invisible flag."""
+        from svoc_tpu.utils import events as ev
+
+        reg = MetricsRegistry()
+        t = Tracer(reg)
+        bad = str(tmp_path / "no" / "such" / "dir" / "t.jsonl")
+        t.set_trace_file(bad)
+        before_events = len(ev.journal.recent(type="trace.write_error"))
+        with t.span("fetch"):
+            pass  # must not raise
+        assert len(t.recent()) == 1  # span survived
+        assert reg.counter("trace_write_errors").count == 1
+        events = ev.journal.recent(type="trace.write_error")
+        assert len(events) == before_events + 1
+        assert bad in str(events[-1].data.get("path"))
+        # the latch is one-shot: further spans don't re-count
+        with t.span("fetch"):
+            pass
+        assert reg.counter("trace_write_errors").count == 1
+        # reconfiguring clears the latch
+        good = str(tmp_path / "ok.jsonl")
+        t.set_trace_file(good)
+        with t.span("fetch"):
+            pass
+        t.flush()
+        assert os.path.exists(good)
+
+
+# ---------------------------------------------------------------------------
+# lineage propagation
+# ---------------------------------------------------------------------------
+
+
+class TestLineage:
+    def test_mint_is_deterministic(self):
+        assert mint_lineage(31) == "blk-00001f"
+        assert mint_lineage(4, prefix="cyc") == "cyc-000004"
+
+    def test_span_inheritance_and_annotation(self):
+        t = Tracer(MetricsRegistry())
+        with t.span("fetch"):
+            assert t.current_lineage() is None
+            assert t.annotate_lineage("blk-000003")
+            assert t.current_lineage() == "blk-000003"
+            with t.span("vectorize"):
+                with t.span("tokenize"):
+                    pass
+            with t.span("fleet", lineage="blk-override"):
+                pass
+        by_name = {s.name: s for s in t.recent()}
+        assert by_name["fetch"].lineage == "blk-000003"
+        assert by_name["vectorize"].lineage == "blk-000003"
+        assert by_name["tokenize"].lineage == "blk-000003"
+        assert by_name["fleet"].lineage == "blk-override"
+        # no open span → annotate is a no-op returning False
+        assert t.annotate_lineage("x") is False
+
+    def test_lineage_does_not_leak_across_threads(self):
+        t = Tracer(MetricsRegistry())
+        got = {}
+
+        def worker():
+            with t.span("tokenize"):
+                got["lineage"] = t.current_lineage()
+
+        with t.span("fetch", lineage="blk-000009"):
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+        assert got["lineage"] is None
+
+    def test_prefetch_pipeline_tags_producer_spans_and_errors(self):
+        from svoc_tpu.io.pipeline import PrefetchPipeline
+        from svoc_tpu.utils import events as ev
+        from svoc_tpu.utils.metrics import tracer as default_tracer
+
+        def tokenizer(texts, seq_len):
+            if texts[0] == "crash":
+                raise RuntimeError("tokenizer exploded")
+            return np.zeros((len(texts), seq_len)), np.ones((len(texts), seq_len))
+
+        pipe = PrefetchPipeline(
+            [["a", "b"], ["crash"]], tokenizer, 8, lineage="blk-00000a"
+        )
+        with pytest.raises(RuntimeError):
+            for _ in pipe:
+                pass
+        pipe.close()
+        spans = [
+            s
+            for s in default_tracer.recent()
+            if s.name == "tokenize" and s.lineage == "blk-00000a"
+        ]
+        assert spans, "producer tokenize span missing its lineage"
+        errors = ev.journal.recent(
+            type="pipeline.producer_error", lineage="blk-00000a"
+        )
+        assert errors and "tokenizer exploded" in errors[-1].data["error"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: fetch → quarantine → resilient commit → audit record
+# ---------------------------------------------------------------------------
+
+
+def _event_types(journal, lineage, after_seq=0):
+    return {
+        e.type
+        for e in journal.recent(lineage=lineage)
+        if e.seq > after_seq
+    }
+
+
+class TestAuditEndToEnd:
+    def test_tiny_pipeline_audit_record_complete(self):
+        """fetch → (poisoned slot) quarantine → resilient commit with
+        one injected transient fault → the audit record joins every leg
+        on the block's lineage id."""
+        from svoc_tpu.io.chain import ChainAdapter
+        from svoc_tpu.resilience.faults import (
+            FaultInjectingBackend,
+            FaultPlan,
+            FaultSpec,
+        )
+        from svoc_tpu.utils import events as ev
+        from tests.test_apps import make_session
+
+        session = make_session()
+        # One transient commit fault on oracle 0x12 (slot 2), exactly
+        # once — forces a commit.retried + resume on the same block.
+        plan = FaultPlan(
+            seed=1,
+            specs=[
+                FaultSpec(
+                    op="invoke:update_prediction",
+                    target=0x12,
+                    probability=1.0,
+                    max_fires=1,
+                )
+            ],
+            registry=MetricsRegistry(),
+        )
+        session.adapter = ChainAdapter(
+            FaultInjectingBackend(session.adapter.backend, plan)
+        )
+        session.supervisor.adapter = session.adapter
+
+        before = ev.journal.last_seq()
+        session.fetch()
+        lineage = session.last_lineage
+        assert lineage is not None
+        # Poison one slot AFTER the (clean) fetch verdict: the commit
+        # path re-inspects its snapshot, skips the slot, and charges
+        # the oracle — all under the same block lineage.
+        with session.lock:
+            session.predictions[0, :] = np.nan
+        outcome = session.commit_resilient()
+        # 6 eligible slots (7 − 1 quarantined), all landed: 1 tx before
+        # the injected fault, 5 on the resumed second attempt.
+        assert outcome.sent == 6 and outcome.attempts == 2
+        assert outcome.complete
+
+        types = _event_types(ev.journal, lineage, after_seq=before)
+        assert {
+            "block.fetched",
+            "quarantine.verdict",
+            "consensus.result",
+            "commit.skipped",
+            "commit.retried",
+            "commit.sent",
+            "supervisor.charge",
+        } <= types
+
+        record = session.audit()
+        assert record["found"] and record["lineage"] == lineage
+        summary = record["summary"]
+        assert summary["commit_sent"] == 6
+        assert summary["commit_skipped"] >= 1
+        assert summary["commit_retries"] == 1
+        assert summary["charged"] == ["0x10"]
+        assert summary["interval_valid"] is True
+        # spans joined on the same id
+        span_names = {s["name"] for s in record["spans"]}
+        assert {"fetch", "consensus", "commit"} <= span_names
+
+    def test_audit_record_unknown_lineage(self):
+        rec = audit_record("blk-ffffff")
+        assert rec["found"] is False and rec["events"] == []
+
+    def test_scenario_journal_fingerprints_replay(self):
+        """Chaos + Byzantine scenarios now fold the event stream into
+        their replay witness (cheap versions of `make obs-smoke`)."""
+        from svoc_tpu.resilience.chaos import run_chaos_scenario
+
+        r1 = run_chaos_scenario(cycles=4, registry=MetricsRegistry())
+        r2 = run_chaos_scenario(cycles=4, registry=MetricsRegistry())
+        assert r1["journal_events"] > 0
+        assert r1["journal_fingerprint"] == r2["journal_fingerprint"]
+        assert r1["fingerprint"] == r2["fingerprint"]
+
+
+# ---------------------------------------------------------------------------
+# web surfaces: typed SSE frames + the audit endpoint
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def server():
+    from svoc_tpu.apps.commands import CommandConsole
+    from svoc_tpu.apps.web import serve
+    from tests.test_apps import make_session
+
+    console = CommandConsole(make_session())
+    srv, _thread = serve(console, port=0, block=False)
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield base, console
+    srv.shutdown()
+
+
+class TestWebSurfaces:
+    def test_audit_endpoint_roundtrip_and_404(self, server):
+        base, console = server
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(f"{base}/api/audit/blk-ffffff", timeout=10)
+        assert exc_info.value.code == 404
+        console.session.fetch()
+        lineage = console.session.last_lineage
+        with urllib.request.urlopen(
+            f"{base}/api/audit/{lineage}", timeout=10
+        ) as r:
+            record = json.loads(r.read())
+        assert record["lineage"] == lineage and record["found"]
+        assert any(e["event"] == "block.fetched" for e in record["events"])
+
+    def test_events_stream_typed_journal_frames_opt_in(self, server):
+        """?journal=1 streams named `event: journal` frames for new
+        events; the unnamed state_version frames are unchanged."""
+        base, console = server
+        with urllib.request.urlopen(
+            f"{base}/api/events?journal=1", timeout=10
+        ) as r:
+
+            def next_frame():
+                name = None
+                while True:
+                    line = r.readline().decode()
+                    if line.startswith("event: "):
+                        name = line[7:].strip()
+                    elif line.startswith("data: "):
+                        return name, json.loads(line[6:])
+
+            name, first = next_frame()
+            assert name is None and "state_version" in first
+            console.session.fetch()  # emits journal events + bumps state
+            seen_types = set()
+            saw_state_frame = False
+            for _ in range(12):
+                name, payload = next_frame()
+                if name == "journal":
+                    seen_types.add(payload["event"])
+                    assert "seq" in payload
+                elif "state_version" in payload:
+                    saw_state_frame = True
+                if "block.fetched" in seen_types and saw_state_frame:
+                    break
+            assert "block.fetched" in seen_types
+            assert saw_state_frame
+
+    def test_plain_events_stream_has_no_named_frames(self, server):
+        base, console = server
+        with urllib.request.urlopen(f"{base}/api/events", timeout=10) as r:
+            # initial frame
+            while True:
+                line = r.readline().decode()
+                if line.startswith("data: "):
+                    break
+            console.session.fetch()
+            # next frame must be the unnamed state_version push
+            while True:
+                line = r.readline().decode()
+                if not line.strip() or line.startswith(":"):
+                    continue
+                assert not line.startswith("event: ")
+                if line.startswith("data: "):
+                    assert "state_version" in json.loads(line[6:])
+                    break
+
+
+# ---------------------------------------------------------------------------
+# postmortem bundles
+# ---------------------------------------------------------------------------
+
+
+class TestPostmortem:
+    def test_bundle_roundtrip_completeness(self, tmp_path):
+        from svoc_tpu.utils.postmortem import BUNDLE_KEYS, build_bundle
+        from tests.test_apps import make_session
+
+        session = make_session()
+        session.fetch()
+        session.commit()
+        path = build_bundle(
+            out_dir=str(tmp_path), trigger="manual", session=session
+        )
+        with open(path) as f:
+            bundle = json.load(f)
+        for key in BUNDLE_KEYS:
+            assert key in bundle, key
+        assert bundle["format"] == "svoc-postmortem-v1"
+        assert bundle["journal"]["fingerprint"]
+        assert any(
+            e["event"] == "commit.sent" for e in bundle["journal"]["events"]
+        )
+        assert bundle["resilience"]["breaker"] == "closed"
+        assert bundle["config"]["n_oracles"] == 7
+        assert "stage_seconds" in bundle["metrics"]
+        assert not os.path.exists(path + ".tmp")  # atomic write
+
+    def test_monitor_triggers_on_breaker_open_and_rate_limits(self, tmp_path):
+        from svoc_tpu.utils.postmortem import PostmortemMonitor
+
+        reg = MetricsRegistry()
+        j = EventJournal(reg)
+        clock_now = [0.0]
+        monitor = PostmortemMonitor(
+            out_dir=str(tmp_path),
+            registry=reg,
+            journal=j,
+            min_interval_s=60.0,
+            max_bundles=2,
+            clock=lambda: clock_now[0],
+        ).install()
+        try:
+            j.emit("breaker.transition", to="open", backend="chain")
+            assert len(monitor.bundles) == 1
+            with open(monitor.bundles[0]) as f:
+                bundle = json.load(f)
+            assert bundle["trigger"] == "breaker_open"
+            assert bundle["trigger_event"]["event"] == "breaker.transition"
+            # journaled, and the bundle event does not re-trigger
+            assert j.recent(type="postmortem.bundle")
+            # rate limit: a second incident inside the window is skipped
+            j.emit("breaker.transition", to="open", backend="chain")
+            assert len(monitor.bundles) == 1
+            # ... but fires after the window
+            clock_now[0] = 61.0
+            j.emit("breaker.transition", to="open", backend="chain")
+            assert len(monitor.bundles) == 2
+            # lifetime cap
+            clock_now[0] = 200.0
+            j.emit("breaker.transition", to="open", backend="chain")
+            assert len(monitor.bundles) == 2
+        finally:
+            monitor.uninstall()
+
+    def test_monitor_classification(self, tmp_path):
+        from svoc_tpu.utils.events import EventRecord
+        from svoc_tpu.utils.postmortem import PostmortemMonitor
+
+        m = PostmortemMonitor(out_dir=str(tmp_path), journal=EventJournal())
+
+        def rec(type_, **data):
+            return EventRecord(1, 0.0, type_, None, data)
+
+        assert m.classify(rec("breaker.transition", to="open")) == "breaker_open"
+        assert m.classify(rec("breaker.transition", to="closed")) is None
+        assert (
+            m.classify(rec("quarantine.verdict", total=7, admitted=3))
+            == "quarantine_spike"
+        )
+        assert m.classify(rec("quarantine.verdict", total=7, admitted=6)) is None
+        assert (
+            m.classify(rec("consensus.result", interval_valid=False))
+            == "interval_invalid"
+        )
+        assert m.classify(rec("consensus.result", interval_valid=True)) is None
+        assert m.classify(rec("pipeline.producer_error")) == "producer_error"
+        assert m.classify(rec("crash")) == "crash"
+        assert m.classify(rec("postmortem.bundle")) is None
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rates
+# ---------------------------------------------------------------------------
+
+
+class TestSLO:
+    def _evaluator(self, sample, **kwargs):
+        from svoc_tpu.utils.slo import SLODefinition, SLOEvaluator
+
+        reg = MetricsRegistry()
+        j = EventJournal(reg)
+        clock_now = [0.0]
+        slo = SLODefinition(
+            name="test",
+            description="fixture",
+            objective=kwargs.pop("objective", 0.99),
+            sample=sample,
+            fast_window_s=kwargs.pop("fast_window_s", 300.0),
+            slow_window_s=kwargs.pop("slow_window_s", 3600.0),
+            **kwargs,
+        )
+        ev = SLOEvaluator([slo], registry=reg, journal=j, clock=lambda: clock_now[0])
+        return ev, reg, j, clock_now
+
+    def test_burn_rate_math(self):
+        """100 events with 10 % errors against a 1 % budget → burn 10×."""
+        state = {"good": 0.0, "total": 0.0}
+        ev, reg, _j, clock = self._evaluator(
+            lambda: (state["good"], state["total"])
+        )
+        ev.evaluate()  # baseline at t=0
+        clock[0] = 100.0
+        state["good"], state["total"] = 90.0, 100.0
+        snap = ev.evaluate()["test"]
+        assert snap["fast"]["error_rate"] == pytest.approx(0.1)
+        assert snap["fast"]["burn"] == pytest.approx(10.0)
+        assert reg.gauge(
+            "slo_burn_rate", labels={"slo": "test", "window": "fast"}
+        ).get() == pytest.approx(10.0)
+
+    def test_windows_differ_fast_recovers(self):
+        """Errors burn the fast window, then a clean fast window decays
+        to zero while the slow window still remembers them."""
+        state = {"good": 0.0, "total": 0.0}
+        ev, _reg, _j, clock = self._evaluator(
+            lambda: (state["good"], state["total"]),
+            fast_window_s=100.0,
+            slow_window_s=1000.0,
+        )
+        ev.evaluate()
+        clock[0] = 50.0
+        state["good"], state["total"] = 50.0, 100.0  # 50% errors
+        snap = ev.evaluate()["test"]
+        assert snap["fast"]["burn"] == pytest.approx(50.0)
+        # 400 s later: a clean window of traffic
+        clock[0] = 450.0
+        state["good"], state["total"] = 250.0, 300.0
+        snap = ev.evaluate()["test"]
+        assert snap["fast"]["error_rate"] == pytest.approx(0.0)
+        assert snap["slow"]["error_rate"] == pytest.approx(50 / 300, rel=1e-4)
+
+    def test_no_traffic_is_zero_burn(self):
+        ev, _reg, _j, clock = self._evaluator(lambda: (0.0, 0.0))
+        snap = ev.evaluate()["test"]
+        assert snap["fast"]["burn"] == 0.0 and not snap["alerting"]
+
+    def test_alert_emitted_once_and_latched(self):
+        state = {"good": 0.0, "total": 0.0}
+        ev, reg, j, clock = self._evaluator(
+            lambda: (state["good"], state["total"]),
+            objective=0.9,
+            fast_burn_alert=2.0,
+            slow_burn_alert=1.0,
+        )
+        ev.evaluate()
+        clock[0] = 10.0
+        state["good"], state["total"] = 10.0, 100.0  # 90% errors, budget 10%
+        snap = ev.evaluate()["test"]
+        assert snap["alerting"]
+        assert len(j.recent(type="slo.alert")) == 1
+        assert reg.counter("slo_alerts", labels={"slo": "test"}).count == 1
+        # still alerting next pass → latched, no duplicate event
+        clock[0] = 20.0
+        state["good"], state["total"] = 11.0, 110.0
+        assert ev.evaluate()["test"]["alerting"]
+        assert len(j.recent(type="slo.alert")) == 1
+        assert ev.alerting() == ["test"]
+
+    def test_default_slos_shape(self):
+        from svoc_tpu.utils.slo import default_slos
+
+        reg = MetricsRegistry()
+        slos = default_slos(reg)
+        assert [s.name for s in slos] == [
+            "commit_success",
+            "consensus_latency",
+            "quarantine_admission",
+        ]
+        # latency source: bucketized good/total from the histogram
+        reg.stage_histogram("consensus").observe(0.01)
+        reg.stage_histogram("consensus").observe(10.0)
+        good, total = slos[1].sample()
+        assert total == 2.0 and good == 1.0

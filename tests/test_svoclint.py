@@ -615,8 +615,71 @@ def test_svoc006_applies_to_web_module_by_path():
 
 
 # ---------------------------------------------------------------------------
-# suppressions
+# SVOC007 — event-in-traced-body
 # ---------------------------------------------------------------------------
+
+
+def test_svoc007_flags_emit_event_in_jit_body():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            from svoc_tpu.utils.events import emit_event
+
+            @jax.jit
+            def step(x):
+                emit_event("consensus.result", n=1)
+                return x + 1
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC007"]
+    assert "trace time" in findings[0].message
+    assert "host" in findings[0].hint
+
+
+def test_svoc007_flags_journal_emit_method_in_jit_body():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            from svoc_tpu.utils.events import journal
+
+            @jax.jit
+            def step(x):
+                journal.emit("commit.sent", sent=1)
+                return x * 2
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC007"]
+
+
+def test_svoc007_negative_emission_around_dispatch():
+    """Host-side emission around the jitted call — the documented
+    pattern — and unrelated `.emit()` methods on non-journal objects
+    must not flag."""
+    findings = analyze_source(
+        src(
+            """
+            import jax
+            from svoc_tpu.utils.events import emit_event
+
+            @jax.jit
+            def step(x):
+                return x + 1
+
+            def commit(x):
+                y = step(x)
+                emit_event("commit.sent", sent=1)
+                return y
+
+            def unrelated(sound):
+                sound.emit("beep")  # not a journal root
+            """
+        )
+    )
+    assert rules_of(findings) == []
 
 
 def test_inline_suppression_silences_one_rule_on_one_line():
@@ -911,7 +974,7 @@ def test_whole_package_run_is_clean_and_fast():
 
 
 def test_every_documented_rule_has_a_registered_doc():
-    assert sorted(RULE_DOCS) == [f"SVOC00{i}" for i in range(1, 7)]
+    assert sorted(RULE_DOCS) == [f"SVOC00{i}" for i in range(1, 8)]
     for doc in RULE_DOCS.values():
         assert doc["severity"] in ("error", "warning")
 
@@ -954,6 +1017,10 @@ _INJECTED = {
     "SVOC006": (
         "# svoclint: tag=thread-entry\n_state = {}\n\ndef h(k, v):\n"
         "    _state[k] = v\n"
+    ),
+    "SVOC007": (
+        "import jax\nfrom svoc_tpu.utils.events import emit_event\n\n"
+        "@jax.jit\ndef f(x):\n    emit_event('x')\n    return x\n"
     ),
 }
 

@@ -392,8 +392,14 @@ class TestMetricsEndpoint:
                 snap = after[stage]
                 assert snap["p50"] <= snap["p95"] <= snap["p99"]
             records = [
-                json.loads(line)
-                for line in trace_path.read_text().strip().splitlines()
+                rec
+                for rec in (
+                    json.loads(line)
+                    for line in trace_path.read_text().strip().splitlines()
+                )
+                # PR 5: the flight-recorder file interleaves event
+                # lines — spans are the ones keyed by `name`.
+                if "name" in rec
             ]
             by_name = {}
             for rec in records:
@@ -422,16 +428,24 @@ class TestMetricsEndpoint:
         session.fetch()
         session.commit()
         tracer.flush()
-        records = [
+        lines = [
             json.loads(line)
             for line in trace_path.read_text().strip().splitlines()
         ]
+        # The flight-recorder file interleaves span lines (`name`) with
+        # event lines (`event`) since PR 5 — both must parse; spans are
+        # the subject here.
+        records = [rec for rec in lines if "name" in rec]
+        events = [rec for rec in lines if "event" in rec]
+        assert any(e["event"] == "block.fetched" for e in events)
         names = {rec["name"] for rec in records}
         for stage in ("fetch", "vectorize", "fleet", "consensus", "commit"):
             assert stage in names, f"no JSONL span for {stage}"
         ids = {rec["span_id"]: rec for rec in records}
         vec = next(rec for rec in records if rec["name"] == "vectorize")
         assert ids[vec["parent_id"]]["name"] == "fetch"
+        # lineage joins spans to the block's events
+        assert vec["lineage"] == session.last_lineage
 
     def test_metrics_command_matches_endpoint(self, server):
         """The console's `metrics prom` dump and the /metrics scrape are

@@ -1,0 +1,238 @@
+"""Flight-recorder smoke: journal replay identity + audit linkage +
+bundle completeness as a CI gate.
+
+Three legs (wired into ``make obs-smoke`` / ``presnapshot`` /
+``verify``; seconds on CPU, no transformer builds):
+
+1. **Journal replay identity** — the seeded Byzantine scenario
+   (:func:`svoc_tpu.resilience.chaos.run_byzantine_scenario`) runs
+   TWICE with fresh journals; the two event streams must digest
+   byte-identically (``journal_fingerprint``), not just the outcomes.
+2. **Audit linkage** — some one lineage id in the scenario's journal
+   must link a refusing ``quarantine.verdict``, a
+   ``supervisor.charge``, and a ``supervisor.replacement`` — the
+   "which block got this oracle voted out" acceptance criterion.
+3. **Bundle completeness + session lineage** — a seeded mini-session
+   (synthetic store, fake vectorizer) runs fetch → commit; its journal
+   must carry ``block.fetched`` / ``quarantine.verdict`` /
+   ``consensus.result`` / ``commit.sent`` all on the block's lineage,
+   the audit record must join events AND spans on that id, and a
+   postmortem bundle built from the live singletons must carry every
+   section (``BUNDLE_KEYS``) and read back as valid JSON.
+
+Usage::
+
+    python tools/obs_smoke.py [--seed 0] [--out OBS_SMOKE.json]
+"""
+
+from __future__ import annotations
+
+import os
+
+# Off-TPU by construction (the axon sitecustomize pins the platform, so
+# go through jax.config too — tools/soak.py measurement postmortem).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+import sys  # noqa: E402
+import tempfile  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _audit_linkage(journal) -> dict:
+    """Find a lineage linking verdict → charge → replacement."""
+    by_lineage: dict = {}
+    for e in journal.recent():
+        if e.lineage is not None:
+            by_lineage.setdefault(e.lineage, []).append(e)
+    for lineage, events in by_lineage.items():
+        has_verdict = any(
+            e.type == "quarantine.verdict" and e.data.get("reasons")
+            for e in events
+        )
+        charges = [e for e in events if e.type == "supervisor.charge"]
+        replacements = [
+            e for e in events if e.type == "supervisor.replacement"
+        ]
+        if has_verdict and charges and replacements:
+            return {
+                "lineage": lineage,
+                "charged": sorted({str(c.data.get("oracle")) for c in charges}),
+                "replaced": [
+                    {"slot": r.data.get("slot"), "old": r.data.get("old")}
+                    for r in replacements
+                ],
+            }
+    return {}
+
+
+def _session_leg(out_dir: str) -> dict:
+    """Leg 3: seeded mini-session fetch+commit, audit + bundle."""
+    import numpy as np
+
+    from svoc_tpu.apps.session import Session, SessionConfig
+    from svoc_tpu.io.comment_store import CommentStore
+    from svoc_tpu.io.scraper import SyntheticSource
+    from svoc_tpu.utils.events import journal
+    from svoc_tpu.utils.postmortem import BUNDLE_KEYS, build_bundle
+
+    def fake_vectorizer(texts):
+        rng = np.random.default_rng(len(texts))
+        v = rng.uniform(0.05, 0.95, size=(len(texts), 6))
+        return v / v.sum(axis=1, keepdims=True)
+
+    store = CommentStore()
+    store.save(SyntheticSource(batch=120)())
+    session = Session(
+        config=SessionConfig(), store=store, vectorizer=fake_vectorizer
+    )
+    seq_before = journal.last_seq()
+    session.fetch()
+    outcome = session.commit_resilient()
+    session.supervisor_step()
+    slo = session.slo_snapshot()
+    lineage = session.last_lineage
+
+    block_events = {
+        e.type for e in journal.recent(lineage=lineage) if e.seq > seq_before
+    }
+    needed = {
+        "block.fetched",
+        "quarantine.verdict",
+        "consensus.result",
+        "commit.sent",
+    }
+    audit = session.audit()
+    bundle_path = build_bundle(
+        out_dir=out_dir, trigger="obs_smoke", session=session
+    )
+    with open(bundle_path) as f:
+        bundle = json.load(f)
+    return {
+        "lineage": lineage,
+        "committed": outcome.sent,
+        "commit_complete": bool(outcome.complete),
+        "block_event_types": sorted(block_events),
+        "missing_event_types": sorted(needed - block_events),
+        "audit_found": bool(audit.get("found")),
+        "audit_spans": len(audit.get("spans") or []),
+        "audit_commit_sent": audit.get("summary", {}).get("commit_sent"),
+        "slo_names": sorted(slo),
+        "bundle_path": bundle_path,
+        "bundle_missing_keys": sorted(
+            k for k in BUNDLE_KEYS if k not in bundle
+        ),
+        "bundle_journal_events": len(bundle["journal"]["events"]),
+    }
+
+
+def _overhead_leg() -> dict:
+    """A/B sanity: journal emission and lineage-tagged spans must stay
+    in the PR-1 span cost class (microseconds — host-side, no device
+    sync).  The bound is deliberately loose (1 ms/op mean) so a loaded
+    CI box cannot flake it; the measured numbers land in the artifact
+    for trend reading."""
+    import time
+
+    from svoc_tpu.utils.events import EventJournal
+    from svoc_tpu.utils.metrics import MetricsRegistry, Tracer
+
+    n = 5000
+    reg = MetricsRegistry()
+    j = EventJournal(reg, capacity=256)
+    t0 = time.perf_counter()
+    for i in range(n):
+        j.emit("commit.sent", lineage="blk-000001", sent=7, total=7)
+    emit_us = (time.perf_counter() - t0) / n * 1e6
+
+    tracer = Tracer(reg)
+    t0 = time.perf_counter()
+    for i in range(n):
+        with tracer.span("consensus"):
+            pass
+    span_us = (time.perf_counter() - t0) / n * 1e6
+    t0 = time.perf_counter()
+    for i in range(n):
+        with tracer.span("consensus", lineage="blk-000001"):
+            pass
+    span_lineage_us = (time.perf_counter() - t0) / n * 1e6
+    return {
+        "emit_us_mean": round(emit_us, 3),
+        "span_us_mean": round(span_us, 3),
+        "span_lineage_us_mean": round(span_lineage_us, 3),
+        "within_bounds": emit_us < 1000.0 and span_lineage_us < 1000.0,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="OBS_SMOKE.json")
+    args = p.parse_args(argv)
+
+    from svoc_tpu.resilience.chaos import run_byzantine_scenario
+    from svoc_tpu.utils.events import EventJournal
+    from svoc_tpu.utils.metrics import MetricsRegistry
+
+    # Legs 1–2: the Byzantine scenario, twice, with fresh journals.
+    j1 = EventJournal(MetricsRegistry())
+    first = run_byzantine_scenario(args.seed, registry=MetricsRegistry(), journal=j1)
+    j2 = EventJournal(MetricsRegistry())
+    second = run_byzantine_scenario(args.seed, registry=MetricsRegistry(), journal=j2)
+    linkage = _audit_linkage(j1)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        session_leg = _session_leg(tmp)
+    overhead = _overhead_leg()
+
+    checks = {
+        "journal_replay_identical": (
+            first["journal_fingerprint"] == second["journal_fingerprint"]
+        ),
+        "scenario_replay_identical": (
+            first["fingerprint"] == second["fingerprint"]
+        ),
+        "journal_nonempty": first["journal_events"] > 0,
+        "audit_links_verdict_charge_replacement": bool(linkage),
+        "session_block_events_complete": not session_leg["missing_event_types"],
+        "session_audit_found": session_leg["audit_found"],
+        "session_audit_has_spans": session_leg["audit_spans"] > 0,
+        "session_commit_complete": session_leg["commit_complete"],
+        "bundle_complete": not session_leg["bundle_missing_keys"],
+        "slo_evaluated": len(session_leg["slo_names"]) == 3,
+        "overhead_within_bounds": overhead["within_bounds"],
+    }
+    ok = all(checks.values())
+    artifact = {
+        "seed": args.seed,
+        "checks": checks,
+        "ok": ok,
+        "journal_fingerprint": first["journal_fingerprint"],
+        "journal_events": first["journal_events"],
+        "audit_linkage": linkage,
+        "session": session_leg,
+        "overhead": overhead,
+    }
+    with open(args.out + ".tmp", "w") as f:
+        json.dump(artifact, f, indent=1)
+    os.replace(args.out + ".tmp", args.out)
+    print(
+        json.dumps(
+            {
+                "obs_smoke": "ok" if ok else "FAILED",
+                "seed": args.seed,
+                "checks": checks,
+                "journal_events": first["journal_events"],
+                "linkage": linkage,
+                "journal_fingerprint": first["journal_fingerprint"][:16],
+            }
+        ),
+        flush=True,
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""postmortem — dump a flight-recorder debug bundle on demand.
+
+Assembles everything the process-wide observability singletons hold —
+journal tail, span ring, metrics registry, environment — into one
+atomically-written JSON bundle (``svoc_tpu.utils.postmortem``).  Run it
+from a REPL/debug session next to a live framework process, or import
+:func:`svoc_tpu.utils.postmortem.build_bundle` and pass the session for
+the resilience/config sections.
+
+Usage::
+
+    python tools/postmortem.py [--out-dir .] [--trigger manual]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out-dir", default=".")
+    p.add_argument("--trigger", default="manual")
+    p.add_argument(
+        "--events-tail", type=int, default=512, help="journal events to embed"
+    )
+    p.add_argument(
+        "--spans-tail", type=int, default=256, help="spans to embed"
+    )
+    args = p.parse_args(argv)
+
+    from svoc_tpu.utils.postmortem import build_bundle
+
+    path = build_bundle(
+        out_dir=args.out_dir,
+        trigger=args.trigger,
+        events_tail=args.events_tail,
+        spans_tail=args.spans_tail,
+    )
+    print(f"postmortem bundle written: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -192,6 +192,17 @@ def main(argv=None) -> int:
     console_lines = []
     console = CommandConsole(session, write=console_lines.append)
 
+    # Flight recorder (docs/OBSERVABILITY.md §events): the soak rides
+    # the process-wide journal; the postmortem monitor auto-bundles on
+    # incident-class events (breaker open, quarantine spike, invalid
+    # interval) so a failing soak leaves evidence beyond the snapshots.
+    from svoc_tpu.utils.events import journal
+    from svoc_tpu.utils.postmortem import PostmortemMonitor
+
+    monitor = PostmortemMonitor(
+        out_dir=".", session=session, max_bundles=4
+    ).install()
+
     baseline_threads = threading.active_count()
     t0 = time.time()
     artifact = {
@@ -276,6 +287,14 @@ def main(argv=None) -> int:
                 "consensus_active": bool(
                     session.adapter.cache.get("consensus_active")
                 ),
+                # Flight-recorder pulse: total journaled events + the
+                # live SLO alert count, so the snapshot series shows
+                # WHEN the story turned, not just how fast it ran.
+                "journal_events": journal.last_seq(),
+                "slo_alerts": registry.family_total("slo_alerts"),
+                "trace_write_errors": registry.counter(
+                    "trace_write_errors"
+                ).count,
             }
             artifact["snapshots"].append(snap)
             flush()
@@ -354,6 +373,12 @@ def main(argv=None) -> int:
             ).count,
             "breaker_state": session.breaker.state(),
             "replacement_history": list(session.supervisor.replacements),
+            # Journal digest (counts by type, last alerts, fingerprint)
+            # + any auto-built postmortem bundles: the artifact answers
+            # "what happened", not just "how fast" (ISSUE 5 satellite).
+            "journal": journal.summary(),
+            "slo": session.slo_step(),
+            "postmortem_bundles": list(monitor.bundles),
             "chaos_seed": args.chaos_seed,
             "rss_mb_first_quarter_median": rss_first,
             "rss_mb_last_quarter_median": rss_last,
