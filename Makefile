@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke fabric-smoke clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -82,10 +82,18 @@ robustness-cert:
 obs-smoke:
 	$(PY) tools/obs_smoke.py
 
+# Multi-claim fabric gate (docs/FABRIC.md): the seeded 4-claim ×
+# 7-oracle scenario twice — byte-identical PER-CLAIM journal
+# fingerprints (replay covers the scheduler interleaving, not just
+# the math), one claim's Byzantine offender quarantined and replaced
+# without touching sibling claims.  Seconds on CPU.
+fabric-smoke:
+	$(PY) tools/fabric_smoke.py
+
 # The default verify path: the cheap static gate first, then the chaos
 # convergence gates (I/O-plane, then data-plane), then the flight
 # recorder, then the suite.
-verify: lint chaos-smoke robustness-smoke obs-smoke test
+verify: lint chaos-smoke robustness-smoke obs-smoke fabric-smoke test
 
 # End-of-round gate: lint + the driver-contract guards FIRST (fast,
 # loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
@@ -96,6 +104,7 @@ presnapshot:
 	$(MAKE) chaos-smoke
 	$(MAKE) robustness-smoke
 	$(MAKE) obs-smoke
+	$(MAKE) fabric-smoke
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_graft_entry.py tests/test_bench.py -q
 	$(MAKE) test

@@ -2311,6 +2311,111 @@ CONFIGS = {
 }
 
 
+def bench_claims(n_claims: int, seconds: float, platform: str) -> dict:
+    """Claim-cube consensus sweep (docs/FABRIC.md): ONE batched gated
+    dispatch over the padded ``[C, N, M]`` cube
+    (:func:`svoc_tpu.consensus.batch.claims_consensus_gated`) vs the
+    sequential per-claim loop of the single-claim gated kernel — the
+    dispatch/fetch overhead a claim-at-a-time server pays C times per
+    cycle and the fabric pays once.  Both sides follow the harness's
+    host-fetch timing protocol (one checksum fetch per timed iteration,
+    so the clock never stops before results reach the host), and the
+    batched outputs are parity-checked against the loop in-run.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from svoc_tpu.consensus.batch import (
+        claims_consensus_gated,
+        pad_claim_cube,
+    )
+    from svoc_tpu.consensus.kernel import ConsensusConfig, jit_consensus_gated
+
+    n_oracles, dim = 7, 6
+    cfg = ConsensusConfig()
+    rng = np.random.default_rng(0)
+    values = rng.uniform(0.0, 1.0, size=(n_claims, n_oracles, dim)).astype(
+        np.float32
+    )
+    ok = np.ones((n_claims, n_oracles), dtype=bool)
+    # Some claims carry a quarantined slot so the gated masking does
+    # real per-claim work (the fabric's steady state, not the all-clean
+    # special case).
+    ok[:: max(1, n_claims // 8), -1] = False
+    padded, ok_padded, claim_mask = pad_claim_cube(values, ok)
+    vj, oj, mj = (
+        jnp.asarray(padded),
+        jnp.asarray(ok_padded),
+        jnp.asarray(claim_mask),
+    )
+    per_claim_v = [jnp.asarray(values[c]) for c in range(n_claims)]
+    per_claim_ok = [jnp.asarray(ok[c]) for c in range(n_claims)]
+    step = jit_consensus_gated(cfg)
+
+    # Warmup compiles + in-run parity: the batched essences must match
+    # the per-claim loop before any number is reported.
+    batched_out = claims_consensus_gated(vj, oj, mj, cfg)
+    looped = [step(per_claim_v[c], per_claim_ok[c]) for c in range(n_claims)]
+    batched_essence = np.asarray(batched_out.essence)[:n_claims]
+    looped_essence = np.stack([np.asarray(o.essence) for o in looped])
+    parity = float(np.max(np.abs(batched_essence - looped_essence)))
+    if parity > 1e-5:
+        raise RuntimeError(
+            f"claim-cube parity broke before timing: max |Δessence| {parity}"
+        )
+
+    window_s = max(1.0, seconds / 2)
+
+    def timed(loop_body) -> tuple:
+        iters, checksum = 0, 0.0
+        t0 = time.perf_counter()
+        deadline = t0 + window_s
+        while time.perf_counter() < deadline:
+            checksum += loop_body()
+            iters += 1
+        return iters, time.perf_counter() - t0, checksum
+
+    def batched_body() -> float:
+        out = claims_consensus_gated(vj, oj, mj, cfg)
+        return float(jnp.sum(out.essence))  # host fetch stops the clock
+
+    def sequential_body() -> float:
+        # C dispatches, ONE host fetch (generous to the loop: the real
+        # per-claim server also fetches per claim).
+        total = None
+        for c in range(n_claims):
+            out = step(per_claim_v[c], per_claim_ok[c])
+            s = jnp.sum(out.essence)
+            total = s if total is None else total + s
+        return float(total)
+
+    b_iters, b_elapsed, b_checksum = timed(batched_body)
+    s_iters, s_elapsed, s_checksum = timed(sequential_body)
+    batched_cps = n_claims * b_iters / b_elapsed
+    sequential_cps = n_claims * s_iters / s_elapsed
+    return {
+        "metric": f"claim-cube consensus {n_claims}x{n_oracles}x{dim}",
+        "value": round(batched_cps, 2),
+        "unit": "claims/sec",
+        "vs_baseline": None,
+        "detail": {
+            "n_claims": n_claims,
+            "n_oracles": n_oracles,
+            "dimension": dim,
+            "bucket": int(padded.shape[0]),
+            "batched_claims_per_s": round(batched_cps, 2),
+            "sequential_claims_per_s": round(sequential_cps, 2),
+            "speedup": round(batched_cps / sequential_cps, 3),
+            "batched_iters": b_iters,
+            "sequential_iters": s_iters,
+            "parity_max_abs_diff": parity,
+            "checksums": [round(b_checksum, 3), round(s_checksum, 3)],
+        },
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -2334,8 +2439,49 @@ def main(argv=None) -> int:
             "collected results to BENCH_ALL.json"
         ),
     )
+    parser.add_argument(
+        "--claims",
+        type=int,
+        default=0,
+        metavar="N",
+        help=(
+            "claim-cube sweep (docs/FABRIC.md): ONE batched gated "
+            "consensus dispatch over [N, 7, 6] vs the sequential "
+            "per-claim loop; reports claims/sec and the speedup"
+        ),
+    )
     args = parser.parse_args(argv)
     small = os.environ.get("SVOC_BENCH_SMALL") == "1"
+
+    if args.claims:
+        # Pure consensus-kernel sweep: tiny blocks, no transformer, no
+        # small-mode shrink or campaign replay needed — CPU smoke
+        # numbers are the acceptance unit (ISSUE 6).
+        platform, fallback_reason = resolve_backend()
+        try:
+            _pin_platform(platform)
+            result = bench_claims(args.claims, args.seconds, platform)
+            if fallback_reason:
+                result["detail"]["backend_fallback"] = fallback_reason
+            emit(result)
+            return 0
+        except Exception as e:
+            import traceback
+
+            emit(
+                {
+                    "metric": f"claim-cube consensus {args.claims}",
+                    "value": None,
+                    "unit": "claims/sec",
+                    "vs_baseline": None,
+                    "error": f"{type(e).__name__}: {e}",
+                    "backend": platform,
+                    "trace_tail": traceback.format_exc()
+                    .strip()
+                    .splitlines()[-3:],
+                }
+            )
+            return 1
 
     if args.all:
         # Per-config wall clock: a wedged backend must cost one config,

@@ -49,6 +49,8 @@ HELP = """Commands:
     - audit [lineage] (per-block audit record — events, spans, and a
       summary joined on one lineage id; default: the last fetch)
     - slo (declarative objectives as fast/slow burn rates)
+    - claims (multi-claim fabric status: per-claim cycles, consensus
+      validity, replacements, lineage — docs/FABRIC.md)
     - multimodal [K|auto] (mixture analysis of the last fetch;
       default K=2, 'auto' selects K by BIC)
 
@@ -95,6 +97,11 @@ class CommandConsole:
     ):
         self.session = session or Session()
         self._write = write
+        #: Multi-claim fabric (docs/FABRIC.md): set by
+        #: ``MultiSession.attach`` — the ``claims`` command and
+        #: ``/api/state``'s ``claims`` section read it.  None = the
+        #: single-claim console of PRs 1–5, unchanged.
+        self.fabric = None
         self._auto_fetch_thread: Optional[threading.Thread] = None
         self._scraper_stop: Optional[threading.Event] = None
         self._scraper_thread: Optional[threading.Thread] = None
@@ -516,6 +523,39 @@ class CommandConsole:
                     f"  events: {len(record['events'])}, "
                     f"spans: {len(record['spans'])}"
                 )
+            elif cmd == "claims":
+                # Multi-claim fabric status (docs/FABRIC.md): one line
+                # per claim — cycle count, last consensus validity, the
+                # claim's own replacement/quarantine accounting, and
+                # its latest block lineage.
+                if self.fabric is None:
+                    emit(
+                        "no claim fabric attached — this console serves "
+                        "a single-claim session"
+                    )
+                    return out
+                snapshot = self.fabric.snapshot()
+                emit(
+                    f"fabric: {snapshot['n_claims']} claims, "
+                    f"{snapshot['steps']} steps"
+                )
+                for claim_id in sorted(snapshot["claims"]):
+                    c = snapshot["claims"][claim_id]
+                    consensus = c.get("consensus") or {}
+                    valid = consensus.get("interval_valid")
+                    emit(
+                        f"  {claim_id}: cycles={c['cycles']}"
+                        + (" PAUSED" if c.get("paused") else "")
+                        + f" valid={'-' if valid is None else valid}"
+                        + f" admitted={consensus.get('admitted', '-')}"
+                        + f" replacements={c.get('replacements', 0)}"
+                        + (
+                            f" quarantined={c['quarantined']}"
+                            if c.get("quarantined")
+                            else ""
+                        )
+                        + (f" block={c['lineage']}" if c.get("lineage") else "")
+                    )
             elif cmd == "slo":
                 snap = self.session.slo_snapshot()
                 for name in sorted(snap):

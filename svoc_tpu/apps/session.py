@@ -121,6 +121,20 @@ class SessionConfig:
     #: refuses a dirty block outright; ``commit_resilient`` skips the
     #: quarantined slots and charges them to the oracle's health.
     quarantine_gate: bool = True
+    #: Multi-claim fabric (docs/FABRIC.md): the claim (market/story)
+    #: this session serves.  When set, lineage ids are minted as
+    #: ``blk<scope>-<claim>-<n>`` so one process journal partitions
+    #: per claim, the supervisor/breaker series carry a claim label,
+    #: and :class:`svoc_tpu.fabric.MultiSession` can own many such
+    #: sessions side by side.  None = the single-claim sessions of
+    #: PRs 1–5, byte-for-byte unchanged.
+    claim: Optional[str] = None
+    #: Lineage scope override (seeded fabric scenarios): replay
+    #: identity needs two runs to mint IDENTICAL lineage ids, which the
+    #: process-unique default ordinal deliberately prevents — only pin
+    #: this together with a FRESH ``journal=`` (else two sessions'
+    #: audit records merge, the exact bug the scope exists to stop).
+    lineage_scope: Optional[str] = None
 
 
 def _default_contract(cfg: SessionConfig) -> OracleConsensusContract:
@@ -165,6 +179,7 @@ class Session:
         store: Optional[CommentStore] = None,
         vectorizer: Optional[Callable[[Sequence[str]], np.ndarray]] = None,
         adapter: Optional[ChainAdapter] = None,
+        journal=None,
     ):
         self.config = config or SessionConfig()
         self.store = store or CommentStore()
@@ -172,21 +187,37 @@ class Session:
         self.adapter = adapter or ChainAdapter(
             LocalChainBackend(_default_contract(self.config))
         )
+        #: Event journal this session emits into — the process default
+        #: unless injected (the multi-claim fabric's seeded smoke runs
+        #: two whole MultiSessions and asserts byte-identical per-claim
+        #: fingerprints, which needs fresh journals whose seqs restart
+        #: at 1; docs/FABRIC.md).
+        self.journal = journal if journal is not None else event_journal
         #: Per-backend circuit breaker: the auto loop's commits consult
         #: it, so a dead chain degrades to cheap short-circuits instead
         #: of a retry storm (state lives in /metrics as
-        #: ``circuit_breaker_state{backend="chain"}``).
+        #: ``circuit_breaker_state{backend="chain"}``; claim sessions
+        #: get their own series — ``backend="chain[<claim>]"`` — so one
+        #: claim's dead chain never masks its siblings' health).
+        breaker_name = (
+            f"chain[{self.config.claim}]" if self.config.claim else "chain"
+        )
         self.breaker = CircuitBreaker(
-            "chain",
+            breaker_name,
             failure_threshold=self.config.breaker_failure_threshold,
             reset_timeout_s=self.config.breaker_reset_s,
             registry=metrics,
+            journal=self.journal,
         )
         #: Fleet health supervisor: commit-failure history + on-chain
         #: reliability → hysteresis scores → automatic replacement votes
         #: (the paper's admin mechanism, driven instead of manual).
         self.supervisor = FleetHealthSupervisor(
-            self.adapter, self.config.supervisor, registry=metrics
+            self.adapter,
+            self.config.supervisor,
+            registry=metrics,
+            journal=self.journal,
+            claim=self.config.claim,
         )
         #: Input-integrity gate (docs/ROBUSTNESS.md): bounds derived
         #: from the consensus model — the contract's [0,1] interval for
@@ -194,6 +225,7 @@ class Session:
         self.gate = QuarantineGate(
             SanitizeConfig.for_consensus(self.config.constrained),
             registry=metrics,
+            journal=self.journal,
         )
         #: Last gate verdict over the fetched fleet (written with the
         #: predictions it describes, under the session lock).
@@ -207,7 +239,19 @@ class Session:
         #: ``blk-000001`` for its first fetch and their audit records
         #: would merge.
         self.last_lineage: Optional[str] = None
-        self._lineage_prefix = f"blk{lineage_scope()}"
+        scope = (
+            self.config.lineage_scope
+            if self.config.lineage_scope is not None
+            else lineage_scope()
+        )
+        #: ``blk<scope>`` for single-claim sessions, ``blk<scope>-<claim>``
+        #: under the fabric — every lineage id this session mints starts
+        #: with it, so one journal partitions cleanly per claim.
+        self.lineage_prefix = (
+            f"blk{scope}-{self.config.claim}"
+            if self.config.claim
+            else f"blk{scope}"
+        )
         self.predictions: Optional[np.ndarray] = None
         self.last_preview: Optional[Dict] = None
         #: Lazy SLO evaluator (``svoc_tpu.utils.slo``) over the shared
@@ -343,12 +387,23 @@ class Session:
 
     # -- the fetch path (simulation_fetch, oracle_scheduler.py:155-161) -----
 
-    def fetch(self) -> Dict:
+    def fetch(
+        self,
+        tamper: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+    ) -> Dict:
         """One simulation step: window → sentiment → fleet → preview.
 
         Returns the preview dict (fleet values, mean/median, normalized
         deviation ranks, honest ground truth) and caches ``predictions``
         for ``commit``.
+
+        ``tamper`` (scenario hook, docs/FABRIC.md): applied to the
+        fleet block ``[N, M]`` BEFORE the quarantine gate and the
+        preview stats — the data-plane twin of the fault injector's
+        chaos wrapper, letting a seeded Byzantine oracle live inside
+        one claim of a multi-claim run.  The gate's counted verdict
+        therefore describes the tampered block it will actually refuse
+        to commit (one verdict per block, as always).
         """
         # The session lock is held only around bounded in-memory work
         # (cursor advance + claim, PRNG split, publish) — NOT around
@@ -372,14 +427,14 @@ class Session:
             # forward/fleet/consensus) inherits it, and every event
             # below carries it, making the whole block auditable as one
             # record (docs/OBSERVABILITY.md §lineage).
-            lineage = mint_lineage(claim, prefix=self._lineage_prefix)
+            lineage = mint_lineage(claim, prefix=self.lineage_prefix)
             tracer.annotate_lineage(lineage)
             if not comments:
                 raise EmptyStoreError(
                     "comment store is empty — run the scraper (or seed the "
                     "store) before fetching"
                 )
-            event_journal.emit(
+            self.journal.emit(
                 "block.fetched",
                 lineage=lineage,
                 n_comments=len(comments),
@@ -412,8 +467,19 @@ class Session:
                 # that fetch without adding any device sync of its own
                 # (hence the svoclint SVOC001 suppressions: the sync IS
                 # this span's documented purpose).
-                mean, median, ranks = _preview_stats(values)
                 predictions = np.asarray(values, dtype=np.float64)  # svoclint: disable=SVOC001
+                if tamper is not None:
+                    # Scenario tampering replaces the block wholesale;
+                    # the preview must describe what the gate sees, so
+                    # the tampered block rides back to the device.  The
+                    # block is ALREADY on the host (the fetch above is
+                    # this span's documented sync) — this asarray just
+                    # normalizes the hook's return, no device round-trip.
+                    predictions = np.asarray(  # svoclint: disable=SVOC001
+                        tamper(predictions), dtype=np.float64
+                    )
+                    values = jnp.asarray(predictions.astype(np.float32))
+                mean, median, ranks = _preview_stats(values)
                 # The gate verdict travels WITH the block it describes
                 # (one count-bearing inspection per fetch; commits
                 # re-check their own snapshot without counting).  The
@@ -442,7 +508,7 @@ class Session:
                 if quarantine is not None
                 else int(predictions.shape[0])
             )
-            event_journal.emit(
+            self.journal.emit(
                 "consensus.result",
                 lineage=lineage,
                 n_oracles=int(predictions.shape[0]),
@@ -501,7 +567,7 @@ class Session:
         if self.config.quarantine_gate:
             report = self.gate.inspect(predictions, count=False)
             if not report.clean:
-                event_journal.emit(
+                self.journal.emit(
                     "commit.failed",
                     lineage=lineage,
                     reason="quarantined",
@@ -519,7 +585,7 @@ class Session:
                 # Interactive failures feed the health scores too — the
                 # supervisor folds ALL commit-failure history.
                 self.supervisor.record_commit_failure(e.failed_oracle, e.cause)
-                event_journal.emit(
+                self.journal.emit(
                     "commit.failed",
                     lineage=lineage,
                     reason="chain",
@@ -530,7 +596,7 @@ class Session:
                 self.bump_state()  # partial txs changed chain state
                 raise
         metrics.counter("chain_transactions").add(n)
-        event_journal.emit(
+        self.journal.emit(
             "commit.sent", lineage=lineage, sent=n, total=n, attempts=1,
             stranded=0,
         )
@@ -591,6 +657,7 @@ class Session:
                     breaker=self.breaker,
                     skip=skip,
                     on_oracle_failure=self.supervisor.record_commit_failure,
+                    journal=self.journal,
                     lineage=lineage,
                 )
             except ChainCommitError as e:
@@ -688,13 +755,15 @@ class Session:
         if lineage is None:
             return {"lineage": None, "found": False, "events": [],
                     "spans": [], "summary": {}}
-        return audit_record(lineage)
+        return audit_record(lineage, journal=self.journal)
 
     def _slo_evaluator(self):
         if self._slo is None:
             from svoc_tpu.utils.slo import SLOEvaluator, default_slos
 
-            self._slo = SLOEvaluator(default_slos(metrics), registry=metrics)
+            self._slo = SLOEvaluator(
+                default_slos(metrics), registry=metrics, journal=self.journal
+            )
         return self._slo
 
     def slo_snapshot(self) -> Dict:

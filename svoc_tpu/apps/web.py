@@ -239,10 +239,29 @@ query('help');  // boot with the command list (main.js:45); its
 // state_version the moment the session changes; each push triggers one
 // /api/state fetch + redraw.  The poll loop stays only as a slow
 // fallback while the event stream is down (server restarting) —
-// EventSource auto-reconnects.
+// EventSource auto-reconnects.  ?journal=1 opts this stream into the
+// flight recorder's TYPED frames too (docs/OBSERVABILITY.md §events);
+// the unnamed state_version frames below are unchanged, and the named
+// 'journal' frames land in their own listener.
 let pushAlive = false;
 let pushedVersion = null, pushRefreshing = false;
-const events = new EventSource('/api/events');
+const events = new EventSource('/api/events?journal=1');
+// Alert-class journal events surface in the console as they happen —
+// the 2 a.m. story (quarantine → breaker → replacement → SLO burn)
+// narrates itself instead of hiding in aggregate bars.
+const alertTypes = ['slo.alert', 'breaker.transition',
+                    'supervisor.replacement', 'quarantine.verdict',
+                    'postmortem.bundle'];
+events.addEventListener('journal', ev => {
+  const e = JSON.parse(ev.data);
+  if (!alertTypes.includes(e.type)) return;
+  // Clean verdicts are per-block routine; only refusals narrate.
+  if (e.type === 'quarantine.verdict'
+      && !(e.data && e.data.reasons && Object.keys(e.data.reasons).length))
+    return;
+  writeLines(['⚠ ' + e.type + (e.lineage ? ' [' + e.lineage + ']' : '')
+              + ' ' + JSON.stringify(e.data)]);
+});
 // Reconnect resets the catch-up target: a pushed version from the
 // PREVIOUS server process is not comparable to the new process's
 // versions (a restarted server counts from 0 again, so a stale high
@@ -379,6 +398,13 @@ class _Handler(BaseHTTPRequestHandler):
                     "normalized_ranks": preview["normalized_ranks"].tolist(),
                 },
             }
+            # Multi-claim fabric (docs/FABRIC.md): when a MultiSession
+            # is attached to the console, /api/state carries every
+            # claim's snapshot — per-claim consensus slice, commit
+            # outcome, supervisor health, and block lineage.
+            fabric = getattr(self.console, "fabric", None)
+            if fabric is not None:
+                payload["claims"] = fabric.claims_state()
             self._send(200, json.dumps(payload).encode(), "application/json")
         elif self.path == "/api/events" or self.path.startswith("/api/events?"):
             self._serve_events()

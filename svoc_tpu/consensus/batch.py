@@ -40,16 +40,23 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from svoc_tpu.consensus.kernel import ConsensusConfig, _reliability
+from svoc_tpu.consensus.kernel import (
+    ConsensusConfig,
+    ConsensusOutput,
+    _reliability,
+    consensus_step_claims,
+    consensus_step_gated_claims,
+)
 from svoc_tpu.ops import sort as sort_ops
 from svoc_tpu.ops import stats
 from svoc_tpu.ops.fixedpoint import WSAD
+from svoc_tpu.robustness.sanitize import quarantine_mask_claims
 
 
 class PrefixMargins(NamedTuple):
@@ -188,3 +195,120 @@ def _certify(
         # max_spread 0 divides by zero on every recompute.
         ok &= False
     return ok
+
+
+# ---------------------------------------------------------------------------
+# Claim micro-batches (docs/FABRIC.md): the fabric's one-dispatch
+# consensus over a padded claim cube.
+# ---------------------------------------------------------------------------
+
+
+def pow2_bucket(n: int, floor: int = 1) -> int:
+    """Smallest power of two ≥ ``n`` (and ≥ ``floor``) — the claim
+    router's micro-batch bucketing.  Claim counts change every
+    scheduling tick (claims pause, registries grow); jitting the cube
+    at the RAW count would recompile the consensus program per distinct
+    count (the svoclint SVOC003 recompile hazard the prefix sweep's
+    ``inter_ks`` bucketing already kills) — bucketing pins the compile
+    count at log₂(max claims)."""
+    if n < 0:
+        raise ValueError("n must be >= 0")
+    bucket = max(1, int(floor))
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+#: Neutral fill for padding claims: mid-domain, in-range for every gate
+#: config, and far from the exact engine's panic surfaces — though a
+#: padding claim's outputs are masked out regardless.
+_PAD_VALUE = 0.5
+
+
+def pad_claim_cube(
+    values: np.ndarray, ok: Optional[np.ndarray] = None, floor: int = 1
+):
+    """Pad a claim cube ``[C, N, M]`` (and its admission masks
+    ``[C, N]``) to the pow2-bucketed claim count.
+
+    Returns ``(values [B, N, M], ok [B, N], claim_mask [B])`` with
+    ``B = pow2_bucket(C, floor)``: padding claims carry the neutral
+    fill with all-admitted masks and ``claim_mask=False`` — the kernel
+    invalidates their outputs (``interval_valid=False``, zero essence)
+    so the router can slice the first ``C`` rows and never observe
+    filler."""
+    values = np.asarray(values, dtype=np.float32)
+    if values.ndim != 3:
+        raise ValueError(f"claim cube must be [C, N, M], got {values.shape}")
+    c, n, _m = values.shape
+    if ok is None:
+        ok = np.ones((c, n), dtype=bool)
+    ok = np.asarray(ok, dtype=bool)
+    if ok.shape != (c, n):
+        raise ValueError(f"ok must be [C, N]={c, n}, got {ok.shape}")
+    bucket = pow2_bucket(c, floor)
+    claim_mask = np.zeros(bucket, dtype=bool)
+    claim_mask[:c] = True
+    if bucket == c:
+        return values, ok, claim_mask
+    pad_values = np.full(
+        (bucket - c, n, values.shape[2]), _PAD_VALUE, dtype=np.float32
+    )
+    pad_ok = np.ones((bucket - c, n), dtype=bool)
+    return (
+        np.concatenate([values, pad_values], axis=0),
+        np.concatenate([ok, pad_ok], axis=0),
+        claim_mask,
+    )
+
+
+# static_argnames: ``cfg`` only (the audited prefix_margins_sweep
+# pattern) — claim_mask/ok stay dynamic arrays, and the claim count is
+# a SHAPE the caller pow2-buckets, so the compile count is bounded by
+# log₂(max claims) per config.
+@partial(jax.jit, static_argnames=("cfg",))
+def claims_consensus(
+    values: jnp.ndarray,  # [C, N, M] padded claim cube
+    claim_mask: jnp.ndarray,  # [C] bool — active claims
+    cfg: ConsensusConfig,
+) -> ConsensusOutput:
+    """One fused dispatch of the ungated two-pass consensus over every
+    claim in a micro-batch (leading claim axis on every output)."""
+    return consensus_step_claims(values, claim_mask, cfg)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def claims_consensus_gated(
+    values: jnp.ndarray,  # [C, N, M]
+    ok: jnp.ndarray,  # [C, N] admission masks (True = admitted)
+    claim_mask: jnp.ndarray,  # [C]
+    cfg: ConsensusConfig,
+) -> ConsensusOutput:
+    """One fused dispatch of the GATED two-pass consensus over a claim
+    micro-batch with precomputed per-claim admission masks (the host
+    gate's verdicts, re-used on device)."""
+    return consensus_step_gated_claims(values, ok, claim_mask, cfg)
+
+
+# ``lo``/``hi`` are static floats: they come from a SanitizeConfig (one
+# or two distinct values per process — the constrained [0,1] gate and
+# the unconstrained codec-only gate), not per-request data, so they
+# cannot drive a recompile storm; tracing them would instead force the
+# range checks through select ops the compiler can no longer fold away
+# when a bound is absent (None).
+@partial(jax.jit, static_argnames=("cfg", "lo", "hi"))
+def claims_consensus_sanitized(
+    values: jnp.ndarray,  # [C, N, M]
+    claim_mask: jnp.ndarray,  # [C]
+    cfg: ConsensusConfig,
+    lo: Optional[float],
+    hi: Optional[float],
+):
+    """Gate + consensus fused into ONE traced program per micro-batch:
+    the vmapped quarantine gate
+    (:func:`svoc_tpu.robustness.sanitize.quarantine_mask_claims`)
+    computes per-claim admission masks in-graph and the gated kernel
+    consumes them without a host round-trip.  Returns ``(output, ok)``
+    so the caller can still account per-claim admissions."""
+    ok = quarantine_mask_claims(values, lo, hi)
+    return consensus_step_gated_claims(values, ok, claim_mask, cfg), ok

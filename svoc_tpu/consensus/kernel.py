@@ -213,6 +213,91 @@ def consensus_step_gated_batched(
     return jax.vmap(lambda v, m: consensus_step_gated(v, m, cfg))(values, ok)
 
 
+# ---------------------------------------------------------------------------
+# Claim as a batch axis (docs/FABRIC.md): the multi-claim fabric runs
+# MANY independent markets/stories through one dispatch.  Semantically
+# the claim axis is exactly the Monte-Carlo batch axis above — each
+# claim is one [N, M] oracle block — plus a per-claim ACTIVITY mask:
+# the claim router pads micro-batches to a pow2-bucketed claim count
+# (svoclint SVOC003 discipline — distinct claim counts must not each
+# pay a fresh compile), and a padding claim's outputs must read as
+# "no consensus", never as a confident essence built from filler.
+# ---------------------------------------------------------------------------
+
+
+def _mask_padded_claims(
+    out: ConsensusOutput, claim_mask: jnp.ndarray
+) -> ConsensusOutput:
+    """Invalidate the padding rows of a claim-batched output:
+    ``interval_valid`` forced False, essences zeroed (a padding claim's
+    filler block can produce arbitrary — even non-finite — values, and
+    they must not leak to a caller that renders before checking the
+    mask), reliability masks cleared."""
+    active = claim_mask.astype(bool)
+    row = active[:, None]
+    return ConsensusOutput(
+        essence=jnp.where(row, out.essence, 0.0),
+        essence_first_pass=jnp.where(row, out.essence_first_pass, 0.0),
+        reliability_first_pass=jnp.where(
+            active, out.reliability_first_pass, 0.0
+        ),
+        reliability_second_pass=jnp.where(
+            active, out.reliability_second_pass, 0.0
+        ),
+        reliable=jnp.logical_and(out.reliable, row),
+        quadratic_risk=jnp.where(row, out.quadratic_risk, 0.0),
+        skewness=jnp.where(row, out.skewness, 0.0),
+        kurtosis=jnp.where(row, out.kurtosis, 0.0),
+        interval_valid=jnp.logical_and(out.interval_valid, active),
+    )
+
+
+def consensus_step_claims(
+    values: jnp.ndarray, claim_mask: jnp.ndarray, cfg: ConsensusConfig
+) -> ConsensusOutput:
+    """Two-pass consensus over a claim cube ``[C, N, M]``.
+
+    Every output field grows a leading claim axis: per-claim essences,
+    per-claim reliabilities, per-claim ``reliable`` masks ``[C, N]``
+    and per-claim ``interval_valid``.  ``claim_mask [C]`` marks the
+    ACTIVE claims (padding rows from the router's pow2 bucketing are
+    False — see :func:`svoc_tpu.consensus.batch.pad_claim_cube`).
+    Active claims compute exactly :func:`consensus_step_batched`, i.e.
+    a vmap of the single-claim kernel — parity-tested against a Python
+    loop of :func:`consensus_step` in ``tests/test_fabric.py``.
+    """
+    return _mask_padded_claims(consensus_step_batched(values, cfg), claim_mask)
+
+
+def consensus_step_gated_claims(
+    values: jnp.ndarray,
+    ok: jnp.ndarray,
+    claim_mask: jnp.ndarray,
+    cfg: ConsensusConfig,
+) -> ConsensusOutput:
+    """Gated two-pass consensus over a claim cube ``[C, N, M]`` with
+    per-claim quarantine masks ``ok [C, N]`` (True = admitted; from
+    :func:`svoc_tpu.robustness.sanitize.quarantine_mask_claims`) and an
+    activity mask ``claim_mask [C]``.
+
+    Per-claim degenerate handling is inherited from
+    :func:`consensus_step_gated`: a claim with fewer than two admitted
+    (or two reliable) oracles reports ``interval_valid=False`` with a
+    finite essence — one poisoned claim can never invalidate, or leak
+    sentinels into, its siblings in the same micro-batch.
+    """
+    return _mask_padded_claims(
+        consensus_step_gated_batched(values, ok, cfg), claim_mask
+    )
+
+
 def jit_consensus(cfg: ConsensusConfig):
     """Return a jitted single-block consensus closure for ``cfg``."""
     return jax.jit(lambda v: consensus_step(v, cfg))
+
+
+def jit_consensus_gated(cfg: ConsensusConfig):
+    """Jitted single-block GATED consensus closure for ``cfg`` — the
+    per-claim reference the claim-cube path is parity-tested (and
+    benchmarked, ``bench.py --claims``) against."""
+    return jax.jit(lambda v, ok: consensus_step_gated(v, ok, cfg))

@@ -1,0 +1,23 @@
+"""Multi-claim consensus fabric (docs/FABRIC.md).
+
+Claim as a first-class batch axis from fetch to commit: a
+:class:`ClaimRegistry` of per-claim state, a :class:`ClaimRouter` that
+assembles pow2-bucketed claim micro-batches and runs ONE claim-cube
+consensus dispatch per cycle, and the :class:`MultiSession` operator
+facade over both (ROADMAP item 1; HybridFlow's
+single-controller-over-multi-workload shape, arxiv 2409.19256).
+"""
+
+from svoc_tpu.fabric.registry import ClaimRegistry, ClaimSpec, ClaimState
+from svoc_tpu.fabric.router import ClaimRouter
+from svoc_tpu.fabric.scenario import run_fabric_scenario
+from svoc_tpu.fabric.session import MultiSession
+
+__all__ = [
+    "ClaimRegistry",
+    "ClaimRouter",
+    "ClaimSpec",
+    "ClaimState",
+    "MultiSession",
+    "run_fabric_scenario",
+]

@@ -1,0 +1,198 @@
+"""Claim registry: per-claim state for the multi-claim consensus fabric.
+
+The paper's design serves ONE claim (one market/story) per session;
+production means thousands of concurrent claims, each with its own
+oracle fleet, lineage family, and SLO (ROADMAP item 1, following
+HybridFlow's single-controller-over-multi-workload shape).  This module
+is the controller's bookkeeping half:
+
+- :class:`ClaimSpec` — the static description of one claim (fleet
+  shape, consensus model, scheduling weight, SLO objectives, and an
+  optional seeded ``tamper`` hook for Byzantine scenarios);
+- :class:`ClaimState` — the live state the fabric owns per claim: the
+  claim's :class:`~svoc_tpu.apps.session.Session` (fleet slots, chain
+  adapter, supervisor health, quarantine gate — everything PRs 1–5
+  built, now one-per-claim), its SLO evaluator, its scheduling
+  bookkeeping, and the latest claim-batched consensus slice;
+- :class:`ClaimRegistry` — the thread-safe id → state map the
+  :class:`~svoc_tpu.fabric.router.ClaimRouter` schedules over.
+
+The dynamic half (micro-batch assembly, fair scheduling, the fused
+claim-cube dispatch) lives in :mod:`svoc_tpu.fabric.router`; the
+operator facade in :mod:`svoc_tpu.fabric.session`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from svoc_tpu.consensus.kernel import ConsensusConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ClaimSpec:
+    """Static description of one claim (market/story/topic).
+
+    ``seed=None`` derives the claim's oracle-stream seed from the
+    fabric's base seed via :func:`svoc_tpu.sim.generators.claim_seed`
+    (crc32-keyed — N claims get independent, replayable streams).
+    ``weight`` is the fair-scheduler share: a weight-2 claim is served
+    ~2× as often as a weight-1 sibling when the micro-batch cannot fit
+    everyone.  ``tamper`` is the Byzantine-scenario hook threaded into
+    ``Session.fetch(tamper=...)`` — called as ``tamper(cycle, block)``
+    with the claim's served-cycle count, returning the (possibly
+    corrupted) ``[N, M]`` block; None for honest claims.
+    """
+
+    claim_id: str
+    seed: Optional[int] = None
+    n_oracles: int = 7
+    n_failing: int = 2
+    dimension: int = 6
+    constrained: bool = True
+    #: unconstrained estimator spread (must be > 0 when
+    #: ``constrained=False`` — the exact engine divides by it).
+    max_spread: float = 10.0
+    weight: int = 1
+    commit_objective: float = 0.99
+    admission_objective: float = 0.90
+    tamper: Optional[Callable[[int, np.ndarray], np.ndarray]] = None
+
+    def __post_init__(self):
+        if not self.claim_id:
+            raise ValueError("claim_id must be non-empty")
+        if "-" in self.claim_id or "/" in self.claim_id:
+            # Lineage ids are ``blk<scope>-<claim>-<n>`` and the audit
+            # endpoint routes on path segments: a separator inside the
+            # claim id would make the partition ambiguous.
+            raise ValueError(
+                f"claim_id {self.claim_id!r} must not contain '-' or '/'"
+            )
+        if self.weight < 1:
+            raise ValueError("weight must be >= 1")
+        if not self.constrained and self.max_spread <= 0.0:
+            raise ValueError(
+                "unconstrained claims need max_spread > 0 "
+                "(contract.cairo:365-368 divides by it)"
+            )
+
+    def consensus_config(self) -> ConsensusConfig:
+        """The claim's kernel configuration — the static half of the
+        claim-cube dispatch (claims sharing it batch together)."""
+        return ConsensusConfig(
+            n_failing=self.n_failing,
+            constrained=self.constrained,
+            max_spread=self.max_spread,
+        )
+
+
+class ClaimState:
+    """Everything the fabric owns for one live claim.
+
+    Mutable fields are written only by the router's (single-threaded)
+    scheduling loop; readers (web UI snapshots) take the registry lock
+    around whole-dict reads and tolerate a torn *latest-consensus*
+    view exactly like the single-claim web UI tolerates a mid-fetch
+    poll.
+    """
+
+    def __init__(self, spec: ClaimSpec, session, evaluator, index: int):
+        self.spec = spec
+        #: the claim's Session (claim-scoped lineage, own adapter /
+        #: supervisor / gate / breaker — PRs 1–5, one instance per claim).
+        self.session = session
+        #: per-claim SLO evaluator (``svoc_tpu.utils.slo.claim_slos``).
+        self.evaluator = evaluator
+        #: registration ordinal — the scheduler's deterministic tie-break.
+        self.index = index
+        #: served-cycle count (the ``tamper`` hook's clock).
+        self.cycles = 0
+        #: scheduling pause (an operator can drain a claim without
+        #: removing its state).
+        self.paused = False
+        #: latest claim-batched consensus slice (None before the first
+        #: served cycle): essence, interval_valid, reliable mask,
+        #: reliabilities — the fabric's device-side view, vs the
+        #: exact-engine state on the claim's own chain.
+        self.last_consensus: Optional[Dict[str, Any]] = None
+        #: latest commit outcome summary.
+        self.last_commit: Optional[Dict[str, Any]] = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-friendly per-claim state (``/api/state``'s ``claims``
+        section, docs/FABRIC.md)."""
+        session = self.session
+        with session.lock:
+            lineage = session.last_lineage
+        resilience = session.resilience_snapshot()
+        return {
+            "claim": self.spec.claim_id,
+            "cycles": self.cycles,
+            "paused": self.paused,
+            "lineage": lineage,
+            "consensus": self.last_consensus,
+            "commit": self.last_commit,
+            "health": resilience["health"],
+            "replacements": resilience["replacements"],
+            "quarantined": resilience["quarantined"],
+            "oracle_list": [
+                repr(a) for a in session.adapter.cache_snapshot().get(
+                    "oracle_list"
+                ) or []
+            ],
+        }
+
+
+class ClaimRegistry:
+    """Thread-safe claim id → :class:`ClaimState` map, iteration in
+    registration order (the scheduler's deterministic base order)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._states: Dict[str, ClaimState] = {}
+        self._next_index = 0
+
+    def add(self, spec: ClaimSpec, session, evaluator) -> ClaimState:
+        with self._lock:
+            if spec.claim_id in self._states:
+                raise ValueError(f"claim {spec.claim_id!r} already registered")
+            state = ClaimState(spec, session, evaluator, self._next_index)
+            self._next_index += 1
+            self._states[spec.claim_id] = state
+            return state
+
+    def remove(self, claim_id: str) -> ClaimState:
+        with self._lock:
+            try:
+                return self._states.pop(claim_id)
+            except KeyError:
+                raise KeyError(f"unknown claim {claim_id!r}") from None
+
+    def get(self, claim_id: str) -> ClaimState:
+        with self._lock:
+            try:
+                return self._states[claim_id]
+            except KeyError:
+                raise KeyError(f"unknown claim {claim_id!r}") from None
+
+    def ids(self) -> List[str]:
+        with self._lock:
+            return list(self._states)
+
+    def states(self) -> List[ClaimState]:
+        """Registration-order snapshot (safe to iterate while claims
+        are added concurrently — the list is a copy)."""
+        with self._lock:
+            return list(self._states.values())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._states)
+
+    def __contains__(self, claim_id: str) -> bool:
+        with self._lock:
+            return claim_id in self._states

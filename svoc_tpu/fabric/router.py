@@ -1,0 +1,342 @@
+"""The claim router: fair micro-batches through one consensus dispatch.
+
+The dynamic half of the fabric (registry in
+:mod:`svoc_tpu.fabric.registry`): each :meth:`ClaimRouter.step`
+
+1. **selects** up to ``max_claims_per_batch`` claims by weighted
+   round-robin (a claim of weight *w* holds *w* slots in the rotation;
+   selection is deterministic, so seeded fabric runs replay
+   byte-identically — ``make fabric-smoke``),
+2. **fetches** each selected claim through its own
+   :meth:`~svoc_tpu.apps.session.Session.fetch` (window → sentiment →
+   fleet → counted quarantine verdict, lineage
+   ``blk<scope>-<claim>-<n>``),
+3. **batches** the fetched fleet blocks into claim cubes — grouped by
+   ``(n_oracles, dimension, consensus config)``, padded to a
+   pow2-bucketed claim count
+   (:func:`svoc_tpu.consensus.batch.pad_claim_cube`) — and runs ONE
+   gated consensus dispatch per group
+   (:func:`svoc_tpu.consensus.batch.claims_consensus_gated`), giving
+   every claim its per-claim essence, ``interval_valid`` and
+   reliability mask,
+4. **commits** each claim resiliently (retry + resume + breaker +
+   supervisor — the claim's own instances), folds the supervisor, and
+5. **accounts** the per-claim SLO counters
+   (``claim_commit_cycles{claim=}`` …) that
+   :func:`svoc_tpu.utils.slo.claim_slos` evaluates.
+
+One claim's Byzantine offender, dead chain, or burning error budget
+stays in that claim's fleet, breaker, and SLO — sibling claims share
+only the accelerator dispatch (the isolation `make fabric-smoke`
+certifies).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from svoc_tpu.apps.session import EmptyStoreError
+from svoc_tpu.consensus.batch import claims_consensus_gated, pad_claim_cube
+from svoc_tpu.fabric.registry import ClaimRegistry, ClaimState
+from svoc_tpu.io.chain import ChainCommitError
+from svoc_tpu.resilience.breaker import CircuitOpenError
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_registry
+from svoc_tpu.utils.metrics import stage_span
+
+
+def resolve_journal(journal):
+    """An injected journal, or the lazily-imported process default —
+    the one resolver the router and the MultiSession facade share."""
+    if journal is not None:
+        return journal
+    from svoc_tpu.utils.events import journal as default_journal
+
+    return default_journal
+
+
+class ClaimRouter:
+    """Multiplexes fetch → vectorize → consensus → commit across the
+    registry's claims.  ``step()`` is the single-threaded scheduling
+    loop (the fabric's controller thread); registry mutation and
+    snapshot reads are safe concurrently."""
+
+    def __init__(
+        self,
+        registry: ClaimRegistry,
+        *,
+        max_claims_per_batch: int = 8,
+        metrics: Optional[MetricsRegistry] = None,
+        journal=None,
+    ):
+        if max_claims_per_batch < 1:
+            raise ValueError("max_claims_per_batch must be >= 1")
+        self.registry = registry
+        self.max_claims_per_batch = max_claims_per_batch
+        self._metrics = metrics or _default_registry
+        self._journal = journal
+        self._lock = threading.Lock()
+        #: weighted rotation: claim ids, each appearing ``weight``
+        #: times.  Rebuilt lazily when the registry's membership
+        #: changes; rotation POSITION survives rebuilds (fairness
+        #: across adds/removes).
+        self._rotation: deque = deque()
+        self._rotation_members: Tuple[Tuple[str, int], ...] = ()
+        self.steps = 0
+
+    def _resolve_journal(self):
+        return resolve_journal(self._journal)
+
+    # -- scheduling ---------------------------------------------------------
+
+    def _refresh_rotation_locked(self, states: List[ClaimState]) -> None:
+        members = tuple(
+            (s.spec.claim_id, s.spec.weight)
+            for s in sorted(states, key=lambda s: s.index)
+        )
+        if members == self._rotation_members:
+            return
+        # Preserve relative order of surviving ids; new claims join at
+        # the rotation tail in registration order.
+        old_order = [cid for cid in self._rotation]
+        alive = {cid for cid, _w in members}
+        seen = set()
+        new_rotation: List[str] = []
+        for cid in old_order:
+            if cid in alive and cid not in seen:
+                seen.add(cid)
+                new_rotation.append(cid)
+        for cid, _w in members:
+            if cid not in seen:
+                seen.add(cid)
+                new_rotation.append(cid)
+        weights = dict(members)
+        expanded: List[str] = []
+        for cid in new_rotation:
+            expanded.extend([cid] * weights[cid])
+        self._rotation = deque(expanded)
+        self._rotation_members = members
+
+    def select(self) -> List[ClaimState]:
+        """The next micro-batch: up to ``max_claims_per_batch`` DISTINCT
+        unpaused claims in weighted-rotation order.  Deterministic —
+        the replay witness covers scheduling, not just math."""
+        states = self.registry.states()
+        by_id = {s.spec.claim_id: s for s in states}
+        with self._lock:
+            self._refresh_rotation_locked(states)
+            if not self._rotation:
+                return []
+            selected: List[ClaimState] = []
+            picked = set()
+            # One full rotation scan at most: claims beyond the batch
+            # cap (or paused) keep their slots for the next step.
+            for _ in range(len(self._rotation)):
+                cid = self._rotation[0]
+                self._rotation.rotate(-1)
+                if cid in picked:
+                    continue
+                state = by_id.get(cid)
+                if state is None or state.paused:
+                    continue
+                picked.add(cid)
+                selected.append(state)
+                if len(selected) >= self.max_claims_per_batch:
+                    break
+            return selected
+
+    # -- the multiplexed cycle ----------------------------------------------
+
+    def step(self) -> Dict[str, Any]:
+        """One fabric cycle over the next micro-batch.  Never raises on
+        a per-claim failure (an empty store or open breaker in one
+        claim must not starve its siblings); per-claim errors land in
+        the report and the claim's own counters."""
+        self.steps += 1
+        report: Dict[str, Any] = {
+            "step": self.steps,
+            "served": [],
+            "skipped": {},
+            "claims": {},
+        }
+        selected = self.select()
+        if not selected:
+            return report
+
+        # ---- fetch every selected claim (its own lineage + verdict) ----
+        fetched: List[ClaimState] = []
+        for state in selected:
+            spec = state.spec
+            tamper = None
+            if spec.tamper is not None:
+                cycle = state.cycles
+                tamper = lambda block, _t=spec.tamper, _c=cycle: _t(_c, block)
+            try:
+                state.session.fetch(tamper=tamper)
+            except EmptyStoreError:
+                report["skipped"][spec.claim_id] = "empty_store"
+                continue
+            except Exception as e:  # noqa: BLE001 — isolation contract
+                # ANY per-claim fetch failure (a raising tamper hook, a
+                # broken vectorizer, a torn store) skips THIS claim,
+                # never the batch — but unlike the routine empty-store
+                # wait it is an anomaly, so it surfaces in its own
+                # counter instead of blending into claim accounting.
+                report["skipped"][spec.claim_id] = (
+                    f"fetch_error:{type(e).__name__}"
+                )
+                self._metrics.counter(
+                    "fabric_claim_errors",
+                    labels={"claim": spec.claim_id, "stage": "fetch"},
+                ).add(1)
+                continue
+            fetched.append(state)
+        if not fetched:
+            return report
+
+        # ---- claim-cube consensus: one dispatch per (shape, config) ----
+        groups: Dict[Any, List[ClaimState]] = {}
+        for state in fetched:
+            spec = state.spec
+            key = (spec.n_oracles, spec.dimension, spec.consensus_config())
+            groups.setdefault(key, []).append(state)
+        with stage_span("fabric_consensus"):
+            for (_n, _m, cfg), members in groups.items():
+                self._consensus_group(members, cfg)
+
+        # ---- commit + supervise + SLO, claim by claim ----
+        for state in fetched:
+            self._commit_claim(state)
+            state.cycles += 1
+            report["served"].append(state.spec.claim_id)
+            report["claims"][state.spec.claim_id] = {
+                "consensus": state.last_consensus,
+                "commit": state.last_commit,
+            }
+        return report
+
+    def _consensus_group(self, members: List[ClaimState], cfg) -> None:
+        """Run the fused gated consensus over one shape/config group and
+        write each member's per-claim slice back."""
+        sessions = [s.session for s in members]
+        blocks = []
+        oks = []
+        for session in sessions:
+            with session.lock:
+                predictions = session.predictions
+                quarantine = session.last_quarantine
+            blocks.append(np.asarray(predictions, dtype=np.float32))
+            oks.append(
+                np.asarray(quarantine.ok, dtype=bool)
+                if quarantine is not None
+                else np.ones(predictions.shape[0], dtype=bool)
+            )
+        values, ok, claim_mask = pad_claim_cube(
+            np.stack(blocks), np.stack(oks)
+        )
+        out = claims_consensus_gated(
+            jnp.asarray(values), jnp.asarray(ok), jnp.asarray(claim_mask), cfg
+        )
+        # ONE host sync for the whole micro-batch — the claim axis
+        # amortizes the dispatch/fetch overhead that a per-claim loop
+        # pays C times (bench.py --claims).
+        essence = np.asarray(out.essence)  # svoclint: disable=SVOC001
+        essence1 = np.asarray(out.essence_first_pass)
+        rel1 = np.asarray(out.reliability_first_pass)
+        rel2 = np.asarray(out.reliability_second_pass)
+        reliable = np.asarray(out.reliable)
+        valid = np.asarray(out.interval_valid)
+        journal = self._resolve_journal()
+        bucket = int(values.shape[0])
+        for i, state in enumerate(members):
+            session = state.session
+            with session.lock:
+                lineage = session.last_lineage
+            n_admitted = int(np.sum(oks[i]))
+            slice_ = {
+                "essence": [round(float(x), 6) for x in essence[i]],
+                "essence_first_pass": [
+                    round(float(x), 6) for x in essence1[i]
+                ],
+                "reliability_first_pass": round(float(rel1[i]), 6),
+                "reliability_second_pass": round(float(rel2[i]), 6),
+                "reliable": [bool(b) for b in reliable[i]],
+                "interval_valid": bool(valid[i]),
+                "admitted": n_admitted,
+            }
+            state.last_consensus = slice_
+            journal.emit(
+                "fabric.consensus",
+                lineage=lineage,
+                claim=state.spec.claim_id,
+                interval_valid=slice_["interval_valid"],
+                admitted=n_admitted,
+                n_reliable=int(np.sum(reliable[i])),
+                batch_claims=len(members),
+                batch_bucket=bucket,
+            )
+            labels = {"claim": state.spec.claim_id}
+            self._metrics.counter(
+                "claim_slots_inspected", labels=labels
+            ).add(int(oks[i].shape[0]))
+            self._metrics.counter(
+                "claim_slots_quarantined", labels=labels
+            ).add(int(oks[i].shape[0]) - n_admitted)
+            self._metrics.gauge(
+                "claim_interval_valid", labels=labels
+            ).set(1.0 if slice_["interval_valid"] else 0.0)
+
+    def _commit_claim(self, state: ClaimState) -> None:
+        """One resilient commit + supervisor fold + SLO pass for one
+        claim; failures count into THAT claim's series only."""
+        session = state.session
+        labels = {"claim": state.spec.claim_id}
+        self._metrics.counter("claim_commit_cycles", labels=labels).add(1)
+        failed = None
+        outcome = None
+        try:
+            outcome = session.commit_resilient()
+        except (ChainCommitError, CircuitOpenError) as e:
+            # The commit path's EXPECTED failure classes: routine claim
+            # accounting (this claim's breaker/supervisor already saw
+            # them).
+            failed = type(e).__name__
+        except Exception as e:  # noqa: BLE001 — isolation contract
+            # Anything else is a defect surfacing per claim (XLA
+            # runtime error, adapter bug): still must not starve the
+            # sibling claims, but it lands in the anomaly counter so it
+            # reads as a bug, not as unexplained SLO burn.
+            failed = f"{type(e).__name__}: {e}"
+            self._metrics.counter(
+                "fabric_claim_errors",
+                labels={"claim": state.spec.claim_id, "stage": "commit"},
+            ).add(1)
+        if failed is not None:
+            self._metrics.counter(
+                "claim_commit_failures", labels=labels
+            ).add(1)
+            state.last_commit = {"error": failed}
+        else:
+            if outcome.stranded:
+                # Degraded cycles burn the claim's commit budget just
+                # like the single-claim soak accounting.
+                self._metrics.counter(
+                    "claim_commit_failures", labels=labels
+                ).add(1)
+            state.last_commit = {
+                "sent": outcome.sent,
+                "total": outcome.total,
+                "attempts": outcome.attempts,
+                "stranded": len(outcome.stranded),
+                "complete": outcome.complete,
+            }
+        session.supervisor_step()
+        try:
+            state.evaluator.evaluate()
+        except Exception:
+            self._metrics.counter("slo_errors").add(1)

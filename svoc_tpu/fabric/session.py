@@ -1,0 +1,195 @@
+"""MultiSession: the operator facade over the claim fabric.
+
+One object that owns N claims end-to-end (docs/FABRIC.md): a
+:class:`~svoc_tpu.fabric.registry.ClaimRegistry` of per-claim state —
+each claim gets its own :class:`~svoc_tpu.apps.session.Session` (fleet,
+chain adapter, supervisor, breaker, quarantine gate, claim-scoped
+lineage) and its own SLO evaluator — multiplexed by a
+:class:`~svoc_tpu.fabric.router.ClaimRouter` through ONE claim-batched
+consensus dispatch per cycle.  The single-claim ``Session`` of PRs 1–5
+is unchanged; ``MultiSession`` composes many of them the way
+HybridFlow's single controller composes many workloads (PAPERS.md,
+arxiv 2409.19256).
+
+Seeding: a claim whose spec leaves ``seed=None`` derives its oracle
+stream from the fabric's ``base_seed`` via
+:func:`svoc_tpu.sim.generators.claim_seed` (crc32-keyed off the claim
+id), so N claims are independent AND replayable from one number.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+from svoc_tpu.apps.session import Session, SessionConfig
+from svoc_tpu.fabric.registry import ClaimRegistry, ClaimSpec, ClaimState
+from svoc_tpu.fabric.router import ClaimRouter
+from svoc_tpu.io.comment_store import CommentStore
+from svoc_tpu.sim.generators import claim_seed
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_metrics
+
+
+class MultiSession:
+    """N claims, one controller (docs/FABRIC.md).
+
+    ``journal``/``metrics``/``lineage_scope`` default to the process
+    singletons — live deployments want one journal and one /metrics
+    surface.  Seeded scenarios (``make fabric-smoke``) inject all three
+    fresh and pinned, because replay identity needs event seqs starting
+    at 1, counters starting at 0, and lineage ids that do not depend on
+    how many sessions the process made before.
+    """
+
+    def __init__(
+        self,
+        specs: Iterable[ClaimSpec] = (),
+        *,
+        base_seed: int = 0,
+        vectorizer: Optional[Callable[[Sequence[str]], object]] = None,
+        store_factory: Optional[Callable[[str], CommentStore]] = None,
+        journal=None,
+        metrics: Optional[MetricsRegistry] = None,
+        lineage_scope: Optional[str] = None,
+        max_claims_per_batch: int = 8,
+    ):
+        self.base_seed = base_seed
+        self._vectorizer = vectorizer
+        self._store_factory = store_factory
+        self._journal = journal
+        self._metrics = metrics or _default_metrics
+        self._lineage_scope = lineage_scope
+        self.registry = ClaimRegistry()
+        self.router = ClaimRouter(
+            self.registry,
+            max_claims_per_batch=max_claims_per_batch,
+            metrics=self._metrics,
+            journal=journal,
+        )
+        for spec in specs:
+            self.add_claim(spec)
+
+    # -- claim lifecycle ----------------------------------------------------
+
+    def add_claim(
+        self,
+        spec: ClaimSpec,
+        *,
+        store: Optional[CommentStore] = None,
+        vectorizer: Optional[Callable[[Sequence[str]], object]] = None,
+    ) -> ClaimState:
+        """Register one claim: build its Session (claim-scoped lineage,
+        own adapter/supervisor/gate/breaker) and its SLO evaluator.
+        The claim joins the router's rotation on the next ``step``."""
+        from svoc_tpu.utils.slo import SLOEvaluator, claim_slos
+
+        seed = (
+            spec.seed
+            if spec.seed is not None
+            else claim_seed(self.base_seed, spec.claim_id)
+        )
+        config = SessionConfig(
+            n_oracles=spec.n_oracles,
+            n_failing=spec.n_failing,
+            dimension=spec.dimension,
+            constrained=spec.constrained,
+            max_spread=spec.max_spread if not spec.constrained else 0.0,
+            seed=seed,
+            claim=spec.claim_id,
+            lineage_scope=self._lineage_scope,
+        )
+        if store is None:
+            store = (
+                self._store_factory(spec.claim_id)
+                if self._store_factory is not None
+                else CommentStore()
+            )
+        session = Session(
+            config=config,
+            store=store,
+            vectorizer=vectorizer or self._vectorizer,
+            journal=self._journal,
+        )
+        evaluator = SLOEvaluator(
+            claim_slos(
+                self._metrics,
+                spec.claim_id,
+                commit_objective=spec.commit_objective,
+                admission_objective=spec.admission_objective,
+            ),
+            registry=self._metrics,
+            journal=self._journal,
+        )
+        return self.registry.add(spec, session, evaluator)
+
+    def remove_claim(self, claim_id: str) -> ClaimState:
+        """Drop a claim from the registry (its Session object survives
+        for the caller — lineage history in the journal is untouched)."""
+        return self.registry.remove(claim_id)
+
+    def pause(self, claim_id: str, paused: bool = True) -> None:
+        """Drain a claim without removing its state: a paused claim
+        keeps its rotation slots but is skipped by ``select``."""
+        self.registry.get(claim_id).paused = paused
+
+    def get(self, claim_id: str) -> ClaimState:
+        return self.registry.get(claim_id)
+
+    def claim_ids(self) -> List[str]:
+        return self.registry.ids()
+
+    # -- the multiplexed loop -----------------------------------------------
+
+    def step(self) -> Dict:
+        """One fabric cycle: fair-select → fetch each → ONE claim-cube
+        consensus dispatch per (shape, config) group → per-claim
+        resilient commit + supervisor + SLO."""
+        return self.router.step()
+
+    def run(self, cycles: int) -> List[Dict]:
+        """``cycles`` steps; returns the per-step reports."""
+        return [self.step() for _ in range(cycles)]
+
+    # -- views ---------------------------------------------------------------
+
+    def claims_state(self) -> Dict[str, Dict]:
+        """Per-claim snapshots (``/api/state``'s ``claims`` section)."""
+        return {
+            state.spec.claim_id: state.snapshot()
+            for state in self.registry.states()
+        }
+
+    def snapshot(self) -> Dict:
+        return {
+            "steps": self.router.steps,
+            "n_claims": len(self.registry),
+            "claims": self.claims_state(),
+        }
+
+    def _resolve_journal(self):
+        from svoc_tpu.fabric.router import resolve_journal
+
+        return resolve_journal(self._journal)
+
+    def claim_fingerprint(self, claim_id: str) -> str:
+        """Replay digest of ONE claim's slice of the journal — every
+        event whose lineage this claim's session minted.  Seqs are
+        global, so identity across runs also certifies identical
+        scheduler interleaving (docs/FABRIC.md §replay)."""
+        state = self.registry.get(claim_id)
+        return self._resolve_journal().fingerprint(
+            lineage_prefix=state.session.lineage_prefix + "-"
+        )
+
+    def audit(self, lineage: str) -> Dict:
+        """The per-block audit record for any claim's block — lineage
+        ids are claim-prefixed, so the id alone names the claim."""
+        from svoc_tpu.utils.events import audit_record
+
+        return audit_record(lineage, journal=self._journal)
+
+    def attach(self, console) -> None:
+        """Expose this fabric through an existing
+        :class:`~svoc_tpu.apps.commands.CommandConsole`: the ``claims``
+        command and ``/api/state``'s ``claims`` section read it."""
+        console.fabric = self

@@ -140,6 +140,7 @@ class FleetHealthSupervisor:
         new_address_factory: Callable[[Set[Any]], Any] = _default_address_factory,
         registry: Optional[MetricsRegistry] = None,
         journal=None,
+        claim: Optional[str] = None,
     ):
         self.adapter = adapter
         self.config = config or SupervisorConfig()
@@ -149,6 +150,14 @@ class FleetHealthSupervisor:
         #: quarantine charges, and replacement votes become typed
         #: events joinable by block lineage.  None = process default.
         self._journal = journal
+        #: Multi-claim fabric (docs/FABRIC.md): the claim this fleet
+        #: serves.  When set, every health/charge/replacement event
+        #: carries ``claim`` in its data and the gauges grow a
+        #: ``claim`` label — N supervisors in one process stay N
+        #: distinguishable series instead of overwriting each other's
+        #: slot gauges.  None = the single-claim series of PRs 3–5,
+        #: unchanged.
+        self.claim = claim
         self._lock = threading.Lock()
         self._scores: Dict[Any, float] = {}
         self._streaks: Dict[Any, int] = {}
@@ -176,6 +185,12 @@ class FleetHealthSupervisor:
         j = self._journal
         if j is None:
             from svoc_tpu.utils.events import journal as j
+        if self.claim is not None:
+            # Claim travels with the event (fabric audit joins can then
+            # partition without parsing lineage ids).  Only when set:
+            # single-claim payloads — and their replay fingerprints —
+            # must stay byte-identical to PR 5.
+            data.setdefault("claim", self.claim)
         j.emit(event_type, lineage=lineage, **data)
 
     def record_quarantine(
@@ -318,17 +333,20 @@ class FleetHealthSupervisor:
 
     def _export_gauges(self, oracles: List[Any]) -> None:
         # Callers hold self._lock.
+        claim_label = (
+            {} if self.claim is None else {"claim": self.claim}
+        )
         lo = 1.0
         for slot, addr in enumerate(oracles):
             score = self._scores.get(addr, 1.0)
             lo = min(lo, score)
             self._registry.gauge(
-                "oracle_health", labels={"slot": str(slot)}
+                "oracle_health", labels={"slot": str(slot), **claim_label}
             ).set(score)
-        self._registry.gauge("oracle_health_min").set(lo)
-        self._registry.gauge("oracles_quarantined").set(
-            len(self._quarantined)
-        )
+        self._registry.gauge("oracle_health_min", labels=claim_label).set(lo)
+        self._registry.gauge(
+            "oracles_quarantined", labels=claim_label
+        ).set(len(self._quarantined))
 
     # -- the replacement vote flow ------------------------------------------
 

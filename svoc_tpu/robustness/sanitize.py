@@ -143,6 +143,20 @@ def quarantine_mask_jax(values, lo: Optional[float], hi: Optional[float]):
     return jnp.logical_not(masks.quarantined)
 
 
+def quarantine_mask_claims(values, lo: Optional[float], hi: Optional[float]):
+    """Admission masks ``ok [C, N]`` for a claim cube ``[C, N, M]`` —
+    the vmapped gate of the multi-claim fabric (docs/FABRIC.md).  One
+    traced program inspects every claim's fleet block; the masks feed
+    :func:`svoc_tpu.consensus.kernel.consensus_step_gated_claims`
+    directly, so gate + consensus fuse into a single dispatch per
+    micro-batch.  Identical per claim to :func:`quarantine_mask_jax`
+    (the host :class:`QuarantineGate` remains the reason-reporting
+    authority — this traced twin only decides admission)."""
+    import jax
+
+    return jax.vmap(lambda v: quarantine_mask_jax(v, lo, hi))(values)
+
+
 @dataclasses.dataclass
 class QuarantineReport:
     """One gate pass over a fleet block (host side).

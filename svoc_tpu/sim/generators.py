@@ -17,8 +17,32 @@ detection benchmark tries to recover).
 
 from __future__ import annotations
 
+import zlib
+
 import jax
 import jax.numpy as jnp
+
+
+def claim_seed(base_seed: int, claim_id) -> int:
+    """Per-claim seed derivation for the multi-claim fabric
+    (docs/FABRIC.md): ``N`` claims sharing one ``base_seed`` each get
+    an independent, replayable oracle stream.
+
+    Same discipline as the fault plan's injection keys
+    (``resilience/faults.py``): the claim id is folded in via
+    ``zlib.crc32(repr(claim_id))`` — NOT ``hash()``, which Python
+    randomizes per process and would silently break cross-process
+    replay — and mixed with the base seed by the plan's polynomial so
+    nearby base seeds and nearby claim ids both decorrelate.  The
+    result fits a ``jax.random.PRNGKey`` / ``np.random.default_rng``
+    seed and is a pure function of ``(base_seed, claim_id)``.
+    """
+    crc = zlib.crc32(repr(claim_id).encode())
+    mixed = (int(base_seed) * 1_000_003 + crc) & 0xFFFFFFFFFFFFFFFF
+    # Fold to 32 bits: PRNGKey wants a word-sized seed, and the crc in
+    # the low word alone would make claim streams independent of the
+    # base seed for base_seed=0.
+    return ((mixed >> 32) ^ mixed) & 0xFFFFFFFF
 
 
 def beta_mode(a: float, b: float) -> float:

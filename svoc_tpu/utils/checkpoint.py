@@ -192,4 +192,22 @@ def restore_simulation(path: str, session) -> None:
     # acting on the discarded pre-restore contract.
     session.supervisor.adapter = session.adapter
     session.supervisor.config = restored_config.supervisor
+    # Claim-derived state (docs/FABRIC.md) is computed at Session
+    # construction; a claim session's checkpoint restored into a plain
+    # Session() must keep partitioning the journal per claim (lineage
+    # ``blk<scope>-<claim>-<n>``) and labeling supervisor series, or
+    # its audit records and per-claim fingerprints silently stop
+    # matching.  (The breaker keeps the constructing session's series
+    # name — breaker state is deliberately NOT checkpointed.)
+    session.supervisor.claim = restored_config.claim
+    scope = (
+        restored_config.lineage_scope
+        if restored_config.lineage_scope is not None
+        else session.lineage_prefix[len("blk"):].split("-", 1)[0]
+    )
+    session.lineage_prefix = (
+        f"blk{scope}-{restored_config.claim}"
+        if restored_config.claim
+        else f"blk{scope}"
+    )
     session.simulation_step = payload["simulation_step"]

@@ -418,17 +418,29 @@ class EventJournal:
         *,
         type: Optional[str] = None,
         lineage: Optional[str] = None,
+        lineage_prefix: Optional[str] = None,
     ) -> List[EventRecord]:
         """Newest-last slice of the ring, optionally filtered by type
         and/or lineage BEFORE the tail cut (so ``recent(5,
         lineage=...)`` is the block's last 5 events, not the journal's
-        last 5 that happen to match)."""
+        last 5 that happen to match).  ``lineage_prefix`` matches a
+        lineage FAMILY — the multi-claim fabric's per-claim partition:
+        a claim session mints ``blk<scope>-<claim>-<n>`` ids, so the
+        prefix ``blk<scope>-<claim>-`` selects every block that claim
+        ever published (docs/FABRIC.md)."""
         with self._lock:
             events = list(self._ring)
         if type is not None:
             events = [e for e in events if e.type == type]
         if lineage is not None:
             events = [e for e in events if e.lineage == lineage]
+        if lineage_prefix is not None:
+            events = [
+                e
+                for e in events
+                if e.lineage is not None
+                and e.lineage.startswith(lineage_prefix)
+            ]
         return events if n is None else events[-n:]
 
     def since(self, seq: int, limit: Optional[int] = None) -> List[EventRecord]:
@@ -456,12 +468,27 @@ class EventJournal:
 
     # -- replay identity ----------------------------------------------------
 
-    def fingerprint(self) -> str:
+    def fingerprint(self, lineage_prefix: Optional[str] = None) -> str:
         """Canonical digest of the buffered event stream — sequence,
         types, lineage and data; never wall timestamps.  Two seeded
-        replays of one scenario must agree on this byte-for-byte."""
+        replays of one scenario must agree on this byte-for-byte.
+
+        ``lineage_prefix`` digests one claim's slice of a shared
+        journal (``make fabric-smoke``'s per-claim replay witness).
+        The filtered payloads still carry their GLOBAL seqs — per-claim
+        identity across runs therefore also certifies that the
+        scheduler interleaved the claims identically, which is exactly
+        what a seeded fabric replay must reproduce."""
         with self._lock:
-            payloads = [e.fingerprint_payload() for e in self._ring]
+            events = list(self._ring)
+        if lineage_prefix is not None:
+            events = [
+                e
+                for e in events
+                if e.lineage is not None
+                and e.lineage.startswith(lineage_prefix)
+            ]
+        payloads = [e.fingerprint_payload() for e in events]
         return hashlib.sha256(
             json.dumps(payloads, sort_keys=True).encode()
         ).hexdigest()

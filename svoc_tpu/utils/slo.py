@@ -138,6 +138,60 @@ def default_slos(
     ]
 
 
+def claim_slos(
+    registry: Optional[MetricsRegistry] = None,
+    claim: str = "",
+    *,
+    commit_objective: float = 0.99,
+    admission_objective: float = 0.90,
+) -> List[SLODefinition]:
+    """Per-claim objectives for the multi-claim fabric (docs/FABRIC.md).
+
+    The claim router maintains claim-labeled cumulative counters as it
+    multiplexes commit cycles — ``claim_commit_cycles{claim=}`` /
+    ``claim_commit_failures{claim=}`` and
+    ``claim_slots_inspected{claim=}`` / ``claim_slots_quarantined``
+    ``{claim=}`` — and each claim gets its own evaluator over them, so
+    one claim's burning error budget pages for THAT market instead of
+    diluting into a fleet-wide average (a thousand healthy claims
+    would otherwise hide one dead one forever).  SLO names are
+    claim-qualified (``commit_success@<claim>``): the burn-rate gauges
+    key on the slo label, and two claims' series must not collide."""
+    if not claim:
+        raise ValueError("claim_slos needs a claim id")
+    reg = registry or _default_registry
+    labels = {"claim": claim}
+
+    def commit_sample() -> Tuple[float, float]:
+        total = float(reg.counter("claim_commit_cycles", labels=labels).count)
+        bad = float(reg.counter("claim_commit_failures", labels=labels).count)
+        return max(0.0, total - bad), total
+
+    def admission_sample() -> Tuple[float, float]:
+        total = float(
+            reg.counter("claim_slots_inspected", labels=labels).count
+        )
+        bad = float(
+            reg.counter("claim_slots_quarantined", labels=labels).count
+        )
+        return max(0.0, total - bad), total
+
+    return [
+        SLODefinition(
+            name=f"commit_success@{claim}",
+            description=f"claim {claim}: commit cycles without a failure",
+            objective=commit_objective,
+            sample=commit_sample,
+        ),
+        SLODefinition(
+            name=f"quarantine_admission@{claim}",
+            description=f"claim {claim}: fleet slots admitted by the gate",
+            objective=admission_objective,
+            sample=admission_sample,
+        ),
+    ]
+
+
 class SLOEvaluator:
     """Samples each SLO's cumulative counters and reports fast/slow
     burn rates; thread-safe (console, soak, and the auto loop may all

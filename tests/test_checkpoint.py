@@ -53,10 +53,10 @@ class TestTrainStateCheckpoint:
 
 
 class TestSimulationCheckpoint:
-    def make_session(self):
+    def make_session(self, **cfg_kwargs):
         from tests.test_apps import make_session
 
-        return make_session()
+        return make_session(**cfg_kwargs)
 
     def test_contract_dict_roundtrip_mid_vote(self):
         from svoc_tpu.consensus.state import OracleConsensusContract
@@ -120,6 +120,38 @@ class TestSimulationCheckpoint:
         s2.fetch()
         assert s2.commit_resilient().complete
         assert s2.supervisor_step()["replaced"] == []
+
+    def test_restore_rehydrates_claim_scoped_state(self, tmp_path):
+        """Claim-derived session state (docs/FABRIC.md) is computed at
+        construction; restoring a claim session's checkpoint into a
+        plain Session() must keep minting claim-partitioned lineage ids
+        and claim-labeled supervisor events — a stale prefix would
+        silently split the claim's audit trail across two families."""
+        s = self.make_session(claim="btc", lineage_scope="ck")
+        s.fetch()
+        path = str(tmp_path / "sim.json")
+        save_simulation(path, s)
+
+        s2 = self.make_session()
+        restore_simulation(path, s2)
+        assert s2.config.claim == "btc"
+        assert s2.lineage_prefix == "blkck-btc"
+        assert s2.supervisor.claim == "btc"
+        s2.fetch()
+        assert s2.last_lineage.startswith("blkck-btc-")
+        # And the reverse: a claim checkpoint is authoritative — a
+        # plain (claimless) checkpoint restored into a claim session
+        # drops the claim segment, keeping its own process scope.
+        s3 = self.make_session()
+        s3.fetch()
+        plain = str(tmp_path / "plain.json")
+        save_simulation(plain, s3)
+        s4 = self.make_session(claim="eth", lineage_scope="ck")
+        restore_simulation(plain, s4)
+        assert s4.supervisor.claim is None
+        s4.fetch()
+        assert s4.last_lineage.startswith("blk")
+        assert "-eth-" not in s4.last_lineage
 
 
 def test_fleet_scale_simulation_roundtrip(tmp_path):

@@ -1,0 +1,463 @@
+"""Multi-claim consensus fabric (docs/FABRIC.md).
+
+Covers: the claim-cube kernels' parity against a Python loop of the
+single-claim kernels (gated and ungated, both consensus configs,
+including degenerate claims), the router's pow2 bucketing/padding, the
+fair weighted scheduler, per-claim seed derivation, and the two-claim
+end-to-end isolation contract (lineage families never merge, one
+claim's poison never crosses the claim axis).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from svoc_tpu.consensus.batch import (  # noqa: E402
+    claims_consensus,
+    claims_consensus_gated,
+    claims_consensus_sanitized,
+    pad_claim_cube,
+    pow2_bucket,
+)
+from svoc_tpu.consensus.kernel import (  # noqa: E402
+    ConsensusConfig,
+    consensus_step,
+    consensus_step_gated,
+)
+from svoc_tpu.fabric.registry import ClaimRegistry, ClaimSpec, ClaimState  # noqa: E402
+from svoc_tpu.fabric.router import ClaimRouter  # noqa: E402
+from svoc_tpu.sim.generators import claim_seed  # noqa: E402
+
+CONFIGS = [
+    ConsensusConfig(),  # constrained (the contract default)
+    ConsensusConfig(constrained=False, max_spread=10.0),
+]
+
+
+def _cube(c=5, n=7, m=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(0.0, 1.0, size=(c, n, m)).astype(np.float32)
+
+
+def _assert_output_close(batched, reference, i):
+    """Claim ``i`` of a batched output vs a single-claim reference."""
+    np.testing.assert_allclose(
+        np.asarray(batched.essence)[i], np.asarray(reference.essence),
+        atol=1e-6, rtol=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(batched.essence_first_pass)[i],
+        np.asarray(reference.essence_first_pass),
+        atol=1e-6, rtol=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(batched.reliability_first_pass)[i],
+        np.asarray(reference.reliability_first_pass),
+        atol=1e-6, rtol=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(batched.reliability_second_pass)[i],
+        np.asarray(reference.reliability_second_pass),
+        atol=1e-6, rtol=0,
+    )
+    assert np.array_equal(
+        np.asarray(batched.reliable)[i], np.asarray(reference.reliable)
+    )
+    assert bool(np.asarray(batched.interval_valid)[i]) == bool(
+        np.asarray(reference.interval_valid)
+    )
+
+
+class TestClaimCubeBatching:
+    def test_pow2_bucket(self):
+        assert [pow2_bucket(n) for n in (0, 1, 2, 3, 4, 5, 9, 64, 65)] == [
+            1, 1, 2, 4, 4, 8, 16, 64, 128,
+        ]
+        assert pow2_bucket(3, floor=8) == 8
+        with pytest.raises(ValueError):
+            pow2_bucket(-1)
+
+    def test_pad_claim_cube_pads_to_bucket_and_masks(self):
+        values = _cube(c=5)
+        ok = np.ones((5, 7), dtype=bool)
+        ok[1, 3] = False
+        padded, ok_padded, claim_mask = pad_claim_cube(values, ok)
+        assert padded.shape == (8, 7, 6)
+        assert ok_padded.shape == (8, 7)
+        assert claim_mask.tolist() == [True] * 5 + [False] * 3
+        np.testing.assert_array_equal(padded[:5], values)
+        np.testing.assert_array_equal(ok_padded[:5], ok)
+        assert ok_padded[5:].all()  # padding claims are all-admitted
+
+    def test_pad_claim_cube_exact_bucket_is_identity(self):
+        values = _cube(c=4)
+        padded, _ok, claim_mask = pad_claim_cube(values)
+        assert padded.shape[0] == 4 and claim_mask.all()
+
+    def test_pad_claim_cube_rejects_bad_shapes(self):
+        with pytest.raises(ValueError):
+            pad_claim_cube(np.zeros((3, 7)))
+        with pytest.raises(ValueError):
+            pad_claim_cube(np.zeros((3, 7, 6)), ok=np.ones((2, 7), dtype=bool))
+
+
+class TestClaimBatchedParity:
+    """Acceptance: the claim-batched kernels are numerically identical
+    to a per-claim Python loop of the existing single-claim kernels."""
+
+    @pytest.mark.parametrize("cfg", CONFIGS, ids=["constrained", "unconstrained"])
+    def test_ungated_matches_per_claim_loop(self, cfg):
+        values = _cube(c=5, seed=1)
+        padded, _ok, claim_mask = pad_claim_cube(values)
+        out = claims_consensus(
+            jnp.asarray(padded), jnp.asarray(claim_mask), cfg
+        )
+        for i in range(values.shape[0]):
+            ref = consensus_step(jnp.asarray(values[i]), cfg)
+            _assert_output_close(out, ref, i)
+
+    @pytest.mark.parametrize("cfg", CONFIGS, ids=["constrained", "unconstrained"])
+    def test_gated_matches_per_claim_loop(self, cfg):
+        values = _cube(c=6, seed=2)
+        ok = np.ones((6, 7), dtype=bool)
+        ok[1, 0] = False  # one quarantined slot
+        ok[2, :5] = False  # degenerate: n_ok == 2 (boundary-valid)
+        ok[3, :6] = False  # degenerate: n_ok == 1 -> no consensus
+        ok[4, :] = False  # degenerate: n_ok == 0 -> no consensus
+        padded, ok_padded, claim_mask = pad_claim_cube(values, ok)
+        out = claims_consensus_gated(
+            jnp.asarray(padded), jnp.asarray(ok_padded),
+            jnp.asarray(claim_mask), cfg,
+        )
+        for i in range(values.shape[0]):
+            ref = consensus_step_gated(
+                jnp.asarray(values[i]), jnp.asarray(ok[i]), cfg
+            )
+            _assert_output_close(out, ref, i)
+
+    def test_degenerate_claims_invalid_but_finite_and_isolated(self):
+        """A claim below 2 admitted oracles reports interval_valid=False
+        with finite essences, and its siblings in the same micro-batch
+        stay valid — sentinel leakage across the claim axis is the bug
+        this pins."""
+        cfg = ConsensusConfig()
+        values = _cube(c=3, seed=3)
+        ok = np.ones((3, 7), dtype=bool)
+        ok[1, :6] = False
+        padded, ok_padded, claim_mask = pad_claim_cube(values, ok)
+        out = claims_consensus_gated(
+            jnp.asarray(padded), jnp.asarray(ok_padded),
+            jnp.asarray(claim_mask), cfg,
+        )
+        valid = np.asarray(out.interval_valid)
+        assert not valid[1]
+        assert valid[0] and valid[2]
+        assert np.isfinite(np.asarray(out.essence)[:3]).all()
+
+    def test_padding_claims_read_as_no_consensus(self):
+        cfg = ConsensusConfig()
+        values = _cube(c=5, seed=4)
+        padded, ok_padded, claim_mask = pad_claim_cube(
+            values, np.ones((5, 7), dtype=bool)
+        )
+        out = claims_consensus_gated(
+            jnp.asarray(padded), jnp.asarray(ok_padded),
+            jnp.asarray(claim_mask), cfg,
+        )
+        assert not np.asarray(out.interval_valid)[5:].any()
+        assert not np.asarray(out.reliable)[5:].any()
+        np.testing.assert_array_equal(np.asarray(out.essence)[5:], 0.0)
+
+    def test_sanitized_fuses_gate_and_kernel(self):
+        """The fused gate+consensus dispatch must agree with the host
+        gate's admission mask and the gated kernel."""
+        from svoc_tpu.robustness.sanitize import QuarantineGate, SanitizeConfig
+
+        cfg = ConsensusConfig()
+        sanitize = SanitizeConfig.for_consensus(constrained=True)
+        values = _cube(c=4, seed=5).astype(np.float64)
+        values[0, 2, 0] = np.nan
+        values[1, 4, :] = 7.5  # out of the constrained [0, 1] domain
+        padded, _ok, claim_mask = pad_claim_cube(values.astype(np.float32))
+        out, ok = claims_consensus_sanitized(
+            jnp.asarray(padded), jnp.asarray(claim_mask), cfg,
+            sanitize.lo, sanitize.hi,
+        )
+        gate = QuarantineGate(sanitize)
+        for i in range(4):
+            report = gate.inspect(values[i], count=False)
+            np.testing.assert_array_equal(np.asarray(ok)[i], report.ok)
+            ref = consensus_step_gated(
+                jnp.asarray(values[i], dtype=jnp.float32),
+                jnp.asarray(report.ok), cfg,
+            )
+            _assert_output_close(out, ref, i)
+
+
+class TestClaimSeed:
+    def test_deterministic_and_distinct(self):
+        assert claim_seed(0, "alpha") == claim_seed(0, "alpha")
+        seeds = {claim_seed(0, f"claim{i}") for i in range(64)}
+        assert len(seeds) == 64  # no collisions across nearby ids
+        assert claim_seed(0, "alpha") != claim_seed(1, "alpha")
+        for s in seeds:
+            assert 0 <= s < 2**32  # PRNGKey/word-sized
+
+    def test_base_seed_mixes_even_at_zero(self):
+        # The crc is folded with the base seed, not OR'd into the low
+        # word: base_seed=0 must still shift every claim's stream.
+        assert claim_seed(0, "x") != claim_seed(7, "x")
+
+
+class TestClaimSpec:
+    def test_rejects_separator_ids(self):
+        for bad in ("", "a-b", "a/b"):
+            with pytest.raises(ValueError):
+                ClaimSpec(claim_id=bad)
+
+    def test_rejects_bad_weight_and_spread(self):
+        with pytest.raises(ValueError):
+            ClaimSpec(claim_id="a", weight=0)
+        with pytest.raises(ValueError):
+            ClaimSpec(claim_id="a", constrained=False, max_spread=0.0)
+
+    def test_consensus_config_groups_identical_claims(self):
+        a = ClaimSpec(claim_id="a").consensus_config()
+        b = ClaimSpec(claim_id="b").consensus_config()
+        assert a == b  # same config -> same micro-batch group
+
+
+class TestRouterScheduling:
+    def _registry_with(self, specs):
+        registry = ClaimRegistry()
+        for spec in specs:
+            registry.add(spec, session=None, evaluator=None)
+        return registry
+
+    def test_weighted_rotation_is_fair_and_deterministic(self):
+        registry = self._registry_with(
+            [ClaimSpec(claim_id="a", weight=2), ClaimSpec(claim_id="b")]
+        )
+        router = ClaimRouter(registry, max_claims_per_batch=1)
+        order = [router.select()[0].spec.claim_id for _ in range(6)]
+        # Weight-2 "a" holds two rotation slots: served twice per full
+        # rotation, deterministically.
+        assert order == ["a", "a", "b", "a", "a", "b"]
+
+    def test_select_returns_distinct_claims_up_to_cap(self):
+        registry = self._registry_with(
+            [ClaimSpec(claim_id=c, weight=3) for c in ("a", "b", "c")]
+        )
+        router = ClaimRouter(registry, max_claims_per_batch=8)
+        picked = [s.spec.claim_id for s in router.select()]
+        assert sorted(picked) == ["a", "b", "c"]  # distinct despite weights
+
+    def test_paused_claims_are_skipped_and_resume(self):
+        registry = self._registry_with(
+            [ClaimSpec(claim_id="a"), ClaimSpec(claim_id="b")]
+        )
+        router = ClaimRouter(registry, max_claims_per_batch=8)
+        registry.get("a").paused = True
+        assert [s.spec.claim_id for s in router.select()] == ["b"]
+        registry.get("a").paused = False
+        assert sorted(s.spec.claim_id for s in router.select()) == ["a", "b"]
+
+    def test_membership_changes_keep_rotation_position(self):
+        registry = self._registry_with(
+            [ClaimSpec(claim_id="a"), ClaimSpec(claim_id="b")]
+        )
+        router = ClaimRouter(registry, max_claims_per_batch=1)
+        assert router.select()[0].spec.claim_id == "a"
+        registry.add(ClaimSpec(claim_id="c"), session=None, evaluator=None)
+        # b keeps its pending turn across the rebuild, and the next
+        # full rotation serves every claim exactly once — a membership
+        # change must not starve or double-serve anyone.
+        assert router.select()[0].spec.claim_id == "b"
+        next_round = [router.select()[0].spec.claim_id for _ in range(2)]
+        assert sorted(["b"] + next_round) == ["a", "b", "c"]
+
+    def test_rejects_bad_batch_cap(self):
+        with pytest.raises(ValueError):
+            ClaimRouter(ClaimRegistry(), max_claims_per_batch=0)
+
+    def test_registry_rejects_duplicates_and_unknown(self):
+        registry = self._registry_with([ClaimSpec(claim_id="a")])
+        with pytest.raises(ValueError):
+            registry.add(ClaimSpec(claim_id="a"), session=None, evaluator=None)
+        with pytest.raises(KeyError):
+            registry.get("nope")
+        assert "a" in registry and len(registry) == 1
+
+
+def _two_claim_multi(journal, metrics):
+    """A deterministic two-claim MultiSession on synthetic stores."""
+    from svoc_tpu.fabric.scenario import deterministic_vectorizer
+    from svoc_tpu.fabric.session import MultiSession
+    from svoc_tpu.io.comment_store import CommentStore
+    from svoc_tpu.io.scraper import SyntheticSource
+
+    def store_factory(claim_id):
+        store = CommentStore()
+        store.save(SyntheticSource(batch=80, seed=claim_seed(0, claim_id))())
+        return store
+
+    multi = MultiSession(
+        base_seed=0,
+        vectorizer=deterministic_vectorizer,
+        store_factory=store_factory,
+        journal=journal,
+        metrics=metrics,
+        lineage_scope="t",
+    )
+    multi.add_claim(ClaimSpec(claim_id="alpha"))
+    multi.add_claim(ClaimSpec(claim_id="beta"))
+    return multi
+
+
+class TestMultiSessionEndToEnd:
+    def test_two_claims_lineage_families_never_merge(self):
+        """ISSUE 6 satellite: a two-claim end-to-end run whose journal
+        lineage ids partition cleanly per claim — every event's lineage
+        belongs to exactly one claim's family."""
+        from svoc_tpu.utils.events import EventJournal
+        from svoc_tpu.utils.metrics import MetricsRegistry
+
+        journal = EventJournal(MetricsRegistry())
+        multi = _two_claim_multi(journal, MetricsRegistry())
+        reports = multi.run(3)
+        assert all(sorted(r["served"]) == ["alpha", "beta"] for r in reports)
+
+        alpha = multi.get("alpha").session
+        beta = multi.get("beta").session
+        assert alpha.lineage_prefix == "blkt-alpha"
+        assert beta.lineage_prefix == "blkt-beta"
+        assert alpha.last_lineage.startswith("blkt-alpha-")
+        assert beta.last_lineage.startswith("blkt-beta-")
+        prefixes = ("blkt-alpha-", "blkt-beta-")
+        for event in journal.recent():
+            if event.lineage is not None:
+                assert sum(event.lineage.startswith(p) for p in prefixes) == 1
+        # Both claims produced full per-block event sets on their own
+        # lineage, and the audit record resolves per claim.
+        for session in (alpha, beta):
+            types = {
+                e.type for e in journal.recent(lineage=session.last_lineage)
+            }
+            assert {"block.fetched", "consensus.result"} <= types
+            record = multi.audit(session.last_lineage)
+            assert record["found"]
+
+    def test_per_claim_fingerprints_differ_and_compose(self):
+        from svoc_tpu.utils.events import EventJournal
+        from svoc_tpu.utils.metrics import MetricsRegistry
+
+        journal = EventJournal(MetricsRegistry())
+        multi = _two_claim_multi(journal, MetricsRegistry())
+        multi.run(2)
+        fp_a = multi.claim_fingerprint("alpha")
+        fp_b = multi.claim_fingerprint("beta")
+        assert fp_a != fp_b
+        # The filter is a partition: an unknown prefix digests empty.
+        assert journal.fingerprint(lineage_prefix="blkt-gamma-") != fp_a
+
+    def test_snapshot_and_claims_state_shape(self):
+        from svoc_tpu.utils.events import EventJournal
+        from svoc_tpu.utils.metrics import MetricsRegistry
+
+        multi = _two_claim_multi(
+            EventJournal(MetricsRegistry()), MetricsRegistry()
+        )
+        multi.step()
+        snapshot = multi.snapshot()
+        assert snapshot["n_claims"] == 2 and snapshot["steps"] == 1
+        for claim_id in ("alpha", "beta"):
+            c = snapshot["claims"][claim_id]
+            assert c["claim"] == claim_id
+            assert c["cycles"] == 1
+            assert c["consensus"]["interval_valid"] is True
+            assert c["commit"]["complete"]
+            assert c["lineage"].startswith(f"blkt-{claim_id}-")
+        import json
+
+        json.dumps(snapshot)  # /api/state ships this verbatim
+
+    def test_raising_tamper_skips_claim_never_the_batch(self):
+        """Isolation contract: a claim whose (user-supplied) tamper
+        hook raises is skipped and counted as an anomaly — its
+        siblings are served, the loop survives."""
+        from svoc_tpu.utils.events import EventJournal
+        from svoc_tpu.utils.metrics import MetricsRegistry
+
+        metrics = MetricsRegistry()
+        multi = _two_claim_multi(EventJournal(MetricsRegistry()), metrics)
+
+        def explode(cycle, block):
+            raise IndexError("bad hook")
+
+        multi.add_claim(
+            ClaimSpec(claim_id="gamma", tamper=explode),
+            store=multi.get("alpha").session.store,
+        )
+        report = multi.step()
+        assert sorted(report["served"]) == ["alpha", "beta"]
+        assert report["skipped"]["gamma"] == "fetch_error:IndexError"
+        assert (
+            metrics.counter(
+                "fabric_claim_errors",
+                labels={"claim": "gamma", "stage": "fetch"},
+            ).count
+            == 1
+        )
+
+    def test_pause_drains_without_removing(self):
+        from svoc_tpu.utils.events import EventJournal
+        from svoc_tpu.utils.metrics import MetricsRegistry
+
+        multi = _two_claim_multi(
+            EventJournal(MetricsRegistry()), MetricsRegistry()
+        )
+        multi.pause("alpha")
+        report = multi.step()
+        assert report["served"] == ["beta"]
+        multi.pause("alpha", paused=False)
+        assert sorted(multi.step()["served"]) == ["alpha", "beta"]
+
+    def test_claims_console_command(self):
+        from svoc_tpu.apps.commands import CommandConsole
+        from svoc_tpu.utils.events import EventJournal
+        from svoc_tpu.utils.metrics import MetricsRegistry
+
+        multi = _two_claim_multi(
+            EventJournal(MetricsRegistry()), MetricsRegistry()
+        )
+        multi.step()
+        console = CommandConsole(multi.get("alpha").session)
+        assert any(
+            "no claim fabric" in line for line in console.query("claims")
+        )
+        multi.attach(console)
+        lines = console.query("claims")
+        assert any("fabric: 2 claims" in line for line in lines)
+        assert any(line.strip().startswith("alpha:") for line in lines)
+        assert any(line.strip().startswith("beta:") for line in lines)
+
+
+class TestFabricScenario:
+    def test_seeded_scenario_replays_per_claim_identical(self):
+        from svoc_tpu.fabric.scenario import run_fabric_scenario
+
+        first = run_fabric_scenario(0, cycles=6)
+        second = run_fabric_scenario(0, cycles=6)
+        assert first["journal_fingerprint"] == second["journal_fingerprint"]
+        for claim_id, c in first["claims"].items():
+            assert (
+                c["fingerprint"] == second["claims"][claim_id]["fingerprint"]
+            )
+        assert first["injection_count"] > 0
+        assert first["siblings_clean"]
+        offender = first["claims"][first["offender_claim"]]
+        assert offender["quarantine_verdicts"] == first["injection_count"]

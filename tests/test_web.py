@@ -214,6 +214,39 @@ class TestLiveRefresh:
         console.session.auto_fetch = True
         assert json.loads(get(base, "/api/state"))["auto_fetch"] is True
 
+    def test_state_carries_claims_when_fabric_attached(self, server):
+        """Multi-claim mode (docs/FABRIC.md): /api/state grows a
+        ``claims`` section — per-claim consensus slice, commit outcome,
+        and block lineage — once a MultiSession is attached; the
+        single-claim payload has no such key."""
+        base, console = server
+        assert "claims" not in json.loads(get(base, "/api/state"))
+        from svoc_tpu.fabric.registry import ClaimSpec
+        from svoc_tpu.fabric.scenario import deterministic_vectorizer
+        from svoc_tpu.fabric.session import MultiSession
+        from svoc_tpu.io.comment_store import CommentStore
+        from svoc_tpu.io.scraper import SyntheticSource
+
+        def store_factory(claim_id):
+            store = CommentStore()
+            store.save(SyntheticSource(batch=80)())
+            return store
+
+        multi = MultiSession(
+            vectorizer=deterministic_vectorizer,
+            store_factory=store_factory,
+            lineage_scope="w",
+        )
+        multi.add_claim(ClaimSpec(claim_id="alpha"))
+        multi.add_claim(ClaimSpec(claim_id="beta"))
+        multi.step()
+        multi.attach(console)
+        claims = json.loads(get(base, "/api/state"))["claims"]
+        assert sorted(claims) == ["alpha", "beta"]
+        for claim_id, c in claims.items():
+            assert c["consensus"]["interval_valid"] is True
+            assert c["lineage"].startswith(f"blkw-{claim_id}-")
+
     def test_events_stream_pushes_state_changes(self, server):
         """/api/events is the push channel (eel-websocket parity): the
         current version arrives immediately, and a session change pushes
@@ -236,7 +269,12 @@ class TestLiveRefresh:
     def test_page_is_push_first_with_poll_fallback(self, server):
         base, _ = server
         page = get(base, "/").decode()
-        assert "EventSource('/api/events')" in page
+        # The page opts into the flight recorder's typed frames (PR 5
+        # gotcha closed by the fabric PR): named 'journal' frames land
+        # in their own listener, unnamed state_version frames drive the
+        # redraw loop unchanged.
+        assert "EventSource('/api/events?journal=1')" in page
+        assert "addEventListener('journal'" in page
         assert "pushAlive" in page  # poll loop gated off while push is up
 
     def test_page_catch_up_loop_paces_and_resets_on_reconnect(self, server):

@@ -1,0 +1,115 @@
+"""Multi-claim fabric smoke: per-claim replay identity + cross-claim
+isolation as a CI gate (``make fabric-smoke``; docs/FABRIC.md).
+
+The seeded scenario (:func:`svoc_tpu.fabric.scenario.run_fabric_scenario`
+— 4 claims × 7 oracles, the last claim carrying a Byzantine offender
+slot) runs TWICE with fresh journals, metrics registries, and a pinned
+lineage scope.  The gate asserts:
+
+1. **Per-claim replay identity** — every claim's slice of the journal
+   (``fingerprint(lineage_prefix="blkfab-<claim>-")``) digests
+   byte-identically across the two runs.  Slices keep their GLOBAL
+   seqs, so per-claim identity also certifies the router interleaved
+   the claims identically — the scheduling is part of the replay
+   witness, not just the math.
+2. **Offender handled** — every injected malformed vector was
+   quarantined by the offender claim's own gate (verdicts ≥
+   injections), and the offender address was voted out through that
+   claim's contract.
+3. **Isolation** — sibling claims saw ZERO refusing quarantine
+   verdicts and ZERO replacements: one claim's poison never crosses
+   the claim axis (they share only the accelerator dispatch).
+4. **Fair service** — every claim was served every cycle (the scenario
+   batch cap covers all claims).
+
+Usage::
+
+    python tools/fabric_smoke.py [--seed 0] [--out FABRIC_SMOKE.json]
+"""
+
+from __future__ import annotations
+
+import os
+
+# Off-TPU by construction (the axon sitecustomize pins the platform, so
+# go through jax.config too — tools/soak.py measurement postmortem).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--cycles", type=int, default=12)
+    p.add_argument("--out", default="FABRIC_SMOKE.json")
+    args = p.parse_args(argv)
+
+    from svoc_tpu.fabric.scenario import run_fabric_scenario
+
+    first = run_fabric_scenario(args.seed, cycles=args.cycles)
+    second = run_fabric_scenario(args.seed, cycles=args.cycles)
+
+    claim_ids = sorted(first["claims"])
+    per_claim_identical = {
+        cid: (
+            first["claims"][cid]["fingerprint"]
+            == second["claims"][cid]["fingerprint"]
+        )
+        for cid in claim_ids
+    }
+    offender = first["claims"][first["offender_claim"]]
+    checks = {
+        "per_claim_replay_identical": all(per_claim_identical.values()),
+        "journal_replay_identical": (
+            first["journal_fingerprint"] == second["journal_fingerprint"]
+        ),
+        "journal_nonempty": first["journal_events"] > 0,
+        "injections_happened": first["injection_count"] > 0,
+        # One counted verdict per injected block, none missed (extra
+        # verdicts are impossible: honest blocks classify clean).
+        "every_injection_quarantined": (
+            offender["quarantine_verdicts"] == first["injection_count"]
+        ),
+        "offender_replaced": first["offender_replaced"],
+        "siblings_clean": first["siblings_clean"],
+        "all_claims_served_every_cycle": all(
+            n == len(claim_ids) for n in first["served_per_step"]
+        ),
+    }
+    ok = all(checks.values())
+    artifact = {
+        "seed": args.seed,
+        "cycles": args.cycles,
+        "checks": checks,
+        "ok": ok,
+        "per_claim_identical": per_claim_identical,
+        "offender_claim": first["offender_claim"],
+        "offender_address": first["offender_address"],
+        "injection_count": first["injection_count"],
+        "injections": first["injections"],
+        "claims": first["claims"],
+        "journal_fingerprint": first["journal_fingerprint"],
+        "journal_events": first["journal_events"],
+    }
+    with open(args.out + ".tmp", "w") as f:
+        json.dump(artifact, f, indent=1)
+    os.replace(args.out + ".tmp", args.out)
+    for name, passed in checks.items():
+        print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+    print(
+        f"fabric-smoke {'OK' if ok else 'FAILED'}: "
+        f"{len(claim_ids)} claims × {args.cycles} cycles, "
+        f"{first['injection_count']} injections quarantined, "
+        f"offender {first['offender_address']} replaced in "
+        f"'{first['offender_claim']}' only -> {args.out}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
