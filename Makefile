@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke fabric-smoke clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke fabric-smoke serving-smoke clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -90,10 +90,18 @@ obs-smoke:
 fabric-smoke:
 	$(PY) tools/fabric_smoke.py
 
+# Serving-tier gate (docs/SERVING.md §smoke): the seeded virtual-time
+# micro-load (warm/overload/recovery over 3 claims) twice —
+# byte-identical journal fingerprints including every shed decision,
+# zero warm-phase shed, nonzero overload shed, real cache hits, p99
+# reported.  Seconds on CPU, no transformer builds.
+serving-smoke:
+	$(PY) tools/serving_smoke.py
+
 # The default verify path: the cheap static gate first, then the chaos
 # convergence gates (I/O-plane, then data-plane), then the flight
-# recorder, then the suite.
-verify: lint chaos-smoke robustness-smoke obs-smoke fabric-smoke test
+# recorder, then the fabric and serving tiers, then the suite.
+verify: lint chaos-smoke robustness-smoke obs-smoke fabric-smoke serving-smoke test
 
 # End-of-round gate: lint + the driver-contract guards FIRST (fast,
 # loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
@@ -105,6 +113,7 @@ presnapshot:
 	$(MAKE) robustness-smoke
 	$(MAKE) obs-smoke
 	$(MAKE) fabric-smoke
+	$(MAKE) serving-smoke
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_graft_entry.py tests/test_bench.py -q
 	$(MAKE) test
@@ -112,6 +121,12 @@ presnapshot:
 # One-line JSON throughput benchmark (flagship; --config N for others).
 bench:
 	$(PY) bench.py
+
+# Serving-tier saturation sweep (docs/SERVING.md §bench): offered-QPS
+# levels through the continuous-batching tier in virtual time →
+# BENCH_SERVING.json (p50/p99 latency, goodput, shed rate, knee).
+bench-serving:
+	$(PY) bench_serving.py
 
 # Round-long liveness-gated hardware measurement campaign (resumes its
 # HW_CAMPAIGN.json journal; run in the background for the whole round).

@@ -1685,13 +1685,23 @@ def bench_config7(seconds: float, small: bool, platform: str) -> dict:
     }
 
 
-def packed_comment_stream(pipe, source, rows: int, seq: int, max_seg: int):
+def packed_comment_stream(
+    pipe, source, rows: int, seq: int, max_seg: int, fill_stats=None
+):
     """Generator of ``(PackedBatch, n_comments)`` with fixed ``[rows,
     seq]`` shapes: the comment buffer always holds enough token lists
     (``rows * max_seg`` worst case) to fill every row, so no packed
     batch is ever partially empty (the packed serving window contract —
     ``svoc_tpu/parallel/serving.py:packed_serving_step_fn``).  Shared by
     configs 8 and 9.
+
+    ``fill_stats`` (optional dict) accumulates per-batch occupancy from
+    :func:`svoc_tpu.models.packing.fill_ratios` — ``batches`` plus
+    summed ``segments``/``tokens`` fractions.  The serving batcher's
+    headroom claim (docs/SERVING.md §batcher) rests on these numbers:
+    a mean segment fill well under 1.0 is the idle capacity cross-claim
+    assembly exists to use.  Mutated on the producer thread; read it
+    only after the stream is closed.
 
     Two host stages, each on its own thread: tokenize+strip runs in an
     inner :class:`PrefetchPipeline` (the C++ tokenizer releases the
@@ -1705,7 +1715,11 @@ def packed_comment_stream(pipe, source, rows: int, seq: int, max_seg: int):
     import collections
 
     from svoc_tpu.io.pipeline import PrefetchPipeline
-    from svoc_tpu.models.packing import pack_tokens_auto, strip_padding
+    from svoc_tpu.models.packing import (
+        fill_ratios,
+        pack_tokens_auto,
+        strip_padding,
+    )
 
     pad_id = pipe.tokenizer.pad_id
     buf = collections.deque()
@@ -1728,6 +1742,13 @@ def packed_comment_stream(pipe, source, rows: int, seq: int, max_seg: int):
             batch, n = pack_tokens_auto(
                 list(buf), seq, max_seg, pad_id, rows=rows
             )
+            if fill_stats is not None:
+                ratios = fill_ratios(batch)
+                fill_stats["batches"] = fill_stats.get("batches", 0) + 1
+                for kind in ("segments", "tokens"):
+                    fill_stats[kind] = (
+                        fill_stats.get(kind, 0.0) + ratios[kind]
+                    )
             for _ in range(n):
                 buf.popleft()
             yield batch, n
@@ -1753,6 +1774,24 @@ def packed_put_fn(row_shard=None):
         return dev, valid, n
 
     return put
+
+
+def fill_ratio_detail(fill_stats: dict) -> dict:
+    """``packing_fill_ratio`` detail block from a
+    :func:`packed_comment_stream` ``fill_stats`` accumulator — mean
+    segment/token occupancy over the run (empty when the stream never
+    produced a batch).  Pairs with the live ``packing_fill_ratio{kind=}``
+    gauges the pack path exports (docs/SERVING.md §batcher)."""
+    n = fill_stats.get("batches", 0)
+    if not n:
+        return {}
+    return {
+        "packing_fill_ratio": {
+            "segments_mean": round(fill_stats["segments"] / n, 4),
+            "tokens_mean": round(fill_stats["tokens"] / n, 4),
+            "batches": n,
+        }
+    }
 
 
 def bench_config8(seconds: float, small: bool, platform: str) -> dict:
@@ -1875,9 +1914,12 @@ def _bench_packed_flagship(
 
     roundtrip = measure_roundtrip_ms()
     source = SyntheticSource(batch=rows, seed=0)
+    fill_stats: dict = {}
 
     def packed_batches():
-        return packed_comment_stream(pipe, source, rows, seq, max_seg)
+        return packed_comment_stream(
+            pipe, source, rows, seq, max_seg, fill_stats=fill_stats
+        )
 
     put = packed_put_fn()
 
@@ -2046,6 +2088,7 @@ def _bench_packed_flagship(
             **stream_detail(stream_stats, steps),
             "device_roundtrip_ms": round(roundtrip, 3),
             "packing_factor": round(packing_factor, 3),
+            **fill_ratio_detail(fill_stats),
             "comments_per_step_mean": round(n_comments / max(steps, 1), 1),
             "row_tokens_per_sec": round(row_tokens_per_sec, 1),
             "packed_forward_ms": round(fwd_ms, 3),
@@ -2144,9 +2187,12 @@ def _bench_packed_dp_serving(
     drain_fleet = fleet_step_fn(mesh, ccfg, n_oracles, subset_size=10)
     roundtrip = measure_roundtrip_ms()
     source = SyntheticSource(batch=rows, seed=0)
+    fill_stats: dict = {}
 
     def packed_batches():
-        return packed_comment_stream(pipe, source, rows, seq, max_seg)
+        return packed_comment_stream(
+            pipe, source, rows, seq, max_seg, fill_stats=fill_stats
+        )
 
     put = packed_put_fn(row_shard)
 
@@ -2276,6 +2322,7 @@ def _bench_packed_dp_serving(
             "per_device_rows": per_dev_rows,
             **stream_detail(stream_stats, steps),
             "packing_factor": round(packing_factor, 3),
+            **fill_ratio_detail(fill_stats),
             "serving_step_ms": round(step_ms, 3),
             "serving_step_exec_ms": round(step_exec_ms, 3),
             "row_tokens_per_sec": round(row_tokens_per_sec, 1),

@@ -48,9 +48,14 @@ HELP = """Commands:
       default 10)
     - audit [lineage] (per-block audit record — events, spans, and a
       summary joined on one lineage id; default: the last fetch)
-    - slo (declarative objectives as fast/slow burn rates)
+    - slo (declarative objectives as fast/slow burn rates; with a
+      fabric/serving tier attached, per-claim and serving-tier burn
+      rates follow the session's)
     - claims (multi-claim fabric status: per-claim cycles, consensus
       validity, replacements, lineage — docs/FABRIC.md)
+    - serving [submit <claim> <text...> | step] (continuous-batching
+      serving tier status / one manual request / one manual cycle —
+      docs/SERVING.md)
     - multimodal [K|auto] (mixture analysis of the last fetch;
       default K=2, 'auto' selects K by BIC)
 
@@ -102,6 +107,11 @@ class CommandConsole:
         #: ``/api/state``'s ``claims`` section read it.  None = the
         #: single-claim console of PRs 1–5, unchanged.
         self.fabric = None
+        #: Continuous-batching serving tier (docs/SERVING.md): set by
+        #: ``ServingTier.attach`` — the ``serving`` command,
+        #: ``POST /api/submit``, and ``/api/state``'s ``serving``
+        #: section read it.  None = no request path (batch-only).
+        self.serving = None
         self._auto_fetch_thread: Optional[threading.Thread] = None
         self._scraper_stop: Optional[threading.Event] = None
         self._scraper_thread: Optional[threading.Thread] = None
@@ -556,17 +566,114 @@ class CommandConsole:
                         )
                         + (f" block={c['lineage']}" if c.get("lineage") else "")
                     )
-            elif cmd == "slo":
-                snap = self.session.slo_snapshot()
-                for name in sorted(snap):
-                    s = snap[name]
+            elif cmd == "serving":
+                # Continuous-batching serving tier (docs/SERVING.md):
+                # status, one manual submit, or one manual cycle.
+                if self.serving is None:
                     emit(
-                        f"{name} (objective {s['objective']:.0%}): "
-                        f"fast burn {s['fast']['burn']:.2f}x, "
-                        f"slow burn {s['slow']['burn']:.2f}x"
-                        + ("  ALERTING" if s["alerting"] else "")
+                        "no serving tier attached — this console serves "
+                        "batch/pull mode only"
                     )
-                    emit(f"  {s['description']}: {s['good']:g}/{s['total']:g} good")
+                    return out
+                if args and args[0] == "submit":
+                    if len(args) < 3:
+                        emit("usage: serving submit <claim> <text...>")
+                        return out
+                    try:
+                        response = self.serving.submit(
+                            args[1], " ".join(args[2:])
+                        )
+                    except KeyError:
+                        emit(f"unknown claim '{args[1]}'")
+                        return out
+                    emit(
+                        f"{response['status']}: {response['request_id']}"
+                        + (
+                            f" ({response['reason']})"
+                            if response["status"] == "shed"
+                            else ""
+                        )
+                        + f" lineage={response['lineage']}"
+                    )
+                elif args and args[0] == "step":
+                    report = self.serving.step()
+                    emit(
+                        f"step {report['step']}: {report['requests']} "
+                        f"requests over {report['claims']} claims, "
+                        f"served {len(report['served'])}"
+                    )
+                elif args:
+                    emit("usage: serving [submit <claim> <text...> | step]")
+                else:
+                    snap = self.serving.snapshot()
+                    emit(
+                        f"serving: {snap['steps']} steps, "
+                        f"submitted={snap['submitted']:g} "
+                        f"admitted={snap['admitted']:g} "
+                        f"cached={snap['cached']:g} "
+                        f"shed={snap['shed']:g} "
+                        f"completed={snap['completed']:g}"
+                    )
+                    cache = snap["cache"]
+                    emit(
+                        f"  cache: {cache['size']}/{cache['capacity']} "
+                        f"entries, hit rate {cache['hit_rate']:.1%} "
+                        f"({cache['hits']:g} hits, "
+                        f"{cache['evictions']:g} evictions)"
+                    )
+                    acfg = self.serving.frontend.controller.config
+                    emit(
+                        f"  burn rate: {snap['burn_rate']:.2f}x "
+                        f"({acfg.burn_slo} {acfg.burn_window} window)"
+                    )
+                    latency = snap["latency"]
+                    if latency.get("count"):
+                        emit(
+                            f"  latency: p50 {latency['p50'] * 1e3:.1f} ms, "
+                            f"p99 {latency['p99'] * 1e3:.1f} ms "
+                            f"over {latency['count']:g} requests"
+                        )
+                    queues = snap["queues"]
+                    if any(queues.values()):
+                        emit(
+                            "  queues: "
+                            + ", ".join(
+                                f"{cid}={depth}"
+                                for cid, depth in sorted(queues.items())
+                                if depth
+                            )
+                        )
+            elif cmd == "slo":
+
+                def emit_burns(snapshot, detail: bool = False) -> None:
+                    for name in sorted(snapshot):
+                        s = snapshot[name]
+                        emit(
+                            f"{name} (objective {s['objective']:.0%}): "
+                            f"fast burn {s['fast']['burn']:.2f}x, "
+                            f"slow burn {s['slow']['burn']:.2f}x"
+                            + ("  ALERTING" if s["alerting"] else "")
+                        )
+                        if detail:
+                            emit(
+                                f"  {s['description']}: "
+                                f"{s['good']:g}/{s['total']:g} good"
+                            )
+
+                emit_burns(self.session.slo_snapshot(), detail=True)
+                # Per-claim burn rates (docs/FABRIC.md §slo): each
+                # claim's evaluator covers ITS commit/admission
+                # counters, so one burning market reads as that market,
+                # not as fleet-average dilution.
+                if self.fabric is not None:
+                    for state in self.fabric.registry.states():
+                        emit_burns(state.evaluator.evaluate())
+                # Serving-tier objectives (docs/SERVING.md): the
+                # request_latency burn here is the SAME gauge admission
+                # reads — the operator sees exactly what the controller
+                # sees.
+                if self.serving is not None:
+                    emit_burns(self.serving.slo_snapshot())
             elif cmd == "multimodal":
                 # Beyond-reference: mixture-model analysis of the LAST
                 # fetched fleet (the scenario documentation/README.md:

@@ -67,6 +67,24 @@ class EmptyStoreError(RuntimeError):
     legitimately race the first scrape."""
 
 
+class DegenerateBlockError(RuntimeError):
+    """Committing this block would panic the on-chain consensus: the
+    contract's golden recompute divides by a zero standard deviation
+    when every reliable prediction agrees exactly in some dimension
+    (``math.cairo:320-338`` skewness over a zero-variance sample — an
+    i128 division by zero, which reverts the transaction).
+
+    A request-fed cold start produces exactly this shape (one comment →
+    every honest bootstrap averages the same vector), so both commit
+    paths dry-run the faithful engine on **request-fed blocks** and
+    refuse pre-tx — the serving tier defers the chain write
+    (``commit.deferred``) instead of stranding the last signer and
+    churning the supervisor's replacement clock over deterministic
+    math.  Store-driven blocks keep their exact historical commit
+    semantics (partial fleets land, per-oracle failures charge the
+    supervisor), which tier-1 pins."""
+
+
 @dataclasses.dataclass
 class SessionConfig:
     """``client/common.py:7-31`` constants, as explicit configuration."""
@@ -254,6 +272,24 @@ class Session:
         )
         self.predictions: Optional[np.ndarray] = None
         self.last_preview: Optional[Dict] = None
+        #: Rolling request-context window (request-driven serving,
+        #: docs/SERVING.md): consensus in pull mode runs over a
+        #: ``config.window``-comment store window, so the serving tier
+        #: must not degrade it to "this step's requests only" — a
+        #: 1-request block would make every honest bootstrap identical
+        #: and the faithful commit would panic on-chain (zero-variance
+        #: skewness, ``math.cairo:320-338``).  ``fetch(window=...)``
+        #: appends each feed here and serves consensus over the last
+        #: ``config.window`` vectors: the claim's recent sentiment plus
+        #: the new requests, exactly the pull-mode window semantics.
+        self._request_window: Optional[np.ndarray] = None
+        #: Source of the published block: ``"store"`` (pull-mode scrape
+        #: window) or ``"serving"`` (request-fed, ``fetch(window=...)``).
+        #: The commit paths read it to scope the degeneracy dry-run to
+        #: request-fed blocks only — store-driven commits keep their
+        #: exact historical semantics (partial fleets, per-oracle
+        #: failures), which tier-1 pins.
+        self._block_source: str = "store"
         #: Lazy SLO evaluator (``svoc_tpu.utils.slo``) over the shared
         #: registry — built on first use so sessions that never ask for
         #: burn rates pay nothing.
@@ -390,6 +426,7 @@ class Session:
     def fetch(
         self,
         tamper: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+        window: Optional[np.ndarray] = None,
     ) -> Dict:
         """One simulation step: window → sentiment → fleet → preview.
 
@@ -404,6 +441,16 @@ class Session:
         one claim of a multi-claim run.  The gate's counted verdict
         therefore describes the tampered block it will actually refuse
         to commit (one verdict per block, as always).
+
+        ``window`` (request-driven feed, docs/SERVING.md): precomputed
+        ``[K, dimension]`` sentiment vectors — the serving batcher
+        already tokenized and forwarded the requests in one cross-claim
+        packed batch, so this path skips the store read and the
+        vectorize stage entirely and feeds the vectors straight into
+        the fleet bootstrap.  Lineage, the quarantine verdict, the
+        journal events, and the publish ordering are identical to the
+        store-driven path — a request-fed block audits exactly like a
+        scraped one.
         """
         # The session lock is held only around bounded in-memory work
         # (cursor advance + claim, PRNG split, publish) — NOT around
@@ -415,9 +462,10 @@ class Session:
         # token keeps publishes in window order.
         with metrics.timer("fetch_latency").time(), stage_span("fetch"):
             with self.lock:
-                comments, _dates, self.simulation_step = self.store.read_window(
-                    self.simulation_step, self.config.window, self.config.fetch_limit
-                )
+                if window is None:
+                    comments, _dates, self.simulation_step = self.store.read_window(
+                        self.simulation_step, self.config.window, self.config.fetch_limit
+                    )
                 self._fetch_claim += 1
                 claim = self._fetch_claim
                 step = self.simulation_step
@@ -429,26 +477,98 @@ class Session:
             # record (docs/OBSERVABILITY.md §lineage).
             lineage = mint_lineage(claim, prefix=self.lineage_prefix)
             tracer.annotate_lineage(lineage)
-            if not comments:
-                raise EmptyStoreError(
-                    "comment store is empty — run the scraper (or seed the "
-                    "store) before fetching"
+            if window is None:
+                if not comments:
+                    raise EmptyStoreError(
+                        "comment store is empty — run the scraper (or seed the "
+                        "store) before fetching"
+                    )
+                n_comments = len(comments)
+                self.journal.emit(
+                    "block.fetched",
+                    lineage=lineage,
+                    n_comments=n_comments,
+                    cursor=step,
                 )
-            self.journal.emit(
-                "block.fetched",
-                lineage=lineage,
-                n_comments=len(comments),
-                cursor=step,
-            )
-            # Resolved only now: an empty store must fail in
-            # milliseconds, not after a transformer build.
-            vectorize = self.vectorizer
-            # A SentimentPipeline records its own tokenize/pack/forward
-            # child spans; "vectorize" covers injected vectorizers too.
-            with stage_span("vectorize"):
-                window = jnp.asarray(
-                    np.asarray(vectorize(comments), dtype=np.float32)
+                # Resolved only now: an empty store must fail in
+                # milliseconds, not after a transformer build.
+                vectorize = self.vectorizer
+                # A SentimentPipeline records its own tokenize/pack/
+                # forward child spans; "vectorize" covers injected
+                # vectorizers too.
+                with stage_span("vectorize"):
+                    window = jnp.asarray(
+                        np.asarray(vectorize(comments), dtype=np.float32)
+                    )
+                subset = self.config.bootstrap_subset
+                source = "store"
+            else:
+                window_np = np.asarray(window, dtype=np.float32)
+                if (
+                    window_np.ndim != 2
+                    or window_np.shape[1] != self.config.dimension
+                ):
+                    raise ValueError(
+                        f"request window must be [K, {self.config.dimension}]"
+                        f", got {window_np.shape}"
+                    )
+                if window_np.shape[0] == 0:
+                    raise EmptyStoreError(
+                        "request-driven fetch got an empty window — the "
+                        "feed should skip claims with no pending requests"
+                    )
+                n_comments = int(window_np.shape[0])
+                # Rolling request context (docs/SERVING.md §windows):
+                # consensus runs over the claim's recent sentiment PLUS
+                # the new requests, capped at the pull-mode window size
+                # — a lone request extends the last block's context
+                # instead of forming a degenerate 1-comment block.
+                with self.lock:
+                    if self._request_window is not None:
+                        window_np = np.concatenate(
+                            [self._request_window, window_np]
+                        )
+                    # Cap unconditionally: a first feed larger than the
+                    # pull-mode window (a flooded cold claim) must obey
+                    # the same window semantics as every later one.
+                    window_np = window_np[-self.config.window :]
+                    self._request_window = window_np
+                window_rows = int(window_np.shape[0])
+                # Request windows are small and arbitrary-sized (1..the
+                # batch budget), where store windows are large and
+                # steady.  pow2-bucket the row count by tiling the
+                # window cyclically: `_fleet` compiles O(log2 max-batch)
+                # shapes (SVOC003 discipline), and the padding rows are
+                # REAL comments repeated, so the bootstrap only ever
+                # averages served content.  The subset stays strictly
+                # under the bucket (never the configured 10 ≥ rows,
+                # which would throw in `jax.random.choice` — and a
+                # subset EQUAL to the bucket would make every honest
+                # oracle average the whole window: identical
+                # predictions, the exact zero-variance block the
+                # faithful commit refuses).
+                bucket = 1 << max(0, window_rows - 1).bit_length()
+                if bucket > window_rows:
+                    window_np = np.resize(
+                        window_np, (bucket, window_np.shape[1])
+                    )
+                subset = min(
+                    self.config.bootstrap_subset, max(1, bucket // 2)
                 )
+                source = "serving"
+                # Same event, extra source tag: store-driven blocks keep
+                # their exact historical payload (seeded smoke
+                # fingerprints), request-fed blocks are distinguishable
+                # in the audit record.
+                self.journal.emit(
+                    "block.fetched",
+                    lineage=lineage,
+                    n_comments=n_comments,
+                    cursor=step,
+                    source="serving",
+                    window_rows=window_rows,
+                )
+                window = jnp.asarray(window_np)
             with self.lock:
                 if self._key_value is None:
                     self._key_value = jax.random.PRNGKey(self.config.seed)
@@ -459,7 +579,7 @@ class Session:
                     window,
                     self.config.n_oracles,
                     self.config.n_failing,
-                    self.config.bootstrap_subset,
+                    subset,
                 )
             with stage_span("consensus"):
                 # The host conversions below are the existing fetch of
@@ -496,13 +616,13 @@ class Session:
                     "median": np.asarray(median),  # svoclint: disable=SVOC001
                     "normalized_ranks": ranks_np,
                     "honest": np.asarray(honest),  # svoclint: disable=SVOC001
-                    "n_comments": len(comments),
+                    "n_comments": n_comments,
                     "lineage": lineage,
                     "quarantine": (
                         quarantine.as_dict() if quarantine is not None else None
                     ),
                 }
-            metrics.counter("comments_processed").add(len(comments))
+            metrics.counter("comments_processed").add(n_comments)
             admitted = (
                 int(np.sum(quarantine.ok))
                 if quarantine is not None
@@ -525,6 +645,7 @@ class Session:
                 if claim > self._fetch_published:
                     self._fetch_published = claim
                     self.predictions = predictions
+                    self._block_source = source
                     self.last_quarantine = quarantine
                     self.last_lineage = lineage
                     self.last_preview = preview
@@ -539,6 +660,50 @@ class Session:
             self.state_version += 1
 
     # -- the commit path (contract.py:200-208) ------------------------------
+
+    def _refuse_degenerate(self, predictions: np.ndarray, lineage) -> None:
+        """Pre-tx dry-run of the faithful engine — the same
+        ``two_pass_consensus`` the contract's golden recompute runs when
+        the final oracle's tx lands.  A fleet whose reliable predictions
+        agree exactly in some dimension panics there (zero-variance
+        skewness, ``math.cairo:320-338`` — an i128 division by zero that
+        reverts the tx), deterministically stranding the last signer and
+        churning the supervisor over pure math.  Refusing here turns
+        that churn into a typed :class:`DegenerateBlockError` BEFORE any
+        tx, journaled as ``commit.deferred`` so the serving tier's defer
+        is auditable on the block's lineage."""
+        from svoc_tpu.consensus import wsad_engine as eng
+        from svoc_tpu.ops.fixedpoint import to_wsad
+
+        try:
+            eng.two_pass_consensus(
+                [
+                    [to_wsad(float(x)) for x in row]
+                    for row in np.asarray(predictions)
+                ],
+                constrained=self.config.constrained,
+                n_failing=self.config.n_failing,
+                max_spread=to_wsad(self.config.max_spread),
+                strict_interval=True,
+            )
+        except ZeroDivisionError:
+            metrics.counter("commit_deferred_degenerate").add(1)
+            self.journal.emit(
+                "commit.deferred", lineage=lineage, reason="degenerate"
+            )
+            raise DegenerateBlockError(
+                "refusing to commit a zero-variance fleet block: the "
+                "on-chain skewness recompute would divide by zero and "
+                "revert the final oracle's tx (math.cairo:320-338) — "
+                "defer until the block regains oracle diversity"
+            ) from None
+        except Exception:
+            # Every OTHER engine panic (interval error, codec range, …)
+            # keeps its existing commit-path semantics: the txs are sent
+            # and fail per-oracle with full breaker/supervisor
+            # accounting.  Only the deterministic zero-variance revert
+            # is worth refusing pre-tx.
+            pass
 
     def commit(self) -> int:
         """Send every oracle's prediction as its own signed tx.
@@ -564,6 +729,7 @@ class Session:
                 raise RuntimeError("fetch before commit")
             predictions = self.predictions
             lineage = self.last_lineage
+            source = self._block_source
         if self.config.quarantine_gate:
             report = self.gate.inspect(predictions, count=False)
             if not report.clean:
@@ -574,6 +740,13 @@ class Session:
                     slots=report.quarantined_slots,
                 )
                 raise QuarantinedInputError(report)
+        if source == "serving":
+            # Request-fed blocks only: a serving cold start (one
+            # request, no window history) deterministically produces
+            # the zero-variance shape — defer instead of reverting.
+            # Store-driven blocks keep their exact historical commit
+            # semantics (partial fleets, per-oracle failure charges).
+            self._refuse_degenerate(predictions, lineage)
         with self._commit_lock, metrics.timer("commit_latency").time():
             try:
                 n = self.adapter.update_all_the_predictions(
@@ -628,6 +801,7 @@ class Session:
                 raise RuntimeError("fetch before commit")
             predictions = self.predictions
             lineage = self.last_lineage
+            source = self._block_source
         # Quarantine gate (docs/ROBUSTNESS.md): refused slots never
         # produce a tx; each refusal charges the slot's oracle exactly
         # like a commit failure, so a persistent garbage emitter walks
@@ -648,6 +822,15 @@ class Session:
                             lineage=lineage,
                         )
                 metrics.counter("commit_skipped_quarantined").add(len(skip))
+        if source == "serving" and not skip:
+            # Request-fed blocks only (store-driven commits keep their
+            # exact historical semantics, which tier-1 pins).  With
+            # skipped slots the on-chain block the LAST tx activates
+            # keeps the skipped oracles' previous values, so a
+            # full-predictions dry-run would not be exact — and a
+            # partially-skipped fleet never reproduces the cold-start
+            # all-identical shape this guard exists for.
+            self._refuse_degenerate(predictions, lineage)
         with self._commit_lock, metrics.timer("commit_latency").time():
             try:
                 outcome = commit_fleet_with_resume(

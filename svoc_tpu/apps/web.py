@@ -22,7 +22,11 @@ HTML page (hand-rolled canvas scatter plots) plus two JSON endpoints:
   must not exhaust the thread pool,
 - ``GET /metrics`` — Prometheus text exposition of the shared
   observability registry (stage-span histograms, counters, timers,
-  device gauges sampled on demand; docs/OBSERVABILITY.md).
+  device gauges sampled on demand; docs/OBSERVABILITY.md),
+- ``POST /api/submit`` — the serving tier's request path
+  (docs/SERVING.md): ``{"claim": ..., "text": ...}`` through cache /
+  admission; 200 = served (``admitted``/``cached``), 429 = shed, 404 =
+  unknown claim, 503 = no serving tier attached.
 
 Start with ``python -m svoc_tpu.apps.web`` or ``serve(console)``.
 """
@@ -405,6 +409,13 @@ class _Handler(BaseHTTPRequestHandler):
             fabric = getattr(self.console, "fabric", None)
             if fabric is not None:
                 payload["claims"] = fabric.claims_state()
+            # Serving tier (docs/SERVING.md): queues, admission
+            # accounting, cache stats, live burn rate, and the
+            # request-latency percentiles — the operator's saturation
+            # view, refreshed with every state poll.
+            serving = getattr(self.console, "serving", None)
+            if serving is not None:
+                payload["serving"] = serving.snapshot()
             self._send(200, json.dumps(payload).encode(), "application/json")
         elif self.path == "/api/events" or self.path.startswith("/api/events?"):
             self._serve_events()
@@ -534,7 +545,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self.server.svoc_sse_streams -= 1
 
     def do_POST(self):  # noqa: N802
-        if self.path != "/api/query":
+        if self.path not in ("/api/query", "/api/submit"):
             self._send(404, b"not found", "text/plain")
             return
         # CSRF guard: a text/plain POST is a "simple request", so any
@@ -555,8 +566,59 @@ class _Handler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length", "0"))
         text = self.rfile.read(length).decode("utf-8", "replace")
+        if self.path == "/api/submit":
+            self._serve_submit(text)
+            return
         lines = self.console.query(text)
         self._send(200, json.dumps(lines).encode(), "application/json")
+
+    def _serve_submit(self, body: str) -> None:
+        """``POST /api/submit`` — the serving tier's ingestion edge
+        (docs/SERVING.md §api).  Body: ``{"claim": ..., "text": ...}``.
+        Status codes carry the admission verdict: 200 for served
+        (``admitted``/``cached``), **429** for ``shed`` (the standard
+        shed-load response — well-behaved clients back off, which is
+        the point of admission control), 404 for an unknown claim, 503
+        when no serving tier is attached."""
+        serving = getattr(self.console, "serving", None)
+        if serving is None:
+            self._send(
+                503,
+                json.dumps({"error": "no serving tier attached"}).encode(),
+                "application/json",
+            )
+            return
+        try:
+            request = json.loads(body)
+            claim = request["claim"]
+            text = request["text"]
+        except (ValueError, TypeError, KeyError):
+            self._send(
+                400,
+                json.dumps(
+                    {"error": 'body must be {"claim": ..., "text": ...}'}
+                ).encode(),
+                "application/json",
+            )
+            return
+        if not isinstance(claim, str) or not isinstance(text, str):
+            self._send(
+                400,
+                json.dumps({"error": "claim and text must be strings"}).encode(),
+                "application/json",
+            )
+            return
+        try:
+            response = serving.submit(claim, text)
+        except KeyError:
+            self._send(
+                404,
+                json.dumps({"error": f"unknown claim {claim!r}"}).encode(),
+                "application/json",
+            )
+            return
+        code = 429 if response["status"] == "shed" else 200
+        self._send(code, json.dumps(response).encode(), "application/json")
 
     def log_message(self, *args):  # silence request logging
         pass

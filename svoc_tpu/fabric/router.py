@@ -40,8 +40,12 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from svoc_tpu.apps.session import EmptyStoreError
-from svoc_tpu.consensus.batch import claims_consensus_gated, pad_claim_cube
+from svoc_tpu.apps.session import DegenerateBlockError, EmptyStoreError
+from svoc_tpu.consensus.batch import (
+    claims_consensus_gated,
+    claims_consensus_sanitized,
+    pad_claim_cube,
+)
 from svoc_tpu.fabric.registry import ClaimRegistry, ClaimState
 from svoc_tpu.io.chain import ChainCommitError
 from svoc_tpu.resilience.breaker import CircuitOpenError
@@ -73,6 +77,7 @@ class ClaimRouter:
         max_claims_per_batch: int = 8,
         metrics: Optional[MetricsRegistry] = None,
         journal=None,
+        sanitized_dispatch: bool = False,
     ):
         if max_claims_per_batch < 1:
             raise ValueError("max_claims_per_batch must be >= 1")
@@ -80,6 +85,14 @@ class ClaimRouter:
         self.max_claims_per_batch = max_claims_per_batch
         self._metrics = metrics or _default_registry
         self._journal = journal
+        #: Fuse gate + consensus into ONE traced program per micro-batch
+        #: (:func:`svoc_tpu.consensus.batch.claims_consensus_sanitized`)
+        #: instead of reusing the host gate's per-claim verdicts.  The
+        #: serving tier turns this on — admission masks come out of the
+        #: same dispatch the consensus runs in, no host round-trip
+        #: between them.  Off by default: the pull-mode fabric keeps its
+        #: PR 6 behavior (and its seeded smoke fingerprints) unchanged.
+        self.sanitized_dispatch = sanitized_dispatch
         self._lock = threading.Lock()
         #: weighted rotation: claim ids, each appearing ``weight``
         #: times.  Rebuilt lazily when the registry's membership
@@ -152,11 +165,25 @@ class ClaimRouter:
 
     # -- the multiplexed cycle ----------------------------------------------
 
-    def step(self) -> Dict[str, Any]:
+    def step(
+        self, feeds: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
         """One fabric cycle over the next micro-batch.  Never raises on
         a per-claim failure (an empty store or open breaker in one
         claim must not starve its siblings); per-claim errors land in
-        the report and the claim's own counters."""
+        the report and the claim's own counters.
+
+        ``feeds`` switches the cycle to **request-driven** feeding
+        (docs/SERVING.md): a ``{claim_id: [K, M] sentiment vectors}``
+        map from the serving batcher.  Only fed claims are served this
+        cycle (the batcher already decided who has work — an idle claim
+        is not an error and costs nothing), each through
+        ``Session.fetch(window=...)``, preserving lineage, gate
+        verdicts, and the per-claim isolation contract: a claim whose
+        feed is malformed (wrong dimension, raising tamper) lands in
+        ITS ``fabric_claim_errors{claim=,stage="fetch"}`` and its
+        siblings are still served.  ``feeds=None`` is the PR 6
+        pull-mode cycle, byte-for-byte unchanged."""
         self.steps += 1
         report: Dict[str, Any] = {
             "step": self.steps,
@@ -164,7 +191,23 @@ class ClaimRouter:
             "skipped": {},
             "claims": {},
         }
-        selected = self.select()
+        if feeds is None:
+            selected = self.select()
+        else:
+            # Registration order (deterministic), fed + unpaused claims
+            # only.  Unknown claim ids in the feed are a caller bug —
+            # surfaced in the report, never fatal to the batch.
+            selected = []
+            known = {s.spec.claim_id: s for s in self.registry.states()}
+            for cid in feeds:
+                state = known.get(cid)
+                if state is None:
+                    report["skipped"][cid] = "unknown_claim"
+                elif state.paused:
+                    report["skipped"][cid] = "paused"
+                else:
+                    selected.append(state)
+            selected.sort(key=lambda s: s.index)
         if not selected:
             return report
 
@@ -177,7 +220,10 @@ class ClaimRouter:
                 cycle = state.cycles
                 tamper = lambda block, _t=spec.tamper, _c=cycle: _t(_c, block)
             try:
-                state.session.fetch(tamper=tamper)
+                state.session.fetch(
+                    tamper=tamper,
+                    window=None if feeds is None else feeds[spec.claim_id],
+                )
             except EmptyStoreError:
                 report["skipped"][spec.claim_id] = "empty_store"
                 continue
@@ -239,9 +285,31 @@ class ClaimRouter:
         values, ok, claim_mask = pad_claim_cube(
             np.stack(blocks), np.stack(oks)
         )
-        out = claims_consensus_gated(
-            jnp.asarray(values), jnp.asarray(ok), jnp.asarray(claim_mask), cfg
-        )
+        if self.sanitized_dispatch:
+            # Gate + consensus in ONE traced program: the in-graph
+            # quarantine twin recomputes the admission masks (identical
+            # to the host gate's — equivalence-tested in
+            # tests/test_fabric.py) and the gated kernel consumes them
+            # without a host round-trip.  Bounds come from the group's
+            # consensus config, exactly like the host gate's
+            # SanitizeConfig.for_consensus.
+            from svoc_tpu.robustness.sanitize import SanitizeConfig
+
+            bounds = SanitizeConfig.for_consensus(cfg.constrained)
+            out, ok_traced = claims_consensus_sanitized(
+                jnp.asarray(values),
+                jnp.asarray(claim_mask),
+                cfg,
+                bounds.lo,
+                bounds.hi,
+            )
+            # The traced masks become the accounting source below (one
+            # fetch covers them along with the outputs).
+            oks = list(np.asarray(ok_traced)[: len(members)])  # svoclint: disable=SVOC001
+        else:
+            out = claims_consensus_gated(
+                jnp.asarray(values), jnp.asarray(ok), jnp.asarray(claim_mask), cfg
+            )
         # ONE host sync for the whole micro-batch — the claim axis
         # amortizes the dispatch/fetch overhead that a per-claim loop
         # pays C times (bench.py --claims).
@@ -296,11 +364,31 @@ class ClaimRouter:
         claim; failures count into THAT claim's series only."""
         session = state.session
         labels = {"claim": state.spec.claim_id}
-        self._metrics.counter("claim_commit_cycles", labels=labels).add(1)
         failed = None
         outcome = None
         try:
             outcome = session.commit_resilient()
+        except DegenerateBlockError:
+            # Expected serving-tier cold start (a first request-fed
+            # block has no oracle diversity yet): the chain write is
+            # deferred, not failed — no commit budget burned, no
+            # anomaly.  It is not a GOOD commit event either:
+            # ``claim_commit_cycles`` counts only attempted chain
+            # writes (incremented below, after this early return), so
+            # a claim that defers forever reads as "no data", not as
+            # commit_success=100% with zero landed txs.  The session
+            # already journaled ``commit.deferred`` on the block's
+            # lineage.
+            self._metrics.counter(
+                "claim_commit_deferred", labels=labels
+            ).add(1)
+            state.last_commit = {"deferred": True}
+            session.supervisor_step()
+            try:
+                state.evaluator.evaluate()
+            except Exception:
+                self._metrics.counter("slo_errors").add(1)
+            return
         except (ChainCommitError, CircuitOpenError) as e:
             # The commit path's EXPECTED failure classes: routine claim
             # accounting (this claim's breaker/supervisor already saw
@@ -316,6 +404,7 @@ class ClaimRouter:
                 "fabric_claim_errors",
                 labels={"claim": state.spec.claim_id, "stage": "commit"},
             ).add(1)
+        self._metrics.counter("claim_commit_cycles", labels=labels).add(1)
         if failed is not None:
             self._metrics.counter(
                 "claim_commit_failures", labels=labels

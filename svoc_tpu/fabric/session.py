@@ -52,6 +52,8 @@ class MultiSession:
         metrics: Optional[MetricsRegistry] = None,
         lineage_scope: Optional[str] = None,
         max_claims_per_batch: int = 8,
+        sanitized_dispatch: bool = False,
+        clock: Optional[Callable[[], float]] = None,
     ):
         self.base_seed = base_seed
         self._vectorizer = vectorizer
@@ -59,15 +61,35 @@ class MultiSession:
         self._journal = journal
         self._metrics = metrics or _default_metrics
         self._lineage_scope = lineage_scope
+        #: Clock for the per-claim SLO evaluators.  Seeded serving
+        #: replays MUST pass the scenario's virtual clock here: the
+        #: evaluators emit latched ``slo.alert`` events into the same
+        #: journal the replay fingerprint digests, so wall-clock burn
+        #: windows would make two identical runs alert differently
+        #: (docs/SERVING.md §replay).
+        self._clock = clock
         self.registry = ClaimRegistry()
         self.router = ClaimRouter(
             self.registry,
             max_claims_per_batch=max_claims_per_batch,
             metrics=self._metrics,
             journal=journal,
+            sanitized_dispatch=sanitized_dispatch,
         )
         for spec in specs:
             self.add_claim(spec)
+
+    @property
+    def metrics(self) -> MetricsRegistry:
+        """The fabric's metrics registry (the serving tier and tools
+        must account into the SAME registry the router does)."""
+        return self._metrics
+
+    @property
+    def journal(self):
+        """The injected journal, or None (= the process default — the
+        serving tier resolves it the same way the router does)."""
+        return self._journal
 
     # -- claim lifecycle ----------------------------------------------------
 
@@ -119,7 +141,27 @@ class MultiSession:
             ),
             registry=self._metrics,
             journal=self._journal,
+            **({"clock": self._clock} if self._clock is not None else {}),
         )
+        # Pre-register the claim's SLO counter series (and the anomaly
+        # counter's stages) at zero, so ``render_prometheus`` exposes a
+        # complete per-claim family from registration onward — a scrape
+        # can tell "claim exists, nothing happened yet" from "claim
+        # unknown", and dashboards don't get born mid-incident.
+        labels = {"claim": spec.claim_id}
+        for name in (
+            "claim_commit_cycles",
+            "claim_commit_failures",
+            "claim_commit_deferred",
+            "claim_slots_inspected",
+            "claim_slots_quarantined",
+        ):
+            self._metrics.counter(name, labels=labels).add(0)
+        for stage in ("fetch", "commit"):
+            self._metrics.counter(
+                "fabric_claim_errors",
+                labels={"claim": spec.claim_id, "stage": stage},
+            ).add(0)
         return self.registry.add(spec, session, evaluator)
 
     def remove_claim(self, claim_id: str) -> ClaimState:
@@ -140,11 +182,12 @@ class MultiSession:
 
     # -- the multiplexed loop -----------------------------------------------
 
-    def step(self) -> Dict:
+    def step(self, feeds=None) -> Dict:
         """One fabric cycle: fair-select → fetch each → ONE claim-cube
         consensus dispatch per (shape, config) group → per-claim
-        resilient commit + supervisor + SLO."""
-        return self.router.step()
+        resilient commit + supervisor + SLO.  ``feeds`` switches to the
+        request-driven cycle (``ClaimRouter.step``, docs/SERVING.md)."""
+        return self.router.step(feeds=feeds)
 
     def run(self, cycles: int) -> List[Dict]:
         """``cycles`` steps; returns the per-step reports."""

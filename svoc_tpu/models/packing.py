@@ -147,6 +147,39 @@ def pack_tokens(
     return PackedBatch(ids, pos, seg, cls_pos, seg_valid, owner), n_consumed
 
 
+def fill_ratios(batch: PackedBatch) -> dict:
+    """Occupancy of a packed batch: ``segments`` (segments used over
+    ``R × S`` slots) and ``tokens`` (real tokens over ``R × T`` id
+    slots).  The serving batcher's headroom claim in numbers — BENCH_r05
+    measured packing_factor 3.03 against ``max_segments=8``, i.e. the
+    segment axis usually runs well under full (docs/SERVING.md)."""
+    r, s = batch.seg_valid.shape
+    t = batch.ids.shape[1]
+    segments_used = int(batch.seg_valid.sum())
+    real_tokens = int((batch.seg > 0).sum())
+    return {
+        "rows": int(r),
+        "segments_used": segments_used,
+        "segments": round(segments_used / float(max(r * s, 1)), 6),
+        "tokens": round(real_tokens / float(max(r * t, 1)), 6),
+    }
+
+
+def observe_fill_ratios(batch: PackedBatch, registry=None) -> dict:
+    """:func:`fill_ratios` plus the ``packing_fill_ratio{kind=}`` gauges
+    every pack-path caller (``SentimentPipeline.call_packed``, the bench
+    comment stream, the serving batcher) exports, so the batcher's
+    fill-the-headroom behavior is observable on ``GET /metrics``."""
+    if registry is None:
+        from svoc_tpu.utils.metrics import registry as registry
+    ratios = fill_ratios(batch)
+    for kind in ("segments", "tokens"):
+        registry.gauge("packing_fill_ratio", labels={"kind": kind}).set(
+            ratios[kind]
+        )
+    return ratios
+
+
 def pack_labels(batch: PackedBatch, labels: np.ndarray) -> np.ndarray:
     """Scatter per-comment ``labels [N, ...]`` into the packed layout
     ``[R, S, ...]`` via the owner map (zeros where no segment) — the

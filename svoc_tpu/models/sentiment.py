@@ -265,7 +265,11 @@ class SentimentPipeline:
         (``svoc_tpu.utils.events``); inside a ``fetch`` span the id is
         inherited automatically, so only detached callers (serving
         loops, tools) need to pass it."""
-        from svoc_tpu.models.packing import pack_tokens_auto, strip_padding
+        from svoc_tpu.models.packing import (
+            observe_fill_ratios,
+            pack_tokens_auto,
+            strip_padding,
+        )
 
         if not len(texts):
             return np.zeros((0, self.dimension))
@@ -276,6 +280,10 @@ class SentimentPipeline:
             batch, n = pack_tokens_auto(
                 token_lists, self.seq_len, max_segments, self.tokenizer.pad_id
             )
+        # Fill-ratio gauges (docs/SERVING.md): how much of the segment
+        # and token headroom this pack actually used — the observable
+        # behind the serving batcher's fill-the-headroom claim.
+        observe_fill_ratios(batch)
         assert n == len(texts), f"packer consumed {n}/{len(texts)} without a row cap"
         forward = self._packed_forward()
         out = np.zeros((len(texts), self.dimension), dtype=np.float64)

@@ -1,0 +1,34 @@
+"""Continuous-batching serving tier (docs/SERVING.md).
+
+The request path in front of the multi-claim fabric: async ingestion
+with SLO-driven admission control (:mod:`svoc_tpu.serving.frontend`),
+cross-claim micro-batch assembly into the packed forward and the fused
+claim-cube consensus (:mod:`svoc_tpu.serving.batcher`), a content-keyed
+dedup/result cache (:mod:`svoc_tpu.serving.cache`), the
+:class:`~svoc_tpu.serving.tier.ServingTier` facade, and the seeded
+virtual-time scenario behind ``make serving-smoke``
+(:mod:`svoc_tpu.serving.scenario`).
+"""
+
+from svoc_tpu.serving.batcher import MicroBatcher
+from svoc_tpu.serving.cache import ResultCache, content_key
+from svoc_tpu.serving.frontend import (
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionDecision,
+    ServingFrontend,
+    ServingRequest,
+)
+from svoc_tpu.serving.tier import ServingTier
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "AdmissionDecision",
+    "MicroBatcher",
+    "ResultCache",
+    "ServingFrontend",
+    "ServingRequest",
+    "ServingTier",
+    "content_key",
+]

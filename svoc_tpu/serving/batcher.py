@@ -1,0 +1,133 @@
+"""Dynamic micro-batch assembly: many claims, one device dispatch.
+
+The serving hot path has two batchable axes and this module fills both
+(docs/SERVING.md §batcher):
+
+- **the forward's segment axis** — pending requests from EVERY claim
+  are tokenized and packed together through the segment-packed flash
+  forward (:meth:`svoc_tpu.models.sentiment.SentimentPipeline.
+  call_packed`).  BENCH_r05's store-driven windows average
+  packing_factor 3.03 against ``max_segments=8``; cross-claim assembly
+  exists to fill that idle headroom — short comments from four markets
+  pack the rows a single market leaves ~60 % empty.  The pack path's
+  ``packing_fill_ratio{kind=}`` gauges make the claim checkable.
+- **the consensus' claim axis** — the per-claim vector groups feed the
+  request-driven fabric cycle, whose consensus runs as ONE fused
+  gate+kernel claim-cube dispatch
+  (:func:`svoc_tpu.consensus.batch.claims_consensus_sanitized`, the
+  router's ``sanitized_dispatch`` mode), pow2-bucketed so the compile
+  count stays bounded (SVOC003 discipline).
+
+Assembly order is a deterministic round-robin over claims in
+registration order, one request per claim per round — fair across
+claims (a deep queue cannot monopolize a batch) and replayable (the
+assembly order is part of the seeded serving fingerprint).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from svoc_tpu.serving.frontend import ServingFrontend, ServingRequest
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_registry
+
+
+class MicroBatcher:
+    """Assembles one micro-batch per serving step and runs the shared
+    cross-claim vectorize."""
+
+    def __init__(
+        self,
+        frontend: ServingFrontend,
+        vectorizer,
+        *,
+        max_requests: int = 64,
+        max_segments: int = 8,
+        metrics: Optional[MetricsRegistry] = None,
+    ):
+        if max_requests < 1:
+            raise ValueError("max_requests must be >= 1")
+        self.frontend = frontend
+        self.vectorizer = vectorizer
+        self.max_requests = max_requests
+        self.max_segments = max_segments
+        self._metrics = metrics or _default_registry
+
+    def assemble(self) -> List[ServingRequest]:
+        """Drain up to ``max_requests`` pending requests, round-robin
+        one-per-claim over the registry's registration order."""
+        picked: List[ServingRequest] = []
+        order = [
+            cid
+            for cid in self.frontend.multi.claim_ids()
+            if self.frontend.depth(cid) > 0
+        ]
+        while order and len(picked) < self.max_requests:
+            still_pending: List[str] = []
+            for cid in order:
+                if len(picked) >= self.max_requests:
+                    break
+                got = self.frontend.drain(cid, 1)
+                if got:
+                    picked.append(got[0])
+                    if self.frontend.depth(cid) > 0:
+                        still_pending.append(cid)
+            order = still_pending
+        if picked:
+            self._metrics.counter("serving_batches").add(1)
+            self._metrics.gauge("serving_batch_requests").set(len(picked))
+            self._metrics.gauge("serving_batch_claims").set(
+                len({r.claim for r in picked})
+            )
+        return picked
+
+    def vectorize(self, texts: Sequence[str]) -> np.ndarray:
+        """Texts → ``[K, M]`` sentiment vectors through the packed
+        cross-claim forward when the vectorizer is a
+        ``SentimentPipeline`` (its pack stage exports the fill-ratio
+        gauges), plain call otherwise (injected test/scenario
+        vectorizers).
+
+        Duplicate texts within one micro-batch (a hot comment
+        submitted to several claims before its first completion — the
+        dedup cache only helps ACROSS steps) are forwarded once and
+        fanned back out, so repeats never burn the packed-segment
+        headroom the batch exists to fill."""
+        texts = list(texts)
+        unique = list(dict.fromkeys(texts))
+        vectors = self._vectorize_unique(unique)
+        if len(unique) == len(texts):
+            return vectors
+        index = {text: i for i, text in enumerate(unique)}
+        return vectors[[index[text] for text in texts]]
+
+    def _vectorize_unique(self, texts: List[str]) -> np.ndarray:
+        call_packed = getattr(self.vectorizer, "call_packed", None)
+        if call_packed is not None:
+            return np.asarray(
+                call_packed(list(texts), self.max_segments), dtype=np.float64
+            )
+        return np.asarray(self.vectorizer(list(texts)), dtype=np.float64)
+
+    @staticmethod
+    def group_by_claim(
+        requests: Sequence[ServingRequest],
+    ) -> Dict[str, np.ndarray]:
+        """The request-driven feed map: per-claim ``[K, M]`` vector
+        stacks in request order (every request must already carry its
+        vector)."""
+        grouped: Dict[str, List[np.ndarray]] = {}
+        for request in requests:
+            if request.vector is None:
+                raise ValueError(
+                    f"request {request.request_id} has no vector — "
+                    "vectorize before grouping"
+                )
+            grouped.setdefault(request.claim, []).append(request.vector)
+        return {
+            cid: np.stack(vectors).astype(np.float32)
+            for cid, vectors in grouped.items()
+        }

@@ -1,0 +1,124 @@
+"""Dedup/result cache: content-keyed LRU over sentiment vectors.
+
+Serving traffic repeats — the same viral comment is submitted against
+the same claim thousands of times — and the expensive stages
+(tokenize → pack → forward) are pure functions of the text.  This cache
+keys on ``(claim, comment-content-hash)`` so a repeat skips the whole
+model path and is answered at submit time, before it ever occupies a
+queue slot or a packed segment (docs/SERVING.md §cache).
+
+Semantics:
+
+- **content-keyed**: the key digests the claim id and the raw comment
+  text; two claims submitting the same text do NOT share an entry (the
+  response also carries the claim's consensus, and an eviction in one
+  claim must not dent another's hit rate).
+- **bounded LRU**: ``capacity`` entries, least-recently-*used* evicted
+  (a hit refreshes recency), so a hot comment survives a flood of
+  one-off texts.
+- **observable**: every lookup and eviction lands in the
+  ``serving_cache{event=hit|miss|evict}`` counters the SLO/console/
+  bench surfaces read — the hit rate is a first-class serving metric.
+
+Thread-safe: the web handler's submit path and the batcher's fill path
+touch it concurrently.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional
+
+import numpy as np
+
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_registry
+
+
+def content_key(claim_id: str, text: str) -> str:
+    """The cache key: a stable digest of ``(claim, comment text)``.
+    Hash-based (not the raw text) so keys are fixed-size and never leak
+    comment content into metrics labels or logs."""
+    digest = hashlib.sha256(
+        f"{claim_id}\x00{text}".encode("utf-8", "replace")
+    ).hexdigest()
+    return digest[:24]
+
+
+class ResultCache:
+    """Bounded LRU of ``key → [M] sentiment vector``."""
+
+    def __init__(
+        self,
+        capacity: int = 4096,
+        *,
+        metrics: Optional[MetricsRegistry] = None,
+    ):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self._metrics = metrics or _default_registry
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, np.ndarray]" = OrderedDict()
+
+    def _count(self, event: str) -> None:
+        self._metrics.counter(
+            "serving_cache", labels={"event": event}
+        ).add(1)
+
+    def get(self, key: str) -> Optional[np.ndarray]:
+        """The cached vector (a copy — callers mutate responses), or
+        None.  Counts one hit or miss per lookup."""
+        with self._lock:
+            vec = self._entries.get(key)
+            if vec is not None:
+                self._entries.move_to_end(key)
+        self._count("hit" if vec is not None else "miss")
+        return None if vec is None else vec.copy()
+
+    def put(self, key: str, vector: np.ndarray) -> None:
+        """Insert/refresh an entry, evicting the least-recently-used
+        one when full.  Idempotent on repeats (the batcher computes a
+        duplicate submitted twice before its first completion)."""
+        vec = np.asarray(vector, dtype=np.float64).copy()
+        evicted = False
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self._entries[key] = vec
+            else:
+                if len(self._entries) >= self.capacity:
+                    self._entries.popitem(last=False)
+                    evicted = True
+                self._entries[key] = vec
+        if evicted:
+            self._count("evict")
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def stats(self) -> Dict[str, float]:
+        """Size + the registry's cumulative hit/miss/evict counts — the
+        console ``serving`` command / ``/api/state`` payload."""
+        counts = {
+            event: self._metrics.counter(
+                "serving_cache", labels={"event": event}
+            ).count
+            for event in ("hit", "miss", "evict")
+        }
+        lookups = counts["hit"] + counts["miss"]
+        return {
+            "size": len(self),
+            "capacity": self.capacity,
+            "hits": counts["hit"],
+            "misses": counts["miss"],
+            "evictions": counts["evict"],
+            "hit_rate": round(counts["hit"] / lookups, 6) if lookups else 0.0,
+        }

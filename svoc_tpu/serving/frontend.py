@@ -1,0 +1,336 @@
+"""Async ingestion front: bounded per-claim queues + admission control.
+
+The fabric of PR 6 *pulls* work on its own cadence; production traffic
+*pushes*.  This module is the push boundary (ROADMAP item 2, following
+G-Core's balanced trainer/server split): every submitted request lands
+in its claim's bounded queue — or is **shed before it costs anything**,
+because overload handled at the door is cheap and overload handled at
+the p99 tail burns the commit objective.
+
+Admission is layered, cheapest check first (docs/SERVING.md §admission):
+
+1. **Cache** — a ``(claim, comment-hash)`` hit is answered immediately
+   from :class:`~svoc_tpu.serving.cache.ResultCache` with the claim's
+   latest consensus attached; it never occupies a queue slot.  This is
+   also the degraded-mode path: while the tier is shedding, repeats
+   still get real answers.
+2. **Queue bound** — a full claim queue sheds with ``reason=
+   "queue_full"``.  Bounds are per claim, so one flooded market never
+   starves a sibling's slots (the PR 6 isolation contract extended to
+   the request path).
+3. **SLO burn** — the controller reads the live
+   ``slo_burn_rate{slo="request_latency", window="fast"}`` gauge the
+   PR 5 evaluator maintains; above the threshold it sheds a configured
+   fraction of cache-miss traffic with ``reason="slo_burn"`` — load
+   drops *before* the 99 % objective's budget is gone.
+
+Every decision is **deterministic and seeded**: the burn-mode shed draw
+is a crc32 of ``(seed, claim, request seq)`` — the fault-plan
+discipline of PRs 3–4 — so a seeded serving replay reproduces the exact
+shed sequence byte-for-byte (``make serving-smoke``).  Admitted and
+shed requests both emit typed journal events (``serving.admitted`` /
+``serving.shed``) carrying a block-lineage id inside the claim's
+lineage family (``blk<scope>-<claim>-rq<seq>``), so the flight recorder
+partitions serving traffic per claim exactly like consensus blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import zlib
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from svoc_tpu.serving.cache import ResultCache, content_key
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_registry
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionConfig:
+    """The admission policy's knobs.
+
+    ``shed_fraction`` is the fraction of cache-miss traffic dropped
+    while the burn gauge is above ``burn_threshold`` (1.0 = full brownout
+    of misses; 0.5 = shed every other request, selected by the seeded
+    draw).  ``seed`` keys the draw — replays of one seed shed the same
+    requests.
+    """
+
+    queue_capacity: int = 64
+    burn_slo: str = "request_latency"
+    burn_window: str = "fast"
+    #: Fast-window burn rate above which misses shed.  The default sits
+    #: well under the 14.4× page threshold: shedding is the *remedy*
+    #: that should prevent the page, not follow it.
+    burn_threshold: float = 4.0
+    shed_fraction: float = 1.0
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.queue_capacity < 1:
+            raise ValueError("queue_capacity must be >= 1")
+        if not 0.0 <= self.shed_fraction <= 1.0:
+            raise ValueError("shed_fraction must be in [0, 1]")
+        if self.burn_threshold <= 0.0:
+            raise ValueError("burn_threshold must be > 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionDecision:
+    action: str  # "admit" | "shed"
+    reason: str = ""
+
+
+class AdmissionController:
+    """Deterministic admit/shed policy over queue depth + burn gauges."""
+
+    def __init__(
+        self,
+        config: Optional[AdmissionConfig] = None,
+        *,
+        metrics: Optional[MetricsRegistry] = None,
+    ):
+        self.config = config or AdmissionConfig()
+        self._metrics = metrics or _default_registry
+
+    def burn_rate(self) -> float:
+        """The live fast-window burn of the configured SLO (0 until the
+        evaluator's first pass — a cold tier admits everything)."""
+        return self._metrics.gauge(
+            "slo_burn_rate",
+            labels={
+                "slo": self.config.burn_slo,
+                "window": self.config.burn_window,
+            },
+        ).get()
+
+    def _shed_draw(self, claim_id: str, seq: int) -> float:
+        """Uniform [0, 1) from a crc32 of (seed, claim, seq) — the
+        fault-plan keying discipline: replayable across processes,
+        decorrelated across claims and requests."""
+        key = f"{self.config.seed}:{claim_id}:{seq}".encode()
+        return zlib.crc32(key) / 2**32
+
+    def decide(
+        self, claim_id: str, queue_depth: int, seq: int
+    ) -> AdmissionDecision:
+        cfg = self.config
+        if queue_depth >= cfg.queue_capacity:
+            return AdmissionDecision("shed", "queue_full")
+        if self.burn_rate() >= cfg.burn_threshold:
+            if self._shed_draw(claim_id, seq) < cfg.shed_fraction:
+                return AdmissionDecision("shed", "slo_burn")
+        return AdmissionDecision("admit")
+
+
+class ServingRequest:
+    """One in-flight request: claim, text, content key, lineage, and
+    the completion slots the batcher fills."""
+
+    __slots__ = (
+        "claim",
+        "text",
+        "seq",
+        "request_id",
+        "lineage",
+        "key",
+        "t_submit",
+        "vector",
+    )
+
+    def __init__(
+        self,
+        claim: str,
+        text: str,
+        seq: int,
+        lineage: str,
+        t_submit: float,
+        key: Optional[str] = None,
+    ):
+        self.claim = claim
+        self.text = text
+        self.seq = seq
+        self.request_id = f"{claim}:{seq}"
+        self.lineage = lineage
+        # The submit path already hashed the text for its cache probe —
+        # reuse that digest instead of hashing twice per miss.
+        self.key = key if key is not None else content_key(claim, text)
+        self.t_submit = t_submit
+        self.vector: Optional[np.ndarray] = None
+
+
+class ServingFrontend:
+    """Per-claim bounded queues + the admission controller, over a
+    :class:`~svoc_tpu.fabric.session.MultiSession`'s claims."""
+
+    def __init__(
+        self,
+        multi,
+        *,
+        admission: Optional[AdmissionConfig] = None,
+        cache: Optional[ResultCache] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        journal=None,
+        clock=None,
+    ):
+        import time
+
+        from svoc_tpu.fabric.router import resolve_journal
+
+        self.multi = multi
+        self._metrics = metrics or _default_registry
+        self._journal = resolve_journal(journal)
+        self._clock = clock if clock is not None else time.monotonic
+        self.cache = cache if cache is not None else ResultCache(
+            metrics=self._metrics
+        )
+        self.controller = AdmissionController(admission, metrics=self._metrics)
+        self._lock = threading.Lock()
+        self._queues: Dict[str, deque] = {}
+        self._seqs: Dict[str, int] = {}
+
+    # -- the submit path ----------------------------------------------------
+
+    def submit(
+        self, claim_id: str, text: str, state=None
+    ) -> Dict[str, Any]:
+        """One request through admission.  Returns the response dict
+        the web/console surfaces serialize:
+
+        - ``status="cached"`` — answered now, with the vector and the
+          claim's latest consensus slice;
+        - ``status="admitted"`` — queued for the next micro-batch;
+        - ``status="shed"`` — rejected, with the reason.
+
+        Raises ``KeyError`` for an unknown claim (the HTTP layer maps
+        it to 404 — an unknown market is a client error, not load).
+        ``state`` lets the tier pass the claim state it already
+        resolved for its membership check, saving a registry lookup on
+        the hot path."""
+        if state is None:
+            state = self.multi.get(claim_id)  # KeyError → caller's 404
+        prefix = state.session.lineage_prefix
+        with self._lock:
+            seq = self._seqs.get(claim_id, 0) + 1
+            self._seqs[claim_id] = seq
+        # Request lineage lives INSIDE the claim's lineage family
+        # (``blk<scope>-<claim>-rq<seq>``): per-claim journal slices and
+        # fingerprints cover serving traffic with no new partition key.
+        lineage = f"{prefix}-rq{seq:06x}"
+        key = content_key(claim_id, text)
+        cached = self.cache.get(key)
+        if cached is not None:
+            self._metrics.counter(
+                "serving_cached", labels={"claim": claim_id}
+            ).add(1)
+            self._journal.emit(
+                "serving.admitted",
+                lineage=lineage,
+                claim=claim_id,
+                seq=seq,
+                source="cache",
+            )
+            return {
+                "status": "cached",
+                "claim": claim_id,
+                "request_id": f"{claim_id}:{seq}",
+                "lineage": lineage,
+                "vector": [round(float(x), 6) for x in cached],
+                "consensus": state.last_consensus,
+            }
+        request = ServingRequest(
+            claim_id, text, seq, lineage, self._clock(), key=key
+        )
+        with self._lock:
+            q = self._queues.setdefault(claim_id, deque())
+            decision = self.controller.decide(claim_id, len(q), seq)
+            if decision.action == "admit":
+                q.append(request)
+                depth = len(q)
+        if decision.action == "admit":
+            self._metrics.counter(
+                "serving_admitted", labels={"claim": claim_id}
+            ).add(1)
+            self._metrics.gauge(
+                "serving_queue_depth", labels={"claim": claim_id}
+            ).set(depth)
+            # Emission OUTSIDE the frontend lock — the journal lock is
+            # a leaf and subscribers may re-enter serving snapshots.
+            self._journal.emit(
+                "serving.admitted",
+                lineage=lineage,
+                claim=claim_id,
+                seq=seq,
+                source="queue",
+            )
+            return {
+                "status": "admitted",
+                "claim": claim_id,
+                "request_id": request.request_id,
+                "lineage": lineage,
+                "queue_depth": depth,
+            }
+        self._metrics.counter(
+            "serving_shed",
+            labels={"claim": claim_id, "reason": decision.reason},
+        ).add(1)
+        self._journal.emit(
+            "serving.shed",
+            lineage=lineage,
+            claim=claim_id,
+            seq=seq,
+            reason=decision.reason,
+        )
+        return {
+            "status": "shed",
+            "claim": claim_id,
+            "request_id": request.request_id,
+            "lineage": lineage,
+            "reason": decision.reason,
+        }
+
+    # -- the batcher's side -------------------------------------------------
+
+    def depth(self, claim_id: str) -> int:
+        with self._lock:
+            q = self._queues.get(claim_id)
+            return len(q) if q else 0
+
+    def depths(self) -> Dict[str, int]:
+        with self._lock:
+            return {cid: len(q) for cid, q in self._queues.items()}
+
+    def purge(self, claim_id: str) -> List[ServingRequest]:
+        """Drop a claim's queue outright (the claim left the fabric);
+        returns the stranded requests so the caller can account every
+        one as dropped — unaccounted strands would read as served in
+        the admission SLO forever."""
+        with self._lock:
+            q = self._queues.pop(claim_id, None)
+            out = list(q) if q else []
+        if out:
+            self._metrics.gauge(
+                "serving_queue_depth", labels={"claim": claim_id}
+            ).set(0)
+        return out
+
+    def drain(self, claim_id: str, limit: int) -> List[ServingRequest]:
+        """Pop up to ``limit`` queued requests (FIFO) and refresh the
+        depth gauge."""
+        out: List[ServingRequest] = []
+        with self._lock:
+            q = self._queues.get(claim_id)
+            if not q:
+                return out
+            while q and len(out) < limit:
+                out.append(q.popleft())
+            depth = len(q)
+        if out:
+            self._metrics.gauge(
+                "serving_queue_depth", labels={"claim": claim_id}
+            ).set(depth)
+        return out

@@ -1,0 +1,207 @@
+"""Seeded serving scenario: the ``make serving-smoke`` gate.
+
+A deterministic, virtual-time micro-load through the whole serving
+tier: open-loop arrivals over N claims sweep through three phases —
+**warm** (under capacity: shed rate must be ~0), **overload** (arrivals
+far above the per-step batch budget: queues hit their bounds, queued
+requests blow the latency target, the ``request_latency`` burn gauge
+crosses the admission threshold, and the tier MUST shed), and
+**recovery** (load drops back; queues drain).  A seeded fraction of
+arrivals repeat comments from a small hot pool, so the dedup cache
+serves real hits mid-overload (the degrade-to-cached path).
+
+Everything is a pure function of ``seed``: arrivals key off
+:func:`svoc_tpu.sim.generators.claim_seed`, the vectorizer is the
+fabric scenario's deterministic crc-of-text map, time is a virtual
+clock the scenario advances itself (latencies, burn-rate windows, and
+therefore every shed decision are clock-exact), and the run gets a
+FRESH journal + FRESH metrics registry + pinned lineage scope — the
+PR 6 replay-pinning rules (docs/SERVING.md §replay).
+``tools/serving_smoke.py`` runs it twice and asserts byte-identical
+journal fingerprints, shed > 0 only under overload, cache hits > 0,
+and a reported p99.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from svoc_tpu.fabric.registry import ClaimSpec
+from svoc_tpu.fabric.scenario import _claim_names, deterministic_vectorizer
+from svoc_tpu.sim.generators import claim_seed
+
+#: (arrivals per step, steps) per phase: warm / overload / recovery.
+DEFAULT_PHASES: Tuple[Tuple[int, int], ...] = ((6, 8), (60, 10), (6, 8))
+
+
+class VirtualClock:
+    """A monotonic clock the scenario advances explicitly — latencies
+    and SLO windows become pure functions of the step count."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = float(start)
+
+    def advance(self, dt: float) -> float:
+        self.now += float(dt)
+        return self.now
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def draw_arrival(rng, names, pool, hot_fraction, unique_text):
+    """One seeded open-loop arrival: ``(claim, text)`` with the claim
+    drawn uniformly and the text either a hot-pool repeat (the dedup
+    cache's workload) or ``unique_text(claim)``.  Shared by this
+    scenario and ``bench_serving.py`` so the smoke gate and the bench
+    artifact can never drift apart on arrival keying; the draw order
+    (claim, hot-vs-unique, pool index) is part of every seeded serving
+    fingerprint."""
+    claim = names[int(rng.integers(0, len(names)))]
+    if rng.random() < hot_fraction:
+        return claim, pool[int(rng.integers(0, len(pool)))]
+    return claim, unique_text(claim)
+
+
+def shed_by_reason(metrics) -> Dict[str, float]:
+    """Per-reason shed totals with claims folded — the reporting shape
+    both the scenario result and the bench artifact carry."""
+    out: Dict[str, float] = {}
+    for labels, count in metrics.family_series("serving_shed"):
+        reason = labels.get("reason", "")
+        out[reason] = out.get(reason, 0.0) + count
+    return out
+
+
+def run_serving_scenario(
+    seed: int = 0,
+    *,
+    phases: Tuple[Tuple[int, int], ...] = DEFAULT_PHASES,
+    n_claims: int = 3,
+    n_oracles: int = 7,
+    dimension: int = 6,
+    step_period_s: float = 0.1,
+    max_requests_per_step: int = 16,
+    queue_capacity: int = 48,
+    hot_pool: int = 10,
+    hot_fraction: float = 0.35,
+    journal=None,
+    metrics=None,
+) -> Dict[str, Any]:
+    """One seeded serving run; returns the journal fingerprint,
+    per-phase shed accounting, cache stats, and latency percentiles."""
+    from svoc_tpu.fabric.session import MultiSession
+    from svoc_tpu.serving.frontend import AdmissionConfig
+    from svoc_tpu.serving.tier import ServingTier
+    from svoc_tpu.utils.events import EventJournal
+    from svoc_tpu.utils.metrics import MetricsRegistry
+    from svoc_tpu.utils.slo import REQUEST_LATENCY_HISTOGRAM, serving_slos
+
+    journal = journal if journal is not None else EventJournal()
+    metrics = metrics if metrics is not None else MetricsRegistry()
+    clock = VirtualClock()
+    names = _claim_names(n_claims)
+
+    multi = MultiSession(
+        base_seed=seed,
+        vectorizer=deterministic_vectorizer,
+        journal=journal,
+        metrics=metrics,
+        lineage_scope="srv",
+        # Serving mode: gate + consensus fused in one traced program
+        # per micro-batch (docs/SERVING.md §consensus).
+        sanitized_dispatch=True,
+        # The per-claim SLO evaluators must share the scenario's
+        # virtual clock: their latched slo.alert events land in the
+        # fingerprinted journal, and wall-clock burn windows would let
+        # two identical runs alert differently on a loaded host.
+        clock=clock,
+    )
+    for name in names:
+        multi.add_claim(
+            ClaimSpec(
+                claim_id=name, n_oracles=n_oracles, dimension=dimension
+            )
+        )
+    tier = ServingTier(
+        multi,
+        vectorizer=deterministic_vectorizer,
+        admission=AdmissionConfig(
+            queue_capacity=queue_capacity, burn_threshold=4.0, seed=seed
+        ),
+        max_requests_per_step=max_requests_per_step,
+        clock=clock,
+        # Short SLO windows so the burn reacts within the (virtual-
+        # seconds) run; the latency target makes a ≥3-step queue wait a
+        # bad request.
+        slos=serving_slos(
+            metrics,
+            latency_target_s=2.5 * step_period_s,
+            fast_window_s=10 * step_period_s,
+            slow_window_s=50 * step_period_s,
+        ),
+    )
+
+    rng = np.random.default_rng(claim_seed(seed, "serving_arrivals"))
+    pool = [f"hot comment {i} — every market has a viral take" for i in range(hot_pool)]
+    phase_stats: List[Dict[str, Any]] = []
+    step_no = 0
+    for phase_idx, (per_step, steps) in enumerate(phases):
+        shed_before = metrics.family_total("serving_shed")
+        hits_before = metrics.counter(
+            "serving_cache", labels={"event": "hit"}
+        ).count
+        submitted = 0
+        for _ in range(steps):
+            clock.advance(step_period_s)
+            for i in range(per_step):
+                claim, text = draw_arrival(
+                    rng,
+                    names,
+                    pool,
+                    hot_fraction,
+                    lambda c: f"unique comment {c} step {step_no} #{i}",
+                )
+                tier.submit(claim, text)
+                submitted += 1
+            tier.step()
+            step_no += 1
+        phase_stats.append(
+            {
+                "phase": phase_idx,
+                "arrivals_per_step": per_step,
+                "steps": steps,
+                "submitted": submitted,
+                "shed": metrics.family_total("serving_shed") - shed_before,
+                "cache_hits": metrics.counter(
+                    "serving_cache", labels={"event": "hit"}
+                ).count
+                - hits_before,
+            }
+        )
+
+    latency = metrics.histogram(REQUEST_LATENCY_HISTOGRAM).snapshot()
+    reason_totals = shed_by_reason(metrics)
+
+    return {
+        "seed": seed,
+        "claims": names,
+        "steps": step_no,
+        "phases": phase_stats,
+        "submitted": metrics.family_total("serving_submitted"),
+        "admitted": metrics.family_total("serving_admitted"),
+        "cached": metrics.family_total("serving_cached"),
+        "shed": metrics.family_total("serving_shed"),
+        "completed": metrics.family_total("serving_completed"),
+        "shed_by_reason": dict(sorted(reason_totals.items())),
+        "cache": tier.cache.stats(),
+        "latency": latency,
+        "snapshot": tier.snapshot(),
+        "journal_fingerprint": journal.fingerprint(),
+        "journal_events": journal.last_seq(),
+        "per_claim_fingerprints": {
+            name: multi.claim_fingerprint(name) for name in names
+        },
+    }

@@ -1,0 +1,339 @@
+"""The serving tier facade: submit → micro-batch → fabric → respond.
+
+One object gives the PR 6 fabric a request path (docs/SERVING.md):
+
+- :meth:`ServingTier.submit` is the ingestion edge (the web layer's
+  ``POST /api/submit`` and the console's ``serving submit`` both call
+  it): cache / admission / bounded queues via
+  :class:`~svoc_tpu.serving.frontend.ServingFrontend`.
+- :meth:`ServingTier.step` is one continuous-batching cycle: the
+  :class:`~svoc_tpu.serving.batcher.MicroBatcher` assembles a fair
+  cross-claim micro-batch, one packed forward vectorizes every cache
+  miss, results fill the dedup cache, and the per-claim vector groups
+  feed the request-driven fabric cycle
+  (``MultiSession.step(feeds=...)`` → fused sanitized claim-cube
+  consensus → per-claim resilient commit).  Completion observes each
+  request's end-to-end latency into ``request_latency_seconds`` — the
+  histogram behind the ``request_latency`` SLO whose burn rate closes
+  the admission loop.
+- The clock is injectable: seeded scenarios
+  (:mod:`svoc_tpu.serving.scenario`) drive virtual time so latencies,
+  burn rates, and shed decisions replay byte-identically.
+
+The tier never owns a thread itself — ``step()`` is driven by the
+caller (``run_loop`` offers the daemon-thread convenience), the same
+inversion the router uses, so tests and seeded replays control the
+cadence exactly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from svoc_tpu.serving.batcher import MicroBatcher
+from svoc_tpu.serving.cache import ResultCache
+from svoc_tpu.serving.frontend import AdmissionConfig, ServingFrontend
+from svoc_tpu.utils.metrics import stage_span
+from svoc_tpu.utils.slo import REQUEST_LATENCY_HISTOGRAM, serving_slos
+
+
+class ServingTier:
+    """Continuous-batching serving over a
+    :class:`~svoc_tpu.fabric.session.MultiSession`."""
+
+    def __init__(
+        self,
+        multi,
+        *,
+        vectorizer=None,
+        admission: Optional[AdmissionConfig] = None,
+        cache: Optional[ResultCache] = None,
+        cache_capacity: int = 4096,
+        max_requests_per_step: int = 64,
+        max_segments: int = 8,
+        clock=None,
+        slos: Optional[Sequence] = None,
+        slo_clock=None,
+    ):
+        from svoc_tpu.fabric.router import resolve_journal
+        from svoc_tpu.utils.slo import SLOEvaluator
+
+        self.multi = multi
+        self._metrics = multi.metrics
+        self._journal = resolve_journal(multi.journal)
+        self._clock = clock if clock is not None else time.monotonic
+        if cache is None:
+            cache = ResultCache(cache_capacity, metrics=self._metrics)
+        self.frontend = ServingFrontend(
+            multi,
+            admission=admission,
+            cache=cache,
+            metrics=self._metrics,
+            journal=self._journal,
+            clock=self._clock,
+        )
+        #: The cross-claim vectorizer.  None = each micro-batch builds
+        #: on demand from the FIRST claim session's vectorizer (the
+        #: shared packed pipeline in live deployments; injected fakes in
+        #: tests/scenarios always pass one explicitly).
+        self._vectorizer = vectorizer
+        self.batcher = MicroBatcher(
+            self.frontend,
+            vectorizer,
+            max_requests=max_requests_per_step,
+            max_segments=max_segments,
+            metrics=self._metrics,
+        )
+        #: The serving SLOs (request_latency drives admission).  The
+        #: evaluator clock defaults to the tier clock so virtual-time
+        #: scenarios burn deterministically.
+        self._evaluator = SLOEvaluator(
+            slos if slos is not None else serving_slos(self._metrics),
+            registry=self._metrics,
+            journal=self._journal,
+            clock=slo_clock if slo_clock is not None else self._clock,
+        )
+        self.steps = 0
+        self._loop_thread: Optional[threading.Thread] = None
+        self._loop_stop: Optional[threading.Event] = None
+
+    @property
+    def cache(self) -> ResultCache:
+        return self.frontend.cache
+
+    def _resolve_vectorizer(self):
+        if self._vectorizer is None:
+            states = self.multi.registry.states()
+            if not states:
+                raise RuntimeError("serving tier has no claims to serve")
+            # The claims share one model anyway (the session property
+            # builds the same pipeline); reuse the first session's.
+            self._vectorizer = states[0].session.vectorizer
+            self.batcher.vectorizer = self._vectorizer
+        return self._vectorizer
+
+    # -- ingestion edge -----------------------------------------------------
+
+    def submit(self, claim_id: str, text: str) -> Dict[str, Any]:
+        """One request through cache + admission (``ServingFrontend``)."""
+        # Membership check BEFORE the labeled counter: claim ids come
+        # straight off the wire, and a counter per arbitrary client
+        # string would grow the registry without bound (and count 404s
+        # as submissions).
+        state = self.multi.get(claim_id)  # KeyError → the HTTP layer's 404
+        self._metrics.counter(
+            "serving_submitted", labels={"claim": claim_id}
+        ).add(1)
+        return self.frontend.submit(claim_id, text, state=state)
+
+    # -- the continuous-batching cycle --------------------------------------
+
+    def step(self) -> Dict[str, Any]:
+        """One serving cycle; returns the step report (consumed request
+        count, per-claim fabric outcome, completion latencies)."""
+        self.steps += 1
+        report: Dict[str, Any] = {
+            "step": self.steps,
+            "requests": 0,
+            "claims": 0,
+            "served": [],
+            "skipped": {},
+            "dropped": 0,
+            "latencies_s": [],
+        }
+        with stage_span("serving_step"):
+            dropped = self._purge_removed_claims()
+            report["dropped"] = dropped
+            requests = self.batcher.assemble()
+            if not requests:
+                # Idle tick: still refresh the burn gauges, so recovery
+                # after an overload is observed even with no traffic.
+                self._evaluator.evaluate()
+                return report
+            self._resolve_vectorizer()
+            drained = len(requests)
+            # Every drained request must end this step accounted —
+            # completed or dropped — even when the step dies mid-way
+            # (an XLA runtime error, a buggy injected vectorizer):
+            # `pending` holds the not-yet-accounted set, and the
+            # except-hook below drops whatever is left before
+            # re-raising, so admission_sample (utils/slo.py) can never
+            # read a lost request as served.
+            pending = set(requests)
+
+            def drop(request) -> None:
+                nonlocal dropped
+                self._metrics.counter(
+                    "serving_dropped", labels={"claim": request.claim}
+                ).add(1)
+                pending.discard(request)
+                dropped += 1
+
+            try:
+                with stage_span("serving_batch"):
+                    try:
+                        vectors = self.batcher.vectorize(
+                            [r.text for r in requests]
+                        )
+                    except Exception:
+                        vectors = None
+                if vectors is None:
+                    # One poisoned text must not lose the whole
+                    # cross-claim micro-batch (the per-claim isolation
+                    # contract extends through the shared forward):
+                    # fall back to per-request vectorize and drop ONLY
+                    # the requests that fail.
+                    self._metrics.counter("serving_vectorize_errors").add(1)
+                    survivors: List[Any] = []
+                    vecs: List[np.ndarray] = []
+                    for request in requests:
+                        try:
+                            vecs.append(
+                                self.batcher.vectorize([request.text])[0]
+                            )
+                            survivors.append(request)
+                        except Exception:
+                            drop(request)
+                    requests, vectors = survivors, vecs
+                for request, vector in zip(requests, vectors):
+                    # The serving step's documented host fetch: the
+                    # packed forward's vectors must land on host to
+                    # fill the dedup cache and feed the per-claim
+                    # fabric groups.
+                    request.vector = np.asarray(vector, dtype=np.float64)  # svoclint: disable=SVOC001
+                    self.cache.put(request.key, request.vector)
+                if requests:
+                    feeds = self.batcher.group_by_claim(requests)
+                    fabric_report = self.multi.step(feeds=feeds)
+                else:
+                    feeds = {}
+                    fabric_report = {"served": [], "skipped": {}}
+                served_claims = set(fabric_report["served"])
+                now = self._clock()
+                latencies: List[float] = []
+                for request in requests:
+                    if request.claim not in served_claims:
+                        # The fabric skipped this claim mid-cycle
+                        # (paused after admission, malformed feed,
+                        # fetch error): its drained requests did NOT
+                        # complete.  They land in serving_dropped,
+                        # which counts against the serving_admission
+                        # objective (utils/slo.py) — a blackholed claim
+                        # burns the SLO instead of reading green
+                        # forever.
+                        drop(request)
+                        continue
+                    latency = max(0.0, now - request.t_submit)
+                    latencies.append(latency)
+                    self._metrics.histogram(
+                        REQUEST_LATENCY_HISTOGRAM
+                    ).observe(latency)
+                    self._metrics.counter(
+                        "serving_completed", labels={"claim": request.claim}
+                    ).add(1)
+                    pending.discard(request)
+            except BaseException:
+                for request in list(pending):
+                    drop(request)
+                raise
+            report.update(
+                requests=drained,
+                claims=len(feeds),
+                served=fabric_report["served"],
+                skipped=fabric_report["skipped"],
+                dropped=dropped,
+                latencies_s=latencies,
+            )
+            # One step event (counts only — deterministic under virtual
+            # clocks; latencies live in the histogram, not the journal).
+            self._journal.emit(
+                "serving.step",
+                step=self.steps,
+                requests=drained,
+                claims=len(feeds),
+                served=len(fabric_report["served"]),
+            )
+            # Burn-rate fold: the gauges admission reads next submit.
+            self._evaluator.evaluate()
+        return report
+
+    def _purge_removed_claims(self) -> int:
+        """Queues whose claim has left the fabric (``remove_claim``
+        after requests were admitted): purge and account every stranded
+        request as dropped.  The batcher's round-robin only visits live
+        claims, so without this sweep the requests would sit queued
+        forever while ``admission_sample`` (utils/slo.py) reads them as
+        served and ``/api/state`` shows a ghost queue."""
+        live = set(self.multi.claim_ids())
+        n = 0
+        for cid in [c for c in self.frontend.depths() if c not in live]:
+            for request in self.frontend.purge(cid):
+                self._metrics.counter(
+                    "serving_dropped", labels={"claim": request.claim}
+                ).add(1)
+                n += 1
+        return n
+
+    # -- background loop (live deployments) ---------------------------------
+
+    def run_loop(self, period_s: float = 0.05) -> threading.Event:
+        """Drive ``step()`` on a daemon thread every ``period_s``;
+        returns the stop event.  Idempotent: a live loop is reused."""
+        if self._loop_thread is not None and self._loop_thread.is_alive():
+            return self._loop_stop
+        stop = threading.Event()
+
+        def loop():
+            while not stop.is_set():
+                try:
+                    self.step()
+                except Exception:
+                    # A serving-cycle defect must not kill the loop —
+                    # per-claim failures are already isolated below;
+                    # this catches tier-level bugs and counts them.
+                    self._metrics.counter("serving_step_errors").add(1)
+                stop.wait(period_s)
+
+        self._loop_stop = stop
+        self._loop_thread = threading.Thread(target=loop, daemon=True)
+        self._loop_thread.start()
+        return stop
+
+    def stop_loop(self) -> None:
+        if self._loop_stop is not None:
+            self._loop_stop.set()
+
+    # -- views ---------------------------------------------------------------
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """Evaluate the serving SLOs (request_latency / admission)."""
+        return self._evaluator.evaluate()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``/api/state`` serving section / console ``serving``
+        payload: queues, admission counts, cache, throughput."""
+        reg = self._metrics
+        return {
+            "steps": self.steps,
+            "queues": self.frontend.depths(),
+            "submitted": reg.family_total("serving_submitted"),
+            "admitted": reg.family_total("serving_admitted"),
+            "cached": reg.family_total("serving_cached"),
+            "shed": reg.family_total("serving_shed"),
+            "completed": reg.family_total("serving_completed"),
+            "dropped": reg.family_total("serving_dropped"),
+            "cache": self.cache.stats(),
+            "burn_rate": self.frontend.controller.burn_rate(),
+            "latency": reg.histogram(REQUEST_LATENCY_HISTOGRAM).snapshot(),
+        }
+
+    def attach(self, console) -> None:
+        """Expose the tier through a
+        :class:`~svoc_tpu.apps.commands.CommandConsole`: the ``serving``
+        command, ``POST /api/submit``, and ``/api/state``'s serving
+        section read it."""
+        console.serving = self

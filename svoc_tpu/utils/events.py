@@ -30,7 +30,9 @@ Event taxonomy (docs/OBSERVABILITY.md §events): ``block.fetched``,
 ``commit.retried`` / ``commit.skipped`` / ``commit.failed``,
 ``breaker.transition``, ``supervisor.health`` / ``supervisor.charge`` /
 ``supervisor.replacement``, ``pipeline.producer_error``,
-``trace.write_error``, ``slo.alert``, ``postmortem.bundle``.
+``trace.write_error``, ``slo.alert``, ``postmortem.bundle``, and the
+serving tier's ``serving.admitted`` / ``serving.shed`` /
+``serving.step`` (docs/SERVING.md).
 
 Cost model: emission is host-side only (svoclint SVOC007 enforces it
 stays out of jit-traced bodies, exactly like SVOC002 does for metrics)
@@ -74,6 +76,7 @@ EVENT_TYPES: Tuple[str, ...] = (
     "commit.retried",
     "commit.skipped",
     "commit.failed",
+    "commit.deferred",
     "breaker.transition",
     "supervisor.health",
     "supervisor.charge",
@@ -82,6 +85,9 @@ EVENT_TYPES: Tuple[str, ...] = (
     "trace.write_error",
     "slo.alert",
     "postmortem.bundle",
+    "serving.admitted",
+    "serving.shed",
+    "serving.step",
 )
 
 #: Types (plus breaker.transition→open) surfaced as "alerts" in journal

@@ -592,6 +592,20 @@ class MetricsRegistry:
                 total += c.count
         return total
 
+    def family_series(self, name: str) -> List[Tuple[Dict[str, str], float]]:
+        """Every counter series in the family ``name`` as
+        ``(labels, count)`` pairs — the per-label breakdown
+        ``family_total`` folds away (serving artifacts report shed
+        counts per reason)."""
+        with self._lock:
+            items = list(self.counters.items())
+        out: List[Tuple[Dict[str, str], float]] = []
+        for key, c in items:
+            fam, labels = self._labels.get(key, (key, {}))
+            if fam == name:
+                out.append((dict(labels), c.count))
+        return out
+
     def stage_snapshot(self) -> Dict[str, Dict[str, float]]:
         """``{stage: {count, sum, p50, p95, p99, ...}}`` for every stage
         observed so far — the block BENCH/SOAK artifacts embed."""

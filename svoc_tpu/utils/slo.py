@@ -66,11 +66,10 @@ class SLODefinition:
             raise ValueError("need 0 < fast_window_s <= slow_window_s")
 
 
-def _histogram_le(registry: MetricsRegistry, stage: str, bound_s: float):
-    """Cumulative ``(count ≤ bound, total)`` from the stage histogram —
-    the latency SLO's ratio source (bucketized: the largest bucket
-    bound ≤ the target is the effective threshold)."""
-    h = registry.stage_histogram(stage)
+def _bucket_ratio(h, bound_s: float) -> Tuple[float, float]:
+    """Cumulative ``(count ≤ bound, total)`` over a histogram's buckets
+    (bucketized: the largest bucket bound ≤ the target is the effective
+    threshold)."""
     buckets = h.cumulative_buckets()
     total = buckets[-1][1] if buckets else 0
     good = 0
@@ -80,6 +79,12 @@ def _histogram_le(registry: MetricsRegistry, stage: str, bound_s: float):
         else:
             break
     return float(good), float(total)
+
+
+def _histogram_le(registry: MetricsRegistry, stage: str, bound_s: float):
+    """:func:`_bucket_ratio` over the stage histogram — the latency
+    SLO's ratio source."""
+    return _bucket_ratio(registry.stage_histogram(stage), bound_s)
 
 
 def default_slos(
@@ -134,6 +139,81 @@ def default_slos(
             description="fleet slots admitted by the input-integrity gate",
             objective=0.90,
             sample=quarantine_sample,
+        ),
+    ]
+
+
+#: The histogram family the serving tier observes end-to-end request
+#: latency (submit → completed consensus) into — shared between the
+#: ``request_latency`` SLO below, the serving bench, and /metrics.
+REQUEST_LATENCY_HISTOGRAM = "request_latency_seconds"
+
+
+def serving_slos(
+    registry: Optional[MetricsRegistry] = None,
+    *,
+    latency_objective: float = 0.99,
+    latency_target_s: float = 0.25,
+    admission_objective: float = 0.95,
+    fast_window_s: float = 300.0,
+    slow_window_s: float = 3600.0,
+) -> List[SLODefinition]:
+    """The serving tier's objectives (docs/SERVING.md):
+
+    - ``request_latency`` — ≥ 99 % of completed requests finish within
+      the latency target (cumulative-bucket ratio over
+      :data:`REQUEST_LATENCY_HISTOGRAM`, the same histogram-as-ratio
+      trick as ``consensus_latency``).  This is the burn rate the
+      :class:`svoc_tpu.serving.frontend.AdmissionController` reads —
+      overload sheds load *before* the commit objective burns.
+    - ``serving_admission`` — ≥ 95 % of submitted requests are served
+      (admitted or answered from cache) rather than shed.  A sustained
+      admission burn means the tier is saturated even if every admitted
+      request is fast.
+
+    Windows are configurable because seeded serving scenarios run in
+    virtual time (seconds, not hours) and need the burn to react within
+    the run (``svoc_tpu/serving/scenario.py``).
+    """
+    reg = registry or _default_registry
+
+    def latency_sample() -> Tuple[float, float]:
+        return _bucket_ratio(
+            reg.histogram(REQUEST_LATENCY_HISTOGRAM), latency_target_s
+        )
+
+    def admission_sample() -> Tuple[float, float]:
+        served = float(reg.family_total("serving_admitted")) + float(
+            reg.family_total("serving_cached")
+        )
+        shed = float(reg.family_total("serving_shed"))
+        # Admitted-then-dropped requests (claim skipped mid-cycle,
+        # vectorizer failure) were never actually served: they count
+        # against the objective exactly like a shed, so a claim that
+        # blackholes its traffic burns this SLO instead of reading
+        # green forever.
+        dropped = float(reg.family_total("serving_dropped"))
+        return max(0.0, served - dropped), served + shed
+
+    return [
+        SLODefinition(
+            name="request_latency",
+            description=(
+                f"serving requests completed within "
+                f"{latency_target_s * 1e3:.0f} ms"
+            ),
+            objective=latency_objective,
+            sample=latency_sample,
+            fast_window_s=fast_window_s,
+            slow_window_s=slow_window_s,
+        ),
+        SLODefinition(
+            name="serving_admission",
+            description="submitted requests served rather than shed",
+            objective=admission_objective,
+            sample=admission_sample,
+            fast_window_s=fast_window_s,
+            slow_window_s=slow_window_s,
         ),
     ]
 

@@ -1,0 +1,732 @@
+"""Continuous-batching serving tier (docs/SERVING.md).
+
+Covers: the dedup cache's hit/miss/evict semantics and capacity
+bounds, deterministic admission control (queue bounds + the forced
+burn-rate flip + the seeded shed draw), frontend lineage/journal
+accounting, fair cross-claim micro-batch assembly, assembler parity
+(the packed cross-claim batch against a per-request loop, and the
+batched request-driven fabric cycle against a claim-at-a-time loop),
+request-driven per-claim isolation (ISSUE 7 satellite: one claim's
+overflow or malformed feed never stalls a sibling), seeded replay
+determinism of the whole serving scenario, and the ``POST /api/submit``
+web path.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from svoc_tpu.fabric.registry import ClaimSpec  # noqa: E402
+from svoc_tpu.fabric.scenario import deterministic_vectorizer  # noqa: E402
+from svoc_tpu.fabric.session import MultiSession  # noqa: E402
+from svoc_tpu.serving.batcher import MicroBatcher  # noqa: E402
+from svoc_tpu.serving.cache import ResultCache, content_key  # noqa: E402
+from svoc_tpu.serving.frontend import (  # noqa: E402
+    AdmissionConfig,
+    AdmissionController,
+)
+from svoc_tpu.serving.scenario import VirtualClock, run_serving_scenario  # noqa: E402
+from svoc_tpu.serving.tier import ServingTier  # noqa: E402
+from svoc_tpu.utils.events import EventJournal  # noqa: E402
+from svoc_tpu.utils.metrics import MetricsRegistry  # noqa: E402
+from svoc_tpu.utils.slo import REQUEST_LATENCY_HISTOGRAM, serving_slos  # noqa: E402
+
+
+def _multi(journal, metrics, claims=("alpha", "beta"), **kw):
+    multi = MultiSession(
+        base_seed=0,
+        vectorizer=deterministic_vectorizer,
+        journal=journal,
+        metrics=metrics,
+        lineage_scope="t",
+        sanitized_dispatch=True,
+        **kw,
+    )
+    for cid in claims:
+        multi.add_claim(ClaimSpec(claim_id=cid, n_oracles=7, dimension=6))
+    return multi
+
+
+def _tier(claims=("alpha", "beta"), *, admission=None, clock=None, **kw):
+    journal = EventJournal(MetricsRegistry())
+    metrics = MetricsRegistry()
+    clock = clock or VirtualClock()
+    multi = _multi(journal, metrics, claims)
+    tier = ServingTier(
+        multi,
+        vectorizer=kw.pop("vectorizer", deterministic_vectorizer),
+        admission=admission,
+        clock=clock,
+        slos=serving_slos(
+            metrics, latency_target_s=0.25, fast_window_s=1.0, slow_window_s=5.0
+        ),
+        **kw,
+    )
+    return tier, multi, journal, metrics, clock
+
+
+class TestResultCache:
+    def test_miss_then_hit_counts_and_copies(self):
+        reg = MetricsRegistry()
+        cache = ResultCache(4, metrics=reg)
+        key = content_key("alpha", "hello")
+        assert cache.get(key) is None
+        cache.put(key, np.array([1.0, 2.0]))
+        got = cache.get(key)
+        np.testing.assert_array_equal(got, [1.0, 2.0])
+        got[0] = 99.0  # a copy: caller mutation never pollutes the cache
+        np.testing.assert_array_equal(cache.get(key), [1.0, 2.0])
+        stats = cache.stats()
+        assert stats["hits"] == 2 and stats["misses"] == 1
+        assert stats["size"] == 1 and stats["evictions"] == 0
+
+    def test_lru_eviction_hit_refreshes_recency(self):
+        reg = MetricsRegistry()
+        cache = ResultCache(2, metrics=reg)
+        ka, kb, kc = (content_key("c", t) for t in ("a", "b", "c"))
+        cache.put(ka, np.zeros(2))
+        cache.put(kb, np.ones(2))
+        cache.get(ka)  # refresh: 'a' is now most recent
+        cache.put(kc, np.full(2, 2.0))  # evicts 'b', not 'a'
+        assert ka in cache and kc in cache and kb not in cache
+        assert len(cache) == 2
+        assert cache.stats()["evictions"] == 1
+
+    def test_capacity_bound_holds_under_churn(self):
+        cache = ResultCache(8, metrics=MetricsRegistry())
+        for i in range(50):
+            cache.put(content_key("c", f"t{i}"), np.array([float(i)]))
+        assert len(cache) == 8
+        assert cache.stats()["evictions"] == 42
+
+    def test_keys_partition_by_claim(self):
+        # Same text, different claims: distinct entries (an eviction in
+        # one claim must not dent another's hit rate).
+        assert content_key("alpha", "same") != content_key("beta", "same")
+
+    def test_rejects_bad_capacity(self):
+        with pytest.raises(ValueError):
+            ResultCache(0)
+
+
+class TestAdmissionController:
+    def test_queue_bound_sheds_first(self):
+        reg = MetricsRegistry()
+        ctrl = AdmissionController(
+            AdmissionConfig(queue_capacity=2), metrics=reg
+        )
+        assert ctrl.decide("alpha", 0, 1).action == "admit"
+        assert ctrl.decide("alpha", 1, 2).action == "admit"
+        decision = ctrl.decide("alpha", 2, 3)
+        assert (decision.action, decision.reason) == ("shed", "queue_full")
+
+    def test_burn_flip_sheds_misses_and_recovers(self):
+        """ISSUE 7: admission flips at a forced burn-rate threshold."""
+        reg = MetricsRegistry()
+        cfg = AdmissionConfig(burn_threshold=4.0, shed_fraction=1.0)
+        ctrl = AdmissionController(cfg, metrics=reg)
+        gauge = reg.gauge(
+            "slo_burn_rate",
+            labels={"slo": "request_latency", "window": "fast"},
+        )
+        assert ctrl.decide("alpha", 0, 1).action == "admit"  # cold: admit
+        gauge.set(10.0)
+        decision = ctrl.decide("alpha", 0, 2)
+        assert (decision.action, decision.reason) == ("shed", "slo_burn")
+        gauge.set(1.0)  # back under: the brownout lifts immediately
+        assert ctrl.decide("alpha", 0, 3).action == "admit"
+
+    def test_fractional_shed_draw_is_seeded_and_deterministic(self):
+        reg = MetricsRegistry()
+        cfg = AdmissionConfig(burn_threshold=4.0, shed_fraction=0.5, seed=7)
+        reg.gauge(
+            "slo_burn_rate",
+            labels={"slo": "request_latency", "window": "fast"},
+        ).set(10.0)
+        a = AdmissionController(cfg, metrics=reg)
+        b = AdmissionController(cfg, metrics=reg)
+        seq_a = [a.decide("alpha", 0, s).action for s in range(40)]
+        seq_b = [b.decide("alpha", 0, s).action for s in range(40)]
+        assert seq_a == seq_b  # replayable across instances
+        assert {"admit", "shed"} == set(seq_a)  # the fraction really splits
+
+    def test_rejects_bad_config(self):
+        with pytest.raises(ValueError):
+            AdmissionConfig(queue_capacity=0)
+        with pytest.raises(ValueError):
+            AdmissionConfig(shed_fraction=1.5)
+        with pytest.raises(ValueError):
+            AdmissionConfig(burn_threshold=0.0)
+
+
+class TestFrontend:
+    def test_submit_admits_with_claim_family_lineage(self):
+        tier, _multi, journal, metrics, _clock = _tier()
+        response = tier.submit("alpha", "first comment")
+        assert response["status"] == "admitted"
+        assert response["lineage"].startswith("blkt-alpha-rq")
+        assert tier.frontend.depth("alpha") == 1
+        events = [e for e in journal.recent() if e.type == "serving.admitted"]
+        assert len(events) == 1
+        assert events[0].lineage == response["lineage"]
+        assert metrics.counter(
+            "serving_admitted", labels={"claim": "alpha"}
+        ).count == 1
+
+    def test_unknown_claim_raises_keyerror(self):
+        tier, *_ = _tier()
+        with pytest.raises(KeyError):
+            tier.submit("nope", "text")
+
+    def test_cached_repeat_answers_immediately(self):
+        tier, _multi, journal, metrics, _clock = _tier()
+        tier.submit("alpha", "viral take")
+        tier.step()  # completes the request and fills the cache
+        response = tier.submit("alpha", "viral take")
+        assert response["status"] == "cached"
+        assert len(response["vector"]) == 6
+        assert tier.frontend.depth("alpha") == 0  # no queue slot used
+        assert metrics.counter(
+            "serving_cache", labels={"event": "hit"}
+        ).count == 1
+
+    def test_queue_overflow_sheds_on_own_lineage_siblings_fine(self):
+        """ISSUE 7 satellite: a claim whose submit queue overflows gets
+        shed events on its own lineage and counters, and never stalls
+        a sibling claim."""
+        tier, _multi, journal, metrics, _clock = _tier(
+            admission=AdmissionConfig(queue_capacity=2)
+        )
+        for i in range(5):
+            tier.submit("alpha", f"flood {i}")
+        response = tier.submit("beta", "calm")
+        assert response["status"] == "admitted"
+        shed_alpha = metrics.counter(
+            "serving_shed", labels={"claim": "alpha", "reason": "queue_full"}
+        ).count
+        assert shed_alpha == 3
+        assert metrics.family_total("serving_shed") == 3  # none on beta
+        shed_events = [e for e in journal.recent() if e.type == "serving.shed"]
+        assert len(shed_events) == 3
+        assert all(
+            e.lineage.startswith("blkt-alpha-rq") for e in shed_events
+        )
+        # The flooded claim still serves what it admitted, and the
+        # sibling is served in the SAME step — no stall.
+        report = tier.step()
+        assert sorted(report["served"]) == ["alpha", "beta"]
+        assert metrics.family_total("serving_completed") == 3
+
+    def test_drain_is_fifo_and_refreshes_depth(self):
+        tier, *_ = _tier()
+        for i in range(3):
+            tier.submit("alpha", f"c{i}")
+        got = tier.frontend.drain("alpha", 2)
+        assert [r.text for r in got] == ["c0", "c1"]
+        assert tier.frontend.depth("alpha") == 1
+
+
+class TestMicroBatcher:
+    def test_round_robin_is_fair_across_claims(self):
+        """A deep queue cannot monopolize a micro-batch: assembly takes
+        one request per claim per round."""
+        tier, *_ = _tier(("alpha", "beta"), max_requests_per_step=4)
+        for i in range(6):
+            tier.submit("alpha", f"a{i}")
+        tier.submit("beta", "b0")
+        tier.submit("beta", "b1")
+        picked = tier.batcher.assemble()
+        order = [r.claim for r in picked]
+        assert order == ["alpha", "beta", "alpha", "beta"]
+        assert tier.frontend.depth("alpha") == 4  # the rest stay queued
+
+    def test_group_by_claim_requires_vectors(self):
+        tier, *_ = _tier()
+        tier.submit("alpha", "x")
+        (request,) = tier.batcher.assemble()
+        with pytest.raises(ValueError, match="no vector"):
+            MicroBatcher.group_by_claim([request])
+
+    def test_assembler_packed_parity_vs_per_request_loop(self):
+        """ISSUE 7 acceptance: the packed cross-claim batch produces
+        the same vectors as a per-request loop through the model."""
+        from svoc_tpu.models.configs import TINY_TEST
+        from svoc_tpu.models.sentiment import SentimentPipeline
+
+        pipe = SentimentPipeline(
+            cfg=TINY_TEST, seq_len=32, batch_size=4, tokenizer_name=None
+        )
+        tier, *_ = _tier(("alpha", "beta", "gamma"), vectorizer=pipe)
+        texts = [
+            "short",
+            "a somewhat longer comment with more tokens in it",
+            "medium length remark",
+            "another take entirely",
+            "yet more words to pack",
+            "final thought",
+        ]
+        batched = tier.batcher.vectorize(texts)  # one packed forward
+        loop = np.stack([pipe([t])[0] for t in texts])  # per-request loop
+        assert batched.shape == (6, 6)
+        np.testing.assert_allclose(batched, loop, atol=1e-4)
+
+    def test_vectorize_dedups_in_batch_duplicates(self):
+        """Duplicates of one hot comment inside a single micro-batch
+        are forwarded once and fanned back out — repeats never occupy
+        packed segments (the cache only answers across steps)."""
+        calls = []
+
+        def counting_vectorizer(texts):
+            calls.append(list(texts))
+            return np.stack([deterministic_vectorizer([t])[0] for t in texts])
+
+        tier, *_ = _tier(vectorizer=counting_vectorizer)
+        texts = ["viral take", "fresh a", "viral take", "fresh b", "viral take"]
+        out = tier.batcher.vectorize(texts)
+        assert calls == [["viral take", "fresh a", "fresh b"]]
+        expected = np.stack([deterministic_vectorizer([t])[0] for t in texts])
+        np.testing.assert_array_equal(out, expected)
+
+    def test_removed_claim_queue_is_purged_and_dropped(self):
+        """Requests stranded by ``remove_claim`` must be accounted as
+        dropped on the next step (counting against serving_admission),
+        not sit queued forever reading as served."""
+        tier, multi, _journal, metrics, _clock = _tier()
+        for i in range(3):
+            assert tier.submit("beta", f"b{i}")["status"] == "admitted"
+        tier.submit("alpha", "a0")
+        multi.remove_claim("beta")
+        report = tier.step()
+        assert report["dropped"] == 3
+        assert report["served"] == ["alpha"]
+        assert (
+            metrics.counter("serving_dropped", labels={"claim": "beta"}).count
+            == 3
+        )
+        assert "beta" not in tier.frontend.depths()  # no ghost queue
+
+
+class TestRequestDrivenFabric:
+    def _feeds(self, seed=0):
+        rng = np.random.default_rng(seed)
+        return {
+            "alpha": rng.uniform(0.1, 0.9, (3, 6)).astype(np.float32),
+            "beta": rng.uniform(0.1, 0.9, (2, 6)).astype(np.float32),
+        }
+
+    def test_batched_step_matches_claim_at_a_time_loop(self):
+        """ISSUE 7 acceptance: micro-batched cross-claim consensus is
+        parity-exact against feeding each claim on its own."""
+        feeds = self._feeds()
+        multi_a = _multi(EventJournal(MetricsRegistry()), MetricsRegistry())
+        report = multi_a.step(feeds=feeds)
+        assert sorted(report["served"]) == ["alpha", "beta"]
+
+        multi_b = _multi(EventJournal(MetricsRegistry()), MetricsRegistry())
+        multi_b.step(feeds={"alpha": feeds["alpha"]})
+        multi_b.step(feeds={"beta": feeds["beta"]})
+
+        for cid in ("alpha", "beta"):
+            batched = multi_a.get(cid).last_consensus
+            looped = multi_b.get(cid).last_consensus
+            assert batched["essence"] == looped["essence"]
+            assert batched["reliable"] == looped["reliable"]
+            assert batched["interval_valid"] == looped["interval_valid"]
+            assert (
+                batched["reliability_second_pass"]
+                == looped["reliability_second_pass"]
+            )
+
+    def test_request_fed_block_audits_like_a_scraped_one(self):
+        journal = EventJournal(MetricsRegistry())
+        multi = _multi(journal, MetricsRegistry())
+        multi.step(feeds=self._feeds())
+        session = multi.get("alpha").session
+        assert session.last_lineage.startswith("blkt-alpha-")
+        types = {e.type for e in journal.recent(lineage=session.last_lineage)}
+        assert {"block.fetched", "consensus.result"} <= types
+        fetched = [
+            e
+            for e in journal.recent(lineage=session.last_lineage)
+            if e.type == "block.fetched"
+        ]
+        assert fetched[0].data["source"] == "serving"
+        assert fetched[0].data["n_comments"] == 3
+
+    def test_cold_start_single_request_defers_commit_then_recovers(self):
+        """A 1-request cold start yields a zero-variance fleet block —
+        the on-chain skewness recompute would revert the final tx
+        (docs/SERVING.md §degeneracy), so the commit defers on a typed
+        ``commit.deferred`` instead of stranding the last signer; the
+        rolling request window restores diversity and the next cycle
+        commits for real."""
+        journal = EventJournal(MetricsRegistry())
+        metrics = MetricsRegistry()
+        multi = _multi(journal, metrics, claims=("alpha",))
+        rng = np.random.default_rng(7)
+        lone = rng.uniform(0.1, 0.9, (1, 6)).astype(np.float32)
+        report = multi.step(feeds={"alpha": lone})
+        assert report["served"] == ["alpha"]
+        labels = {"claim": "alpha"}
+        assert metrics.counter("claim_commit_deferred", labels=labels).count == 1
+        assert metrics.counter("claim_commit_failures", labels=labels).count == 0
+        state = multi.get("alpha")
+        assert state.last_commit == {"deferred": True}
+        deferred = [e for e in journal.recent(40) if e.type == "commit.deferred"]
+        assert deferred and deferred[0].data["reason"] == "degenerate"
+        assert deferred[0].lineage.startswith("blkt-alpha-")
+        # More traffic → the rolling window regains diversity → commit.
+        more = rng.uniform(0.1, 0.9, (3, 6)).astype(np.float32)
+        multi.step(feeds={"alpha": more})
+        assert state.last_commit.get("complete") is True
+        assert metrics.counter("claim_commit_deferred", labels=labels).count == 1
+
+    def test_malformed_feed_isolated_to_its_claim(self):
+        """ISSUE 7 satellite: a malformed feed lands in that claim's
+        ``fabric_claim_errors{stage="fetch"}``; siblings are served."""
+        metrics = MetricsRegistry()
+        multi = _multi(EventJournal(MetricsRegistry()), metrics)
+        feeds = self._feeds()
+        feeds["alpha"] = np.zeros((2, 3), dtype=np.float32)  # wrong dim
+        report = multi.step(feeds=feeds)
+        assert report["served"] == ["beta"]
+        assert report["skipped"]["alpha"].startswith("fetch_error:")
+        assert metrics.counter(
+            "fabric_claim_errors", labels={"claim": "alpha", "stage": "fetch"}
+        ).count == 1
+
+    def test_empty_feed_window_is_isolated_not_fatal(self):
+        multi = _multi(EventJournal(MetricsRegistry()), MetricsRegistry())
+        feeds = self._feeds()
+        feeds["alpha"] = np.zeros((0, 6), dtype=np.float32)
+        report = multi.step(feeds=feeds)
+        assert report["served"] == ["beta"]
+        assert report["skipped"]["alpha"] == "empty_store"
+
+    def test_unknown_and_paused_claims_are_reported_not_served(self):
+        multi = _multi(EventJournal(MetricsRegistry()), MetricsRegistry())
+        multi.pause("beta")
+        feeds = self._feeds()
+        feeds["ghost"] = feeds.pop("beta")
+        report = multi.step(feeds=feeds)
+        assert report["served"] == ["alpha"]
+        assert report["skipped"]["ghost"] == "unknown_claim"
+        report = multi.step(feeds={"beta": self._feeds()["beta"]})
+        assert report["skipped"]["beta"] == "paused"
+
+    def test_pull_mode_unchanged_without_feeds(self):
+        """feeds=None keeps the PR 6 pull cycle: claims read their own
+        stores (here empty → the routine empty_store skip)."""
+        multi = _multi(EventJournal(MetricsRegistry()), MetricsRegistry())
+        report = multi.step()
+        assert report["served"] == []
+        assert set(report["skipped"].values()) == {"empty_store"}
+
+
+class TestServingTierEndToEnd:
+    def test_step_completes_requests_and_observes_latency(self):
+        clock = VirtualClock()
+        tier, multi, journal, metrics, _ = _tier(clock=clock)
+        tier.submit("alpha", "one")
+        tier.submit("beta", "two")
+        clock.advance(0.05)
+        report = tier.step()
+        assert report["requests"] == 2
+        assert sorted(report["served"]) == ["alpha", "beta"]
+        assert report["latencies_s"] == [0.05, 0.05]
+        hist = metrics.histogram(REQUEST_LATENCY_HISTOGRAM).snapshot()
+        assert hist["count"] == 2
+        assert metrics.family_total("serving_completed") == 2
+        # Completion fills the dedup cache for both texts.
+        assert tier.cache.stats()["size"] == 2
+        steps = [e for e in journal.recent() if e.type == "serving.step"]
+        assert len(steps) == 1 and steps[0].data["requests"] == 2
+
+    def test_skipped_claim_requests_drop_not_complete(self):
+        """A claim the fabric skips mid-cycle (paused after admission)
+        must not have its drained requests counted as completed — that
+        would read a blackholed claim as green on both serving SLOs."""
+        clock = VirtualClock()
+        tier, multi, _journal, metrics, _ = _tier(clock=clock)
+        tier.submit("alpha", "one")
+        tier.submit("beta", "two")
+        multi.pause("beta")
+        clock.advance(0.05)
+        report = tier.step()
+        assert report["served"] == ["alpha"]
+        assert report["skipped"] == {"beta": "paused"}
+        assert report["dropped"] == 1
+        assert report["latencies_s"] == [0.05]
+        assert metrics.family_total("serving_completed") == 1
+        assert metrics.counter(
+            "serving_dropped", labels={"claim": "beta"}
+        ).count == 1
+        assert metrics.histogram(REQUEST_LATENCY_HISTOGRAM).snapshot()[
+            "count"
+        ] == 1
+        assert tier.snapshot()["dropped"] == 1
+
+    def test_poison_text_drops_only_its_request(self):
+        """A text that makes the shared packed forward raise must not
+        lose the whole drained cross-claim micro-batch: the step falls
+        back to per-request vectorize and drops only the poison."""
+
+        def poisoned(texts):
+            if any(t == "poison" for t in texts):
+                raise RuntimeError("tokenizer exploded")
+            return deterministic_vectorizer(texts)
+
+        clock = VirtualClock()
+        tier, _multi, _journal, metrics, _ = _tier(
+            clock=clock, vectorizer=poisoned
+        )
+        tier.submit("alpha", "a perfectly fine comment")
+        tier.submit("beta", "poison")
+        clock.advance(0.05)
+        report = tier.step()
+        assert report["requests"] == 2
+        assert report["dropped"] == 1
+        assert report["served"] == ["alpha"]
+        assert metrics.counter("serving_vectorize_errors").count == 1
+        assert metrics.counter(
+            "serving_dropped", labels={"claim": "beta"}
+        ).count == 1
+        assert metrics.family_total("serving_completed") == 1
+
+    def test_idle_step_still_evaluates_slos(self):
+        tier, _multi, _journal, metrics, _ = _tier()
+        report = tier.step()
+        assert report["requests"] == 0
+        # The evaluator ran: the burn gauges exist (0.0 on a cold tier).
+        assert tier.frontend.controller.burn_rate() == 0.0
+
+    def test_snapshot_shape(self):
+        tier, *_ = _tier()
+        tier.submit("alpha", "x")
+        tier.step()
+        snap = tier.snapshot()
+        assert snap["steps"] == 1
+        assert snap["submitted"] == 1 and snap["completed"] == 1
+        assert snap["cache"]["size"] == 1
+        assert "p99" in snap["latency"]
+        assert isinstance(snap["queues"], dict)
+
+
+class TestServingScenarioReplay:
+    # Short phases: determinism is phase-shape-independent, and tier-1
+    # budget matters more than saturation realism here (the full-shape
+    # run is make serving-smoke / bench_serving.py).
+    PHASES = ((4, 3), (30, 4), (4, 3))
+
+    def test_seeded_replay_is_fingerprint_identical(self):
+        a = run_serving_scenario(seed=3, phases=self.PHASES)
+        b = run_serving_scenario(seed=3, phases=self.PHASES)
+        assert a["journal_fingerprint"] == b["journal_fingerprint"]
+        assert a["per_claim_fingerprints"] == b["per_claim_fingerprints"]
+        assert a["shed_by_reason"] == b["shed_by_reason"]
+        assert a["journal_events"] > 0
+
+    def test_different_seeds_diverge(self):
+        a = run_serving_scenario(seed=3, phases=self.PHASES)
+        b = run_serving_scenario(seed=4, phases=self.PHASES)
+        assert a["journal_fingerprint"] != b["journal_fingerprint"]
+
+    def test_overload_sheds_and_cache_serves(self):
+        r = run_serving_scenario(seed=0, phases=self.PHASES)
+        warm, overload, _recovery = r["phases"]
+        assert warm["shed"] == 0
+        assert overload["shed"] > 0
+        assert r["cache"]["hits"] > 0
+        assert r["completed"] > 0
+        assert r["latency"]["count"] > 0
+
+
+class TestSubmitEndpoint:
+    @staticmethod
+    def _submit(base, payload):
+        req = urllib.request.Request(
+            f"{base}/api/submit",
+            data=json.dumps(payload).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+
+    def _served_console(self, **tier_kw):
+        from tests.conftest import make_fake_console
+
+        console = make_fake_console()
+        tier, *_ = _tier(**tier_kw)
+        tier.attach(console)
+        return console, tier
+
+    def test_submit_happy_and_cached_paths(self):
+        from svoc_tpu.apps.web import serve
+
+        console, tier = self._served_console()
+        srv, _ = serve(console, port=0, block=False)
+        try:
+            base = f"http://127.0.0.1:{srv.server_address[1]}"
+            status, body = self._submit(
+                base, {"claim": "alpha", "text": "hello world"}
+            )
+            assert status == 200 and body["status"] == "admitted"
+            assert body["lineage"].startswith("blkt-alpha-rq")
+            tier.step()
+            status, body = self._submit(
+                base, {"claim": "alpha", "text": "hello world"}
+            )
+            assert status == 200 and body["status"] == "cached"
+            assert len(body["vector"]) == 6
+            # /api/state grows the serving section.
+            with urllib.request.urlopen(f"{base}/api/state", timeout=10) as r:
+                state = json.loads(r.read())
+            assert state["serving"]["submitted"] == 2
+            assert state["serving"]["completed"] == 1
+        finally:
+            srv.shutdown()
+
+    def test_submit_shed_is_429_unknown_404_malformed_400(self):
+        from svoc_tpu.apps.web import serve
+
+        console, _tier = self._served_console(
+            admission=AdmissionConfig(queue_capacity=1)
+        )
+        srv, _ = serve(console, port=0, block=False)
+        try:
+            base = f"http://127.0.0.1:{srv.server_address[1]}"
+            status, _ = self._submit(base, {"claim": "alpha", "text": "a"})
+            assert status == 200
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                self._submit(base, {"claim": "alpha", "text": "b"})
+            assert exc_info.value.code == 429
+            assert json.loads(exc_info.value.read())["reason"] == "queue_full"
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                self._submit(base, {"claim": "ghost", "text": "x"})
+            assert exc_info.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                self._submit(base, {"wrong": "shape"})
+            assert exc_info.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                self._submit(base, {"claim": 3, "text": "x"})
+            assert exc_info.value.code == 400
+        finally:
+            srv.shutdown()
+
+    def test_submit_without_tier_is_503(self):
+        from svoc_tpu.apps.web import serve
+        from tests.conftest import make_fake_console
+
+        srv, _ = serve(make_fake_console(), port=0, block=False)
+        try:
+            base = f"http://127.0.0.1:{srv.server_address[1]}"
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                self._submit(base, {"claim": "alpha", "text": "x"})
+            assert exc_info.value.code == 503
+        finally:
+            srv.shutdown()
+
+
+class TestServingConsole:
+    def _console_with_tier(self):
+        from tests.conftest import make_fake_console
+
+        console = make_fake_console()
+        tier, multi, *_ = _tier()
+        tier.attach(console)
+        multi.attach(console)
+        return console, tier
+
+    def test_serving_command_status_submit_step(self):
+        console, _tier = self._console_with_tier()
+        out = console.query("serving")
+        assert any("serving: 0 steps" in line for line in out)
+        out = console.query("serving submit alpha a hot take")
+        assert any("admitted: alpha:1" in line for line in out)
+        out = console.query("serving step")
+        assert any("step 1: 1 requests over 1 claims" in line for line in out)
+        out = console.query("serving")
+        assert any("hit rate" in line for line in out)
+
+    def test_serving_command_errors(self):
+        console, _tier = self._console_with_tier()
+        out = console.query("serving submit ghost hi")
+        assert any("unknown claim" in line for line in out)
+        out = console.query("serving bogus")
+        assert any("usage:" in line for line in out)
+
+    def test_serving_command_without_tier(self):
+        from tests.conftest import make_fake_console
+
+        out = make_fake_console().query("serving")
+        assert any("no serving tier attached" in line for line in out)
+
+    def test_slo_command_includes_serving_objectives(self):
+        console, tier = self._console_with_tier()
+        tier.submit("alpha", "x")
+        tier.step()
+        out = console.query("slo")
+        joined = "\n".join(out)
+        assert "request_latency" in joined
+        assert "serving_admission" in joined
+        # The fabric's per-claim objectives ride along (ISSUE 7
+        # satellite: per-claim burn rates in the slo output).
+        assert "claim_commit_success" in joined or "commit_success" in joined
+
+
+class TestPerClaimPrometheus:
+    def test_claim_counters_render_from_registration(self):
+        """ISSUE 7 satellite: per-claim SLO counters and
+        fabric_claim_errors render on /metrics from claim registration
+        onward, before any traffic."""
+        metrics = MetricsRegistry()
+        _multi(EventJournal(MetricsRegistry()), metrics)
+        text = metrics.render_prometheus()
+        for cid in ("alpha", "beta"):
+            assert f'svoc_claim_commit_cycles_total{{claim="{cid}"}} 0' in text
+            assert (
+                f'svoc_fabric_claim_errors_total{{claim="{cid}",stage="fetch"}} 0'
+                in text
+            )
+            assert (
+                f'svoc_fabric_claim_errors_total{{claim="{cid}",stage="commit"}} 0'
+                in text
+            )
+
+
+class TestPackingFillRatio:
+    def test_fill_ratios_and_gauges_from_pack_path(self):
+        """ISSUE 7 satellite: the pack path's segment/token occupancy is
+        observable — ``fill_ratios`` math plus the
+        ``packing_fill_ratio{kind=}`` gauges on the registry."""
+        from svoc_tpu.models.packing import (
+            fill_ratios,
+            observe_fill_ratios,
+            pack_tokens_auto,
+        )
+
+        token_lists = [[5, 6, 7], [8, 9], [10, 11, 12, 13], [14, 15]]
+        batch, n = pack_tokens_auto(token_lists, 32, 4, 0)
+        assert n == len(token_lists)
+        ratios = fill_ratios(batch)
+        rows, slots = batch.seg_valid.shape
+        assert ratios["rows"] == rows
+        assert ratios["segments_used"] == int(batch.seg_valid.sum())
+        assert ratios["segments"] == pytest.approx(
+            ratios["segments_used"] / (rows * slots)
+        )
+        assert 0.0 < ratios["tokens"] <= 1.0
+
+        metrics = MetricsRegistry()
+        observed = observe_fill_ratios(batch, metrics)
+        assert observed == ratios
+        text = metrics.render_prometheus()
+        assert 'packing_fill_ratio{kind="segments"}' in text
+        assert 'packing_fill_ratio{kind="tokens"}' in text

@@ -1,0 +1,119 @@
+"""Serving-tier smoke: seeded replay identity + graceful overload as a
+CI gate (``make serving-smoke``; docs/SERVING.md §smoke).
+
+The seeded virtual-time scenario
+(:func:`svoc_tpu.serving.scenario.run_serving_scenario` — warm /
+overload / recovery phases over 3 claims, a hot comment pool feeding
+the dedup cache) runs TWICE with fresh journals, fresh metrics
+registries, and a pinned lineage scope (the replay-pinning rules).
+The gate asserts:
+
+1. **Replay identity** — the journal fingerprint (every
+   ``serving.admitted`` / ``serving.shed`` / ``serving.step`` /
+   ``block.fetched`` / consensus / commit event, including every shed
+   decision) digests byte-identically across the two runs, and so does
+   every per-claim journal slice.
+2. **Warm phase clean** — under-capacity arrivals shed ~nothing
+   (admission control must not reject a healthy tier's traffic).
+3. **Overload sheds** — the overload phase produces nonzero shed: the
+   queue bounds + the ``request_latency`` burn threshold turn
+   saturation into rejected requests, not an unbounded latency tail.
+4. **Cache serves** — the hot pool produces real cache hits (the
+   degrade-to-cached path works mid-overload).
+5. **p99 reported** — the request-latency histogram saw completions
+   and reports a finite p99.
+
+Usage::
+
+    python tools/serving_smoke.py [--seed 0] [--out SERVING_SMOKE.json]
+"""
+
+from __future__ import annotations
+
+import os
+
+# Off-TPU by construction (the axon sitecustomize pins the platform, so
+# go through jax.config too — tools/soak.py measurement postmortem).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="SERVING_SMOKE.json")
+    args = p.parse_args(argv)
+
+    from svoc_tpu.serving.scenario import run_serving_scenario
+
+    first = run_serving_scenario(args.seed)
+    second = run_serving_scenario(args.seed)
+
+    warm, overload, recovery = first["phases"]
+    per_claim_identical = {
+        cid: (
+            first["per_claim_fingerprints"][cid]
+            == second["per_claim_fingerprints"][cid]
+        )
+        for cid in first["claims"]
+    }
+    latency = first["latency"]
+    checks = {
+        "journal_replay_identical": (
+            first["journal_fingerprint"] == second["journal_fingerprint"]
+        ),
+        "per_claim_replay_identical": all(per_claim_identical.values()),
+        "journal_nonempty": first["journal_events"] > 0,
+        # ≤ 1% of warm arrivals shed (0 at the default seed; the slack
+        # keeps alternate seeds honest rather than flaky).
+        "warm_phase_clean": warm["shed"] <= 0.01 * warm["submitted"],
+        "overload_sheds": overload["shed"] > 0,
+        "cache_hits_nonzero": first["cache"]["hits"] > 0,
+        "completions_nonzero": first["completed"] > 0,
+        "p99_reported": (
+            latency.get("count", 0) > 0
+            and latency.get("p99") is not None
+            and latency["p99"] < float("inf")
+        ),
+    }
+    ok = all(checks.values())
+    artifact = {
+        "seed": args.seed,
+        "checks": checks,
+        "ok": ok,
+        "per_claim_identical": per_claim_identical,
+        "phases": first["phases"],
+        "shed_by_reason": first["shed_by_reason"],
+        "cache": first["cache"],
+        "latency": latency,
+        "submitted": first["submitted"],
+        "admitted": first["admitted"],
+        "cached": first["cached"],
+        "shed": first["shed"],
+        "completed": first["completed"],
+        "journal_fingerprint": first["journal_fingerprint"],
+        "journal_events": first["journal_events"],
+    }
+    with open(args.out + ".tmp", "w") as f:
+        json.dump(artifact, f, indent=1)
+    os.replace(args.out + ".tmp", args.out)
+    for name, passed in checks.items():
+        print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+    print(
+        f"serving-smoke {'OK' if ok else 'FAILED'}: "
+        f"{first['submitted']:g} arrivals over {first['steps']} steps "
+        f"({len(first['claims'])} claims), shed {first['shed']:g} "
+        f"(overload {overload['shed']:g}), cache hit rate "
+        f"{first['cache']['hit_rate']:.1%}, p99 "
+        f"{latency.get('p99', 0.0) * 1e3:.0f} ms -> {args.out}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
