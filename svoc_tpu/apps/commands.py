@@ -56,6 +56,11 @@ HELP = """Commands:
     - serving [submit <claim> <text...> | step] (continuous-batching
       serving tier status / one manual request / one manual cycle —
       docs/SERVING.md)
+    - durability [snapshot] (crash-consistency status: snapshot
+      freshness, commit-intent WAL health, open cycles; 'snapshot'
+      forces one — docs/RESILIENCE.md)
+    - drain (graceful teardown: stop admission, flush queues,
+      snapshot, postmortem bundle — what SIGTERM does)
     - multimodal [K|auto] (mixture analysis of the last fetch;
       default K=2, 'auto' selects K by BIC)
 
@@ -112,6 +117,12 @@ class CommandConsole:
         #: ``POST /api/submit``, and ``/api/state``'s ``serving``
         #: section read it.  None = no request path (batch-only).
         self.serving = None
+        #: Durability layer (docs/RESILIENCE.md §durability): set by
+        #: ``RecoveryManager.attach`` / ``GracefulDrain.attach`` — the
+        #: ``durability``/``drain`` commands and ``/api/state``'s
+        #: durability section read them.  None = in-memory-only.
+        self.durability = None
+        self.drainer = None
         self._auto_fetch_thread: Optional[threading.Thread] = None
         self._scraper_stop: Optional[threading.Event] = None
         self._scraper_thread: Optional[threading.Thread] = None
@@ -643,6 +654,63 @@ class CommandConsole:
                                 if depth
                             )
                         )
+            elif cmd == "durability":
+                # Crash-consistency status (docs/RESILIENCE.md
+                # §durability): snapshot freshness + WAL health.
+                if self.durability is None:
+                    emit(
+                        "no durability layer attached — this session's "
+                        "state is in-memory only (chain writes are still "
+                        "exact within the process lifetime)"
+                    )
+                    return out
+                if args and args[0] == "snapshot":
+                    path = self.durability.snapshot()
+                    emit(f"snapshot written: {path}")
+                    return out
+                if args:
+                    emit("usage: durability [snapshot]")
+                    return out
+                status = self.durability.status()
+                emit(
+                    f"snapshot: {status['snapshot_path']}"
+                    + (
+                        ""
+                        if status["snapshot_exists"]
+                        else " (none yet)"
+                    )
+                    + f", {status['snapshots_this_process']} this process"
+                )
+                emit(
+                    f"wal: {status['wal_path'] or '(none)'}, "
+                    f"{status['wal_records']} records, "
+                    f"{len(status['wal_open_cycles'])} open cycles"
+                )
+                for lin in status["wal_open_cycles"]:
+                    emit(f"  OPEN {lin} — a commit is in flight (or a "
+                         "crash awaits reconciliation)")
+            elif cmd == "drain":
+                # The SIGTERM path, manually (docs/RESILIENCE.md
+                # §drain): stop admission, flush, snapshot, bundle.
+                if self.drainer is None:
+                    emit(
+                        "no drain handler attached — wire a "
+                        "GracefulDrain (svoc_tpu.durability) first"
+                    )
+                    return out
+                report = self.drainer.drain(reason="console")
+                if report.get("already_drained"):
+                    emit("already drained")
+                    return out
+                flush = report.get("flush") or {}
+                emit(
+                    f"drained: {flush.get('flush_steps', 0)} flush steps, "
+                    f"{flush.get('deferred', 0)} requests deferred"
+                )
+                if report.get("snapshot"):
+                    emit(f"snapshot: {report['snapshot']}")
+                if report.get("bundle"):
+                    emit(f"bundle: {report['bundle']}")
             elif cmd == "slo":
 
                 def emit_burns(snapshot, detail: bool = False) -> None:

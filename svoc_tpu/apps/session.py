@@ -245,6 +245,13 @@ class Session:
             registry=metrics,
             journal=self.journal,
         )
+        #: Commit-intent WAL (docs/RESILIENCE.md §durability): when
+        #: attached (:meth:`attach_wal`), every ``commit_resilient``
+        #: cycle journals fsynced per-tx intent/landed records so a
+        #: SIGKILL at any instruction leaves enough evidence to resume
+        #: EXACTLY the stranded suffix on restart — zero duplicate txs.
+        #: None = the in-memory-only sessions of PRs 1–7, unchanged.
+        self.wal = None
         #: Last gate verdict over the fetched fleet (written with the
         #: predictions it describes, under the session lock).
         self.last_quarantine: Optional[QuarantineReport] = None
@@ -802,6 +809,38 @@ class Session:
             predictions = self.predictions
             lineage = self.last_lineage
             source = self._block_source
+        if (
+            self.wal is not None
+            and lineage is not None
+            and lineage in self.wal.completed_lineages()
+        ):
+            # Snapshot-replay re-execution (docs/RESILIENCE.md
+            # §durability): a restart resumes from its snapshot and
+            # re-runs the steps after it; this block's commit cycle
+            # already CLOSED in a previous life (its txs are on chain,
+            # witnessed by the WAL's done record), so the chain writes
+            # — and the supervisor/SLO charges the original run
+            # already made — must not happen twice.
+            done = next(
+                (
+                    r
+                    for r in reversed(self.wal.records())
+                    if r.get("kind") == "done"
+                    and r.get("lineage") == lineage
+                ),
+                {},
+            )
+            sent = int(done.get("sent", 0))
+            self.journal.emit(
+                "commit.sent",
+                lineage=lineage,
+                sent=sent,
+                total=sent,
+                attempts=0,
+                stranded=0,
+                replayed=True,
+            )
+            return CommitOutcome(sent=sent, total=sent, attempts=0)
         # Quarantine gate (docs/ROBUSTNESS.md): refused slots never
         # produce a tx; each refusal charges the slot's oracle exactly
         # like a commit failure, so a persistent garbage emitter walks
@@ -832,6 +871,45 @@ class Session:
             # all-identical shape this guard exists for.
             self._refuse_degenerate(predictions, lineage)
         with self._commit_lock, metrics.timer("commit_latency").time():
+            wal_cycle = None
+            if self.wal is not None:
+                # The cycle-open needs the oracle list (one chain RPC)
+                # BEFORE commit_fleet_with_resume's own breaker
+                # machinery runs — so the breaker contract must be
+                # honored here too: an OPEN breaker short-circuits
+                # before paying the RPC + payload fsyncs, and a
+                # transport failure on the read records a breaker
+                # failure exactly like the loop's first-RPC failure
+                # would (otherwise an outage with a WAL attached would
+                # never trip the breaker).
+                retry_after = self.breaker.retry_after_s()
+                if retry_after > 0:
+                    metrics.counter("commit_short_circuits").add(1)
+                    self.journal.emit(
+                        "commit.failed",
+                        lineage=lineage,
+                        reason="circuit_open",
+                        backend=self.breaker.name,
+                        sent=0,
+                    )
+                    raise CircuitOpenError(
+                        self.breaker.name, retry_after, sent=0
+                    )
+                try:
+                    oracles = self.adapter.call_oracle_list()
+                except Exception:
+                    self.breaker.record_failure()
+                    metrics.counter("chain_commit_failures").add(1)
+                    self.journal.emit(
+                        "commit.failed",
+                        lineage=lineage,
+                        reason="transport",
+                        sent=0,
+                    )
+                    raise
+                wal_cycle = self._open_wal_cycle(
+                    predictions, lineage, skip, oracles
+                )
             try:
                 outcome = commit_fleet_with_resume(
                     self.adapter,
@@ -842,6 +920,7 @@ class Session:
                     on_oracle_failure=self.supervisor.record_commit_failure,
                     journal=self.journal,
                     lineage=lineage,
+                    wal=wal_cycle,
                 )
             except ChainCommitError as e:
                 # resilient_sent is the TRUE landed-tx count (committed
@@ -866,6 +945,43 @@ class Session:
             metrics.counter("chain_commit_failures").add(1)
         self.bump_state()
         return outcome
+
+    def attach_wal(self, wal) -> None:
+        """Wire a :class:`svoc_tpu.durability.wal.CommitIntentWAL` into
+        the resilient commit path (docs/RESILIENCE.md §durability)."""
+        self.wal = wal
+
+    def _open_wal_cycle(self, predictions, lineage, skip, oracles):
+        """The cycle-open record: the full felt payload matrix ahead of
+        any tx, so a restart can classify AND resend every slot.  A
+        slot whose payload cannot encode (garbage the gate somehow
+        missed) records ``None`` — the commit loop will fail that tx
+        with its usual codec semantics, and the reconciler treats the
+        slot like a skip.  The encode here is deliberately repeated by
+        the per-tx loop (digest parity REQUIRES the WAL payload and
+        the wire payload to be the same encoding; the cost is
+        microseconds against a signed tx).  WAL append failures
+        propagate unwrapped — "no durable intent, no tx", and a disk
+        problem must not feed the CHAIN breaker."""
+        from svoc_tpu.ops.fixedpoint import encode_vector
+
+        skip_set = frozenset(int(i) for i in skip)
+        payloads = []
+        for i, p in enumerate(np.asarray(predictions)):
+            if i in skip_set:
+                payloads.append(None)
+                continue
+            try:
+                payloads.append(encode_vector(p))
+            except Exception:
+                payloads.append(None)
+        return self.wal.cycle(
+            lineage,
+            claim=self.config.claim,
+            oracles=oracles[: len(payloads)],
+            payloads=payloads,
+            skip=sorted(skip_set),
+        )
 
     def supervisor_step(self) -> Optional[Dict]:
         """One fleet-health fold (auto loop cadence).  Never raises —

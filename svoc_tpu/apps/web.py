@@ -416,6 +416,14 @@ class _Handler(BaseHTTPRequestHandler):
             serving = getattr(self.console, "serving", None)
             if serving is not None:
                 payload["serving"] = serving.snapshot()
+            # Durability layer (docs/RESILIENCE.md §durability):
+            # snapshot freshness + commit-intent WAL health, so an
+            # operator can see at a glance whether a restart would
+            # recover warm (and whether a cycle is awaiting
+            # reconciliation).
+            durability = getattr(self.console, "durability", None)
+            if durability is not None:
+                payload["durability"] = durability.status()
             self._send(200, json.dumps(payload).encode(), "application/json")
         elif self.path == "/api/events" or self.path.startswith("/api/events?"):
             self._serve_events()

@@ -1,0 +1,58 @@
+"""Crash-consistent durability (docs/RESILIENCE.md §durability).
+
+The PR 3–7 stack survives faults *within* a process lifetime; this
+package makes the guarantees hold *across* process death:
+
+- :mod:`~svoc_tpu.durability.wal` — the commit-intent write-ahead log
+  (fsynced per-tx intent/landed records; exactly-once chain semantics).
+- :mod:`~svoc_tpu.durability.reconcile` — the restart reconciler that
+  joins WAL intents against on-chain state and resumes only stranded
+  slots.
+- :mod:`~svoc_tpu.durability.chainlog` — a crash-surviving tx log for
+  the local chain simulator (the external-chain stand-in the
+  kill/restart harness needs).
+- :mod:`~svoc_tpu.durability.recovery` — snapshot + journal-replay
+  recovery manager and the SIGTERM graceful-drain handler.
+- :mod:`~svoc_tpu.durability.scenario` — the seeded kill/restart
+  scenario behind ``make crash-smoke``.
+"""
+
+from svoc_tpu.durability.chainlog import (
+    DurableLocalBackend,
+    duplicate_predictions,
+    read_chain_log,
+    replay_chain_log,
+)
+from svoc_tpu.durability.reconcile import (
+    ReconcileReport,
+    reconcile_wal,
+    wal_cycles,
+)
+from svoc_tpu.durability.recovery import (
+    GracefulDrain,
+    RecoveryError,
+    RecoveryManager,
+)
+from svoc_tpu.durability.wal import (
+    CommitIntentWAL,
+    WALCycle,
+    payload_digest,
+    read_wal,
+)
+
+__all__ = [
+    "CommitIntentWAL",
+    "DurableLocalBackend",
+    "GracefulDrain",
+    "ReconcileReport",
+    "RecoveryError",
+    "RecoveryManager",
+    "WALCycle",
+    "duplicate_predictions",
+    "payload_digest",
+    "read_chain_log",
+    "read_wal",
+    "reconcile_wal",
+    "replay_chain_log",
+    "wal_cycles",
+]

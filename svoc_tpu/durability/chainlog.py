@@ -1,0 +1,174 @@
+"""Durable local chain: a tx log that survives the process.
+
+The real deployment's chain (Sepolia) is EXTERNAL — it survives our
+process dying mid-commit, which is exactly what makes crash consistency
+hard (a restart must ask the chain what landed).  The in-memory
+:class:`~svoc_tpu.io.chain.LocalChainBackend` dies WITH the process, so
+neither the recovery manager nor the kill/restart harness could observe
+the one failure mode that matters.  This wrapper restores the external
+property for simulations:
+
+- every successful ``invoke`` (signed tx) appends one fsynced record to
+  a tx log **after** the in-memory contract applied it — a tx is "on
+  chain" iff it is in the log.  A kill between the in-memory apply and
+  the append evaporates the tx, which is indistinguishable from the tx
+  never landing (the in-memory state dies too): process-level
+  atomicity, and reverted txs never pollute the log.
+- :func:`replay_chain_log` rebuilds the contract state on restart by
+  re-applying the log onto a fresh contract — the simulator's
+  equivalent of the chain simply still being there.
+
+The log is ALSO the harness's duplicate-tx witness: each
+``update_prediction`` record carries the caller and the payload digest,
+so ``tools/crash_smoke.py`` asserts zero ``(caller, digest)``
+duplicates across a kill/restart matrix without trusting any in-process
+accounting.
+
+The wrapper deliberately does NOT forward the batched fleet commit
+(``invoke_update_predictions_batch``): tx-granular logging is the
+point, and the adapter falls back to the per-tx loop when the attribute
+is absent.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from svoc_tpu.consensus.state import OracleConsensusContract
+from svoc_tpu.durability.wal import payload_digest, read_wal, seal_jsonl
+from svoc_tpu.io.chain import LocalChainBackend
+
+
+class DurableLocalBackend:
+    """A :class:`LocalChainBackend` whose txs survive the process."""
+
+    def __init__(self, contract: OracleConsensusContract, log_path: str):
+        self._inner = LocalChainBackend(contract)
+        self.log_path = log_path
+        seal_jsonl(log_path)  # a torn tail is a tx that never landed
+        self._f = None
+        #: Crash-harness hook (``tools/crash_smoke.py``): called with
+        #: the record AFTER it was fsynced — the "between tx i and
+        #: i+1" kill point (the tx is durably on chain, the WAL's
+        #: landed record is not yet written).
+        self.crash_hook = None
+
+    # The supervisor's locality probe and the fault injector both walk
+    # ``.backend`` chains — expose the wrapped backend the same way.
+    @property
+    def backend(self) -> LocalChainBackend:
+        return self._inner
+
+    @property
+    def contract(self) -> OracleConsensusContract:
+        return self._inner.contract
+
+    # -- reads pass through -------------------------------------------------
+
+    def call(self, function_name: str) -> Any:
+        return self._inner.call(function_name)
+
+    def call_as(self, caller: int, function_name: str) -> Any:
+        return self._inner.call_as(caller, function_name)
+
+    # -- writes: apply, then journal ---------------------------------------
+
+    def invoke(self, caller: int, function_name: str, /, **kwargs) -> None:
+        self._inner.invoke(caller, function_name, **kwargs)
+        record: Dict[str, Any] = {"caller": int(caller), "fn": function_name}
+        if function_name == "update_prediction":
+            felts = [int(x) for x in kwargs["prediction"]]
+            record["prediction"] = felts
+            record["digest"] = payload_digest(felts)
+        elif function_name == "update_proposition":
+            p = kwargs["proposition"]
+            record["proposition"] = None if p is None else [int(p[0]), int(p[1])]
+        elif function_name == "vote_for_a_proposition":
+            record["which_admin"] = int(kwargs["which_admin"])
+            record["support"] = bool(kwargs["support_his_proposition"])
+        self._append(record)
+        if self.crash_hook is not None:
+            self.crash_hook(record)
+
+    def _append(self, record: Dict[str, Any]) -> None:
+        if self._f is None:
+            self._f = open(self.log_path, "a")
+        self._f.write(json.dumps(record, sort_keys=True) + "\n")
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        if self._f is not None:
+            with contextlib.suppress(OSError):
+                self._f.close()
+            self._f = None
+
+
+def read_chain_log(path: str) -> List[Dict[str, Any]]:
+    """The tx log, torn-tail tolerant (same crash semantics as the
+    WAL reader — a torn tail is a tx that never durably landed, and
+    :func:`replay_chain_log` must skip it, not crash)."""
+    records = read_wal(path)
+    # A torn final record could parse as JSON yet be a truncated felt
+    # list — guard by requiring the per-kind mandatory keys.
+    out = []
+    for r in records:
+        fn = r.get("fn")
+        if fn == "update_prediction" and "digest" not in r:
+            continue
+        if "caller" not in r or fn is None:
+            continue
+        out.append(r)
+    return out
+
+
+def replay_chain_log(
+    path: str, contract: OracleConsensusContract
+) -> int:
+    """Re-apply the tx log onto ``contract`` (freshly constructed with
+    the deployment constructor args) — the restarted process's view of
+    the still-alive chain.  Returns the number of replayed txs."""
+    backend = LocalChainBackend(contract)
+    n = 0
+    for r in read_chain_log(path):
+        fn = r["fn"]
+        if fn == "update_prediction":
+            backend.invoke(
+                r["caller"], fn, prediction=[int(x) for x in r["prediction"]]
+            )
+        elif fn == "update_proposition":
+            p = r.get("proposition")
+            backend.invoke(
+                r["caller"], fn,
+                proposition=None if p is None else (int(p[0]), int(p[1])),
+            )
+        elif fn == "vote_for_a_proposition":
+            backend.invoke(
+                r["caller"], fn,
+                which_admin=r["which_admin"],
+                support_his_proposition=r["support"],
+            )
+        else:  # pragma: no cover — unknown entrypoints never logged
+            raise ValueError(f"unknown logged entrypoint {fn!r}")
+        n += 1
+    return n
+
+
+def duplicate_predictions(path: str) -> List[Dict[str, Any]]:
+    """Every ``(caller, digest)`` pair that appears more than once in
+    the tx log — the harness's zero-duplicates witness.  Fleet payloads
+    vary per cycle (continuous sentiment vectors), so a repeated pair
+    means the same tx was sent twice."""
+    seen: Dict[tuple, int] = {}
+    dups: List[Dict[str, Any]] = []
+    for r in read_chain_log(path):
+        if r["fn"] != "update_prediction":
+            continue
+        key = (r["caller"], r["digest"])
+        seen[key] = seen.get(key, 0) + 1
+        if seen[key] == 2:
+            dups.append({"caller": r["caller"], "digest": r["digest"]})
+    return dups

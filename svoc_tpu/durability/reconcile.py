@@ -1,0 +1,271 @@
+"""WAL reconciliation: join commit intents against on-chain truth.
+
+On restart the WAL may hold cycles with no ``done`` record — the
+process died mid-commit.  For every such cycle the reconciler
+classifies each eligible slot (docs/RESILIENCE.md §durability):
+
+=====================  ======================================  =========
+evidence               meaning                                 action
+=====================  ======================================  =========
+``landed`` record      tx durably confirmed before the crash   none
+chain digest == WAL    tx landed; the landed append was lost   none
+chain digest != WAL    the slot still holds the previous       resend
+                       block's value — the tx never went out
+chain read fails       backend unreachable: cannot prove       none (re-
+                       either way                              run later)
+``skip`` / no payload  quarantined or unencodable slot — the   none
+                       original commit would not have sent it
+=====================  ======================================  =========
+
+Only *stranded* slots are resent — a slot is never resent on missing
+evidence, so a kill at ANY point (including during a previous
+reconcile) produces zero duplicate transactions; and because resends
+use the WAL's recorded payload, a crash mid-reconcile converges: the
+next reconcile sees the resent slots as landed (chain witness) and
+finishes the rest.  *Unknown* slots keep the cycle OPEN (the next
+reconcile retries); a cycle with everything landed/stranded-resent is
+closed with a ``done`` record so the recovery manager's WAL rotation
+can proceed.
+
+One caveat, documented rather than hidden: the chain witness compares
+payload digests, so a stranded tx whose payload equals the value
+ALREADY on chain (a byte-identical consecutive block — measure-zero for
+continuous sentiment vectors) classifies as landed and is not resent.
+The chain state is indistinguishable either way; the tx is semantically
+idempotent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from svoc_tpu.durability.wal import CommitIntentWAL, payload_digest
+
+#: Slot classifications (the decision table above).
+LANDED_DURABLE = "landed_durable"
+LANDED_CHAIN = "landed_chain"
+STRANDED = "stranded"
+UNKNOWN = "unknown"
+SKIPPED = "skipped"
+
+
+@dataclasses.dataclass
+class SlotVerdict:
+    slot: int
+    oracle: Any
+    classification: str
+    resent: bool = False
+    resend_error: Optional[str] = None
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class CycleReconciliation:
+    lineage: str
+    claim: Optional[str]
+    slots: List[SlotVerdict]
+    closed: bool
+
+    def count(self, classification: str) -> int:
+        return sum(
+            1 for s in self.slots if s.classification == classification
+        )
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "lineage": self.lineage,
+            "claim": self.claim,
+            "closed": self.closed,
+            "slots": [s.as_dict() for s in self.slots],
+            "counts": {
+                c: self.count(c)
+                for c in (
+                    LANDED_DURABLE, LANDED_CHAIN, STRANDED, UNKNOWN, SKIPPED
+                )
+            },
+        }
+
+
+@dataclasses.dataclass
+class ReconcileReport:
+    cycles: List[CycleReconciliation]
+
+    @property
+    def open_cycles(self) -> int:
+        return len(self.cycles)
+
+    @property
+    def resent(self) -> int:
+        return sum(1 for c in self.cycles for s in c.slots if s.resent)
+
+    @property
+    def unknown(self) -> int:
+        return sum(c.count(UNKNOWN) for c in self.cycles)
+
+    @property
+    def unaccounted(self) -> int:
+        """Slots with NO classification — always 0 by construction;
+        exported so the crash gate asserts the property instead of
+        trusting it."""
+        return sum(
+            1
+            for c in self.cycles
+            for s in c.slots
+            if s.classification
+            not in (LANDED_DURABLE, LANDED_CHAIN, STRANDED, UNKNOWN, SKIPPED)
+        )
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "open_cycles": self.open_cycles,
+            "resent": self.resent,
+            "unknown": self.unknown,
+            "unaccounted": self.unaccounted,
+            "cycles": [c.as_dict() for c in self.cycles],
+        }
+
+
+def wal_cycles(records: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Fold raw WAL records into per-lineage cycle views."""
+    cycles: Dict[str, Dict[str, Any]] = {}
+    for r in records:
+        kind = r.get("kind")
+        lineage = r.get("lineage")
+        if kind == "cycle":
+            cycles[lineage] = {
+                "claim": r.get("claim"),
+                "total": int(r.get("total", 0)),
+                "skip": set(int(i) for i in r.get("skip", [])),
+                "oracles": list(r.get("oracles", [])),
+                "payloads": list(r.get("payloads", [])),
+                "intents": {},
+                "landed": set(),
+                "done": False,
+                "failed": None,
+            }
+        elif lineage in cycles:
+            if kind == "intent":
+                cycles[lineage]["intents"][int(r["slot"])] = r.get("digest")
+            elif kind == "landed":
+                cycles[lineage]["landed"].add(int(r["slot"]))
+            elif kind == "done":
+                # A failure-closed cycle is NOT done for durability
+                # purposes: its outcome was an error, its stranded
+                # slots still want reconciling, and the replay dedup
+                # set excludes it (wal.completed_lineages).
+                cycles[lineage]["done"] = "failed" not in r
+                cycles[lineage]["failed"] = r.get("failed")
+    return cycles
+
+
+def reconcile_wal(
+    wal: CommitIntentWAL,
+    adapter_for: Callable[[Optional[str]], Any],
+    *,
+    resend: bool = True,
+    journal=None,
+    registry=None,
+) -> ReconcileReport:
+    """Reconcile every open cycle in ``wal`` against the chain.
+
+    ``adapter_for(claim)`` resolves the claim's
+    :class:`~svoc_tpu.io.chain.ChainAdapter` (claim is None for
+    single-claim sessions).  With ``resend=True`` stranded slots are
+    re-sent from the WAL's recorded payloads; cycles with nothing left
+    unknown are closed.  Emits one ``durability.reconcile`` journal
+    event per open cycle and counts outcomes into
+    ``wal_reconciled{outcome=}``.
+    """
+    from svoc_tpu.fabric.router import resolve_journal
+    from svoc_tpu.utils.metrics import registry as _default_registry
+
+    j = resolve_journal(journal)
+    reg = registry if registry is not None else _default_registry
+    out: List[CycleReconciliation] = []
+    for lineage, cyc in wal_cycles(wal.records()).items():
+        if cyc["done"]:
+            continue
+        try:
+            adapter = adapter_for(cyc["claim"])
+        except Exception:
+            adapter = None
+        # ONE bulk read per cycle (not two RPCs per slot): the chain
+        # witness for every slot, or None when the backend is
+        # unreachable — the whole cycle then classifies unknown.
+        chain_rows = None
+        if adapter is not None:
+            try:
+                chain_rows = adapter.get_the_predictions()
+            except Exception:
+                chain_rows = None
+        verdicts: List[SlotVerdict] = []
+        for slot in range(cyc["total"]):
+            oracle = (
+                cyc["oracles"][slot] if slot < len(cyc["oracles"]) else None
+            )
+            payload = (
+                cyc["payloads"][slot] if slot < len(cyc["payloads"]) else None
+            )
+            if slot in cyc["skip"] or payload is None:
+                verdicts.append(SlotVerdict(slot, oracle, SKIPPED))
+                continue
+            if slot in cyc["landed"]:
+                verdicts.append(SlotVerdict(slot, oracle, LANDED_DURABLE))
+                continue
+            if (
+                adapter is None
+                or chain_rows is None
+                or not 0 <= slot < len(chain_rows)
+            ):
+                # Backend unreachable / pre-consensus read failure /
+                # fleet shrank under us: cannot prove landed OR
+                # stranded — never resend on missing evidence.
+                verdicts.append(SlotVerdict(slot, oracle, UNKNOWN))
+                continue
+            on_chain = chain_rows[slot]
+            if payload_digest(on_chain) == payload_digest(payload):
+                verdicts.append(SlotVerdict(slot, oracle, LANDED_CHAIN))
+                continue
+            verdict = SlotVerdict(slot, oracle, STRANDED)
+            if resend:
+                try:
+                    adapter._invoke_prediction_felts(oracle, payload)
+                    verdict.resent = True
+                except Exception as e:
+                    # A resend failure leaves the slot stranded-and-
+                    # accounted; the cycle stays open for a later pass.
+                    verdict.resend_error = repr(e)
+            verdicts.append(verdict)
+        unknown = sum(1 for v in verdicts if v.classification == UNKNOWN)
+        failed_resend = sum(
+            1 for v in verdicts if v.classification == STRANDED and not v.resent
+        ) if resend else 0
+        closed = resend and unknown == 0 and failed_resend == 0
+        if closed:
+            wal.close_cycle(
+                lineage,
+                sent=sum(1 for v in verdicts if v.resent),
+                note="reconciled",
+            )
+        rec = CycleReconciliation(
+            lineage=lineage, claim=cyc["claim"], slots=verdicts, closed=closed
+        )
+        out.append(rec)
+        for v in verdicts:
+            reg.counter(
+                "wal_reconciled", labels={"outcome": v.classification}
+            ).add(1)
+        j.emit(
+            "durability.reconcile",
+            lineage=lineage,
+            claim=cyc["claim"],
+            closed=closed,
+            **{c: rec.count(c) for c in (
+                LANDED_DURABLE, LANDED_CHAIN, STRANDED, UNKNOWN, SKIPPED
+            )},
+            resent=sum(1 for v in verdicts if v.resent),
+        )
+    return ReconcileReport(cycles=out)

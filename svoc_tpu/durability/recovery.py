@@ -1,0 +1,390 @@
+"""Snapshot + journal-replay recovery, and the graceful-drain handler.
+
+The WAL (:mod:`svoc_tpu.durability.wal`) makes the CHAIN side of a
+crash exact; this module recovers everything else the long-lived
+service holds in memory (docs/RESILIENCE.md §durability):
+
+- :class:`RecoveryManager` — periodic atomic snapshots
+  (:func:`svoc_tpu.utils.checkpoint.multi_session_to_dict` + the
+  journal ring + cumulative counters + serving queues + the virtual
+  clock), on a router post-step cadence.  Recovery =
+  **snapshot ∘ journal-tail replay ∘ WAL reconcile**: restore the
+  snapshot, roll the event journal forward from the fsynced trace file
+  (fingerprint continuity asserted before the roll), re-seed counters,
+  then reconcile the WAL against the (replayed or real) chain —
+  HybridFlow's single-controller-recovers-the-dataflow discipline
+  applied to our fabric.
+- :class:`GracefulDrain` — the SIGTERM/SIGINT path (G-Core's
+  drain-and-handoff): stop admission (``serving.shed{reason=
+  draining}``), flush in-flight micro-batches, defer what cannot
+  complete, snapshot, and leave a ``shutdown``-classified postmortem
+  bundle.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from svoc_tpu.durability.reconcile import ReconcileReport, reconcile_wal
+from svoc_tpu.utils.checkpoint import (
+    load_snapshot,
+    multi_session_to_dict,
+    restore_multi_session,
+    save_snapshot,
+)
+
+SNAPSHOT_NAME = "snapshot.json"
+
+
+class RecoveryError(RuntimeError):
+    """Recovery found torn/contradictory durable state (a fingerprint
+    discontinuity between the snapshot's journal ring and its recorded
+    digest) — refusing to roll forward on corrupt history."""
+
+
+class RecoveryManager:
+    """Owns the durable artifacts of one fabric/serving deployment."""
+
+    def __init__(
+        self,
+        multi,
+        *,
+        out_dir: str,
+        wal=None,
+        tier=None,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.multi = multi
+        self.out_dir = out_dir
+        self.wal = wal
+        self.tier = tier
+        self._clock = clock
+        self._metrics = multi.metrics
+        self._lock = threading.Lock()
+        self.snapshots = 0
+        #: Orphan claim state quarantined by a restore (membership
+        #: changed between snapshot and recovery).  Carried forward
+        #: into every subsequent snapshot — the "never silently
+        #: dropped" contract would otherwise only last until the next
+        #: cadence tick overwrote snapshot.json.
+        self._unclaimed: Dict[str, Any] = {}
+
+    @property
+    def snapshot_path(self) -> str:
+        return os.path.join(self.out_dir, SNAPSHOT_NAME)
+
+    def _journal(self):
+        from svoc_tpu.fabric.router import resolve_journal
+
+        return resolve_journal(self.multi.journal)
+
+    # -- the snapshot side --------------------------------------------------
+
+    def snapshot(self) -> str:
+        """One atomic snapshot; rotates the WAL afterwards (every cycle
+        the snapshot covers is closed, so the archived log is pure
+        history).  Returns the snapshot path."""
+        journal = self._journal()
+        with self._lock:
+            payload = multi_session_to_dict(self.multi)
+            if self._unclaimed:
+                payload["unclaimed"] = dict(self._unclaimed)
+            payload["journal"] = {
+                "events": journal.export_ring(),
+                "last_seq": journal.last_seq(),
+                "fingerprint": journal.fingerprint(),
+            }
+            payload["counters"] = self._metrics.counters_snapshot()
+            if self._clock is not None:
+                payload["clock"] = float(self._clock())
+            if self.tier is not None:
+                payload["serving"] = self.tier.serving_state_dict()
+            save_snapshot(self.snapshot_path, payload)
+            self.snapshots += 1
+            n = self.snapshots
+        if self.wal is not None:
+            try:
+                self.wal.rotate()
+            except RuntimeError:
+                # An open cycle (a commit raced the cadence hook, or a
+                # pre-restart cycle awaits reconciliation): keep the
+                # log, rotate on a later snapshot.
+                self._metrics.counter("wal_rotate_deferred").add(1)
+        self._metrics.counter("durability_snapshots").add(1)
+        journal.emit(
+            "durability.snapshot",
+            path=SNAPSHOT_NAME,
+            n=n,
+            events=len(payload["journal"]["events"]),
+            router_steps=payload["router_steps"],
+        )
+        return self.snapshot_path
+
+    def install_cadence(self, every_n_steps: int = 1) -> None:
+        """Snapshot every N cycles from the stack's quiesced point:
+        the SERVING tier's post-step hook when a tier is wired
+        (completions counted, queues updated — so every admitted
+        request is accountable as completed / queued / deferred), else
+        the router's (no commit in flight between fabric cycles)."""
+        if every_n_steps < 1:
+            raise ValueError("every_n_steps must be >= 1")
+
+        if self.tier is not None:
+            def hook(_report: Dict[str, Any]) -> None:
+                if self.tier.steps % every_n_steps == 0:
+                    self.snapshot()
+
+            self.tier.post_step_hooks.append(hook)
+        else:
+            def hook(_report: Dict[str, Any]) -> None:
+                if self.multi.router.steps % every_n_steps == 0:
+                    self.snapshot()
+
+            self.multi.router.post_step_hooks.append(hook)
+
+    # -- the recovery side --------------------------------------------------
+
+    def recover(
+        self,
+        *,
+        adapters: Optional[Dict[str, Any]] = None,
+        trace_path: Optional[str] = None,
+        resend: bool = True,
+    ) -> Dict[str, Any]:
+        """Bring a freshly-constructed fabric back to the pre-crash
+        state: snapshot restore → fingerprint-checked journal ring →
+        trace-tail roll-forward → counter re-seed → serving queue
+        re-enqueue + lost-request accounting → WAL reconcile.  Safe
+        with NO snapshot on disk (first-crash-before-first-snapshot:
+        everything restores empty and the WAL reconcile still runs).
+        """
+        from svoc_tpu.utils.events import read_trace_events
+
+        journal = self._journal()
+        report: Dict[str, Any] = {
+            "snapshot": None,
+            "journal_events": 0,
+            "tail_events": 0,
+            "restored_clock": None,
+            "membership": None,
+            "requeued": 0,
+            "lost_requests": 0,
+            "reconcile": None,
+        }
+        snap_seq = 0
+        if os.path.exists(self.snapshot_path):
+            payload = load_snapshot(self.snapshot_path)
+            report["snapshot"] = self.snapshot_path
+            report["membership"] = restore_multi_session(
+                payload, self.multi, adapters=adapters
+            )
+            # Quarantined orphans (claims gone from the live roster)
+            # survive every future snapshot until an operator (or a
+            # later restore into a roster that has them) claims them.
+            self._unclaimed.update(payload.get("unclaimed") or {})
+            ring = payload.get("journal", {}).get("events", [])
+            recorded_fp = payload.get("journal", {}).get("fingerprint")
+            snap_seq = int(payload.get("journal", {}).get("last_seq", 0))
+            journal.restore(ring)
+            if recorded_fp is not None and journal.fingerprint() != recorded_fp:
+                raise RecoveryError(
+                    "journal ring fingerprint diverges from the snapshot's "
+                    "recorded digest — refusing to roll forward on corrupt "
+                    "history"
+                )
+            report["journal_events"] = len(ring)
+            self._metrics.restore_counters(payload.get("counters", []))
+            if payload.get("clock") is not None:
+                report["restored_clock"] = float(payload["clock"])
+            if self.tier is not None and payload.get("serving"):
+                report["requeued"] = self.tier.restore_serving_state(
+                    payload["serving"]
+                )
+        tail: List[Dict[str, Any]] = []
+        if trace_path is not None and os.path.exists(trace_path):
+            tail = read_trace_events(trace_path, since_seq=snap_seq)
+            if tail:
+                journal.restore(
+                    (journal.export_ring() if snap_seq else []) + tail
+                )
+            report["tail_events"] = len(tail)
+        report["lost_requests"] = self._account_lost_requests(journal, tail)
+        if self.wal is not None:
+            rec: ReconcileReport = reconcile_wal(
+                self.wal,
+                self._adapter_for,
+                resend=resend,
+                journal=journal,
+                registry=self._metrics,
+            )
+            report["reconcile"] = rec.as_dict()
+        return report
+
+    # -- views ---------------------------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """The console/web durability panel: snapshot freshness, WAL
+        health, reconcile counters — cheap, no chain I/O."""
+        from svoc_tpu.durability.reconcile import wal_cycles
+
+        snap_exists = os.path.exists(self.snapshot_path)
+        open_cycles: List[str] = []
+        wal_records = 0
+        if self.wal is not None:
+            records = self.wal.records()
+            wal_records = len(records)
+            open_cycles = [
+                lin
+                for lin, c in wal_cycles(records).items()
+                if not c["done"]
+            ]
+        return {
+            "snapshot_path": self.snapshot_path,
+            "snapshot_exists": snap_exists,
+            "snapshots_this_process": self.snapshots,
+            "wal_path": getattr(self.wal, "path", None),
+            "wal_records": wal_records,
+            "wal_open_cycles": open_cycles,
+        }
+
+    def attach(self, console) -> None:
+        """Expose this manager through a
+        :class:`~svoc_tpu.apps.commands.CommandConsole`: the
+        ``durability`` command and ``/api/state``'s durability section
+        read it."""
+        console.durability = self
+
+    def _adapter_for(self, claim: Optional[str]):
+        if claim is None:
+            states = self.multi.registry.states()
+            if not states:
+                raise KeyError("no claims registered")
+            return states[0].session.adapter
+        return self.multi.get(claim).session.adapter
+
+    def _account_lost_requests(self, journal, tail) -> int:
+        """Every request ADMITTED after the snapshot (the trace tail)
+        was in flight when the process died: its text is gone (only
+        the snapshot carries queue contents), so it cannot be
+        re-served — journal each one as
+        ``serving.deferred{reason="crash_recovery"}`` and count it
+        dropped.  Deliberately CONSERVATIVE: a post-snapshot request
+        that completed before the crash is deferred too (per-request
+        completions are not journaled — that would bloat every replay
+        fingerprint), so the dropped/deferred side may over-count but
+        an admitted request is never silently unaccounted; the
+        restored counters keep every pre-snapshot completion."""
+        lost = 0
+        for record in tail:
+            if record.get("event") != "serving.admitted":
+                continue
+            data = record.get("data") or {}
+            if data.get("source") != "queue":
+                continue  # cache answers completed synchronously
+            journal.emit(
+                "serving.deferred",
+                lineage=record.get("lineage"),
+                claim=data.get("claim"),
+                seq=data.get("seq"),
+                reason="crash_recovery",
+            )
+            if data.get("claim"):
+                self._metrics.counter(
+                    "serving_dropped", labels={"claim": str(data["claim"])}
+                ).add(1)
+            lost += 1
+        return lost
+
+
+class GracefulDrain:
+    """SIGTERM/SIGINT → stop admission, flush, snapshot, bundle.
+
+    The drain sequence (docs/RESILIENCE.md §drain):
+
+    1. admission latches: new submissions shed ``reason="draining"``;
+    2. in-flight micro-batches flush (bounded ``tier.drain`` steps);
+       what cannot complete is journaled ``serving.deferred``;
+    3. the recovery manager snapshots (the restart's warm start);
+    4. a ``shutdown``-classified postmortem bundle is written
+       (:meth:`svoc_tpu.utils.postmortem.PostmortemMonitor.shutdown`);
+    5. one ``durability.drain`` event summarizes the teardown.
+
+    ``install()`` wires it to SIGTERM/SIGINT, chaining any previous
+    handler; ``drain()`` is idempotent and callable directly (tests,
+    the console's ``drain`` command).
+    """
+
+    def __init__(
+        self,
+        *,
+        manager: Optional[RecoveryManager] = None,
+        tier=None,
+        monitor=None,
+        journal=None,
+    ):
+        self.manager = manager
+        self.tier = tier if tier is not None else (
+            manager.tier if manager is not None else None
+        )
+        self.monitor = monitor
+        self._journal = journal
+        self._lock = threading.Lock()
+        self._drained = False
+        from svoc_tpu.utils.postmortem import SignalChain
+
+        self._signal_chain = SignalChain(
+            lambda signum, _frame: self.drain(reason=f"signal_{signum}")
+        )
+
+    def _resolve_journal(self):
+        from svoc_tpu.fabric.router import resolve_journal
+
+        if self._journal is not None:
+            return resolve_journal(self._journal)
+        if self.manager is not None:
+            return self.manager._journal()
+        return resolve_journal(None)
+
+    def drain(self, reason: str = "signal") -> Dict[str, Any]:
+        with self._lock:
+            if self._drained:
+                return {"already_drained": True}
+            self._drained = True
+        report: Dict[str, Any] = {"reason": reason}
+        if self.tier is not None:
+            report["flush"] = self.tier.drain()
+        if self.manager is not None:
+            report["snapshot"] = self.manager.snapshot()
+        if self.monitor is not None:
+            report["bundle"] = self.monitor.shutdown(reason)
+        self._resolve_journal().emit(
+            "durability.drain",
+            reason=reason,
+            deferred=report.get("flush", {}).get("deferred", 0),
+            snapshot=report.get("snapshot") is not None,
+            bundle=report.get("bundle"),
+        )
+        return report
+
+    def attach(self, console) -> None:
+        """Expose the drain path through a
+        :class:`~svoc_tpu.apps.commands.CommandConsole` (the ``drain``
+        command)."""
+        console.drainer = self
+
+    def install(self, signals=None) -> "GracefulDrain":
+        """Hook SIGTERM/SIGINT through the shared
+        :class:`~svoc_tpu.utils.postmortem.SignalChain` (previous
+        handlers chained, ignored signals stay ignored, default
+        disposition re-delivered otherwise)."""
+        import signal as _signal
+
+        if signals is None:
+            signals = (_signal.SIGTERM, _signal.SIGINT)
+        self._signal_chain.install(signals)
+        return self
+
+    def uninstall(self) -> None:
+        self._signal_chain.uninstall()

@@ -1,0 +1,320 @@
+"""Seeded kill/restart scenario: the ``make crash-smoke`` workload.
+
+A serving-driven multi-claim run whose EVERY durable artifact lives in
+one work directory: per-claim chain tx logs (the external-chain
+stand-in, :mod:`~svoc_tpu.durability.chainlog`), the commit-intent WAL,
+periodic snapshots, and an fsynced journal trace.  The harness
+(``tools/crash_smoke.py``) runs it in a subprocess, SIGKILLs it at a
+seeded fault point, re-runs it in the same directory — the scenario
+auto-detects the durable state and recovers (snapshot restore → journal
+tail replay → WAL reconcile → resume serving) — and asserts the
+durability contract over the artifacts:
+
+- **zero duplicate txs** — no ``(caller, digest)`` pair appears twice
+  in any chain log, at ANY kill point;
+- **zero unaccounted slots/requests** — every WAL intent classifies
+  landed/stranded/unknown, every admitted request ends completed or
+  journaled deferred;
+- **replay identity** — two runs of the full kill/restart matrix
+  produce byte-identical recovered per-claim journal fingerprints.
+
+Everything is a pure function of ``seed`` + the crash point: arrivals
+key off :func:`claim_seed` PER ITERATION (so a re-run of a half-dead
+cycle redraws identically), time is a virtual clock persisted in the
+snapshot, and the fault points are COUNTER-based (the Nth WAL intent,
+the Nth landed tx, the Nth serving step), never timing-based.
+
+Crash points (``crash_point=``):
+
+- ``"mid_wal_append"`` — tears the Nth intent record in half (half the
+  JSON line, fsynced, then SIGKILL): the restart must ignore the torn
+  tail and classify the slot by chain digest.
+- ``"inter_tx"`` — SIGKILL right after the Nth ``update_prediction``
+  hit the chain log (tx durably on chain, WAL ``landed`` record never
+  written): the restart must classify it landed via the chain witness
+  and NOT resend.
+- ``"pre_snapshot"`` — SIGKILL at the end of serving step N, after the
+  commits but before the cadence snapshot: the restart rolls forward
+  from an older snapshot purely on the journal tail + WAL.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from svoc_tpu.consensus.state import OracleConsensusContract
+from svoc_tpu.durability.chainlog import (
+    DurableLocalBackend,
+    duplicate_predictions,
+    read_chain_log,
+    replay_chain_log,
+)
+from svoc_tpu.durability.recovery import GracefulDrain, RecoveryManager
+from svoc_tpu.durability.wal import CommitIntentWAL
+from svoc_tpu.fabric.registry import ClaimSpec
+from svoc_tpu.fabric.scenario import _claim_names, deterministic_vectorizer
+from svoc_tpu.sim.generators import claim_seed
+
+CRASH_POINTS = ("mid_wal_append", "inter_tx", "pre_snapshot")
+
+#: Default counter thresholds per crash point — deep enough into the
+#: run that several cycles committed and at least one snapshot landed.
+DEFAULT_CRASH_AT = {"mid_wal_append": 12, "inter_tx": 10, "pre_snapshot": 5}
+
+
+def _die() -> None:  # pragma: no cover — the harness child only
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _spec_contract(spec: ClaimSpec, n_admins: int = 3) -> OracleConsensusContract:
+    """The claim's deployment (mirrors ``apps.session._default_contract``:
+    admins 0xA0…, oracles 0x10…) — reconstructed identically on every
+    restart so the replayed tx log lands on the same genesis."""
+    return OracleConsensusContract(
+        admins=[0xA0 + i for i in range(n_admins)],
+        oracles=[0x10 + i for i in range(spec.n_oracles)],
+        required_majority=2,
+        n_failing_oracles=spec.n_failing,
+        constrained=spec.constrained,
+        unconstrained_max_spread=spec.max_spread if not spec.constrained else 0.0,
+        dimension=spec.dimension,
+    )
+
+
+def run_durable_scenario(
+    workdir: str,
+    seed: int = 0,
+    *,
+    total_steps: int = 10,
+    n_claims: int = 2,
+    n_oracles: int = 7,
+    dimension: int = 6,
+    arrivals_per_step: int = 6,
+    snapshot_every: int = 2,
+    step_period_s: float = 0.1,
+    crash_point: Optional[str] = None,
+    crash_at: Optional[int] = None,
+) -> Dict[str, Any]:
+    """One scenario phase in ``workdir`` — fresh when the directory has
+    no durable state, recovery otherwise.  With ``crash_point`` set the
+    process SIGKILLs itself at the seeded fault point (the call never
+    returns); without it the phase runs to ``total_steps``, drains
+    gracefully, and returns the result dict the harness asserts over.
+    """
+    from svoc_tpu.fabric.session import MultiSession
+    from svoc_tpu.serving.frontend import AdmissionConfig
+    from svoc_tpu.serving.scenario import VirtualClock
+    from svoc_tpu.serving.tier import ServingTier
+    from svoc_tpu.utils.events import EventJournal
+    from svoc_tpu.utils.metrics import MetricsRegistry
+    from svoc_tpu.utils.postmortem import PostmortemMonitor
+    from svoc_tpu.utils.slo import serving_slos
+
+    if crash_point is not None and crash_point not in CRASH_POINTS:
+        raise ValueError(f"unknown crash_point {crash_point!r}")
+    crash_at = (
+        crash_at
+        if crash_at is not None
+        else DEFAULT_CRASH_AT.get(crash_point or "", 0)
+    )
+    os.makedirs(workdir, exist_ok=True)
+    # The journal trace is a durability artifact here — every emit must
+    # be on the platter before the next instruction (SVOC_TRACE_FSYNC
+    # semantics, forced programmatically so the child needs no env).
+    trace_path = os.path.join(workdir, "trace.jsonl")
+    wal_path = os.path.join(workdir, "wal.jsonl")
+
+    metrics = MetricsRegistry()
+    journal = EventJournal(registry=metrics)
+    from svoc_tpu.utils import events as _events
+
+    writer = _events.shared_writer(trace_path)
+    writer.fsync = True
+    journal.set_trace_file(trace_path)
+    clock = VirtualClock()
+    names = _claim_names(n_claims)
+    specs = {
+        name: ClaimSpec(
+            claim_id=name, n_oracles=n_oracles, dimension=dimension
+        )
+        for name in names
+    }
+
+    def chain_log_path(claim_id: str) -> str:
+        return os.path.join(workdir, f"chain-{claim_id}.jsonl")
+
+    backends: Dict[str, DurableLocalBackend] = {}
+
+    def adapter_factory(spec: ClaimSpec):
+        from svoc_tpu.io.chain import ChainAdapter
+
+        contract = _spec_contract(spec)
+        path = chain_log_path(spec.claim_id)
+        replay_chain_log(path, contract)  # no-op on a fresh directory
+        backend = DurableLocalBackend(contract, path)
+        backends[spec.claim_id] = backend
+        return ChainAdapter(backend)
+
+    wal = CommitIntentWAL(wal_path)
+    multi = MultiSession(
+        base_seed=seed,
+        vectorizer=deterministic_vectorizer,
+        journal=journal,
+        metrics=metrics,
+        lineage_scope="dur",
+        max_claims_per_batch=n_claims,
+        sanitized_dispatch=True,
+        clock=clock,
+        adapter_factory=adapter_factory,
+    )
+    for name in names:
+        multi.add_claim(specs[name])
+    multi.attach_wal(wal)
+    tier = ServingTier(
+        multi,
+        vectorizer=deterministic_vectorizer,
+        admission=AdmissionConfig(queue_capacity=32, seed=seed),
+        max_requests_per_step=16,
+        clock=clock,
+        slos=serving_slos(
+            metrics,
+            latency_target_s=2.5 * step_period_s,
+            fast_window_s=10 * step_period_s,
+            slow_window_s=50 * step_period_s,
+        ),
+    )
+    manager = RecoveryManager(
+        multi, out_dir=workdir, wal=wal, tier=tier, clock=clock
+    )
+
+    # ---- recovery (auto-detected from the durable artifacts) ----
+    recovered = os.path.exists(manager.snapshot_path) or bool(wal.records())
+    recovery_report = None
+    if recovered:
+        recovery_report = manager.recover(
+            adapters={
+                cid: multi.get(cid).session.adapter for cid in names
+            },
+            trace_path=trace_path,
+        )
+        if recovery_report["restored_clock"] is not None:
+            clock.now = recovery_report["restored_clock"]
+
+    # ---- arm the seeded fault point ----
+    if crash_point == "mid_wal_append":
+        intent_count = [0]
+
+        def wal_crash(kind: str, record: Dict[str, Any]) -> None:
+            if kind != "intent":
+                return
+            intent_count[0] += 1
+            if intent_count[0] == crash_at:
+                wal.simulate_torn_append(record)
+                _die()
+
+        wal.crash_hook = wal_crash
+    elif crash_point == "inter_tx":
+        tx_count = [0]
+
+        def chain_crash(record: Dict[str, Any]) -> None:
+            if record.get("fn") != "update_prediction":
+                return
+            tx_count[0] += 1
+            if tx_count[0] == crash_at:
+                _die()
+
+        for backend in backends.values():
+            backend.crash_hook = chain_crash
+    elif crash_point == "pre_snapshot":
+
+        def step_crash(_report: Dict[str, Any]) -> None:
+            if tier.steps == crash_at:
+                _die()
+
+        # Registered BEFORE the cadence hook: the kill lands after the
+        # step's commits but before its snapshot.
+        tier.post_step_hooks.append(step_crash)
+
+    manager.install_cadence(snapshot_every)
+    monitor = PostmortemMonitor(
+        out_dir=workdir, registry=metrics, journal=journal
+    ).install()
+    drainer = GracefulDrain(manager=manager, monitor=monitor, journal=journal)
+
+    # ---- the serving loop (iteration-keyed seeded arrivals) ----
+    pool = [f"hot take {i} on the claim economy" for i in range(8)]
+    while tier.steps < total_steps:
+        step_no = tier.steps  # continues across restarts (restored)
+        clock.advance(step_period_s)
+        rng = np.random.default_rng(claim_seed(seed, f"arrivals{step_no}"))
+        for i in range(arrivals_per_step):
+            claim = names[int(rng.integers(0, len(names)))]
+            if rng.random() < 0.3:
+                text = pool[int(rng.integers(0, len(pool)))]
+            else:
+                text = f"comment {claim} step {step_no} #{i}"
+            tier.submit(claim, text)
+        tier.step()
+
+    drain_report = drainer.drain(reason="scenario_end")
+
+    # ---- the result the harness asserts over ----
+    chain: Dict[str, Any] = {}
+    total_dups: List[Dict[str, Any]] = []
+    for name in names:
+        path = chain_log_path(name)
+        txs = read_chain_log(path)
+        dups = duplicate_predictions(path)
+        total_dups.extend(dups)
+        chain[name] = {
+            "txs": len(txs),
+            "predictions": sum(
+                1 for t in txs if t["fn"] == "update_prediction"
+            ),
+            "duplicates": len(dups),
+        }
+    from svoc_tpu.durability.reconcile import wal_cycles
+
+    open_cycles = [
+        lin for lin, c in wal_cycles(wal.records()).items() if not c["done"]
+    ]
+    admitted = metrics.family_total("serving_admitted")
+    completed = metrics.family_total("serving_completed")
+    dropped = metrics.family_total("serving_dropped")
+    return {
+        "seed": seed,
+        "recovered": recovered,
+        "recovery": recovery_report,
+        "steps": tier.steps,
+        "drain": drain_report,
+        "chain": chain,
+        "duplicate_txs": len(total_dups),
+        "wal_open_cycles": open_cycles,
+        "requests": {
+            "admitted": admitted,
+            "completed": completed,
+            "dropped": dropped,
+            "cached": metrics.family_total("serving_cached"),
+            # Nothing admitted may vanish: completed + dropped covers
+            # admitted (re-served snapshot requests can push the sum
+            # ABOVE admitted — at-least-once, never silent loss).
+            "unaccounted": max(0.0, admitted - completed - dropped),
+        },
+        "claims": {
+            name: {
+                "fingerprint": multi.claim_fingerprint(name),
+                "cycles": multi.get(name).cycles,
+                "oracle_list": [
+                    hex(a)
+                    for a in multi.get(name).session.adapter.call_oracle_list()
+                ],
+            }
+            for name in names
+        },
+        "journal_fingerprint": journal.fingerprint(),
+        "journal_events": journal.last_seq(),
+    }

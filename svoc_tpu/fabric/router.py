@@ -101,6 +101,14 @@ class ClaimRouter:
         self._rotation: deque = deque()
         self._rotation_members: Tuple[Tuple[str, int], ...] = ()
         self.steps = 0
+        #: End-of-cycle hooks, called with the step report AFTER every
+        #: claim was committed/accounted — the recovery manager's
+        #: snapshot cadence rides here (docs/RESILIENCE.md
+        #: §durability), and the crash harness's seeded kill points
+        #: too.  Hooks run in registration order on the router thread;
+        #: an exception is counted (``fabric_hook_errors``) and never
+        #: kills the loop.
+        self.post_step_hooks: List[Any] = []
 
     def _resolve_journal(self):
         return resolve_journal(self._journal)
@@ -184,6 +192,17 @@ class ClaimRouter:
         ITS ``fabric_claim_errors{claim=,stage="fetch"}`` and its
         siblings are still served.  ``feeds=None`` is the PR 6
         pull-mode cycle, byte-for-byte unchanged."""
+        report = self._step_inner(feeds=feeds)
+        for hook in list(self.post_step_hooks):
+            try:
+                hook(report)
+            except Exception:  # noqa: BLE001 — a hook must not kill serving
+                self._metrics.counter("fabric_hook_errors").add(1)
+        return report
+
+    def _step_inner(
+        self, feeds: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
         self.steps += 1
         report: Dict[str, Any] = {
             "step": self.steps,

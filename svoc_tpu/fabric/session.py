@@ -54,10 +54,20 @@ class MultiSession:
         max_claims_per_batch: int = 8,
         sanitized_dispatch: bool = False,
         clock: Optional[Callable[[], float]] = None,
+        adapter_factory=None,
     ):
         self.base_seed = base_seed
         self._vectorizer = vectorizer
         self._store_factory = store_factory
+        #: ``adapter_factory(spec) -> ChainAdapter`` overrides each new
+        #: claim session's default in-memory chain — the durability
+        #: layer injects adapters over a crash-surviving tx log here
+        #: (:mod:`svoc_tpu.durability.chainlog`), and a Sepolia
+        #: deployment would inject real backends the same way.
+        self._adapter_factory = adapter_factory
+        #: Commit-intent WAL shared by every claim session once
+        #: :meth:`attach_wal` is called (claim-tagged records).
+        self._wal = None
         self._journal = journal
         self._metrics = metrics or _default_metrics
         self._lineage_scope = lineage_scope
@@ -130,8 +140,15 @@ class MultiSession:
             config=config,
             store=store,
             vectorizer=vectorizer or self._vectorizer,
+            adapter=(
+                self._adapter_factory(spec)
+                if self._adapter_factory is not None
+                else None
+            ),
             journal=self._journal,
         )
+        if self._wal is not None:
+            session.attach_wal(self._wal)
         evaluator = SLOEvaluator(
             claim_slos(
                 self._metrics,
@@ -163,6 +180,15 @@ class MultiSession:
                 labels={"claim": spec.claim_id, "stage": stage},
             ).add(0)
         return self.registry.add(spec, session, evaluator)
+
+    def attach_wal(self, wal) -> None:
+        """Wire one :class:`svoc_tpu.durability.wal.CommitIntentWAL`
+        through every claim session (current and future): each claim's
+        resilient commits journal claim-tagged, fsynced intent records
+        into the shared log (docs/RESILIENCE.md §durability)."""
+        self._wal = wal
+        for state in self.registry.states():
+            state.session.attach_wal(wal)
 
     def remove_claim(self, claim_id: str) -> ClaimState:
         """Drop a claim from the registry (its Session object survives

@@ -34,7 +34,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Protocol, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Protocol, Sequence, Tuple
 
 from svoc_tpu.consensus.state import ContractError, OracleConsensusContract
 from svoc_tpu.ops.fixedpoint import (
@@ -567,6 +567,33 @@ class ChainAdapter:
             )
         ]
 
+    @_atomic
+    def get_the_predictions(self) -> List[List[int]]:
+        """The EXACT felt vectors currently stored on chain, one per
+        oracle slot — the WAL reconciler's landed/stranded witness
+        (docs/RESILIENCE.md §durability): a commit intent whose payload
+        digest matches its slot's read landed before the crash; a
+        mismatch means the slot still holds the previous block's value
+        and the tx is stranded.  Admin-gated like
+        ``get_oracle_value_list`` (the raw per-oracle dump is the only
+        entrypoint that exposes stored values); bulk by design — the
+        reconciler reads the fleet ONCE per cycle instead of paying
+        two RPCs per slot.  Propagates chain errors — the reconciler
+        classifies those as *unknown*, never as stranded."""
+        admins = self.backend.call("get_admin_list")
+        if not admins:
+            raise RuntimeError("contract has no admins to read values as")
+        rows = self.backend.call_as(admins[0], "get_oracle_value_list")
+        return [[int(x) for x in vec] for _addr, vec, _en, _rel in rows]
+
+    def get_the_prediction(self, slot: int) -> List[int]:
+        """One slot of :meth:`get_the_predictions`; raises
+        ``IndexError`` for an out-of-range slot."""
+        rows = self.get_the_predictions()
+        if not 0 <= int(slot) < len(rows):
+            raise IndexError(f"slot {slot} outside [0, {len(rows)})")
+        return rows[int(slot)]
+
     # -- index/address resolution (client/contract.py:95-123) --------------
 
     def address_to_oracle_index(self, address) -> int:
@@ -604,6 +631,8 @@ class ChainAdapter:
         start: int = 0,
         skip: Sequence[int] = (),
         lineage: Optional[str] = None,
+        on_intent: Optional[Callable[[int, Any, List[int]], None]] = None,
+        on_landed: Optional[Callable[[int], None]] = None,
     ) -> int:
         """One signed tx per oracle, in oracle-list order
         (``client/contract.py:200-208``); returns the tx count *sent by
@@ -641,13 +670,33 @@ class ChainAdapter:
         ``lineage`` tags the ``commit`` stage span with the fleet
         block's lineage id (``svoc_tpu.utils.events``) so the span is
         joinable into the block's audit record.
+
+        ``on_intent(idx, oracle, felts)`` / ``on_landed(idx)`` are the
+        commit-intent WAL's per-tx hooks (docs/RESILIENCE.md
+        §durability): the intent hook runs IMMEDIATELY before each tx
+        with the exact felt payload about to be signed, the landed hook
+        immediately after the invoke returns.  Hooks force the per-tx
+        loop (intent granularity IS the tx granularity).  A hook
+        exception propagates unwrapped — a WAL that cannot persist the
+        intent must stop the commit ("no durable intent, no tx"), and
+        that is an infrastructure failure, not the oracle's.
         """
         from svoc_tpu.utils.metrics import stage_span
 
         with stage_span("commit", lineage=lineage):
             return self._update_all_the_predictions(
-                predictions, batch=batch, start=start, skip=skip
+                predictions, batch=batch, start=start, skip=skip,
+                on_intent=on_intent, on_landed=on_landed,
             )
+
+    @_atomic
+    def _invoke_prediction_felts(self, oracle_address, felts: List[int]) -> None:
+        """Pre-encoded twin of :meth:`invoke_update_prediction` — the
+        WAL path encodes once, journals the felts, then signs the SAME
+        payload (digest in the log must equal digest on the wire)."""
+        self.backend.invoke(
+            oracle_address, "update_prediction", prediction=felts
+        )
 
     def _update_all_the_predictions(
         self,
@@ -656,6 +705,8 @@ class ChainAdapter:
         batch: Optional[bool] = None,
         start: int = 0,
         skip: Sequence[int] = (),
+        on_intent: Optional[Callable[[int, Any, List[int]], None]] = None,
+        on_landed: Optional[Callable[[int], None]] = None,
     ) -> int:
         oracles = self.call_oracle_list()
         total = min(len(oracles), len(predictions))
@@ -667,9 +718,11 @@ class ChainAdapter:
         batched_invoke = getattr(
             self.backend, "invoke_update_predictions_batch", None
         )
+        wal_hooks = on_intent is not None or on_landed is not None
         if batch is None:
             batch = (
                 not skip_set
+                and not wal_hooks
                 and batched_invoke is not None
                 and total - start >= self.BATCH_COMMIT_THRESHOLD
             )
@@ -677,6 +730,11 @@ class ChainAdapter:
             raise ValueError(
                 "batch commit cannot skip slots (contiguous caller "
                 "range) — use batch=False with skip"
+            )
+        if batch and wal_hooks:
+            raise ValueError(
+                "batch commit cannot journal per-tx intents — use "
+                "batch=False with on_intent/on_landed"
             )
         if batch:
             if batched_invoke is None:
@@ -738,8 +796,29 @@ class ChainAdapter:
             if idx in skip_set:
                 continue  # quarantined slot: no tx, no count
             oracle, prediction = oracles[idx], predictions[idx]
+            felts = None
+            if wal_hooks:
+                # Encode BEFORE the intent hook: a codec failure is
+                # this tx's failure (as on the plain path) and must not
+                # leave a journaled intent for a payload that can never
+                # be signed.
+                try:
+                    felts = encode_vector(prediction)
+                except Exception as e:
+                    raise ChainCommitError(
+                        committed=idx,
+                        total=total,
+                        failed_oracle=oracle,
+                        cause=e,
+                        sent_count=n,
+                    ) from e
+                if on_intent is not None:
+                    on_intent(idx, oracle, felts)  # WAL errors propagate
             try:
-                self.invoke_update_prediction(oracle, prediction)
+                if felts is not None:
+                    self._invoke_prediction_felts(oracle, felts)
+                else:
+                    self.invoke_update_prediction(oracle, prediction)
             except ChainCommitError:
                 raise
             except Exception as e:
@@ -750,6 +829,8 @@ class ChainAdapter:
                     cause=e,
                     sent_count=n,
                 ) from e
+            if on_landed is not None:
+                on_landed(idx)
             n += 1
         return n
 

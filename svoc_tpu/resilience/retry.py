@@ -140,15 +140,38 @@ class CommitOutcome:
         return not self.stranded and self.sent == self.total
 
 
-def _landed(e: ChainCommitError, start: int) -> int:
+def _landed(e: ChainCommitError, start: int, wal=None) -> int:
     """Txs the failing attempt actually landed: ``sent_count`` when the
     raiser supplied it (it diverges from the index delta whenever
-    quarantine skips sit inside the attempted range), else the
+    quarantine skips sit inside the attempted range); else the WAL's
+    durable landed count for the attempt when a commit-intent WAL is
+    riding the loop (the raiser died BEFORE reporting — its index is a
+    guess, the fsynced landed records are not); else the
     attempt-relative index delta — never ``committed`` itself, which on
     a resumed attempt counts the already-landed prefix (pre-PR-4
     pickles and third-party raisers may lack the attribute)."""
     sent_count = getattr(e, "sent_count", None)
-    return sent_count if sent_count is not None else e.committed - start
+    if sent_count is not None:
+        return sent_count
+    if wal is not None:
+        return wal.attempt_landed
+    return e.committed - start
+
+
+def _failure_index(e: ChainCommitError, wal=None) -> int:
+    """The absolute fleet index to resume at.  ``e.committed`` on the
+    well-behaved paths; when the raiser supplied no ``sent_count`` (it
+    died before reporting) AND a commit-intent WAL rode the attempt,
+    the WAL's attempt cursor — the last slot with a durable intent and
+    no landed record — is authoritative: a backend that raised with an
+    optimistically-advanced ``committed`` would otherwise make resume
+    SKIP a tx that never landed (the pre-report death window,
+    docs/RESILIENCE.md §durability)."""
+    if wal is not None and getattr(e, "sent_count", None) is None:
+        cursor = wal.attempt_cursor()
+        if cursor is not None:
+            return cursor
+    return e.committed
 
 
 def commit_fleet_with_resume(
@@ -164,6 +187,7 @@ def commit_fleet_with_resume(
     registry: Optional[MetricsRegistry] = None,
     journal=None,
     lineage: Optional[str] = None,
+    wal=None,
 ) -> CommitOutcome:
     """Commit the whole fleet, resuming across partial failures.
 
@@ -203,6 +227,15 @@ def commit_fleet_with_resume(
     story lands in the flight recorder as ``commit.sent`` /
     ``commit.retried`` / ``commit.skipped`` / ``commit.failed`` events
     tagged with the block lineage — the audit record's commit leg.
+
+    ``wal`` (a :class:`svoc_tpu.durability.wal.WALCycle`): rides the
+    loop with per-tx intent/landed records so the accounting survives
+    process death, and serves as the authoritative resume cursor and
+    landed count whenever the raiser supplied no ``sent_count``
+    (:func:`_failure_index` / :func:`_landed`).  Every exit path —
+    success, stranded-complete, deadline, breaker, transport — closes
+    the cycle (``done``); only a kill leaves it open for the restart
+    reconciler (docs/RESILIENCE.md §durability).
     """
     reg = registry or _default_registry
     if journal is None:
@@ -236,16 +269,23 @@ def commit_fleet_with_resume(
                 backend=breaker.name,
                 sent=sent,
             )
+            if wal is not None:
+                wal.done(sent, stranded, failed="circuit_open")
             raise CircuitOpenError(
                 breaker.name, breaker.retry_after_s(), sent=sent
             )
         attempts += 1
+        if wal is not None:
+            wal.new_attempt(start)
         t0 = clock()
         try:
             n = adapter.update_all_the_predictions(
-                predictions, start=start, skip=skip, lineage=lineage
+                predictions, start=start, skip=skip, lineage=lineage,
+                on_intent=wal.intent if wal is not None else None,
+                on_landed=wal.landed if wal is not None else None,
             )
         except ChainCommitError as e:
+            landed = _landed(e, start, wal)
             if breaker is not None:
                 # Progress credit: an attempt that LANDED txs before
                 # failing proves the backend alive — record success, or
@@ -256,15 +296,18 @@ def commit_fleet_with_resume(
                 # a quarantine-skipped slot between ``start`` and the
                 # failure advances the index without proving anything
                 # about the backend.
-                if _landed(e, start) > 0:
+                if landed > 0:
                     breaker.record_success()
                 else:
                     breaker.record_failure()
             if on_oracle_failure is not None:
                 on_oracle_failure(e.failed_oracle, e)
-            landed = _landed(e, start)
             sent += landed
-            j = e.committed  # absolute index of the failed oracle
+            # Absolute index of the failed oracle — the WAL's durable
+            # intent/landed records override a pre-report raiser's
+            # guess (satellite fix: an over-advanced index here would
+            # skip a tx that never landed).
+            j = _failure_index(e, wal)
             consecutive[j] = consecutive.get(j, 0) + 1
             if consecutive[j] >= policy.max_attempts:
                 # This oracle exhausted its budget — strand it and keep
@@ -294,6 +337,8 @@ def commit_fleet_with_resume(
                         attempts=attempts,
                         stranded=len(stranded),
                     )
+                    if wal is not None:
+                        wal.done(sent, stranded)
                     return CommitOutcome(
                         sent=sent,
                         # Eligible slots only: quarantine skips are
@@ -329,6 +374,8 @@ def commit_fleet_with_resume(
                     sent=sent,
                     cause=str(e.cause),
                 )
+                if wal is not None:
+                    wal.done(sent, stranded, failed="deadline")
                 raise
             reg.counter("retries", labels={"op": "commit"}).add(1)
             journal.emit(
@@ -358,6 +405,8 @@ def commit_fleet_with_resume(
                 reason="transport",
                 sent=sent,
             )
+            if wal is not None:
+                wal.done(sent, stranded, failed="transport")
             raise
         else:
             if breaker is not None:
@@ -378,6 +427,8 @@ def commit_fleet_with_resume(
                 attempts=attempts,
                 stranded=len(stranded),
             )
+            if wal is not None:
+                wal.done(sent, stranded)
             return CommitOutcome(
                 sent=sent,
                 total=fleet_total - len(skip_set),

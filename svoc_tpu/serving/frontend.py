@@ -192,6 +192,13 @@ class ServingFrontend:
         self._lock = threading.Lock()
         self._queues: Dict[str, deque] = {}
         self._seqs: Dict[str, int] = {}
+        #: Graceful-drain latch (docs/RESILIENCE.md §drain): while set,
+        #: every cache-miss submission sheds with ``reason="draining"``
+        #: — admission stops at the door so the flush loop can empty
+        #: the queues.  Cache hits still answer (they cost nothing and
+        #: occupy no slot — the same degraded-mode contract as SLO-burn
+        #: shedding).
+        self._draining = False
 
     # -- the submit path ----------------------------------------------------
 
@@ -247,7 +254,10 @@ class ServingFrontend:
         )
         with self._lock:
             q = self._queues.setdefault(claim_id, deque())
-            decision = self.controller.decide(claim_id, len(q), seq)
+            if self._draining:
+                decision = AdmissionDecision("shed", "draining")
+            else:
+                decision = self.controller.decide(claim_id, len(q), seq)
             if decision.action == "admit":
                 q.append(request)
                 depth = len(q)
@@ -292,6 +302,67 @@ class ServingFrontend:
             "lineage": lineage,
             "reason": decision.reason,
         }
+
+    def set_draining(self, draining: bool = True) -> None:
+        """Flip the drain latch (the SIGTERM handler's first act)."""
+        with self._lock:
+            self._draining = bool(draining)
+
+    @property
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    # -- snapshot / restore (docs/RESILIENCE.md §durability) ---------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Queued requests + per-claim seq cursors, JSON-safe.  Seqs
+        MUST survive a restart: request lineage is minted from them,
+        and a reset would re-mint already-published lineage ids."""
+        with self._lock:
+            return {
+                "seqs": dict(self._seqs),
+                "queues": {
+                    cid: [
+                        {
+                            "text": r.text,
+                            "seq": r.seq,
+                            "lineage": r.lineage,
+                            "t_submit": r.t_submit,
+                        }
+                        for r in q
+                    ]
+                    for cid, q in self._queues.items()
+                    if q
+                },
+            }
+
+    def restore_state(self, state: Dict[str, Any]) -> int:
+        """Re-enqueue snapshotted requests and restore seq cursors
+        (max-merged — never move a cursor backwards).  Returns the
+        number of re-enqueued requests."""
+        n = 0
+        with self._lock:
+            for cid, seq in (state.get("seqs") or {}).items():
+                self._seqs[cid] = max(self._seqs.get(cid, 0), int(seq))
+            for cid, entries in (state.get("queues") or {}).items():
+                q = self._queues.setdefault(cid, deque())
+                for e in entries:
+                    q.append(
+                        ServingRequest(
+                            cid,
+                            e["text"],
+                            int(e["seq"]),
+                            e["lineage"],
+                            float(e.get("t_submit", 0.0)),
+                        )
+                    )
+                    n += 1
+                depth = len(q)
+                self._metrics.gauge(
+                    "serving_queue_depth", labels={"claim": cid}
+                ).set(depth)
+        return n
 
     # -- the batcher's side -------------------------------------------------
 

@@ -98,6 +98,14 @@ class ServingTier:
             clock=slo_clock if slo_clock is not None else self._clock,
         )
         self.steps = 0
+        #: End-of-step hooks (the recovery manager's snapshot cadence,
+        #: docs/RESILIENCE.md §durability): run AFTER completions are
+        #: counted and queues updated — the tier's only fully-quiesced
+        #: point, so a snapshot here can account every admitted request
+        #: as completed, queued, or (post-snapshot) deferred.  Same
+        #: contract as ``ClaimRouter.post_step_hooks``: registration
+        #: order, exceptions counted, never kill the loop.
+        self.post_step_hooks: List[Any] = []
         self._loop_thread: Optional[threading.Thread] = None
         self._loop_stop: Optional[threading.Event] = None
 
@@ -135,6 +143,15 @@ class ServingTier:
     def step(self) -> Dict[str, Any]:
         """One serving cycle; returns the step report (consumed request
         count, per-claim fabric outcome, completion latencies)."""
+        report = self._step_inner()
+        for hook in list(self.post_step_hooks):
+            try:
+                hook(report)
+            except Exception:  # noqa: BLE001 — a hook must not kill serving
+                self._metrics.counter("serving_hook_errors").add(1)
+        return report
+
+    def _step_inner(self) -> Dict[str, Any]:
         self.steps += 1
         report: Dict[str, Any] = {
             "step": self.steps,
@@ -277,6 +294,67 @@ class ServingTier:
                 ).add(1)
                 n += 1
         return n
+
+    # -- graceful drain (docs/RESILIENCE.md §drain) --------------------------
+
+    def drain(self, max_steps: int = 16) -> Dict[str, Any]:
+        """Stop admission and flush: new submissions shed with
+        ``reason="draining"`` (typed ``serving.shed`` events); up to
+        ``max_steps`` serving cycles run the already-admitted queues
+        through the fabric; whatever still cannot complete (a paused
+        claim, a failing fetch) is purged and journaled per-request as
+        ``serving.deferred{reason="draining"}`` — every admitted
+        request ends the drain either ANSWERED or DEFERRED, never
+        silently lost.  Idempotent; returns the accounting."""
+        self.frontend.set_draining(True)
+        deferred = 0
+
+        def defer(request) -> None:
+            nonlocal deferred
+            self._metrics.counter(
+                "serving_dropped", labels={"claim": request.claim}
+            ).add(1)
+            self._journal.emit(
+                "serving.deferred",
+                lineage=request.lineage,
+                claim=request.claim,
+                seq=request.seq,
+                reason="draining",
+            )
+            deferred += 1
+
+        # Paused claims first: the flush loop cannot serve them (the
+        # router skips paused claims), and letting the batcher drain
+        # their queues into a step would silently drop them instead of
+        # journaling the deferral.
+        for state in self.multi.registry.states():
+            if state.paused:
+                for request in self.frontend.purge(state.spec.claim_id):
+                    defer(request)
+        flushed_steps = 0
+        while flushed_steps < max_steps and any(
+            self.frontend.depths().values()
+        ):
+            self.step()
+            flushed_steps += 1
+        for cid in list(self.frontend.depths()):
+            for request in self.frontend.purge(cid):
+                defer(request)
+        return {
+            "flush_steps": flushed_steps,
+            "deferred": deferred,
+            "queues_empty": not any(self.frontend.depths().values()),
+        }
+
+    def serving_state_dict(self) -> Dict[str, Any]:
+        """The tier's durable slice (queued requests + seq cursors +
+        the step cursor) — embedded in the recovery manager's
+        snapshot."""
+        return {"steps": self.steps, **self.frontend.state_dict()}
+
+    def restore_serving_state(self, state: Dict[str, Any]) -> int:
+        self.steps = max(self.steps, int(state.get("steps", 0)))
+        return self.frontend.restore_state(state)
 
     # -- background loop (live deployments) ---------------------------------
 

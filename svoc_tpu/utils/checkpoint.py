@@ -3,7 +3,7 @@
 The reference has no model checkpoints; its durable state is the
 on-chain contract storage (rehydrated by ``resume``), the sqlite
 comment DB, and the deployment JSON files (SURVEY.md §5).  The TPU
-framework adds two things worth persisting:
+framework adds three things worth persisting:
 
 - **Training state** (:class:`svoc_tpu.train.trainer.TrainState`) —
   saved with orbax, which handles sharded arrays natively: each host
@@ -12,8 +12,18 @@ framework adds two things worth persisting:
   long-running local simulation survives restarts the way the chain
   does for the real deployment.  Exact wsad ints and vote state are
   plain Python data, saved as JSON next to the orbax directory.
+- **Service state** (docs/RESILIENCE.md §durability) — everything the
+  multi-claim fabric/serving stack holds in memory beyond the chain:
+  per-claim request windows and publish cursors, supervisor EMA health
+  + hysteresis streaks, breaker states, the PRNG key, and the claim
+  registry's membership.  :func:`multi_session_to_dict` /
+  :func:`restore_multi_session` are the snapshot half of the PR 8
+  recovery manager; a claim present in the snapshot but absent from
+  the restoring fabric is QUARANTINED into the snapshot's
+  ``unclaimed`` section — never silently dropped, never a crash.
 
-Both paths are exercised in ``tests/test_checkpoint.py``.
+All three paths are exercised in ``tests/test_checkpoint.py`` /
+``tests/test_durability.py``.
 """
 
 from __future__ import annotations
@@ -21,7 +31,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 from svoc_tpu.consensus.state import OracleConsensusContract
 from svoc_tpu.train.trainer import TrainState
@@ -211,3 +221,305 @@ def restore_simulation(path: str, session) -> None:
         else f"blk{scope}"
     )
     session.simulation_step = payload["simulation_step"]
+
+
+# ---------------------------------------------------------------------------
+# Service state (docs/RESILIENCE.md §durability)
+# ---------------------------------------------------------------------------
+
+
+def _addr_json(addr: Any) -> Any:
+    """Sim/real addresses are ints (the felt space); symbolic test
+    doubles degrade to repr — good enough for display, and a restore
+    keyed on them only has to match other reprs from the same dump."""
+    return addr if isinstance(addr, (int, str)) else repr(addr)
+
+
+def supervisor_state_to_dict(sup) -> Dict[str, Any]:
+    """Everything :class:`~svoc_tpu.resilience.supervisor.
+    FleetHealthSupervisor` folds across steps: EMA scores, hysteresis
+    streaks, the quarantine set, pending (un-folded) failures, the step
+    count, and the replacement history/backstop state."""
+    with sup._lock:
+        return {
+            "scores": [[_addr_json(a), s] for a, s in sup._scores.items()],
+            "streaks": [[_addr_json(a), n] for a, n in sup._streaks.items()],
+            "quarantined": [_addr_json(a) for a in sup._quarantined],
+            "pending_failures": [
+                [_addr_json(a), n] for a, n in sup._pending_failures.items()
+            ],
+            "steps": sup._steps,
+            "replace_disabled": sup._replace_disabled,
+            "replacements": [dict(r) for r in sup.replacements],
+        }
+
+
+def restore_supervisor_state(sup, d: Dict[str, Any]) -> None:
+    with sup._lock:
+        sup._scores = {a: float(s) for a, s in d.get("scores", [])}
+        sup._streaks = {a: int(n) for a, n in d.get("streaks", [])}
+        sup._quarantined = set(d.get("quarantined", []))
+        sup._pending_failures = {
+            a: int(n) for a, n in d.get("pending_failures", [])
+        }
+        sup._steps = int(d.get("steps", 0))
+        sup._replace_disabled = bool(d.get("replace_disabled", False))
+        sup.replacements = [dict(r) for r in d.get("replacements", [])]
+
+
+def breaker_state_to_dict(breaker) -> Dict[str, Any]:
+    with breaker._lock:
+        return {
+            "state": breaker._state,
+            "consecutive_failures": breaker._consecutive_failures,
+        }
+
+
+def restore_breaker_state(breaker, d: Dict[str, Any]) -> None:
+    """Rehydrate a breaker conservatively: a snapshot-OPEN breaker
+    restores OPEN with a FRESH reset window (the outage may have ended
+    while we were dead — half-open probes will find out in one
+    ``reset_timeout_s``); half-open collapses to open (the in-flight
+    probe died with the process).  Transitions go through the normal
+    path so the gauge/counter/journal story stays consistent."""
+    from svoc_tpu.resilience.breaker import BREAKER_CLOSED, BREAKER_OPEN
+
+    state = d.get("state", BREAKER_CLOSED)
+    with breaker._lock:
+        breaker._consecutive_failures = int(d.get("consecutive_failures", 0))
+        if state == BREAKER_CLOSED:
+            breaker._transition(BREAKER_CLOSED)
+        else:
+            breaker._opened_at = breaker._clock()
+            breaker._probes_in_flight = 0
+            breaker._transition(BREAKER_OPEN)
+    breaker._flush_events()
+
+
+def session_durable_dict(session) -> Dict[str, Any]:
+    """The full per-claim durable state: the :func:`save_simulation`
+    payload PLUS what PRs 6–7 added in memory — the rolling request
+    window, the block source, the lineage/publish cursors, the PRNG
+    key, and the supervisor/breaker state.  The contract is included
+    for self-contained checkpoints; crash recovery over a durable
+    chain log IGNORES it (the replayed chain is strictly newer —
+    :func:`restore_durable_session`)."""
+    import numpy as np
+
+    from svoc_tpu.io.chain import LocalChainBackend
+
+    backend = session.adapter.backend
+    inner = getattr(backend, "backend", None)
+    contract = None
+    if isinstance(backend, LocalChainBackend):
+        contract = contract_to_dict(backend.contract)
+    elif isinstance(inner, LocalChainBackend):
+        contract = contract_to_dict(inner.contract)
+    with session.lock:
+        window = session._request_window
+        key = session._key_value
+        payload = {
+            "version": _SCHEMA_VERSION,
+            "contract": contract,
+            "simulation_step": session.simulation_step,
+            "config": dataclasses.asdict(session.config),
+            "request_window": (
+                None if window is None else np.asarray(window).tolist()
+            ),
+            "block_source": session._block_source,
+            "last_lineage": session.last_lineage,
+            "fetch_claim": session._fetch_claim,
+            "fetch_published": session._fetch_published,
+            # The PRNG key as raw uint32 words: post-restore fleet
+            # draws CONTINUE the stream instead of replaying it from
+            # the seed (two restarts must not publish the same
+            # bootstrap noise twice).
+            "prng_key": (
+                None if key is None else np.asarray(key).tolist()
+            ),
+        }
+    payload["supervisor"] = supervisor_state_to_dict(session.supervisor)
+    payload["breaker"] = breaker_state_to_dict(session.breaker)
+    return payload
+
+
+def restore_durable_session(
+    payload: Dict[str, Any], session, adapter=None
+) -> None:
+    """Rehydrate ``session`` from :func:`session_durable_dict`.
+
+    ``adapter`` — when the caller already rebuilt the chain (a replayed
+    :mod:`svoc_tpu.durability.chainlog` tx log, or a real Sepolia
+    adapter), the snapshot's embedded contract is IGNORED: the chain
+    outlived us and is strictly newer than any snapshot.  Without it,
+    falls back to the embedded contract like :func:`restore_simulation`.
+    """
+    import jax.numpy as jnp
+
+    from svoc_tpu.apps.session import SessionConfig
+    from svoc_tpu.io.chain import ChainAdapter, LocalChainBackend
+    from svoc_tpu.resilience.retry import RetryPolicy
+    from svoc_tpu.resilience.supervisor import SupervisorConfig
+
+    cfg_dict = dict(payload["config"])
+    if isinstance(cfg_dict.get("commit_retry"), dict):
+        cfg_dict["commit_retry"] = RetryPolicy(**cfg_dict["commit_retry"])
+    if isinstance(cfg_dict.get("supervisor"), dict):
+        cfg_dict["supervisor"] = SupervisorConfig(**cfg_dict["supervisor"])
+    restored_config = SessionConfig(**cfg_dict)
+    if restored_config.dimension != session.config.dimension:
+        session._vectorizer = None
+    session.config = restored_config
+    if adapter is not None:
+        session.adapter = adapter
+    else:
+        if payload.get("contract") is None:
+            raise ValueError(
+                "snapshot has no embedded contract and no adapter was "
+                "provided — rebuild the chain first (replay_chain_log)"
+            )
+        session.adapter = ChainAdapter(
+            LocalChainBackend(contract_from_dict(payload["contract"]))
+        )
+    session.supervisor.adapter = session.adapter
+    session.supervisor.config = restored_config.supervisor
+    session.supervisor.claim = restored_config.claim
+    restore_supervisor_state(session.supervisor, payload.get("supervisor", {}))
+    restore_breaker_state(session.breaker, payload.get("breaker", {}))
+    scope = (
+        restored_config.lineage_scope
+        if restored_config.lineage_scope is not None
+        else session.lineage_prefix[len("blk"):].split("-", 1)[0]
+    )
+    session.lineage_prefix = (
+        f"blk{scope}-{restored_config.claim}"
+        if restored_config.claim
+        else f"blk{scope}"
+    )
+    window = payload.get("request_window")
+    key = payload.get("prng_key")
+    with session.lock:
+        import numpy as np
+
+        session.simulation_step = int(payload["simulation_step"])
+        session._request_window = (
+            None if window is None else np.asarray(window, dtype=np.float32)
+        )
+        session._block_source = payload.get("block_source", "store")
+        session.last_lineage = payload.get("last_lineage")
+        # Lineage continuity: the next fetch must mint claim N+1, or a
+        # restarted session would re-mint already-published lineage ids
+        # and merge two different blocks' audit records.
+        session._fetch_claim = int(payload.get("fetch_claim", 0))
+        session._fetch_published = int(payload.get("fetch_published", 0))
+        session._key_value = (
+            None
+            if key is None
+            else jnp.asarray(np.asarray(key, dtype=np.uint32))
+        )
+
+
+def multi_session_to_dict(multi) -> Dict[str, Any]:
+    """Snapshot a :class:`svoc_tpu.fabric.session.MultiSession`: the
+    claim registry's membership (specs) + every claim's durable session
+    state + the router's scheduling cursor.  ``tamper`` hooks are
+    scenario-local callables and are NOT serialized (a restored claim
+    is honest until its scenario re-arms it)."""
+    claims: Dict[str, Any] = {}
+    for state in multi.registry.states():
+        claims[state.spec.claim_id] = {
+            "spec": claim_spec_to_dict(state.spec),
+            "cycles": state.cycles,
+            "paused": state.paused,
+            "session": session_durable_dict(state.session),
+        }
+    return {
+        "version": _SCHEMA_VERSION,
+        "router_steps": multi.router.steps,
+        "claims": claims,
+        "unclaimed": {},
+    }
+
+
+def claim_spec_to_dict(spec) -> Dict[str, Any]:
+    d = dataclasses.asdict(spec)
+    d.pop("tamper", None)  # callables don't serialize; re-arm on restore
+    return d
+
+
+def claim_spec_from_dict(d: Dict[str, Any]):
+    from svoc_tpu.fabric.registry import ClaimSpec
+
+    return ClaimSpec(**d)
+
+
+def restore_multi_session(
+    payload: Dict[str, Any], multi, adapters: Optional[Dict[str, Any]] = None
+) -> Dict[str, Any]:
+    """Rehydrate ``multi``'s claims in place from
+    :func:`multi_session_to_dict`.
+
+    Membership may have CHANGED between snapshot and restore (a claim
+    added or removed by an operator, a different scenario roster): a
+    snapshot claim with no live counterpart is moved into the
+    snapshot's ``unclaimed`` section — quarantined, not dropped, so a
+    later restore (or a human) can still recover it — and a live claim
+    with no snapshot state is left fresh.  ``adapters`` maps claim id →
+    rebuilt chain adapter (:func:`restore_durable_session` semantics).
+    Returns ``{"restored": [...], "unclaimed": [...], "fresh": [...]}``.
+    """
+    adapters = adapters or {}
+    live = {s.spec.claim_id: s for s in multi.registry.states()}
+    restored: list = []
+    unclaimed = payload.setdefault("unclaimed", {})
+    # A previously-quarantined orphan whose claim is back in the live
+    # roster is reclaimed — the quarantine is a waiting room, not a
+    # grave.  When the snapshot ALSO carries fresher live state for
+    # the id, that state wins and the orphan STAYS quarantined (an
+    # eager pop here would silently drop it — the exact failure the
+    # section exists to prevent).
+    claims = payload.setdefault("claims", {})
+    for cid in [c for c in list(unclaimed) if c in live]:
+        if cid not in claims:
+            claims[cid] = unclaimed.pop(cid)
+    fresh = [cid for cid in live if cid not in payload.get("claims", {})]
+    for cid, entry in list(payload.get("claims", {}).items()):
+        state = live.get(cid)
+        if state is None:
+            # Orphan: quarantine into the snapshot itself.  Never raise
+            # — the rest of the fabric must come back up.
+            unclaimed[cid] = entry
+            continue
+        restore_durable_session(
+            entry["session"], state.session, adapter=adapters.get(cid)
+        )
+        state.cycles = int(entry.get("cycles", 0))
+        state.paused = bool(entry.get("paused", False))
+        restored.append(cid)
+    multi.router.steps = int(payload.get("router_steps", 0))
+    return {
+        "restored": sorted(restored),
+        "unclaimed": sorted(unclaimed),
+        "fresh": sorted(fresh),
+    }
+
+
+def save_snapshot(path: str, payload: Dict[str, Any]) -> None:
+    """Atomic (tmp + rename + fsync file AND directory) JSON write —
+    a snapshot either exists whole or not at all, and the rename is
+    durable before we return (the recovery manager may rotate the WAL
+    immediately after, trusting the snapshot exists)."""
+    from svoc_tpu.utils.events import _json_safe, fsync_dir
+
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(_json_safe(payload), f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(path)
+
+
+def load_snapshot(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)

@@ -88,6 +88,10 @@ EVENT_TYPES: Tuple[str, ...] = (
     "serving.admitted",
     "serving.shed",
     "serving.step",
+    "serving.deferred",
+    "durability.snapshot",
+    "durability.reconcile",
+    "durability.drain",
 )
 
 #: Types (plus breaker.transition→open) surfaced as "alerts" in journal
@@ -96,6 +100,24 @@ ALERT_TYPES = frozenset(
     {"slo.alert", "pipeline.producer_error", "trace.write_error",
      "commit.failed", "postmortem.bundle"}
 )
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the DIRECTORY containing ``path`` — renames and creates
+    are metadata, and until the directory entry is durable a crash can
+    resurrect the pre-rename layout.  Shared by the rotating trace
+    writer, the commit-intent WAL, and the snapshot writer; failures
+    are swallowed (platforms without directory fds)."""
+    dirname = os.path.dirname(os.path.abspath(path))
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        with contextlib.suppress(OSError):
+            os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _json_safe(value: Any) -> Any:
@@ -167,6 +189,14 @@ class RotatingJsonlWriter:
 
     MAX_BYTES_ENV = "SVOC_TRACE_MAX_BYTES"
     KEEP_ENV = "SVOC_TRACE_KEEP"
+    #: Opt-in crash durability (docs/OBSERVABILITY.md §tracing): "1"
+    #: fsyncs the file after EVERY written line (and the directory on
+    #: rotation), so the journal tail the recovery manager replays
+    #: after a SIGKILL is complete up to the last emit.  Costs one
+    #: fdatasync per event (~50 µs–2 ms depending on the disk) — leave
+    #: it off for pure-observability traces, turn it on when the trace
+    #: is a durability artifact (docs/RESILIENCE.md §durability).
+    FSYNC_ENV = "SVOC_TRACE_FSYNC"
     DEFAULT_MAX_BYTES = 64 * 1024 * 1024
     DEFAULT_KEEP = 3
 
@@ -176,6 +206,7 @@ class RotatingJsonlWriter:
         max_bytes: Optional[int] = None,
         keep: Optional[int] = None,
         registry: Optional[MetricsRegistry] = None,
+        fsync: Optional[bool] = None,
     ):
         self.path = path
         if max_bytes is None:
@@ -184,12 +215,15 @@ class RotatingJsonlWriter:
             )
         if keep is None:
             keep = int(os.environ.get(self.KEEP_ENV, self.DEFAULT_KEEP))
+        if fsync is None:
+            fsync = os.environ.get(self.FSYNC_ENV, "") == "1"
         if max_bytes < 1:
             raise ValueError("max_bytes must be >= 1")
         if keep < 0:
             raise ValueError("keep must be >= 0")
         self.max_bytes = max_bytes
         self.keep = keep
+        self.fsync = bool(fsync)
         self._registry = registry or _default_registry
         self._lock = threading.Lock()
         self._file = None
@@ -225,6 +259,10 @@ class RotatingJsonlWriter:
                         os.replace(src, f"{self.path}.{i + 1}")
             with contextlib.suppress(OSError):
                 os.replace(self.path, f"{self.path}.1")
+        if self.fsync:
+            # The renames above are metadata: a torn segment chain
+            # would break the recovery manager's walk.
+            fsync_dir(self.path)
         self._size = 0
 
     def write_line(self, line: str) -> None:
@@ -243,6 +281,13 @@ class RotatingJsonlWriter:
                 self._rotate_locked()
                 self._open_locked()
             self._file.write(text)
+            if self.fsync:
+                # Line-buffered write already reached the OS; fsync
+                # pushes it to the platter so a SIGKILL one instruction
+                # later cannot lose it (the recovery manager's replay
+                # contract, docs/RESILIENCE.md §durability).
+                with contextlib.suppress(OSError):
+                    os.fsync(self._file.fileno())
             self._size += nbytes
             self._gauge.set(self._size)
 
@@ -472,6 +517,44 @@ class EventJournal:
         with self._lock:
             self._ring.clear()
 
+    # -- snapshot / recovery (docs/RESILIENCE.md §durability) ---------------
+
+    def export_ring(self) -> List[Dict[str, Any]]:
+        """The full buffered ring as JSON-safe dicts (``ts`` included —
+        operators want wall time back after a restore; fingerprints
+        still ignore it).  What the recovery manager's snapshot
+        embeds."""
+        with self._lock:
+            return [e.as_dict() for e in self._ring]
+
+    def restore(self, records: Iterable[Mapping[str, Any]]) -> int:
+        """Rebuild the ring from :meth:`export_ring`-shaped dicts (a
+        snapshot's journal section, optionally extended with the
+        fsynced trace tail — :func:`read_trace_events`), PRESERVING the
+        original seqs so fingerprints and audit records survive a
+        process death.  Records are deduped by seq and sorted; the next
+        ``emit`` continues numbering after the highest restored seq.
+        Deliberately does NOT run subscribers — a restore replays
+        history, it does not re-trigger postmortems.  Returns the
+        number of restored events."""
+        by_seq: Dict[int, EventRecord] = {}
+        for r in records:
+            rec = EventRecord(
+                seq=int(r["seq"]),
+                ts=float(r.get("ts", 0.0) or 0.0),
+                type=str(r["event"]),
+                lineage=r.get("lineage"),
+                data=dict(r.get("data") or {}),
+            )
+            by_seq[rec.seq] = rec
+        ordered = [by_seq[s] for s in sorted(by_seq)]
+        with self._lock:
+            self._ring.clear()
+            self._ring.extend(ordered)
+            last = ordered[-1].seq if ordered else 0
+            self._seq = itertools.count(last + 1)
+        return len(ordered)
+
     # -- replay identity ----------------------------------------------------
 
     def fingerprint(self, lineage_prefix: Optional[str] = None) -> str:
@@ -520,6 +603,58 @@ class EventJournal:
             "alerts": alerts[-last_alerts:],
             "fingerprint": self.fingerprint(),
         }
+
+
+def read_trace_events(
+    path: str, since_seq: int = 0, keep: Optional[int] = None
+) -> List[Dict[str, Any]]:
+    """Read journal events back out of a (possibly rotated) trace file
+    — the recovery manager's roll-forward source (docs/RESILIENCE.md
+    §durability).  Walks the rotated segments oldest→newest, keeps only
+    EVENT lines (keyed ``event`` — the file is shared with span lines
+    keyed ``name``), drops seqs ≤ ``since_seq``, and tolerates a torn
+    final line (a SIGKILL mid-append leaves half a record; everything
+    before it was fsynced when ``SVOC_TRACE_FSYNC=1``).  Mid-file
+    garbage raises — that is corruption, not a crash artifact."""
+    if keep is None:
+        keep = int(
+            os.environ.get(
+                RotatingJsonlWriter.KEEP_ENV, RotatingJsonlWriter.DEFAULT_KEEP
+            )
+        )
+    segments = [
+        f"{path}.{i}" for i in range(keep, 0, -1) if os.path.exists(f"{path}.{i}")
+    ]
+    if os.path.exists(path):
+        segments.append(path)
+    out: List[Dict[str, Any]] = []
+    for seg_idx, seg in enumerate(segments):
+        with open(seg, "r") as f:
+            lines = f.read().split("\n")
+        # A trailing "" element means the file ends in a newline — the
+        # normal case; anything else is a torn tail.
+        torn = lines and lines[-1] != ""
+        body, tail = (lines[:-1], lines[-1]) if lines else ([], "")
+        for line in body:
+            if not line:
+                continue
+            record = json.loads(line)
+            if "event" in record and int(record.get("seq", 0)) > since_seq:
+                out.append(record)
+        if torn and tail:
+            is_last = seg_idx == len(segments) - 1
+            try:
+                record = json.loads(tail)
+            except ValueError:
+                if not is_last:
+                    raise ValueError(
+                        f"corrupt trace segment {seg!r}: torn line in a "
+                        "non-final segment"
+                    )
+                continue  # the crash artifact: ignore the torn append
+            if "event" in record and int(record.get("seq", 0)) > since_seq:
+                out.append(record)
+    return out
 
 
 #: Process-wide default journal (the apps layer, soak, and bench use

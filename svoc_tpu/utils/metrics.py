@@ -606,6 +606,35 @@ class MetricsRegistry:
                 out.append((dict(labels), c.count))
         return out
 
+    def counters_snapshot(self) -> List[Dict[str, Any]]:
+        """Every counter series as ``{name, labels, count}`` — the
+        recovery snapshot's SLO-continuity section (docs/RESILIENCE.md
+        §durability): burn-rate evaluators difference CUMULATIVE
+        counters, so a restart that zeroed them would read an error
+        burst as recovery (goods jump from 0) or vice versa."""
+        with self._lock:
+            items = list(self.counters.items())
+            labels = dict(self._labels)
+        out: List[Dict[str, Any]] = []
+        for key, c in items:
+            name, lbl = labels.get(key, (key, {}))
+            out.append({"name": name, "labels": dict(lbl), "count": c.count})
+        return out
+
+    def restore_counters(self, entries: List[Dict[str, Any]]) -> int:
+        """Re-seed counter series from :meth:`counters_snapshot` (adds
+        onto current values — callers restore into a FRESH registry).
+        Returns the number of restored series."""
+        n = 0
+        for e in entries:
+            value = float(e.get("count", 0.0))
+            if value:
+                self.counter(e["name"], labels=e.get("labels") or None).add(
+                    value
+                )
+                n += 1
+        return n
+
     def stage_snapshot(self) -> Dict[str, Dict[str, float]]:
         """``{stage: {count, sum, p50, p95, p99, ...}}`` for every stage
         observed so far — the block BENCH/SOAK artifacts embed."""

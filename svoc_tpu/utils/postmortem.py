@@ -57,6 +57,63 @@ BUNDLE_KEYS = (
     "env",
 )
 
+class SignalChain:
+    """Install one callback on a set of signals, CHAINING whatever was
+    there before — the one implementation of the prev-handler dance
+    shared by :class:`PostmortemMonitor` and
+    :class:`svoc_tpu.durability.recovery.GracefulDrain`:
+
+    - a callable previous handler runs after the callback;
+    - ``SIG_IGN`` stays ignored (the callback runs, but an ignored
+      signal is never converted into process death);
+    - the default disposition is restored and the signal re-delivered
+      otherwise, so the process still dies with the conventional exit
+      status.
+
+    Install failures (non-main thread, unsupported platform) are
+    skipped silently — hooks are best-effort by design.
+    """
+
+    def __init__(self, callback: Callable[[int, Any], None]):
+        self._callback = callback
+        self._prev: Dict[int, Any] = {}
+
+    def install(self, signals) -> None:
+        import signal as _signal
+
+        for sig in signals:
+            try:
+                prev = _signal.signal(sig, self._on_signal)
+            except (ValueError, OSError):
+                continue
+            self._prev[sig] = prev
+
+    def uninstall(self) -> None:
+        import signal as _signal
+
+        for sig, prev in self._prev.items():
+            try:
+                _signal.signal(
+                    sig, prev if prev is not None else _signal.SIG_DFL
+                )
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+
+    def _on_signal(self, signum, frame) -> None:
+        import signal as _signal
+
+        self._callback(signum, frame)
+        prev = self._prev.get(signum)
+        if prev is _signal.SIG_IGN:
+            return
+        if callable(prev):
+            prev(signum, frame)
+        else:
+            _signal.signal(signum, _signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+
 _bundle_counter = iter(range(1, 10**9))
 _bundle_counter_lock = threading.Lock()
 
@@ -216,6 +273,11 @@ class PostmortemMonitor:
         self._last_built: Optional[float] = None
         #: Paths of every bundle this monitor built (soak artifacts).
         self.bundles: List[str] = []
+        self._shutdown_done = False
+        self._signal_chain = SignalChain(
+            lambda signum, _frame: self.shutdown(f"signal_{signum}")
+        )
+        self._atexit_registered = False
 
     def install(self) -> "PostmortemMonitor":
         self._journal.subscribe(self._on_event)
@@ -223,6 +285,66 @@ class PostmortemMonitor:
 
     def uninstall(self) -> None:
         self._journal.unsubscribe(self._on_event)
+
+    # -- orderly-shutdown bundles (docs/RESILIENCE.md §drain) ---------------
+
+    def install_shutdown_hooks(self, signals=None) -> "PostmortemMonitor":
+        """Register SIGTERM + atexit hooks so an ORDERLY shutdown (and
+        the parent of an OOM-killed child, whose own atexit still runs)
+        always leaves a final bundle.  The bundle is classified
+        ``shutdown`` — not ``crash`` — and is EXEMPT from the 60 s rate
+        limit and the lifetime cap: a dying process gets its last word
+        even mid-incident-storm.  Chained via :class:`SignalChain`: a
+        previously-installed handler still runs after the bundle is
+        written, and an ignored signal stays ignored."""
+        import atexit
+        import signal as _signal
+
+        if signals is None:
+            signals = (_signal.SIGTERM,)
+        self._signal_chain.install(signals)
+        if not self._atexit_registered:
+            atexit.register(self.shutdown, "atexit")
+            self._atexit_registered = True
+        return self
+
+    def uninstall_shutdown_hooks(self) -> None:
+        self._signal_chain.uninstall()
+
+    def shutdown(self, reason: str = "shutdown") -> Optional[str]:
+        """Build the final bundle, once (later calls — e.g. atexit
+        after a SIGTERM already bundled — are no-ops)."""
+        with self._lock:
+            if self._shutdown_done:
+                return None
+            self._shutdown_done = True
+        try:
+            path = build_bundle(
+                out_dir=self.out_dir,
+                trigger="shutdown",
+                trigger_event={"reason": reason},
+                session=self._session,
+                registry=self._registry,
+                tracer=self._tracer,
+                journal=self._journal,
+                slo=self._slo,
+            )
+        except Exception:
+            # A failing teardown bundle must never turn a clean
+            # shutdown into a crash.
+            (self._registry or _default_registry).counter(
+                "postmortem_errors"
+            ).add(1)
+            return None
+        with self._lock:
+            self.bundles.append(path)
+        (self._registry or _default_registry).counter(
+            "postmortem_bundles", labels={"trigger": "shutdown"}
+        ).add(1)
+        self._journal.emit(
+            "postmortem.bundle", trigger="shutdown", reason=reason, path=path
+        )
+        return path
 
     def classify(self, record: EventRecord) -> Optional[str]:
         """The trigger name for an incident-class event, else None."""

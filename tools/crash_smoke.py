@@ -1,0 +1,230 @@
+"""Kill/restart chaos gate: crash-consistent durability as CI
+(``make crash-smoke``; docs/RESILIENCE.md §durability).
+
+For each seeded fault point — ``mid_wal_append`` (a commit-intent
+record torn in half mid-fsync), ``inter_tx`` (SIGKILL between tx *i*
+landing on the chain log and its WAL ``landed`` record), and
+``pre_snapshot`` (SIGKILL after a serving step's commits, before its
+cadence snapshot) — the harness:
+
+1. runs the seeded serving scenario
+   (:func:`svoc_tpu.durability.scenario.run_durable_scenario`) in a
+   SUBPROCESS that SIGKILLs itself at the fault point (asserted: the
+   child died by SIGKILL, not cleanly);
+2. re-runs the same scenario in the same work directory: the child
+   auto-detects the durable state and recovers (snapshot restore →
+   fingerprint-checked journal ring → trace-tail replay → WAL
+   reconcile → resume serving → graceful drain);
+3. asserts over the recovered child's result:
+   **zero duplicate txs** in any chain log, **zero unknown and zero
+   unaccounted WAL slots** (the backend is reachable — every intent
+   classifies landed or stranded-resent), **zero unaccounted admitted
+   requests**, **zero open WAL cycles** after the drain.
+
+The FULL matrix runs twice; the recovered per-claim journal
+fingerprints must be byte-identical across the two matrix runs — the
+recovery path itself is part of the replay witness.
+
+Usage::
+
+    python tools/crash_smoke.py [--seed 0] [--out CRASH_SMOKE.json]
+    python tools/crash_smoke.py --child <workdir> [--crash-point P]
+"""
+
+from __future__ import annotations
+
+import os
+
+# Off-TPU by construction (the axon sitecustomize pins the platform —
+# tools/soak.py measurement postmortem).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+import signal  # noqa: E402
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+import tempfile  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TOTAL_STEPS = 8
+
+
+def child_main(args) -> int:
+    from svoc_tpu.durability.scenario import run_durable_scenario
+
+    result = run_durable_scenario(
+        args.child,
+        seed=args.seed,
+        total_steps=TOTAL_STEPS,
+        crash_point=args.crash_point,
+    )
+    # Only the non-crashing (recovery / clean) phase reaches here.
+    with open(os.path.join(args.child, "result.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    return 0
+
+
+def spawn_child(workdir: str, seed: int, crash_point=None) -> subprocess.Popen:
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--child", workdir, "--seed", str(seed),
+    ]
+    if crash_point is not None:
+        cmd += ["--crash-point", crash_point]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env,
+    )
+
+
+def run_matrix(seed: int, crash_points, base_dir: str) -> dict:
+    """One full kill/restart matrix.  The fault points use disjoint
+    work directories, so the crash children run as one parallel wave
+    and the recovery children as a second — each child still pays the
+    full cold-process jax import (that isolation IS the experiment),
+    but the waves overlap it."""
+    out = {
+        point: {"crash_point": point, "killed": None, "result": None,
+                "notes": []}
+        for point in crash_points
+    }
+    for point in crash_points:
+        os.makedirs(os.path.join(base_dir, point), exist_ok=True)
+    crash_procs = {
+        point: spawn_child(
+            os.path.join(base_dir, point), seed, crash_point=point
+        )
+        for point in crash_points
+    }
+    for point, proc in crash_procs.items():
+        _stdout, stderr = proc.communicate()
+        out[point]["killed"] = proc.returncode == -signal.SIGKILL
+        if not out[point]["killed"]:
+            out[point]["notes"].append(
+                f"child exited {proc.returncode}, expected SIGKILL; "
+                f"stderr tail: {stderr[-500:]}"
+            )
+    recover_procs = {
+        point: spawn_child(os.path.join(base_dir, point), seed)
+        for point in crash_points
+    }
+    for point, proc in recover_procs.items():
+        _stdout, stderr = proc.communicate()
+        if proc.returncode != 0:
+            out[point]["notes"].append(
+                f"recovery child exited {proc.returncode}; "
+                f"stderr tail: {stderr[-500:]}"
+            )
+        else:
+            with open(os.path.join(base_dir, point, "result.json")) as f:
+                out[point]["result"] = json.load(f)
+    return out
+
+
+def check_matrix(matrix: dict) -> dict:
+    checks = {}
+    for point, entry in matrix.items():
+        r = entry["result"]
+        ok = (
+            entry["killed"]
+            and r is not None
+            and r["recovered"]
+            and r["duplicate_txs"] == 0
+            and all(c["duplicates"] == 0 for c in r["chain"].values())
+            and not r["wal_open_cycles"]
+            and r["requests"]["unaccounted"] == 0
+            and r["steps"] == TOTAL_STEPS
+        )
+        rec = (r or {}).get("recovery") or {}
+        reconcile = rec.get("reconcile") or {}
+        checks[point] = {
+            "killed_by_sigkill": bool(entry["killed"]),
+            "recovered": bool(r and r["recovered"]),
+            "zero_duplicate_txs": bool(r and r["duplicate_txs"] == 0),
+            "zero_open_wal_cycles": bool(r and not r["wal_open_cycles"]),
+            "zero_unknown_slots": reconcile.get("unknown", 0) == 0,
+            "zero_unaccounted_slots": reconcile.get("unaccounted", 0) == 0,
+            "zero_unaccounted_requests": bool(
+                r and r["requests"]["unaccounted"] == 0
+            ),
+            "ran_to_completion": bool(r and r["steps"] == TOTAL_STEPS),
+            "ok": ok,
+            "notes": entry["notes"],
+        }
+    return checks
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="CRASH_SMOKE.json")
+    p.add_argument("--child", default=None, help="(internal) scenario workdir")
+    p.add_argument(
+        "--crash-point", default=None,
+        choices=["mid_wal_append", "inter_tx", "pre_snapshot"],
+    )
+    args = p.parse_args(argv)
+    if args.child is not None:
+        return child_main(args)
+
+    from svoc_tpu.durability.scenario import CRASH_POINTS
+
+    base = tempfile.mkdtemp(prefix="crash-smoke-")
+    first = run_matrix(args.seed, CRASH_POINTS, os.path.join(base, "run1"))
+    second = run_matrix(args.seed, CRASH_POINTS, os.path.join(base, "run2"))
+    checks = check_matrix(first)
+
+    fingerprints = {}
+    for point in CRASH_POINTS:
+        r1 = first[point]["result"] or {}
+        r2 = second[point]["result"] or {}
+        c1 = {c: v["fingerprint"] for c, v in (r1.get("claims") or {}).items()}
+        c2 = {c: v["fingerprint"] for c, v in (r2.get("claims") or {}).items()}
+        fingerprints[point] = {
+            "identical": bool(c1) and c1 == c2,
+            "claims": c1,
+        }
+    all_checks = {
+        f"{point}.{name}": value
+        for point, ch in checks.items()
+        for name, value in ch.items()
+        if name not in ("ok", "notes")
+    }
+    all_checks["recovered_fingerprints_identical_across_matrix_runs"] = all(
+        f["identical"] for f in fingerprints.values()
+    )
+    ok = all(all_checks.values())
+    artifact = {
+        "seed": args.seed,
+        "total_steps": TOTAL_STEPS,
+        "crash_points": list(CRASH_POINTS),
+        "checks": checks,
+        "fingerprints": fingerprints,
+        "ok": ok,
+        "matrix": {
+            point: {
+                "killed": first[point]["killed"],
+                "notes": first[point]["notes"],
+                "result": first[point]["result"],
+            }
+            for point in CRASH_POINTS
+        },
+    }
+    with open(args.out + ".tmp", "w") as f:
+        json.dump(artifact, f, indent=1)
+    os.replace(args.out + ".tmp", args.out)
+    for name, passed in sorted(all_checks.items()):
+        print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+    print(
+        f"crash-smoke {'OK' if ok else 'FAILED'}: "
+        f"{len(CRASH_POINTS)} kill points x 2 matrix runs, "
+        f"0 duplicate txs asserted over the chain logs -> {args.out}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
