@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke fabric-smoke serving-smoke crash-smoke clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke fabric-smoke serving-smoke crash-smoke pallas-parity clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -98,6 +98,17 @@ fabric-smoke:
 serving-smoke:
 	$(PY) tools/serving_smoke.py
 
+# Pallas consensus parity gate (docs/PARALLELISM.md §pallas-consensus):
+# CPU interpret-mode parity of the fused single-claim and gated
+# claim-cube kernels vs the XLA parity oracles (both configs,
+# degenerate/quarantined/padded claims, Cairo tie order), plus the
+# fallback-counter and typed env-knob smoke.  < 60 s, no transformer
+# builds; SVOC_PALLAS_INTERPRET=1 exercises the dispatch layer's
+# interpret opt-in path.
+pallas-parity:
+	JAX_PLATFORMS=cpu SVOC_PALLAS_INTERPRET=1 \
+	$(PY) -m pytest tests/test_pallas_consensus.py -q -m 'not slow'
+
 # Crash-consistency gate (docs/RESILIENCE.md §durability): the seeded
 # serving scenario SIGKILLed at 3 fault points (mid-WAL-append,
 # between tx i and i+1, post-commit pre-snapshot) in subprocesses,
@@ -113,7 +124,7 @@ crash-smoke:
 # convergence gates (I/O-plane, then data-plane), then the flight
 # recorder, then the fabric and serving tiers, then crash consistency,
 # then the suite.
-verify: lint chaos-smoke robustness-smoke obs-smoke fabric-smoke serving-smoke crash-smoke test
+verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke fabric-smoke serving-smoke crash-smoke test
 
 # End-of-round gate: lint + the driver-contract guards FIRST (fast,
 # loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
@@ -121,6 +132,7 @@ verify: lint chaos-smoke robustness-smoke obs-smoke fabric-smoke serving-smoke c
 # Run before EVERY snapshot.
 presnapshot:
 	$(MAKE) lint
+	$(MAKE) pallas-parity
 	$(MAKE) chaos-smoke
 	$(MAKE) robustness-smoke
 	$(MAKE) obs-smoke

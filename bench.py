@@ -139,12 +139,17 @@ def perf_decision(key: str, default: str, env_var: str) -> tuple:
 
 
 def resolve_consensus_impl() -> str:
-    """The consensus-impl routing shared by the dense and packed
-    flagship bodies: PERF_DECISIONS / SVOC_CONSENSUS_IMPL, validated."""
-    impl, _ = perf_decision("consensus_impl", "xla", "SVOC_CONSENSUS_IMPL")
-    if impl not in ("xla", "pallas"):
-        raise ValueError(f"SVOC_CONSENSUS_IMPL={impl!r} not in xla|pallas")
-    return impl
+    """The consensus-impl routing shared by the flagship bodies and the
+    claim-cube sweep: ONE resolver — the library's
+    (`svoc_tpu.consensus.dispatch`), lazy-imported because every caller
+    has already pinned the platform (bench's module level must stay
+    import-light for the pre-jax campaign_replay path), pointed at this
+    module's (monkeypatchable) record path.  Rejections name the
+    allowed values and the deciding env var identically here and in the
+    serving path."""
+    from svoc_tpu.consensus.dispatch import resolve_consensus_impl as _resolve
+
+    return _resolve(path=PERF_DECISIONS_PATH)
 
 
 # --------------------------------------------------------------------------
@@ -1455,8 +1460,12 @@ def bench_config6(seconds: float, small: bool, platform: str) -> dict:
     )
 
     # Pallas half, hang-contained.  Generous cap: CPU interpret mode is
-    # slow but finishes; a Mosaic hang runs forever.
-    pallas_timeout_s = float(os.environ.get("SVOC_PALLAS_TIMEOUT", "300"))
+    # slow but finishes; a Mosaic hang runs forever.  Typed validation:
+    # a malformed SVOC_PALLAS_TIMEOUT raises PallasConfigError with the
+    # var name + expected form, caught by main's parseable error line.
+    from svoc_tpu.consensus.dispatch import env_float
+
+    pallas_timeout_s = env_float("SVOC_PALLAS_TIMEOUT", 300.0, minimum=1e-3)
     pallas = {}
     pallas_hung = False
     try:
@@ -2358,7 +2367,198 @@ CONFIGS = {
 }
 
 
-def bench_claims(n_claims: int, seconds: float, platform: str) -> dict:
+CLAIMS_AB_SNIPPET = """
+import json, os, sys, time
+import numpy as np
+import jax
+
+# Mirror the parent's resolved platform BEFORE the first backend touch
+# (see PALLAS_HALF_SNIPPET: the axon sitecustomize pins jax at the TPU,
+# so on a CPU fallback a bare child would hang reaching a dead tunnel).
+if os.environ.get("SVOC_PALLAS_PLATFORM") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step_gated_claims
+from svoc_tpu.ops.pallas_consensus import fused_consensus_gated_claims
+
+n_claims, n_oracles, dim, n_reps = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+)
+cfg = ConsensusConfig(n_failing=max(2, n_oracles // 4), constrained=True)
+rng = np.random.default_rng(0)
+values = jnp.asarray(
+    rng.uniform(0.01, 0.99, size=(n_claims, n_oracles, dim)).astype(np.float32)
+)
+ok = np.ones((n_claims, n_oracles), dtype=bool)
+ok[:: max(1, n_claims // 8), -1] = False  # same gated work as the parent sweep
+ok = jnp.asarray(ok)
+claim_mask = jnp.asarray(np.ones(n_claims, dtype=bool))
+interpret = jax.default_backend() != "tpu"
+if interpret:
+    # Interpret mode is a parity/status run, not a measurement: a
+    # couple of dispatches bound the child's wall clock.
+    n_reps = min(n_reps, 3)
+t0 = time.perf_counter()
+out = fused_consensus_gated_claims(values, ok, claim_mask, cfg, interpret=interpret)
+np.asarray(out.essence)  # host fetch proves compile + execution
+compile_s = time.perf_counter() - t0
+print(json.dumps({"stage": "compiled", "compile_s": round(compile_s, 2)}),
+      flush=True)
+# Warm the perturbed dispatch pattern (the eager add compiles on first
+# use), then amortize n_reps dispatches, fetch last.
+np.asarray(
+    fused_consensus_gated_claims(
+        values + 1e-6, ok, claim_mask, cfg, interpret=interpret
+    ).essence
+)
+h = None
+t1 = time.perf_counter()
+for i in range(n_reps):
+    h = fused_consensus_gated_claims(
+        values + 1e-6 * (i + 1), ok, claim_mask, cfg, interpret=interpret
+    )
+np.asarray(h.essence)
+exec_ms = (time.perf_counter() - t1) / n_reps * 1e3
+ref = jax.jit(consensus_step_gated_claims, static_argnames=("cfg",))(
+    values, ok, claim_mask, cfg
+)
+match = bool(np.allclose(np.asarray(out.essence), np.asarray(ref.essence),
+                         atol=5e-5))
+print(json.dumps({
+    "compile_s": round(compile_s, 2),
+    "exec_ms": round(exec_ms, 3),
+    "essence_match_xla": match,
+    "mode": "interpret" if interpret else "compiled",
+    "n_reps": n_reps,
+}), flush=True)
+"""
+
+
+def claims_pallas_ab(
+    n_claims: int, n_oracles: int, dim: int, platform: str
+) -> dict:
+    """Pallas-vs-XLA A/B at the claim-cube shape, pallas half in a
+    SUBPROCESS under the shared hard timeout — a Mosaic hang is
+    recorded as the measurement outcome (``pallas_hung``), never a
+    wedged bench (the config-6 containment, reused).  On a non-TPU
+    backend the child runs interpreter mode and says so: the record
+    carries ``mode: "interpret"`` and NO speedup claim — an interpreted
+    timing is parity evidence, not a routing decision
+    (tools/decide_perf.py only believes ``detail.backend == "tpu"``
+    anyway)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from svoc_tpu.consensus.dispatch import env_float
+    from svoc_tpu.consensus.kernel import (
+        ConsensusConfig,
+        consensus_step_gated_claims,
+    )
+    from svoc_tpu.ops.pallas_consensus import (
+        PALLAS_MAX_ORACLES,
+        fused_fallback_reason,
+    )
+
+    cfg = ConsensusConfig(n_failing=max(2, n_oracles // 4), constrained=True)
+    rng = np.random.default_rng(0)
+    values = jnp.asarray(
+        rng.uniform(0.01, 0.99, size=(n_claims, n_oracles, dim)).astype(
+            np.float32
+        )
+    )
+    ok = np.ones((n_claims, n_oracles), dtype=bool)
+    ok[:: max(1, n_claims // 8), -1] = False
+    ok = jnp.asarray(ok)
+    claim_mask = jnp.asarray(np.ones(n_claims, dtype=bool))
+
+    # XLA half in-process (it is the production default and cannot
+    # hang): amortized exec over perturbed dispatches, fetch-last.
+    xla = jax.jit(consensus_step_gated_claims, static_argnames=("cfg",))
+    np.asarray(xla(values, ok, claim_mask, cfg).essence)  # compile
+    np.asarray(xla(values + 1e-6, ok, claim_mask, cfg).essence)  # warm pattern
+    reps = amortize_reps(platform)
+    h = None
+    t0 = time.perf_counter()
+    for i in range(reps):
+        h = xla(values + 1e-6 * (i + 1), ok, claim_mask, cfg)
+    np.asarray(h.essence)
+    xla_exec_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    pallas_timeout_s = env_float("SVOC_PALLAS_TIMEOUT", 300.0, minimum=1e-3)
+    pallas = {}
+    pallas_hung = False
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                CLAIMS_AB_SNIPPET,
+                str(n_claims),
+                str(n_oracles),
+                str(dim),
+                str(reps),
+            ],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=pallas_timeout_s,
+            env={**os.environ, "SVOC_PALLAS_PLATFORM": platform},
+        )
+        for line in reversed(proc.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                try:
+                    pallas = json.loads(line)
+                except json.JSONDecodeError:
+                    pallas = {"error": "truncated output (child killed?)"}
+                break
+        if proc.returncode != 0 and "exec_ms" not in pallas:
+            pallas = {
+                "error": (proc.stderr or "").strip().splitlines()[-3:],
+                "rc": proc.returncode,
+            }
+    except subprocess.TimeoutExpired as e:
+        pallas_hung = True
+        stdout = e.stdout or b""
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        pallas = {
+            "hung_after_s": pallas_timeout_s,
+            "hang_stage": "execution" if '"compiled"' in stdout else "compile",
+        }
+
+    pallas_exec_ms = pallas.get("exec_ms")
+    compiled = pallas.get("mode") == "compiled"
+    return {
+        "n_claims": n_claims,
+        "n_oracles": n_oracles,
+        "dimension": dim,
+        "xla_exec_ms": round(xla_exec_ms, 3),
+        "pallas_exec_ms": round(pallas_exec_ms, 3) if pallas_exec_ms else None,
+        # A speedup is only claimed from a COMPILED pallas half — an
+        # interpret-mode number is parity/status evidence, never a
+        # fake (de)speedup that could leak into a routing argument.
+        "pallas_vs_xla_speedup": (
+            round(xla_exec_ms / pallas_exec_ms, 3)
+            if pallas_exec_ms and compiled
+            else None
+        ),
+        "pallas_mode": pallas.get("mode"),
+        "pallas_hung": pallas_hung,
+        "pallas_info": pallas,
+        "pallas_kernel_active": (
+            n_oracles <= PALLAS_MAX_ORACLES
+            and fused_fallback_reason(n_oracles, cfg) is None
+        ),
+        "timeout_s": pallas_timeout_s,
+    }
+
+
+def bench_claims(
+    n_claims: int, seconds: float, platform: str, n_oracles: int = 7
+) -> dict:
     """Claim-cube consensus sweep (docs/FABRIC.md): ONE batched gated
     dispatch over the padded ``[C, N, M]`` cube
     (:func:`svoc_tpu.consensus.batch.claims_consensus_gated`) vs the
@@ -2368,6 +2568,11 @@ def bench_claims(n_claims: int, seconds: float, platform: str) -> dict:
     host-fetch timing protocol (one checksum fetch per timed iteration,
     so the clock never stops before results reach the host), and the
     batched outputs are parity-checked against the loop in-run.
+
+    The batched dispatch HONORS the committed ``consensus_impl``
+    routing (env > PERF_DECISIONS.json > xla), and the detail always
+    carries a pallas-vs-XLA A/B at this cube shape
+    (:func:`claims_pallas_ab`, subprocess-contained).
     """
     import numpy as np
 
@@ -2380,8 +2585,9 @@ def bench_claims(n_claims: int, seconds: float, platform: str) -> dict:
     )
     from svoc_tpu.consensus.kernel import ConsensusConfig, jit_consensus_gated
 
-    n_oracles, dim = 7, 6
-    cfg = ConsensusConfig()
+    dim = 6
+    consensus_impl = resolve_consensus_impl()
+    cfg = ConsensusConfig(n_failing=max(2, n_oracles // 4), constrained=True)
     rng = np.random.default_rng(0)
     values = rng.uniform(0.0, 1.0, size=(n_claims, n_oracles, dim)).astype(
         np.float32
@@ -2402,13 +2608,19 @@ def bench_claims(n_claims: int, seconds: float, platform: str) -> dict:
     step = jit_consensus_gated(cfg)
 
     # Warmup compiles + in-run parity: the batched essences must match
-    # the per-claim loop before any number is reported.
-    batched_out = claims_consensus_gated(vj, oj, mj, cfg)
+    # the per-claim loop before any number is reported.  The XLA loop
+    # is the parity ORACLE; a pallas-routed batched dispatch is a
+    # different (lossless) float program, so its bar is float-assoc
+    # tolerance rather than the near-bit XLA-vs-XLA one.
+    batched_out = claims_consensus_gated(
+        vj, oj, mj, cfg, consensus_impl=consensus_impl
+    )
     looped = [step(per_claim_v[c], per_claim_ok[c]) for c in range(n_claims)]
     batched_essence = np.asarray(batched_out.essence)[:n_claims]
     looped_essence = np.stack([np.asarray(o.essence) for o in looped])
     parity = float(np.max(np.abs(batched_essence - looped_essence)))
-    if parity > 1e-5:
+    parity_tol = 1e-5 if consensus_impl == "xla" else 5e-5
+    if parity > parity_tol:
         raise RuntimeError(
             f"claim-cube parity broke before timing: max |Δessence| {parity}"
         )
@@ -2425,7 +2637,9 @@ def bench_claims(n_claims: int, seconds: float, platform: str) -> dict:
         return iters, time.perf_counter() - t0, checksum
 
     def batched_body() -> float:
-        out = claims_consensus_gated(vj, oj, mj, cfg)
+        out = claims_consensus_gated(
+            vj, oj, mj, cfg, consensus_impl=consensus_impl
+        )
         return float(jnp.sum(out.essence))  # host fetch stops the clock
 
     def sequential_body() -> float:
@@ -2442,6 +2656,24 @@ def bench_claims(n_claims: int, seconds: float, platform: str) -> dict:
     s_iters, s_elapsed, s_checksum = timed(sequential_body)
     batched_cps = n_claims * b_iters / b_elapsed
     sequential_cps = n_claims * s_iters / s_elapsed
+
+    # Pallas-vs-XLA A/B at this cube shape, hang-contained.  Runs
+    # regardless of the routed impl — the A/B exists to (over)turn the
+    # routing, so it cannot depend on it.
+    ab = claims_pallas_ab(n_claims, n_oracles, dim, platform)
+    # Fallback visibility (docs/FABRIC.md §consensus_impl): whatever
+    # the routed timed loop could not honor shows up here, never only
+    # in a subprocess log.
+    from svoc_tpu.utils.metrics import registry as _obs_registry
+
+    fallbacks = {
+        ",".join(f"{k}={v}" for k, v in sorted(labels.items())) or "none": int(
+            count
+        )
+        for labels, count in _obs_registry.family_series(
+            "consensus_pallas_fallback"
+        )
+    }
     return {
         "metric": f"claim-cube consensus {n_claims}x{n_oracles}x{dim}",
         "value": round(batched_cps, 2),
@@ -2452,6 +2684,7 @@ def bench_claims(n_claims: int, seconds: float, platform: str) -> dict:
             "n_oracles": n_oracles,
             "dimension": dim,
             "bucket": int(padded.shape[0]),
+            "consensus_impl": consensus_impl,
             "batched_claims_per_s": round(batched_cps, 2),
             "sequential_claims_per_s": round(sequential_cps, 2),
             "speedup": round(batched_cps / sequential_cps, 3),
@@ -2459,6 +2692,8 @@ def bench_claims(n_claims: int, seconds: float, platform: str) -> dict:
             "sequential_iters": s_iters,
             "parity_max_abs_diff": parity,
             "checksums": [round(b_checksum, 3), round(s_checksum, 3)],
+            "pallas_ab": ab,
+            "pallas_fallbacks": fallbacks,
         },
     }
 
@@ -2493,8 +2728,19 @@ def main(argv=None) -> int:
         metavar="N",
         help=(
             "claim-cube sweep (docs/FABRIC.md): ONE batched gated "
-            "consensus dispatch over [N, 7, 6] vs the sequential "
-            "per-claim loop; reports claims/sec and the speedup"
+            "consensus dispatch over [N, oracles, 6] vs the sequential "
+            "per-claim loop; reports claims/sec, the speedup, and a "
+            "hang-contained pallas-vs-xla A/B at the same shape"
+        ),
+    )
+    parser.add_argument(
+        "--claims-oracles",
+        type=int,
+        default=7,
+        metavar="K",
+        help=(
+            "fleet size per claim for --claims (default 7, the "
+            "reference fleet; 1024 is the flagship A/B shape)"
         ),
     )
     args = parser.parse_args(argv)
@@ -2507,7 +2753,9 @@ def main(argv=None) -> int:
         platform, fallback_reason = resolve_backend()
         try:
             _pin_platform(platform)
-            result = bench_claims(args.claims, args.seconds, platform)
+            result = bench_claims(
+                args.claims, args.seconds, platform, args.claims_oracles
+            )
             if fallback_reason:
                 result["detail"]["backend_fallback"] = fallback_reason
             emit(result)

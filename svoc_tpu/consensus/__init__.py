@@ -1,5 +1,12 @@
-"""Two-pass robust consensus: fused XLA kernel + faithful contract simulator."""
+"""Two-pass robust consensus: fused XLA/Pallas kernels + faithful
+contract simulator + the impl-routing layer (docs/FABRIC.md
+§consensus_impl)."""
 
+from svoc_tpu.consensus.dispatch import (  # noqa: F401
+    ConsensusImplError,
+    PallasConfigError,
+    resolve_consensus_impl,
+)
 from svoc_tpu.consensus.kernel import (  # noqa: F401
     ConsensusConfig,
     ConsensusOutput,

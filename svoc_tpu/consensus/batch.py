@@ -39,6 +39,7 @@ via the ``boundary_gap`` margin.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from functools import partial
 from typing import NamedTuple, Optional
 
@@ -46,6 +47,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from svoc_tpu.consensus.dispatch import (
+    pallas_interpret_opt_in,
+    report_pallas_fallback,
+    resolve_consensus_impl,
+    validate_consensus_impl,
+)
 from svoc_tpu.consensus.kernel import (
     ConsensusConfig,
     ConsensusOutput,
@@ -53,6 +60,7 @@ from svoc_tpu.consensus.kernel import (
     consensus_step_claims,
     consensus_step_gated_claims,
 )
+from svoc_tpu.ops import pallas_consensus as pallas_ops
 from svoc_tpu.ops import sort as sort_ops
 from svoc_tpu.ops import stats
 from svoc_tpu.ops.fixedpoint import WSAD
@@ -267,26 +275,22 @@ def pad_claim_cube(
 # a SHAPE the caller pow2-buckets, so the compile count is bounded by
 # log₂(max claims) per config.
 @partial(jax.jit, static_argnames=("cfg",))
-def claims_consensus(
+def _claims_consensus_xla(
     values: jnp.ndarray,  # [C, N, M] padded claim cube
     claim_mask: jnp.ndarray,  # [C] bool — active claims
     cfg: ConsensusConfig,
 ) -> ConsensusOutput:
-    """One fused dispatch of the ungated two-pass consensus over every
-    claim in a micro-batch (leading claim axis on every output)."""
     return consensus_step_claims(values, claim_mask, cfg)
 
 
+# static_argnames: ``cfg`` only, as above.
 @partial(jax.jit, static_argnames=("cfg",))
-def claims_consensus_gated(
+def _claims_consensus_gated_xla(
     values: jnp.ndarray,  # [C, N, M]
     ok: jnp.ndarray,  # [C, N] admission masks (True = admitted)
     claim_mask: jnp.ndarray,  # [C]
     cfg: ConsensusConfig,
 ) -> ConsensusOutput:
-    """One fused dispatch of the GATED two-pass consensus over a claim
-    micro-batch with precomputed per-claim admission masks (the host
-    gate's verdicts, re-used on device)."""
     return consensus_step_gated_claims(values, ok, claim_mask, cfg)
 
 
@@ -297,18 +301,162 @@ def claims_consensus_gated(
 # range checks through select ops the compiler can no longer fold away
 # when a bound is absent (None).
 @partial(jax.jit, static_argnames=("cfg", "lo", "hi"))
-def claims_consensus_sanitized(
+def _claims_consensus_sanitized_xla(
     values: jnp.ndarray,  # [C, N, M]
     claim_mask: jnp.ndarray,  # [C]
     cfg: ConsensusConfig,
     lo: Optional[float],
     hi: Optional[float],
 ):
+    ok = quarantine_mask_claims(values, lo, hi)
+    return consensus_step_gated_claims(values, ok, claim_mask, cfg), ok
+
+
+# static_argnames: the sanitize bounds only (see the sanitized wrapper
+# above) — the pallas route computes the in-graph admission masks with
+# the same traced gate, then hands them to the fused kernel's own jit.
+@partial(jax.jit, static_argnames=("lo", "hi"))
+def _quarantine_claims_jit(values, lo, hi):
+    return quarantine_mask_claims(values, lo, hi)
+
+
+#: (n_oracles, dim, cfg) triples whose pallas dispatch raised — a
+#: Mosaic lowering failure is deterministic per shape/config, so one
+#: failure routes that group to XLA for the process lifetime instead of
+#: re-raising (and re-catching) on every fabric cycle.  The COUNTER
+#: still ticks per skipped dispatch; only the exception handling is
+#: one-shot.
+_MOSAIC_BROKEN: set = set()
+_MOSAIC_LOCK = threading.Lock()
+
+
+def _pallas_route(
+    values: jnp.ndarray, cfg: ConsensusConfig, consensus_impl, metrics, op: str
+) -> bool:
+    """Whether this claim-cube dispatch should run the fused Pallas
+    kernel.  Any "no" that was REQUESTED as pallas (the resolved impl
+    said pallas but the dispatch cannot honor it) is a counted
+    fallback — the no-silent-fallback contract."""
+    impl = (
+        validate_consensus_impl(consensus_impl)
+        if consensus_impl is not None
+        else resolve_consensus_impl()
+    )
+    if impl != "pallas":
+        return False
+    _c, n, dim = values.shape
+    reason = pallas_ops.fused_fallback_reason(n, cfg)
+    if reason is None and (n, dim, cfg) in _MOSAIC_BROKEN:
+        reason = "mosaic_error"
+    if reason is None and jax.default_backend() != "tpu":
+        if not pallas_interpret_opt_in():
+            # Interpreter mode is a parity tool, not a serving path: a
+            # pallas-routed CPU box serves the XLA graph and SAYS so.
+            reason = "non_tpu"
+    if reason is not None:
+        report_pallas_fallback(reason, op=op, metrics=metrics)
+        return False
+    return True
+
+
+def _pallas_broke(values, cfg, e: Exception, metrics, op: str) -> None:
+    with _MOSAIC_LOCK:
+        _MOSAIC_BROKEN.add((values.shape[1], values.shape[2], cfg))
+    report_pallas_fallback(
+        "mosaic_error",
+        op=op,
+        detail=f"{type(e).__name__}: {e}",
+        metrics=metrics,
+    )
+
+
+def claims_consensus(
+    values: jnp.ndarray,  # [C, N, M] padded claim cube
+    claim_mask: jnp.ndarray,  # [C] bool — active claims
+    cfg: ConsensusConfig,
+    consensus_impl: Optional[str] = None,
+    metrics=None,
+) -> ConsensusOutput:
+    """One fused dispatch of the ungated two-pass consensus over every
+    claim in a micro-batch (leading claim axis on every output).
+
+    ``consensus_impl`` picks the execution strategy (``"xla"`` |
+    ``"pallas"``; ``None`` resolves env > PERF_DECISIONS.json > xla —
+    :func:`svoc_tpu.consensus.dispatch.resolve_consensus_impl`).  The
+    pallas route runs the gated fused kernel with all-admitted masks —
+    documented identical semantics on finite cubes (``ok = ones`` ≡
+    ungated, tests/test_robustness.py); non-finite rows additionally
+    get the gated kernel's neutral fill instead of XLA's NaN
+    propagation.  Every route the resolved pallas impl cannot honor is
+    a counted fallback to XLA (``consensus_pallas_fallback{reason=}``).
+    """
+    if _pallas_route(values, cfg, consensus_impl, metrics, "claims_consensus"):
+        ok = jnp.ones(values.shape[:2], dtype=bool)
+        try:
+            return pallas_ops.fused_consensus_gated_claims(
+                values, ok, claim_mask, cfg
+            )
+        except Exception as e:  # noqa: BLE001 — counted, then XLA re-raises real input errors
+            _pallas_broke(values, cfg, e, metrics, "claims_consensus")
+    return _claims_consensus_xla(values, claim_mask, cfg)
+
+
+def claims_consensus_gated(
+    values: jnp.ndarray,  # [C, N, M]
+    ok: jnp.ndarray,  # [C, N] admission masks (True = admitted)
+    claim_mask: jnp.ndarray,  # [C]
+    cfg: ConsensusConfig,
+    consensus_impl: Optional[str] = None,
+    metrics=None,
+) -> ConsensusOutput:
+    """One fused dispatch of the GATED two-pass consensus over a claim
+    micro-batch with precomputed per-claim admission masks (the host
+    gate's verdicts, re-used on device).  ``consensus_impl`` as in
+    :func:`claims_consensus`; the XLA graph remains the parity oracle
+    (``make pallas-parity``)."""
+    if _pallas_route(
+        values, cfg, consensus_impl, metrics, "claims_consensus_gated"
+    ):
+        try:
+            return pallas_ops.fused_consensus_gated_claims(
+                values, ok, claim_mask, cfg
+            )
+        except Exception as e:  # noqa: BLE001 — counted, then XLA re-raises real input errors
+            _pallas_broke(values, cfg, e, metrics, "claims_consensus_gated")
+    return _claims_consensus_gated_xla(values, ok, claim_mask, cfg)
+
+
+def claims_consensus_sanitized(
+    values: jnp.ndarray,  # [C, N, M]
+    claim_mask: jnp.ndarray,  # [C]
+    cfg: ConsensusConfig,
+    lo: Optional[float],
+    hi: Optional[float],
+    consensus_impl: Optional[str] = None,
+    metrics=None,
+):
     """Gate + consensus fused into ONE traced program per micro-batch:
     the vmapped quarantine gate
     (:func:`svoc_tpu.robustness.sanitize.quarantine_mask_claims`)
     computes per-claim admission masks in-graph and the gated kernel
     consumes them without a host round-trip.  Returns ``(output, ok)``
-    so the caller can still account per-claim admissions."""
-    ok = quarantine_mask_claims(values, lo, hi)
-    return consensus_step_gated_claims(values, ok, claim_mask, cfg), ok
+    so the caller can still account per-claim admissions.  The pallas
+    route keeps the no-host-round-trip property: the traced gate's
+    masks feed the fused kernel's jit directly (two dispatches, zero
+    fetches between them)."""
+    if _pallas_route(
+        values, cfg, consensus_impl, metrics, "claims_consensus_sanitized"
+    ):
+        try:
+            ok = _quarantine_claims_jit(values, lo, hi)
+            return (
+                pallas_ops.fused_consensus_gated_claims(
+                    values, ok, claim_mask, cfg
+                ),
+                ok,
+            )
+        except Exception as e:  # noqa: BLE001 — counted, then XLA re-raises real input errors
+            _pallas_broke(
+                values, cfg, e, metrics, "claims_consensus_sanitized"
+            )
+    return _claims_consensus_sanitized_xla(values, claim_mask, cfg, lo, hi)

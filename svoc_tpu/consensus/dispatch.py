@@ -1,0 +1,214 @@
+"""Consensus-implementation routing and pallas-fallback accounting.
+
+The fabric/serving hot path dispatches every claim micro-batch through
+ONE of two parity-tested consensus implementations
+(``docs/FABRIC.md`` §consensus_impl):
+
+- ``"xla"`` — the stitched XLA graph
+  (:func:`svoc_tpu.consensus.kernel.consensus_step_gated_claims`), the
+  parity oracle and the committed default;
+- ``"pallas"`` — the fused VMEM-resident claim-cube kernel
+  (:func:`svoc_tpu.ops.pallas_consensus.fused_consensus_gated_claims`).
+
+The choice resolves exactly like the flagship variant routing in
+``bench.py``: ``SVOC_CONSENSUS_IMPL`` env override > the committed
+``PERF_DECISIONS.json`` record (written by ``tools/decide_perf.py``
+from measured on-chip A/Bs, never at runtime) > the ``"xla"`` default.
+Both candidates are lossless (identical consensus up to float
+tolerance, ``make pallas-parity``), so the record only picks the
+execution strategy — semantics never change with it.
+
+Every time a pallas-routed dispatch has to fall back to XLA (fleet
+over the oracle cap, non-TPU backend without the interpret opt-in, a
+Mosaic lowering failure) the fallback is COUNTED in
+``consensus_pallas_fallback{reason=}`` and logged once per reason —
+before this module, the config-6 bench subprocess was the only place a
+fallback was visible, and a production box could silently serve the
+slow path forever.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Optional, Tuple
+
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_registry
+
+#: Repo root (the directory holding ``bench.py`` and the committed
+#: decision record) — dispatch.py lives at svoc_tpu/consensus/.
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+PERF_DECISIONS_PATH = os.path.join(_REPO_ROOT, "PERF_DECISIONS.json")
+
+ALLOWED_CONSENSUS_IMPLS = ("xla", "pallas")
+CONSENSUS_IMPL_ENV = "SVOC_CONSENSUS_IMPL"
+#: Opt-in that lets a pallas-routed dispatch run the kernel in
+#: interpreter mode on a non-TPU backend (tests, ``make
+#: pallas-parity``).  Without it a non-TPU pallas route falls back to
+#: XLA and counts ``reason="non_tpu"`` — interpret mode is a parity
+#: tool, not a serving path.
+PALLAS_INTERPRET_ENV = "SVOC_PALLAS_INTERPRET"
+
+
+class ConsensusImplError(ValueError):
+    """An unknown consensus implementation was requested (env override
+    or a corrupt committed record)."""
+
+
+class PallasConfigError(ValueError):
+    """A ``SVOC_PALLAS_*`` env knob failed validation.  Raised at first
+    USE of the knob (never at import) with the variable name, the bad
+    value, and the expected form in the message."""
+
+
+def env_int(name: str, default: int, minimum: Optional[int] = None) -> int:
+    """``int(os.environ[name])`` with a typed, actionable error."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        value = int(raw)
+    except ValueError:
+        raise PallasConfigError(
+            f"{name}={raw!r} is not an integer (expected e.g. "
+            f"{name}={default}); unset it to use the default"
+        ) from None
+    if minimum is not None and value < minimum:
+        raise PallasConfigError(
+            f"{name}={value} is below the minimum {minimum}; unset it "
+            f"to use the default {default}"
+        )
+    return value
+
+
+def env_float(
+    name: str, default: float, minimum: Optional[float] = None
+) -> float:
+    """``float(os.environ[name])`` with a typed, actionable error."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        value = float(raw)
+    except ValueError:
+        raise PallasConfigError(
+            f"{name}={raw!r} is not a number (expected e.g. "
+            f"{name}={default}); unset it to use the default"
+        ) from None
+    if minimum is not None and value < minimum:
+        raise PallasConfigError(
+            f"{name}={value} is below the minimum {minimum}; unset it "
+            f"to use the default {default}"
+        )
+    return value
+
+
+def perf_decision(
+    key: str, default: str, env_var: str, path: Optional[str] = None
+) -> Tuple[str, str]:
+    """Resolve a routing decision to ``(value, source)``: env override
+    > the committed PERF_DECISIONS.json record > ``default``.  The
+    library twin of ``bench.perf_decision`` (same precedence, same
+    never-raises-on-a-bad-record contract), parameterized on the record
+    path so tests can redirect it."""
+    value = os.environ.get(env_var)
+    source = f"env:{env_var}"
+    if not value:
+        try:
+            with open(path or PERF_DECISIONS_PATH) as f:
+                data = json.load(f)
+            # A JSON-valid non-object record degrades like a missing
+            # one — this resolver never raises on a bad record.
+            value = data.get(key) if isinstance(data, dict) else None
+            source = "PERF_DECISIONS.json"
+        except (OSError, ValueError):
+            value = None
+    if not value:
+        value, source = default, "default"
+    return value, source
+
+
+def validate_consensus_impl(impl: str, source: str = "caller") -> str:
+    """Reject anything outside :data:`ALLOWED_CONSENSUS_IMPLS` with a
+    message naming the allowed values AND the deciding env var."""
+    if impl not in ALLOWED_CONSENSUS_IMPLS:
+        allowed = ", ".join(repr(v) for v in ALLOWED_CONSENSUS_IMPLS)
+        raise ConsensusImplError(
+            f"consensus_impl {impl!r} (from {source}) is not a known "
+            f"consensus implementation: allowed values are {allowed}; "
+            f"set {CONSENSUS_IMPL_ENV} to override the committed "
+            "PERF_DECISIONS.json record"
+        )
+    return impl
+
+
+def resolve_consensus_impl(path: Optional[str] = None) -> str:
+    """The production consensus-impl routing: env > committed record >
+    ``"xla"``, validated.  Resolved ONCE per :class:`ClaimRouter` (the
+    impl choice is part of a seeded replay's config — docs/FABRIC.md
+    §replay), so the file read never sits on the per-step hot path."""
+    impl, source = perf_decision(
+        "consensus_impl", "xla", CONSENSUS_IMPL_ENV, path=path
+    )
+    return validate_consensus_impl(impl, source)
+
+
+def pallas_interpret_opt_in() -> bool:
+    return os.environ.get(PALLAS_INTERPRET_ENV) == "1"
+
+
+# ---------------------------------------------------------------------------
+# Fallback accounting: no silent XLA fallbacks.
+# ---------------------------------------------------------------------------
+
+FALLBACK_COUNTER = "consensus_pallas_fallback"
+
+_log = logging.getLogger("svoc_tpu.consensus.pallas")
+_log_lock = threading.Lock()
+_logged_reasons: set = set()
+
+
+def report_pallas_fallback(
+    reason: str,
+    *,
+    op: str = "fused_consensus",
+    detail: str = "",
+    metrics: Optional[MetricsRegistry] = None,
+) -> None:
+    """Count one pallas→XLA fallback and log the FIRST occurrence of
+    each reason (one-shot — a steady-state fallback must not spam the
+    log at dispatch rate; the counter carries the rate).
+
+    Reasons: ``fleet_too_large`` (over ``SVOC_PALLAS_MAX_ORACLES``),
+    ``unaligned_fleet`` (fleet not a multiple of the rank block),
+    ``smooth_mode`` (non-cairo median), ``non_tpu`` (no TPU backend and
+    no ``SVOC_PALLAS_INTERPRET=1`` opt-in), ``mosaic_error`` (the
+    kernel raised at lowering/compile/run time).
+    """
+    (metrics or _default_registry).counter(
+        FALLBACK_COUNTER, labels={"reason": reason}
+    ).add(1)
+    with _log_lock:
+        if reason in _logged_reasons:
+            return
+        _logged_reasons.add(reason)
+    _log.warning(
+        "%s fell back to the XLA consensus kernel (reason=%s%s); "
+        "further fallbacks are counted in %s{reason=%s} without logging",
+        op,
+        reason,
+        f": {detail}" if detail else "",
+        FALLBACK_COUNTER,
+        reason,
+    )
+
+
+def reset_fallback_log() -> None:
+    """Re-arm the one-shot log (tests)."""
+    with _log_lock:
+        _logged_reasons.clear()

@@ -46,6 +46,7 @@ from svoc_tpu.consensus.batch import (
     claims_consensus_sanitized,
     pad_claim_cube,
 )
+from svoc_tpu.consensus.dispatch import resolve_consensus_impl
 from svoc_tpu.fabric.registry import ClaimRegistry, ClaimState
 from svoc_tpu.io.chain import ChainCommitError
 from svoc_tpu.resilience.breaker import CircuitOpenError
@@ -78,6 +79,7 @@ class ClaimRouter:
         metrics: Optional[MetricsRegistry] = None,
         journal=None,
         sanitized_dispatch: bool = False,
+        consensus_impl: Optional[str] = None,
     ):
         if max_claims_per_batch < 1:
             raise ValueError("max_claims_per_batch must be >= 1")
@@ -85,6 +87,20 @@ class ClaimRouter:
         self.max_claims_per_batch = max_claims_per_batch
         self._metrics = metrics or _default_registry
         self._journal = journal
+        #: Consensus execution strategy for every claim-cube dispatch
+        #: this router issues (``"xla"`` | ``"pallas"``), resolved ONCE
+        #: at construction (env > PERF_DECISIONS.json > xla) — the impl
+        #: choice is part of a seeded replay's config (docs/FABRIC.md
+        #: §replay), so it must not drift mid-run if the committed
+        #: record changes under a live process.  Both impls are
+        #: parity-tested lossless (``make pallas-parity``); an
+        #: unhonorable pallas route falls back to XLA with a counted
+        #: ``consensus_pallas_fallback{reason=}``.
+        self.consensus_impl = (
+            consensus_impl
+            if consensus_impl is not None
+            else resolve_consensus_impl()
+        )
         #: Fuse gate + consensus into ONE traced program per micro-batch
         #: (:func:`svoc_tpu.consensus.batch.claims_consensus_sanitized`)
         #: instead of reusing the host gate's per-claim verdicts.  The
@@ -321,13 +337,20 @@ class ClaimRouter:
                 cfg,
                 bounds.lo,
                 bounds.hi,
+                consensus_impl=self.consensus_impl,
+                metrics=self._metrics,
             )
             # The traced masks become the accounting source below (one
             # fetch covers them along with the outputs).
             oks = list(np.asarray(ok_traced)[: len(members)])  # svoclint: disable=SVOC001
         else:
             out = claims_consensus_gated(
-                jnp.asarray(values), jnp.asarray(ok), jnp.asarray(claim_mask), cfg
+                jnp.asarray(values),
+                jnp.asarray(ok),
+                jnp.asarray(claim_mask),
+                cfg,
+                consensus_impl=self.consensus_impl,
+                metrics=self._metrics,
             )
         # ONE host sync for the whole micro-batch — the claim axis
         # amortizes the dispatch/fetch overhead that a per-claim loop

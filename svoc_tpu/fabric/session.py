@@ -53,6 +53,7 @@ class MultiSession:
         lineage_scope: Optional[str] = None,
         max_claims_per_batch: int = 8,
         sanitized_dispatch: bool = False,
+        consensus_impl: Optional[str] = None,
         clock: Optional[Callable[[], float]] = None,
         adapter_factory=None,
     ):
@@ -79,12 +80,19 @@ class MultiSession:
         #: (docs/SERVING.md §replay).
         self._clock = clock
         self.registry = ClaimRegistry()
+        #: ``consensus_impl`` pins the claim-cube execution strategy
+        #: (``"xla"`` | ``"pallas"``; None = env > PERF_DECISIONS.json
+        #: > xla, resolved once by the router).  Seeded replays that
+        #: want a non-default impl must pass it explicitly — the impl
+        #: choice is part of the replay's config (docs/FABRIC.md
+        #: §replay), like the fresh journal/registry/pinned scope.
         self.router = ClaimRouter(
             self.registry,
             max_claims_per_batch=max_claims_per_batch,
             metrics=self._metrics,
             journal=journal,
             sanitized_dispatch=sanitized_dispatch,
+            consensus_impl=consensus_impl,
         )
         for spec in specs:
             self.add_claim(spec)

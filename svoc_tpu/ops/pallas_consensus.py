@@ -1,10 +1,16 @@
-"""Fused two-pass consensus as a single Pallas TPU kernel.
+"""Fused two-pass consensus as Pallas TPU kernels — single-claim and
+gated claim-cube.
 
 The XLA version (:func:`svoc_tpu.consensus.kernel.consensus_step`)
 compiles to a dozen fused loops with intermediate HBM round-trips for
 the sorts; at fleet scale (N=1024, M≤32) the whole working set is a few
-hundred KB, so this kernel keeps *everything* resident in VMEM and
-computes both passes in one launch.
+hundred KB, so these kernels keep *everything* resident in VMEM and
+compute both passes in one launch.  The claim-cube kernel
+(:func:`fused_consensus_gated_claims`) additionally grids over claims —
+one claim's ``[N, M]`` cube per program instance — so the fabric's
+micro-batch (docs/FABRIC.md) pays ONE launch for C claims, the blocked
+on-chip reduction regime of Large-Scale Distributed Linear Algebra with
+TPUs (PAPERS.md, arxiv 2112.09017).
 
 Selection without sorting: Mosaic has no general sort lowering, so
 order statistics are computed by **rank counting** — for a key vector
@@ -14,32 +20,48 @@ j > i)]``, the exact stable order of the reference's
 values, ties in descending index).  The O(N²) comparison matrix
 reduces to ranks on the MXU (HIGHEST precision — bf16 rounding would
 corrupt the counts), and the value at rank r is recovered with a
-one-hot matmul.  Semantics match ``consensus_step`` with
-``smooth_mode="cairo"`` (equivalence-tested in
-``tests/test_pallas_consensus.py``).  Fleets above
-:data:`PALLAS_MAX_ORACLES` fall back to the XLA kernel — see the
-constant's note on Mosaic compile scaling.
+one-hot matmul (ungated) or a sentinel-preserving masked sum (gated —
+the ``+inf`` quarantine sentinel must survive selection exactly like
+the XLA masked sort's ``+inf`` rows, see
+:func:`_masked_value_at_rank`).  Semantics match ``consensus_step`` /
+``consensus_step_gated_claims`` with ``smooth_mode="cairo"``
+(equivalence-tested in ``tests/test_pallas_consensus.py``; ``make
+pallas-parity``).  Fleets above ``PALLAS_MAX_ORACLES`` fall back to
+the XLA kernels — see :func:`fused_fallback_reason` — and every
+fallback is counted in ``consensus_pallas_fallback{reason=}``
+(:func:`svoc_tpu.consensus.dispatch.report_pallas_fallback`).
 
 Mosaic constraints shape the code: no scalar VMEM stores and no 1-D →
 0-D reductions, so every tensor stays 2-D ([N,1] columns, [1,M] rows,
-[1,1] scalars) and every reduction keeps dims.
+[1,1] scalars) and every reduction keeps dims.  Gated counts
+(``n_ok``, ``n_rel``) are traced [1,1] floats — exact integers far
+below 2²⁴, so float equality against ranks is safe.
 
-On non-TPU backends the kernel runs in interpreter mode (slow, for
-tests); :func:`fused_consensus` picks automatically.
+On non-TPU backends the kernels run in interpreter mode (slow, for
+tests); ``interpret=None`` picks automatically.  The production
+dispatch (:mod:`svoc_tpu.consensus.batch`) additionally refuses
+interpret mode unless ``SVOC_PALLAS_INTERPRET=1`` — the interpreter is
+a parity tool, never a serving path.
 """
 
 from __future__ import annotations
 
 import functools
-import os
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from svoc_tpu.consensus.kernel import ConsensusConfig
+from svoc_tpu.consensus.dispatch import env_int, report_pallas_fallback
+from svoc_tpu.consensus.kernel import (
+    ConsensusConfig,
+    ConsensusOutput,
+    _mask_padded_claims,
+    consensus_step,
+    consensus_step_gated_claims,
+)
 
 
 #: Column-block width for the rank computation.  Each loop body touches
@@ -48,6 +70,56 @@ from svoc_tpu.consensus.kernel import ConsensusConfig
 #: took ~1 min to compile at N=128, capping the kernel below fleet
 #: scale.
 _RANK_BLOCK = 128
+
+#: Default for the largest fleet the Pallas kernels compile for,
+#: overridable via ``SVOC_PALLAS_MAX_ORACLES``.  Since the round-5
+#: rework the rank computation is a ``fori_loop`` (ONE compiled body
+#: per rank call regardless of N — see :func:`_stable_rank_2d`), so
+#: compiled code size no longer grows with fleet size; the cap now only
+#: bounds the [1, N] scratch row and the O(N²) runtime of rank
+#: counting.  Above the cap the fused entry points transparently run
+#: the XLA graphs with identical semantics (counted fallback).
+_PALLAS_MAX_ORACLES_DEFAULT = 1024
+
+
+def pallas_max_oracles() -> int:
+    """``SVOC_PALLAS_MAX_ORACLES`` resolved lazily with a typed error
+    (:class:`svoc_tpu.consensus.dispatch.PallasConfigError`) — a
+    malformed value used to ``ValueError`` at import time, killing any
+    importer before it could even reach the XLA fallback."""
+    return env_int(
+        "SVOC_PALLAS_MAX_ORACLES", _PALLAS_MAX_ORACLES_DEFAULT, minimum=1
+    )
+
+
+def __getattr__(name: str):
+    # Lazy module attribute (PEP 562): ``PALLAS_MAX_ORACLES`` keeps its
+    # historical spelling for importers (bench.py, tools) while the env
+    # var is parsed at first USE, not at import.
+    if name == "PALLAS_MAX_ORACLES":
+        return pallas_max_oracles()
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def fused_fallback_reason(
+    n_oracles: int, cfg: ConsensusConfig
+) -> Optional[str]:
+    """Why this fleet/config cannot run the fused Pallas kernels, or
+    ``None`` when it can.  The one shape/config gate shared by every
+    fused entry point AND the production dispatch
+    (:mod:`svoc_tpu.consensus.batch`), so routing and fallback
+    accounting can never disagree about eligibility."""
+    if cfg.smooth_mode != "cairo":
+        # The kernels implement only the cairo degenerate smooth
+        # median; other smooth modes take the XLA path so semantics
+        # never depend on fleet size.
+        return "smooth_mode"
+    if n_oracles > pallas_max_oracles():
+        return "fleet_too_large"
+    if n_oracles > _RANK_BLOCK and n_oracles % _RANK_BLOCK != 0:
+        # Fleets above the rank block must tile it evenly.
+        return "unaligned_fleet"
+    return None
 
 
 def _rank_body(key_col, idx, kj, jdx, acc, ones):
@@ -216,51 +288,24 @@ class FusedConsensusOutput(NamedTuple):
     kurtosis: jnp.ndarray  # [M]
 
 
-#: Largest fleet the Pallas kernel compiles for, overridable via
-#: ``SVOC_PALLAS_MAX_ORACLES``.  Since the round-5 rework the rank
-#: computation is a ``fori_loop`` (ONE compiled body per rank call
-#: regardless of N — see :func:`_stable_rank_2d`), so compiled code
-#: size no longer grows with fleet size; the cap now only bounds the
-#: [1, N] scratch row and the O(N²) runtime of rank counting.  Above
-#: the cap :func:`fused_consensus` transparently runs the XLA graph
-#: with identical semantics.
-PALLAS_MAX_ORACLES = int(os.environ.get("SVOC_PALLAS_MAX_ORACLES", "1024"))
+# static_argnames: ``cfg`` is a frozen dataclass (hashable static
+# config, the audited prefix_margins_sweep pattern) — values stays the
+# only dynamic arg, so the compile count is one per (shape, cfg).
+_consensus_step_jit = jax.jit(consensus_step, static_argnames=("cfg",))
+
+# static_argnames: ``cfg`` as above; ``ok``/``claim_mask`` stay dynamic
+# arrays and the claim count is a SHAPE the callers pow2-bucket, so the
+# compile count is bounded by log₂(max claims) per config.
+_xla_gated_claims_jit = jax.jit(
+    consensus_step_gated_claims, static_argnames=("cfg",)
+)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "interpret"))
-def fused_consensus(
-    values: jnp.ndarray, cfg: ConsensusConfig, interpret: bool | None = None
+def _fused_consensus_pallas(
+    values: jnp.ndarray, cfg: ConsensusConfig, interpret: bool
 ) -> FusedConsensusOutput:
-    """One-launch two-pass consensus on ``values [N, M]`` (float32).
-
-    ``interpret=None`` auto-selects: compiled on TPU, interpreter
-    elsewhere (tests).  Fleets larger than :data:`PALLAS_MAX_ORACLES`
-    route to the XLA kernel with identical semantics and outputs.
-    """
     n, dim = values.shape
-    # The kernel implements only the cairo degenerate smooth median;
-    # other smooth modes take the XLA path so semantics never depend on
-    # fleet size.  Fleets above the rank block must tile it evenly.
-    if (
-        n > PALLAS_MAX_ORACLES
-        or (n > _RANK_BLOCK and n % _RANK_BLOCK != 0)
-        or cfg.smooth_mode != "cairo"
-    ):
-        from svoc_tpu.consensus.kernel import consensus_step
-
-        out = consensus_step(values.astype(jnp.float32), cfg)
-        return FusedConsensusOutput(
-            essence=out.essence,
-            essence_first_pass=out.essence_first_pass,
-            reliability_first_pass=out.reliability_first_pass,
-            reliability_second_pass=out.reliability_second_pass,
-            reliable=out.reliable,
-            quadratic_risk=out.quadratic_risk,
-            skewness=out.skewness,
-            kurtosis=out.kurtosis,
-        )
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     values = values.astype(jnp.float32)
     kernel = functools.partial(_consensus_kernel, cfg=cfg, n=n, dim=dim)
     essence, essence1, rel, mask, qr, moments = pl.pallas_call(
@@ -288,4 +333,308 @@ def fused_consensus(
         quadratic_risk=qr[:, 0],
         skewness=moments[0],
         kurtosis=moments[1],
+    )
+
+
+def fused_consensus(
+    values: jnp.ndarray, cfg: ConsensusConfig, interpret: bool | None = None
+) -> FusedConsensusOutput:
+    """One-launch two-pass consensus on ``values [N, M]`` (float32).
+
+    ``interpret=None`` auto-selects: compiled on TPU, interpreter
+    elsewhere (tests).  Ineligible fleets/configs
+    (:func:`fused_fallback_reason`) route to the XLA kernel with
+    identical semantics and outputs — COUNTED in
+    ``consensus_pallas_fallback{reason=}``.  This wrapper is a plain
+    dispatcher (the jits live inside) so the counting is a host-side
+    effect, never an impure traced body; when the wrapper itself is
+    traced into an outer jit (the flagship's fused fleet+consensus
+    step), the count fires once per compiled routing decision, which is
+    when the fallback actually happens.
+    """
+    n, dim = values.shape
+    reason = fused_fallback_reason(n, cfg)
+    if reason is not None:
+        report_pallas_fallback(reason, op="fused_consensus")
+        out = _consensus_step_jit(values.astype(jnp.float32), cfg)
+        return FusedConsensusOutput(
+            essence=out.essence,
+            essence_first_pass=out.essence_first_pass,
+            reliability_first_pass=out.reliability_first_pass,
+            reliability_second_pass=out.reliability_second_pass,
+            reliable=out.reliable,
+            quadratic_risk=out.quadratic_risk,
+            skewness=out.skewness,
+            kurtosis=out.kurtosis,
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _fused_consensus_pallas(values, cfg, bool(interpret))
+
+
+# ---------------------------------------------------------------------------
+# Gated claim-cube kernel: one grid program per claim, quarantine
+# admission folded into both passes (docs/FABRIC.md).
+# ---------------------------------------------------------------------------
+
+
+def _masked_value_at_rank(key, ranks, r):
+    """``[1, 1]`` KEY value (sentinel included) at traced rank ``r``.
+
+    The gated medians must reproduce the XLA masked sort exactly: when
+    the rank-``r`` element is a masked row, the XLA path reads its
+    ``+inf`` sentinel out of the sorted column (and the caller's
+    isfinite guard later zeroes the essence).  A one-hot MATMUL cannot
+    select a sentinel (``0 · inf = NaN``), so this selection is a
+    masked sum — unselected rows contribute an exact 0.0, the selected
+    row contributes its key, finite or not.  ``r`` is a traced [1,1]
+    float holding an exact integer (ranks are exact — N ≪ 2²⁴), so
+    float equality is safe."""
+    sel = ranks == r  # [N, 1]
+    return jnp.sum(jnp.where(sel, key, 0.0), axis=0, keepdims=True)
+
+
+def _gated_smooth_median_col(col, mask_col, m, keyrow_scr, n: int):
+    """Cairo smooth median of the ``m`` (traced, [1,1] f32) unmasked
+    entries of ``col [N, 1]``: mean of the keys at ranks
+    ``clip(m//2-1)`` and ``clip(m//2)`` — index clipping and the +inf
+    sentinel behavior exactly as ``stats.masked_smooth_median`` (the
+    degenerate ``m < 2`` cases read sentinels there too)."""
+    key = jnp.where(mask_col, col, jnp.inf)
+    ranks = _stable_rank_2d(key, keyrow_scr)
+    mid = jnp.floor(m * 0.5)  # [1,1] exact integer float
+    a = _masked_value_at_rank(key, ranks, jnp.clip(mid - 1.0, 0.0, n - 1.0))
+    b = _masked_value_at_rank(key, ranks, jnp.clip(mid, 0.0, n - 1.0))
+    return (a + b) * 0.5
+
+
+def _gated_claims_kernel(
+    values_ref,
+    ok_ref,
+    essence_ref,
+    essence1_ref,
+    rel_ref,
+    mask_ref,
+    qr_ref,
+    moments_ref,
+    valid_ref,
+    keyrow_scr,
+    *,
+    cfg: ConsensusConfig,
+    n: int,
+    dim: int,
+):
+    """One claim's gated two-pass consensus, everything VMEM-resident.
+
+    Mirrors :func:`svoc_tpu.consensus.kernel.consensus_step_gated`
+    op-for-op (the traced-count twin of the static-count
+    ``_consensus_kernel`` above): neutral-fill before any arithmetic,
+    admission-masked first pass, ``+inf`` gated ranking sentinel,
+    reliability cut counted from ``n_ok``, essence₁-centered
+    second-pass risk, count-clamped moments, and the
+    ``interval_valid`` degeneracy flags (``n_ok < 2`` / ``n_rel < 2``)
+    — parity-pinned by ``make pallas-parity``."""
+    v = values_ref[0]  # [N, M]
+    okf = ok_ref[0]  # [N, 1] f32, 1.0 = admitted
+    okb = okf > 0.5
+    # Neutral fill: quarantined rows are masked out of every reduction
+    # below, but masked reductions multiply by 0 rather than select,
+    # and 0 * NaN is NaN — the fill must happen before any arithmetic.
+    safe = jnp.where(okb, v, 0.0)
+    safe = jnp.where(jnp.isfinite(safe), safe, 0.0)
+    n_ok = jnp.sum(okf, axis=0, keepdims=True)  # [1, 1]
+    cols = [safe[:, c : c + 1] for c in range(dim)]
+
+    # ---- FIRST PASS over the admitted subset ----
+    essence1 = jnp.concatenate(
+        [
+            _gated_smooth_median_col(c, okb, n_ok, keyrow_scr, n)
+            for c in cols
+        ],
+        axis=1,
+    )  # [1, M]
+    diff = safe - essence1
+    qr = jnp.sum(diff * diff, axis=1, keepdims=True)  # [N, 1]
+    qr_ok = jnp.where(okb, qr, 0.0)
+
+    def reliability(mean_qr):  # [1,1] -> [1,1]
+        if cfg.constrained:
+            return 1.0 - 2.0 * jnp.sqrt(mean_qr / dim)
+        u = jnp.sqrt(mean_qr)
+        return 1.0 - jnp.minimum(cfg.max_spread, u) / cfg.max_spread
+
+    rel1 = reliability(
+        jnp.sum(qr_ok, axis=0, keepdims=True) / jnp.maximum(n_ok, 1.0)
+    )
+
+    # Gated ranking: quarantined rows carry the +inf sentinel so they
+    # sort strictly last, and the reliability cut counts from n_ok —
+    # quarantine must not absorb the mask budget
+    # (sort_ops.gated_reliability_mask, one tie semantics).
+    ranked = jnp.where(okb, qr, jnp.inf)
+    risk_rank = _stable_rank_2d(ranked, keyrow_scr)
+    reliable = jnp.logical_and(
+        risk_rank < (n_ok - cfg.n_failing), okb
+    )  # [N, 1]
+    w = reliable.astype(jnp.float32)
+    n_rel = jnp.sum(w, axis=0, keepdims=True)  # [1, 1]
+
+    # ---- SECOND PASS (essence₁-centered risk quirk preserved) ----
+    if cfg.constrained:
+        essence2 = jnp.concatenate(
+            [
+                _gated_smooth_median_col(c, reliable, n_rel, keyrow_scr, n)
+                for c in cols
+            ],
+            axis=1,
+        )
+    else:
+        essence2 = jnp.sum(safe * w, axis=0, keepdims=True) / jnp.maximum(
+            n_rel, 1.0
+        )
+    rel2 = reliability(
+        jnp.sum(qr_ok * w, axis=0, keepdims=True) / jnp.maximum(n_rel, 1.0)
+    )
+
+    # ---- MOMENTS over the reliable subset (traced count, clamped
+    # denominators — stats.masked_* formula for formula) ----
+    mean_rel = jnp.sum(safe * w, axis=0, keepdims=True) / jnp.maximum(
+        n_rel, 1.0
+    )  # [1, M]
+    centered = (safe - mean_rel) * w
+    var = jnp.sum(centered * centered, axis=0, keepdims=True) / jnp.maximum(
+        n_rel, 1.0
+    )
+    std = jnp.sqrt(var)
+    z = jnp.where(
+        reliable, (safe - mean_rel) / jnp.maximum(std, 1e-30), 0.0
+    )
+    s3 = jnp.sum(z**3, axis=0, keepdims=True)
+    skew = s3 * n_rel / jnp.maximum((n_rel - 1.0) * (n_rel - 2.0), 1.0)
+    s4 = jnp.sum(z**4, axis=0, keepdims=True)
+    t1 = s4 * n_rel * (n_rel + 1.0) / jnp.maximum(n_rel - 1.0, 1.0)
+    t2 = 3.0 * (n_rel - 1.0) ** 2
+    kurt = (t1 - t2) / jnp.maximum((n_rel - 2.0) * (n_rel - 3.0), 1.0)
+
+    def interval_ok(x):  # [1,1] -> [1,1] bool
+        return jnp.logical_and(x >= 0.0, x <= 1.0)
+
+    valid = jnp.logical_and(interval_ok(rel1), interval_ok(rel2))
+    valid = jnp.logical_and(valid, n_ok >= 2.0)
+    valid = jnp.logical_and(valid, n_rel >= 2.0)
+
+    # An all-quarantined (or single-survivor) claim reports a FINITE
+    # essence alongside its invalid flag — +inf sort sentinels must not
+    # leak to callers that render before checking validity.
+    essence2 = jnp.where(jnp.isfinite(essence2), essence2, 0.0)
+    essence1 = jnp.where(jnp.isfinite(essence1), essence1, 0.0)
+
+    essence_ref[:] = essence2
+    essence1_ref[:] = essence1
+    rel_ref[:] = jnp.concatenate([rel1, rel2], axis=1)  # [1, 2]
+    mask_ref[0] = reliable.astype(jnp.int32)  # [N, 1]
+    qr_ref[0] = qr
+    moments_ref[0] = jnp.concatenate([skew, kurt], axis=0)  # [2, M]
+    valid_ref[:] = valid.astype(jnp.int32)  # [1, 1]
+
+
+# static_argnames: ``cfg``/``interpret`` only (the audited
+# prefix_margins_sweep pattern) — values/ok/claim_mask stay dynamic
+# arrays, and the claim count is a SHAPE the callers pow2-bucket
+# (pad_claim_cube), so the compile count is bounded by log₂(max claims)
+# per (fleet shape, config).
+@functools.partial(jax.jit, static_argnames=("cfg", "interpret"))
+def _fused_gated_claims_pallas(
+    values: jnp.ndarray,
+    ok: jnp.ndarray,
+    claim_mask: jnp.ndarray,
+    cfg: ConsensusConfig,
+    interpret: bool,
+) -> ConsensusOutput:
+    c, n, dim = values.shape
+    values = values.astype(jnp.float32)
+    # The admission mask rides as an [C, N, 1] f32 column so the kernel
+    # block keeps Mosaic's 2-D invariants (an [N] bool row would need
+    # an in-kernel transpose).
+    okc = ok.astype(jnp.float32)[..., None]
+    kernel = functools.partial(_gated_claims_kernel, cfg=cfg, n=n, dim=dim)
+    outs = pl.pallas_call(
+        kernel,
+        grid=(c,),
+        in_specs=[
+            pl.BlockSpec((1, n, dim), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=(
+            jax.ShapeDtypeStruct((c, dim), jnp.float32),  # essence
+            jax.ShapeDtypeStruct((c, dim), jnp.float32),  # essence1
+            jax.ShapeDtypeStruct((c, 2), jnp.float32),  # rel1/rel2
+            jax.ShapeDtypeStruct((c, n, 1), jnp.int32),  # reliable
+            jax.ShapeDtypeStruct((c, n, 1), jnp.float32),  # qr
+            jax.ShapeDtypeStruct((c, 2, dim), jnp.float32),  # moments
+            jax.ShapeDtypeStruct((c, 1), jnp.int32),  # interval_valid
+        ),
+        out_specs=(
+            pl.BlockSpec((1, dim), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, dim), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 2, dim), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ),
+        # One [1, N] staging row, reused by every rank call of every
+        # grid program (programs run sequentially per core).
+        scratch_shapes=[pltpu.VMEM((1, n), jnp.float32)],
+        interpret=interpret,
+    )(values, okc)
+    essence, essence1, rel, mask, qr, moments, valid = outs
+    out = ConsensusOutput(
+        essence=essence,
+        essence_first_pass=essence1,
+        reliability_first_pass=rel[:, 0],
+        reliability_second_pass=rel[:, 1],
+        reliable=mask[:, :, 0].astype(bool),
+        quadratic_risk=qr[:, :, 0],
+        skewness=moments[:, 0, :],
+        kurtosis=moments[:, 1, :],
+        interval_valid=valid[:, 0].astype(bool),
+    )
+    # Padded claim rows forced inactive with the SAME masking the XLA
+    # claim kernels use — filler can never read as a confident essence.
+    return _mask_padded_claims(out, claim_mask)
+
+
+def fused_consensus_gated_claims(
+    values: jnp.ndarray,  # [C, N, M] padded claim cube
+    ok: jnp.ndarray,  # [C, N] admission masks (True = admitted)
+    claim_mask: Optional[jnp.ndarray] = None,  # [C] active claims
+    cfg: ConsensusConfig = ConsensusConfig(),
+    interpret: bool | None = None,
+) -> ConsensusOutput:
+    """Gated two-pass consensus over a claim cube in ONE Pallas launch
+    (one grid program per claim, everything VMEM-resident) — the fused
+    twin of :func:`~svoc_tpu.consensus.kernel.consensus_step_gated_claims`
+    with identical outputs (leading claim axis on every field,
+    per-claim degenerate handling, padded rows forced inactive).
+
+    ``interpret=None`` auto-selects like :func:`fused_consensus`.
+    Ineligible fleets/configs (:func:`fused_fallback_reason`) route to
+    the XLA claim kernel with a counted fallback.  The production
+    dispatch with backend/impl policy lives in
+    :func:`svoc_tpu.consensus.batch.claims_consensus_gated`.
+    """
+    c, n, _dim = values.shape
+    if claim_mask is None:
+        claim_mask = jnp.ones((c,), dtype=bool)
+    reason = fused_fallback_reason(n, cfg)
+    if reason is not None:
+        report_pallas_fallback(reason, op="fused_consensus_gated_claims")
+        return _xla_gated_claims_jit(
+            values.astype(jnp.float32), ok, claim_mask, cfg
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _fused_gated_claims_pallas(
+        values, ok, claim_mask, cfg, bool(interpret)
     )

@@ -397,6 +397,11 @@ class ServingTier:
         reg = self._metrics
         return {
             "steps": self.steps,
+            # The claim-cube execution strategy serving this tier's
+            # consensus dispatches (docs/FABRIC.md §consensus_impl) —
+            # surfaced so an operator can tell a pallas-routed box from
+            # an XLA one without reading PERF_DECISIONS.json.
+            "consensus_impl": self.multi.router.consensus_impl,
             "queues": self.frontend.depths(),
             "submitted": reg.family_total("serving_submitted"),
             "admitted": reg.family_total("serving_admitted"),

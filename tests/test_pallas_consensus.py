@@ -1,12 +1,26 @@
-"""Fused Pallas consensus vs the XLA kernel (interpret mode on CPU)."""
+"""Fused Pallas consensus vs the XLA kernels (interpret mode on CPU).
+
+This file is the ``make pallas-parity`` gate (CPU interpret-mode
+parity + fallback-path smoke, budget < 60 s): single-claim ungated
+parity, gated claim-cube parity on both configs — degenerate claims,
+quarantine-all rows, pow2 padding rows, the ``n_failing >= N-1``
+guard — plus the no-silent-fallback counter and the typed env-knob
+errors."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from svoc_tpu.consensus.kernel import ConsensusConfig, consensus_step
-from svoc_tpu.ops.pallas_consensus import fused_consensus
+from svoc_tpu.consensus.kernel import (
+    ConsensusConfig,
+    consensus_step,
+    consensus_step_gated_claims,
+)
+from svoc_tpu.ops.pallas_consensus import (
+    fused_consensus,
+    fused_consensus_gated_claims,
+)
 
 
 def fleets(key, n, dim, constrained=True):
@@ -111,3 +125,311 @@ def test_compiled_size_is_constant_in_fleet_size():
 
     counts = {n: eqn_count(n) for n in (256, 512, 1024)}
     assert len(set(counts.values())) == 1, counts
+
+
+# ---------------------------------------------------------------------------
+# Gated claim-cube kernel (docs/FABRIC.md §consensus_impl)
+# ---------------------------------------------------------------------------
+
+
+def _assert_claims_parity(out, ref, atol=2e-5):
+    """Field-for-field parity of two claim-batched ConsensusOutputs:
+    reliable/interval_valid EXACT, floats within interpret-mode float
+    tolerance (inf risks of all-quarantined claims compare equal)."""
+    np.testing.assert_array_equal(
+        np.asarray(out.reliable), np.asarray(ref.reliable)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.interval_valid), np.asarray(ref.interval_valid)
+    )
+    for field in (
+        "essence",
+        "essence_first_pass",
+        "reliability_first_pass",
+        "reliability_second_pass",
+        "quadratic_risk",
+    ):
+        np.testing.assert_allclose(
+            np.asarray(getattr(out, field)),
+            np.asarray(getattr(ref, field)),
+            atol=atol,
+            err_msg=field,
+        )
+    np.testing.assert_allclose(
+        np.asarray(out.skewness), np.asarray(ref.skewness), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.kurtosis), np.asarray(ref.kurtosis), atol=1e-3
+    )
+
+
+def _claim_cube(key, c, n, dim, constrained):
+    if constrained:
+        return jax.random.uniform(key, (c, n, dim), minval=0.01, maxval=0.99)
+    return 20.0 + 3.0 * jax.random.normal(key, (c, n, dim))
+
+
+GATED_CASES = [
+    # (C, N, n_failing, dim, constrained)
+    (4, 7, 2, 6, True),  # reference fleet, both pad-free
+    (4, 7, 2, 6, False),
+    (3, 16, 4, 3, True),  # C=3 exercises explicit padding below
+    (2, 256, 64, 6, True),  # multi-block rank loop (2 blocks of 128)
+]
+
+
+@pytest.mark.parametrize("c,n,f,dim,constrained", GATED_CASES)
+def test_gated_claims_matches_xla(c, n, f, dim, constrained):
+    """The full degenerate spectrum in ONE cube: a clean claim, a
+    partially quarantined claim (with a poisoned NaN row), an
+    all-quarantined claim (n_ok=0), and a single-survivor claim
+    (n_ok=1) — per-claim isolation means one cube covers them all."""
+    cfg = ConsensusConfig(
+        n_failing=f, constrained=constrained, max_spread=10.0
+    )
+    values = np.asarray(
+        _claim_cube(jax.random.PRNGKey(c * n + dim), c, n, dim, constrained)
+    ).astype(np.float32)
+    ok = np.ones((c, n), dtype=bool)
+    if c > 1:
+        ok[1, : max(1, n // 4)] = False  # partially quarantined
+        values[1, 0, :] = np.nan  # poisoned quarantined row
+    if c > 2:
+        ok[2, :] = False  # all quarantined: n_ok = 0
+    if c > 3:
+        ok[3, : n - 1] = False  # single survivor: n_ok = 1
+    claim_mask = np.ones(c, dtype=bool)
+    v, o, m = jnp.asarray(values), jnp.asarray(ok), jnp.asarray(claim_mask)
+    ref = consensus_step_gated_claims(v, o, m, cfg)
+    out = fused_consensus_gated_claims(v, o, m, cfg, interpret=True)
+    _assert_claims_parity(out, ref)
+    # The degenerate claims really are degenerate (guards the test).
+    valid = np.asarray(ref.interval_valid)
+    if c > 2:
+        assert not valid[2]
+    if c > 3:
+        assert not valid[3]
+
+
+def test_gated_claims_padding_rows_forced_inactive():
+    """pad_claim_cube's pow2 filler rows must come back invalid with
+    zero essence from the pallas path exactly as from XLA."""
+    from svoc_tpu.consensus.batch import pad_claim_cube
+
+    cfg = ConsensusConfig(n_failing=2, constrained=True)
+    rng = np.random.default_rng(7)
+    values = rng.uniform(0.01, 0.99, (3, 8, 4)).astype(np.float32)
+    padded, ok, claim_mask = pad_claim_cube(values)
+    assert padded.shape[0] == 4 and not claim_mask[3]
+    v, o, m = jnp.asarray(padded), jnp.asarray(ok), jnp.asarray(claim_mask)
+    ref = consensus_step_gated_claims(v, o, m, cfg)
+    out = fused_consensus_gated_claims(v, o, m, cfg, interpret=True)
+    _assert_claims_parity(out, ref)
+    assert not np.asarray(out.interval_valid)[3]
+    np.testing.assert_array_equal(np.asarray(out.essence)[3], 0.0)
+    assert not np.asarray(out.reliable)[3].any()
+
+
+def test_gated_claims_n_failing_guard():
+    """``n_failing >= N-1`` leaves < 2 reliable oracles: no consensus —
+    interval_valid False with a FINITE essence, on both impls."""
+    n = 8
+    cfg = ConsensusConfig(n_failing=n - 1, constrained=True)
+    values = jnp.asarray(
+        np.random.default_rng(1).uniform(0.1, 0.9, (2, n, 3)).astype(
+            np.float32
+        )
+    )
+    ok = jnp.ones((2, n), dtype=bool)
+    claim_mask = jnp.ones(2, dtype=bool)
+    ref = consensus_step_gated_claims(values, ok, claim_mask, cfg)
+    out = fused_consensus_gated_claims(
+        values, ok, claim_mask, cfg, interpret=True
+    )
+    _assert_claims_parity(out, ref)
+    assert not np.asarray(out.interval_valid).any()
+    assert np.isfinite(np.asarray(out.essence)).all()
+
+
+def test_gated_claims_tie_order_matches_cairo():
+    """Duplicate risks across the gated ranking: the stable
+    descending-index tiebreak must pick the same reliable sets as the
+    XLA lexsort, per claim."""
+    cfg = ConsensusConfig(n_failing=2, constrained=True)
+    base = np.array(
+        [[0.5], [0.5], [0.9], [0.9], [0.9], [0.5], [0.5]], np.float32
+    )
+    values = jnp.asarray(np.stack([base, base[::-1]]))
+    ok = jnp.asarray(np.ones((2, 7), dtype=bool))
+    claim_mask = jnp.ones(2, dtype=bool)
+    ref = consensus_step_gated_claims(values, ok, claim_mask, cfg)
+    out = fused_consensus_gated_claims(
+        values, ok, claim_mask, cfg, interpret=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.reliable), np.asarray(ref.reliable)
+    )
+
+
+# ---------------------------------------------------------------------------
+# No silent fallback (consensus_pallas_fallback{reason=}) and the
+# dispatch layer's impl routing
+# ---------------------------------------------------------------------------
+
+
+def _fallback_counts(registry):
+    return {
+        labels.get("reason"): count
+        for labels, count in registry.family_series(
+            "consensus_pallas_fallback"
+        )
+    }
+
+
+def test_fallback_counter_fleet_too_large(monkeypatch):
+    """Over the oracle cap the fused entry points serve XLA results AND
+    count the fallback — the bench subprocess must not stay the only
+    place a fallback is visible."""
+    from svoc_tpu.utils.metrics import registry as default_registry
+
+    monkeypatch.setenv("SVOC_PALLAS_MAX_ORACLES", "8")
+    before = _fallback_counts(default_registry).get("fleet_too_large", 0)
+    cfg = ConsensusConfig(n_failing=2, constrained=True)
+    values = jnp.asarray(
+        np.random.default_rng(2).uniform(0.1, 0.9, (2, 16, 3)).astype(
+            np.float32
+        )
+    )
+    ok = jnp.ones((2, 16), dtype=bool)
+    out = fused_consensus_gated_claims(
+        values, ok, jnp.ones(2, dtype=bool), cfg
+    )
+    ref = consensus_step_gated_claims(
+        values, ok, jnp.ones(2, dtype=bool), cfg
+    )
+    _assert_claims_parity(out, ref)
+    after = _fallback_counts(default_registry).get("fleet_too_large", 0)
+    assert after == before + 1
+
+
+def test_dispatch_pallas_route_counts_non_tpu(monkeypatch):
+    """A pallas-routed dispatch on a non-TPU backend without the
+    interpret opt-in serves XLA and counts reason=non_tpu into the
+    CALLER's registry (the router passes its own).  The backend is
+    pinned via monkeypatch so the assertion holds on a TPU host too."""
+    from svoc_tpu.consensus.batch import claims_consensus_gated
+    from svoc_tpu.utils.metrics import MetricsRegistry
+
+    monkeypatch.delenv("SVOC_PALLAS_INTERPRET", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    reg = MetricsRegistry()
+    cfg = ConsensusConfig(n_failing=2, constrained=True)
+    values = jnp.asarray(
+        np.random.default_rng(3).uniform(0.1, 0.9, (2, 8, 3)).astype(
+            np.float32
+        )
+    )
+    ok = jnp.ones((2, 8), dtype=bool)
+    mask = jnp.ones(2, dtype=bool)
+    out = claims_consensus_gated(
+        values, ok, mask, cfg, consensus_impl="pallas", metrics=reg
+    )
+    assert _fallback_counts(reg) == {"non_tpu": 1}
+    ref = consensus_step_gated_claims(values, ok, mask, cfg)
+    _assert_claims_parity(out, ref)
+
+
+def test_dispatch_pallas_route_with_interpret_opt_in(monkeypatch):
+    """With SVOC_PALLAS_INTERPRET=1 the pallas route actually runs the
+    kernel on CPU: no fallback counted, parity holds — this is the
+    `make pallas-parity` dispatch path.  The backend is pinned to CPU
+    so a TPU host exercises the same interpret path (a compiled-TPU
+    dispatch here would re-risk the known Mosaic compile hang inside
+    tier-1)."""
+    from svoc_tpu.consensus.batch import (
+        claims_consensus,
+        claims_consensus_gated,
+    )
+    from svoc_tpu.utils.metrics import MetricsRegistry
+
+    monkeypatch.setenv("SVOC_PALLAS_INTERPRET", "1")
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    reg = MetricsRegistry()
+    cfg = ConsensusConfig(n_failing=2, constrained=True)
+    values = jnp.asarray(
+        np.random.default_rng(4).uniform(0.1, 0.9, (2, 8, 3)).astype(
+            np.float32
+        )
+    )
+    ok = jnp.asarray(np.array([[True] * 8, [True] * 5 + [False] * 3]))
+    mask = jnp.ones(2, dtype=bool)
+    out = claims_consensus_gated(
+        values, ok, mask, cfg, consensus_impl="pallas", metrics=reg
+    )
+    ref = consensus_step_gated_claims(values, ok, mask, cfg)
+    _assert_claims_parity(out, ref)
+    # The ungated wrapper routes through the gated kernel with
+    # all-admitted masks — same outputs as the ungated XLA claims path
+    # on finite cubes.
+    from svoc_tpu.consensus.kernel import consensus_step_claims
+
+    out_u = claims_consensus(
+        values, mask, cfg, consensus_impl="pallas", metrics=reg
+    )
+    ref_u = consensus_step_claims(values, mask, cfg)
+    _assert_claims_parity(out_u, ref_u)
+    assert _fallback_counts(reg) == {}
+
+
+def test_router_resolves_impl_once(monkeypatch):
+    """ClaimRouter pins consensus_impl at construction (replay rule:
+    the impl choice is part of a seeded run's config)."""
+    from svoc_tpu.fabric.registry import ClaimRegistry
+    from svoc_tpu.fabric.router import ClaimRouter
+
+    monkeypatch.setenv("SVOC_CONSENSUS_IMPL", "pallas")
+    router = ClaimRouter(ClaimRegistry())
+    assert router.consensus_impl == "pallas"
+    monkeypatch.setenv("SVOC_CONSENSUS_IMPL", "xla")
+    assert router.consensus_impl == "pallas"  # pinned, not re-resolved
+    explicit = ClaimRouter(ClaimRegistry(), consensus_impl="xla")
+    assert explicit.consensus_impl == "xla"
+
+
+# ---------------------------------------------------------------------------
+# Typed env-knob parsing (no ValueError-at-import)
+# ---------------------------------------------------------------------------
+
+
+def test_env_knobs_raise_typed_errors(monkeypatch):
+    from svoc_tpu.consensus.dispatch import PallasConfigError, env_float
+    from svoc_tpu.ops import pallas_consensus as pc
+
+    monkeypatch.setenv("SVOC_PALLAS_MAX_ORACLES", "not-a-number")
+    with pytest.raises(PallasConfigError, match="SVOC_PALLAS_MAX_ORACLES"):
+        pc.pallas_max_oracles()
+    with pytest.raises(PallasConfigError, match="SVOC_PALLAS_MAX_ORACLES"):
+        _ = pc.PALLAS_MAX_ORACLES  # lazy module attr, same validation
+    monkeypatch.setenv("SVOC_PALLAS_MAX_ORACLES", "0")
+    with pytest.raises(PallasConfigError, match="minimum"):
+        pc.pallas_max_oracles()
+    monkeypatch.setenv("SVOC_PALLAS_MAX_ORACLES", "512")
+    assert pc.PALLAS_MAX_ORACLES == 512
+
+    monkeypatch.setenv("SVOC_PALLAS_TIMEOUT", "soon")
+    with pytest.raises(PallasConfigError, match="SVOC_PALLAS_TIMEOUT"):
+        env_float("SVOC_PALLAS_TIMEOUT", 300.0, minimum=1e-3)
+
+
+def test_resolve_consensus_impl_rejection_names_allowed_values(monkeypatch):
+    from svoc_tpu.consensus.dispatch import (
+        ConsensusImplError,
+        resolve_consensus_impl,
+    )
+
+    monkeypatch.setenv("SVOC_CONSENSUS_IMPL", "cuda")
+    with pytest.raises(ConsensusImplError) as err:
+        resolve_consensus_impl()
+    message = str(err.value)
+    assert "'xla'" in message and "'pallas'" in message
+    assert "SVOC_CONSENSUS_IMPL" in message
