@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke fabric-smoke serving-smoke crash-smoke pallas-parity clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving bench-shard campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke fabric-smoke serving-smoke crash-smoke shard-smoke pallas-parity clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -109,6 +109,15 @@ pallas-parity:
 	JAX_PLATFORMS=cpu SVOC_PALLAS_INTERPRET=1 \
 	$(PY) -m pytest tests/test_pallas_consensus.py -q -m 'not slow'
 
+# Sharded claim-cube gate (docs/PARALLELISM.md §sharded-claims): the
+# seeded fabric scenario on a pinned 2x4 (claim x oracle) mesh over 8
+# simulated CPU devices, twice — byte-identical per-claim journal
+# fingerprints — plus an unmeshed run whose fingerprints must MATCH
+# the meshed ones (the sharded dispatch is bitwise-exact), nonzero
+# sharded dispatches, zero fallbacks.  Seconds on CPU.
+shard-smoke:
+	$(PY) tools/shard_smoke.py
+
 # Crash-consistency gate (docs/RESILIENCE.md §durability): the seeded
 # serving scenario SIGKILLed at 3 fault points (mid-WAL-append,
 # between tx i and i+1, post-commit pre-snapshot) in subprocesses,
@@ -124,7 +133,7 @@ crash-smoke:
 # convergence gates (I/O-plane, then data-plane), then the flight
 # recorder, then the fabric and serving tiers, then crash consistency,
 # then the suite.
-verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke fabric-smoke serving-smoke crash-smoke test
+verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke fabric-smoke shard-smoke serving-smoke crash-smoke test
 
 # End-of-round gate: lint + the driver-contract guards FIRST (fast,
 # loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
@@ -137,6 +146,7 @@ presnapshot:
 	$(MAKE) robustness-smoke
 	$(MAKE) obs-smoke
 	$(MAKE) fabric-smoke
+	$(MAKE) shard-smoke
 	$(MAKE) serving-smoke
 	$(MAKE) crash-smoke
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -152,6 +162,14 @@ bench:
 # BENCH_SERVING.json (p50/p99 latency, goodput, shed rate, knee).
 bench-serving:
 	$(PY) bench_serving.py
+
+# Sharded claim-cube mesh sweep (docs/PARALLELISM.md §sharded-claims):
+# 1/2/4/8 simulated devices at fixed total work, each point a
+# subprocess with the device count forced, in-run bitwise parity →
+# BENCH_SHARD_r07.json (scaling verdict is an honest null on hosts
+# whose cores can't back the simulated devices).
+bench-shard:
+	$(PY) bench.py --shard-sweep --claims 64 --claims-oracles 256
 
 # Round-long liveness-gated hardware measurement campaign (resumes its
 # HW_CAMPAIGN.json journal; run in the background for the whole round).

@@ -328,10 +328,13 @@ def main(argv=None) -> int:
         ),
     }
     ok = all(checks.values())
+    from bench import device_topology
+
     artifact = {
         "seed": args.seed,
         "vectorizer": args.vectorizer,
         "claims": args.claims,
+        "device_topology": device_topology(),
         "steps_per_level": args.steps,
         "knee_qps": knee,
         "p99_bound_ms": p99_bound_ms,

@@ -558,7 +558,10 @@ class CommandConsole:
                 snapshot = self.fabric.snapshot()
                 emit(
                     f"fabric: {snapshot['n_claims']} claims, "
-                    f"{snapshot['steps']} steps"
+                    f"{snapshot['steps']} steps, "
+                    f"impl={snapshot.get('consensus_impl', 'xla')}, "
+                    f"mesh={snapshot.get('mesh') or 'none'}"
+                    + (" pipelined" if snapshot.get("pipelined") else "")
                 )
                 for claim_id in sorted(snapshot["claims"]):
                     c = snapshot["claims"][claim_id]

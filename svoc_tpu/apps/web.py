@@ -408,7 +408,15 @@ class _Handler(BaseHTTPRequestHandler):
             # outcome, supervisor health, and block lineage.
             fabric = getattr(self.console, "fabric", None)
             if fabric is not None:
-                payload["claims"] = fabric.claims_state()
+                # One snapshot serves both sections: the per-claim map
+                # and the fabric's pinned dispatch routing
+                # (docs/FABRIC.md §mesh — consensus_impl, claim mesh,
+                # pipelining), so a pull-mode deployment surfaces the
+                # routing even without a serving tier attached and a
+                # future snapshot field never needs a second edit here.
+                fabric_snapshot = fabric.snapshot()
+                payload["claims"] = fabric_snapshot.pop("claims")
+                payload["fabric"] = fabric_snapshot
             # Serving tier (docs/SERVING.md): queues, admission
             # accounting, cache stats, live burn rate, and the
             # request-latency percentiles — the operator's saturation

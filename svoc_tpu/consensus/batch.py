@@ -211,19 +211,29 @@ def _certify(
 # ---------------------------------------------------------------------------
 
 
-def pow2_bucket(n: int, floor: int = 1) -> int:
+def pow2_bucket(n: int, floor: int = 1, multiple_of: int = 1) -> int:
     """Smallest power of two ≥ ``n`` (and ≥ ``floor``) — the claim
     router's micro-batch bucketing.  Claim counts change every
     scheduling tick (claims pause, registries grow); jitting the cube
     at the RAW count would recompile the consensus program per distinct
     count (the svoclint SVOC003 recompile hazard the prefix sweep's
     ``inter_ks`` bucketing already kills) — bucketing pins the compile
-    count at log₂(max claims)."""
+    count at log₂(max claims).
+
+    ``multiple_of`` additionally rounds the bucket up to a multiple of
+    the claim mesh's claim-axis size (docs/PARALLELISM.md
+    §sharded-claims: shard_map needs ``C % mesh_claims == 0``).  It is
+    fixed per process (the mesh is pinned at router construction), so
+    the bucket set stays pow2-derived and the compile count bounded."""
     if n < 0:
         raise ValueError("n must be >= 0")
+    if multiple_of < 1:
+        raise ValueError("multiple_of must be >= 1")
     bucket = max(1, int(floor))
     while bucket < n:
         bucket *= 2
+    if bucket % multiple_of:
+        bucket = ((bucket + multiple_of - 1) // multiple_of) * multiple_of
     return bucket
 
 
@@ -234,17 +244,25 @@ _PAD_VALUE = 0.5
 
 
 def pad_claim_cube(
-    values: np.ndarray, ok: Optional[np.ndarray] = None, floor: int = 1
+    values: np.ndarray,
+    ok: Optional[np.ndarray] = None,
+    floor: int = 1,
+    multiple_of: int = 1,
 ):
     """Pad a claim cube ``[C, N, M]`` (and its admission masks
     ``[C, N]``) to the pow2-bucketed claim count.
 
     Returns ``(values [B, N, M], ok [B, N], claim_mask [B])`` with
-    ``B = pow2_bucket(C, floor)``: padding claims carry the neutral
-    fill with all-admitted masks and ``claim_mask=False`` — the kernel
-    invalidates their outputs (``interval_valid=False``, zero essence)
-    so the router can slice the first ``C`` rows and never observe
-    filler."""
+    ``B = pow2_bucket(C, floor, multiple_of)``: padding claims carry
+    the neutral fill with all-admitted masks and ``claim_mask=False``
+    — the kernel invalidates their outputs (``interval_valid=False``,
+    zero essence) so the router can slice the first ``C`` rows and
+    never observe filler.  ``multiple_of`` is the mesh claim-axis size
+    when the cube dispatches sharded
+    (:mod:`svoc_tpu.parallel.claim_shard`); the padded rows ride the
+    sharded path through the SAME ``_mask_padded_claims`` the
+    single-device kernel applies, so they stay inactive there too
+    (pinned in ``tests/test_claim_shard.py``)."""
     values = np.asarray(values, dtype=np.float32)
     if values.ndim != 3:
         raise ValueError(f"claim cube must be [C, N, M], got {values.shape}")
@@ -254,7 +272,7 @@ def pad_claim_cube(
     ok = np.asarray(ok, dtype=bool)
     if ok.shape != (c, n):
         raise ValueError(f"ok must be [C, N]={c, n}, got {ok.shape}")
-    bucket = pow2_bucket(c, floor)
+    bucket = pow2_bucket(c, floor, multiple_of)
     claim_mask = np.zeros(bucket, dtype=bool)
     claim_mask[:c] = True
     if bucket == c:

@@ -162,6 +162,31 @@ def pallas_interpret_opt_in() -> bool:
     return os.environ.get(PALLAS_INTERPRET_ENV) == "1"
 
 
+#: ``SVOC_MESH=<claims>x<oracles>`` — operator override for the claim
+#: mesh (kept in sync with ``svoc_tpu.parallel.mesh.CLAIM_MESH_ENV``;
+#: duplicated literal so this resolver keeps importing no jax).
+CLAIM_MESH_ENV = "SVOC_MESH"
+
+
+def resolve_claim_mesh(path: Optional[str] = None) -> Optional[str]:
+    """The claim-cube MESH routing twin of
+    :func:`resolve_consensus_impl`: ``SVOC_MESH`` env > the committed
+    ``PERF_DECISIONS.json`` ``claim_mesh`` record (written by
+    ``tools/decide_perf.py`` from a measured ``BENCH_SHARD`` sweep,
+    never by hand) > ``None`` (unsharded single-device dispatch).
+
+    Returns the raw ``"<claims>x<oracles>"`` spec string or ``None``;
+    :func:`svoc_tpu.parallel.mesh.claim_mesh` validates and builds the
+    mesh.  Resolved ONCE per :class:`ClaimRouter` construction — the
+    mesh, like the impl, is part of a seeded replay's config
+    (docs/FABRIC.md §mesh) and must not drift mid-run.
+    """
+    value, _source = perf_decision("claim_mesh", "", CLAIM_MESH_ENV, path=path)
+    if not value or str(value).strip().lower() in ("none", "off"):
+        return None
+    return str(value)
+
+
 # ---------------------------------------------------------------------------
 # Fallback accounting: no silent XLA fallbacks.
 # ---------------------------------------------------------------------------
@@ -188,7 +213,11 @@ def report_pallas_fallback(
     ``unaligned_fleet`` (fleet not a multiple of the rank block),
     ``smooth_mode`` (non-cairo median), ``non_tpu`` (no TPU backend and
     no ``SVOC_PALLAS_INTERPRET=1`` opt-in), ``mosaic_error`` (the
-    kernel raised at lowering/compile/run time).
+    kernel raised at lowering/compile/run time),
+    ``sharded_unsupported`` (a pallas route on a claim mesh whose
+    oracle axis is sharded — partial fleets cannot feed the fused
+    kernel, the XLA sharded body serves instead;
+    :mod:`svoc_tpu.parallel.claim_shard`).
     """
     (metrics or _default_registry).counter(
         FALLBACK_COUNTER, labels={"reason": reason}

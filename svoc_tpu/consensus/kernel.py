@@ -153,7 +153,12 @@ def consensus_step_gated(
 
     Semantics with ``ok = ones(N)`` are identical to
     :func:`consensus_step` (equivalence-tested in
-    ``tests/test_robustness.py``).
+    ``tests/test_robustness.py``).  This function is also the ONE
+    per-claim program of the mesh-sharded claim cube
+    (:mod:`svoc_tpu.parallel.claim_shard` vmaps it over the gathered
+    block) — sharded-vs-single parity is bitwise because there is one
+    implementation, not two that agree; restructuring these ops changes
+    XLA's fusion rounding and breaks the 0.0 parity bar.
     """
     n, dim = values.shape
     # Neutral fill: quarantined rows are masked out of every reduction

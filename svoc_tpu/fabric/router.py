@@ -45,8 +45,12 @@ from svoc_tpu.consensus.batch import (
     claims_consensus_gated,
     claims_consensus_sanitized,
     pad_claim_cube,
+    pow2_bucket,
 )
-from svoc_tpu.consensus.dispatch import resolve_consensus_impl
+from svoc_tpu.consensus.dispatch import (
+    resolve_claim_mesh,
+    resolve_consensus_impl,
+)
 from svoc_tpu.fabric.registry import ClaimRegistry, ClaimState
 from svoc_tpu.io.chain import ChainCommitError
 from svoc_tpu.resilience.breaker import CircuitOpenError
@@ -65,6 +69,23 @@ def resolve_journal(journal):
     return default_journal
 
 
+class _PendingGroup:
+    """One in-flight claim-cube dispatch: the device outputs plus the
+    per-claim context (lineage, admission source) captured at dispatch
+    time, so the pipelined write-back one cycle later journals against
+    the RIGHT blocks even after the sessions fetched new ones."""
+
+    __slots__ = ("members", "cfg", "out", "oks", "bucket", "lineages")
+
+    def __init__(self, members, cfg, out, oks, bucket, lineages):
+        self.members = members
+        self.cfg = cfg
+        self.out = out
+        self.oks = oks
+        self.bucket = bucket
+        self.lineages = lineages
+
+
 class ClaimRouter:
     """Multiplexes fetch → vectorize → consensus → commit across the
     registry's claims.  ``step()`` is the single-threaded scheduling
@@ -80,6 +101,8 @@ class ClaimRouter:
         journal=None,
         sanitized_dispatch: bool = False,
         consensus_impl: Optional[str] = None,
+        mesh=None,
+        pipelined: bool = False,
     ):
         if max_claims_per_batch < 1:
             raise ValueError("max_claims_per_batch must be >= 1")
@@ -101,6 +124,30 @@ class ClaimRouter:
             if consensus_impl is not None
             else resolve_consensus_impl()
         )
+        #: The 2-D (claim × oracle) dispatch mesh, resolved ONCE at
+        #: construction like the impl above (``SVOC_MESH`` env > the
+        #: committed PERF_DECISIONS.json ``claim_mesh`` record > no
+        #: mesh) — the mesh is part of a seeded replay's config
+        #: (docs/FABRIC.md §mesh) and is surfaced in
+        #: ``MultiSession.snapshot()`` / ``ServingTier.snapshot()`` /
+        #: ``/api/state``.  Accepts a ``"<claims>x<oracles>"`` spec,
+        #: a prebuilt :class:`jax.sharding.Mesh`, ``"off"`` (explicitly
+        #: unsharded), or None (resolve).  The sharded path is
+        #: bitwise-exact vs the single-device cube
+        #: (docs/PARALLELISM.md §sharded-claims), so pinning a mesh
+        #: does not change seeded-smoke fingerprints.
+        self._shard = self._build_shard(mesh)
+        self.mesh_spec = self._shard.spec_str if self._shard else None
+        #: Double-buffered dispatch (docs/PARALLELISM.md
+        #: §sharded-claims, pipelining): the claim-cube consensus for
+        #: cycle k-1 executes on device while the host fetches (and
+        #: commits) cycle k — its write-back (state.last_consensus,
+        #: ``fabric.consensus`` events) lands one cycle later, drained
+        #: by :meth:`flush`.  Pull-mode only: request-driven feeds need
+        #: same-cycle accounting.  Off by default — the PR 6 cycle (and
+        #: its smoke fingerprints) is byte-identical when off.
+        self.pipelined = pipelined
+        self._inflight: List[_PendingGroup] = []
         #: Fuse gate + consensus into ONE traced program per micro-batch
         #: (:func:`svoc_tpu.consensus.batch.claims_consensus_sanitized`)
         #: instead of reusing the host gate's per-claim verdicts.  The
@@ -128,6 +175,28 @@ class ClaimRouter:
 
     def _resolve_journal(self):
         return resolve_journal(self._journal)
+
+    def _build_shard(self, mesh):
+        """Resolve + pin the claim mesh (constructor-only).  Returns a
+        :class:`~svoc_tpu.parallel.claim_shard.ClaimShardDispatcher`
+        or None for the single-device path."""
+        from jax.sharding import Mesh
+
+        from svoc_tpu.parallel.claim_shard import ClaimShardDispatcher
+        from svoc_tpu.parallel.mesh import claim_mesh
+
+        if isinstance(mesh, ClaimShardDispatcher):
+            return mesh
+        if not isinstance(mesh, Mesh):
+            spec = mesh if mesh is not None else resolve_claim_mesh()
+            mesh = claim_mesh(spec)
+            if mesh is None:
+                return None
+        return ClaimShardDispatcher(
+            mesh,
+            consensus_impl=self.consensus_impl,
+            metrics=self._metrics,
+        )
 
     # -- scheduling ---------------------------------------------------------
 
@@ -208,6 +277,12 @@ class ClaimRouter:
         ITS ``fabric_claim_errors{claim=,stage="fetch"}`` and its
         siblings are still served.  ``feeds=None`` is the PR 6
         pull-mode cycle, byte-for-byte unchanged."""
+        if self.pipelined and feeds is not None:
+            raise ValueError(
+                "pipelined dispatch is pull-mode only: request-driven "
+                "feeds need same-cycle consensus accounting "
+                "(docs/PARALLELISM.md §sharded-claims)"
+            )
         report = self._step_inner(feeds=feeds)
         for hook in list(self.post_step_hooks):
             try:
@@ -215,6 +290,17 @@ class ClaimRouter:
             except Exception:  # noqa: BLE001 — a hook must not kill serving
                 self._metrics.counter("fabric_hook_errors").add(1)
         return report
+
+    def flush(self) -> int:
+        """Drain the pipelined in-flight consensus write-backs (the
+        pipeline's one-cycle tail); returns how many groups were
+        finished.  A no-op when unpipelined or already drained."""
+        pending, self._inflight = self._inflight, []
+        if pending:
+            with stage_span("fabric_consensus"):
+                for group in pending:
+                    self._finish_group(group)
+        return len(pending)
 
     def _step_inner(
         self, feeds: Optional[Dict[str, Any]] = None
@@ -286,9 +372,27 @@ class ClaimRouter:
             spec = state.spec
             key = (spec.n_oracles, spec.dimension, spec.consensus_config())
             groups.setdefault(key, []).append(state)
-        with stage_span("fabric_consensus"):
-            for (_n, _m, cfg), members in groups.items():
-                self._consensus_group(members, cfg)
+        if self.pipelined:
+            # Double-buffered dispatch: enqueue cycle k's cubes (async,
+            # no host sync), THEN resolve cycle k-1's — its collectives
+            # executed on device while this cycle's blocks were being
+            # fetched on the host.  The commit below still commits
+            # cycle k's blocks (the chain path never consumed the cube
+            # outputs); only state.last_consensus and the
+            # ``fabric.consensus`` events trail one cycle, against the
+            # lineages captured at dispatch.
+            dispatched = [
+                self._dispatch_group(members, cfg)
+                for (_n, _m, cfg), members in groups.items()
+            ]
+            pending, self._inflight = self._inflight, dispatched
+            with stage_span("fabric_consensus"):
+                for group in pending:
+                    self._finish_group(group)
+        else:
+            with stage_span("fabric_consensus"):
+                for (_n, _m, cfg), members in groups.items():
+                    self._finish_group(self._dispatch_group(members, cfg))
 
         # ---- commit + supervise + SLO, claim by claim ----
         for state in fetched:
@@ -301,16 +405,25 @@ class ClaimRouter:
             }
         return report
 
-    def _consensus_group(self, members: List[ClaimState], cfg) -> None:
-        """Run the fused gated consensus over one shape/config group and
-        write each member's per-claim slice back."""
-        sessions = [s.session for s in members]
+    def _dispatch_group(
+        self, members: List[ClaimState], cfg
+    ) -> _PendingGroup:
+        """Collect one shape/config group's blocks and issue ONE fused
+        gated consensus dispatch — device outputs only, no host sync
+        (the pipelined mode's overlap window lives between this and
+        :meth:`_finish_group`).  Routes through the pinned claim mesh
+        when one is configured; the sharded program is bitwise-exact
+        vs the single-device one (docs/PARALLELISM.md §sharded-claims),
+        so the route never changes results or fingerprints."""
+        lineages = []
         blocks = []
         oks = []
-        for session in sessions:
+        for state in members:
+            session = state.session
             with session.lock:
                 predictions = session.predictions
                 quarantine = session.last_quarantine
+                lineages.append(session.last_lineage)
             blocks.append(np.asarray(predictions, dtype=np.float32))
             oks.append(
                 np.asarray(quarantine.ok, dtype=bool)
@@ -318,8 +431,17 @@ class ClaimRouter:
                 else np.ones(predictions.shape[0], dtype=bool)
             )
         values, ok, claim_mask = pad_claim_cube(
-            np.stack(blocks), np.stack(oks)
+            np.stack(blocks),
+            np.stack(oks),
+            multiple_of=self._shard.claim_size if self._shard else 1,
         )
+        # The journaled batch_bucket is the MESH-INDEPENDENT pow2
+        # bucket, not values.shape[0]: mesh padding (multiple_of above)
+        # can grow the dispatched cube (e.g. 2 claims on a 4-wide or
+        # 3-wide claim axis), and the fabric.consensus event data must
+        # not depend on where the cube computed — the meshed==unmeshed
+        # fingerprint identity (make shard-smoke) is a contract.
+        journal_bucket = pow2_bucket(len(members))
         if self.sanitized_dispatch:
             # Gate + consensus in ONE traced program: the in-graph
             # quarantine twin recomputes the admission masks (identical
@@ -331,18 +453,25 @@ class ClaimRouter:
             from svoc_tpu.robustness.sanitize import SanitizeConfig
 
             bounds = SanitizeConfig.for_consensus(cfg.constrained)
-            out, ok_traced = claims_consensus_sanitized(
-                jnp.asarray(values),
-                jnp.asarray(claim_mask),
-                cfg,
-                bounds.lo,
-                bounds.hi,
-                consensus_impl=self.consensus_impl,
-                metrics=self._metrics,
-            )
-            # The traced masks become the accounting source below (one
-            # fetch covers them along with the outputs).
-            oks = list(np.asarray(ok_traced)[: len(members)])  # svoclint: disable=SVOC001
+            if self._shard is not None:
+                out, ok_traced = self._shard.dispatch_sanitized(
+                    values, claim_mask, cfg, bounds.lo, bounds.hi
+                )
+            else:
+                out, ok_traced = claims_consensus_sanitized(
+                    jnp.asarray(values),
+                    jnp.asarray(claim_mask),
+                    cfg,
+                    bounds.lo,
+                    bounds.hi,
+                    consensus_impl=self.consensus_impl,
+                    metrics=self._metrics,
+                )
+            # The traced masks become the accounting source (fetched in
+            # _finish_group along with the outputs).
+            oks = ok_traced
+        elif self._shard is not None:
+            out = self._shard.dispatch_gated(values, ok, claim_mask, cfg)
         else:
             out = claims_consensus_gated(
                 jnp.asarray(values),
@@ -352,6 +481,20 @@ class ClaimRouter:
                 consensus_impl=self.consensus_impl,
                 metrics=self._metrics,
             )
+        return _PendingGroup(
+            members, cfg, out, oks, journal_bucket, lineages
+        )
+
+    def _finish_group(self, pending: _PendingGroup) -> None:
+        """Host-sync one dispatched group and write each member's
+        per-claim slice back (consensus state, journal, metrics)."""
+        members = pending.members
+        out = pending.out
+        oks = pending.oks
+        if not isinstance(oks, list):
+            # Sanitized dispatch: the traced in-graph masks (still on
+            # device, padded to the bucket) are the accounting source.
+            oks = list(np.asarray(oks)[: len(members)])  # svoclint: disable=SVOC001
         # ONE host sync for the whole micro-batch — the claim axis
         # amortizes the dispatch/fetch overhead that a per-claim loop
         # pays C times (bench.py --claims).
@@ -362,11 +505,9 @@ class ClaimRouter:
         reliable = np.asarray(out.reliable)
         valid = np.asarray(out.interval_valid)
         journal = self._resolve_journal()
-        bucket = int(values.shape[0])
+        bucket = pending.bucket
         for i, state in enumerate(members):
-            session = state.session
-            with session.lock:
-                lineage = session.last_lineage
+            lineage = pending.lineages[i]
             n_admitted = int(np.sum(oks[i]))
             slice_ = {
                 "essence": [round(float(x), 6) for x in essence[i]],

@@ -89,11 +89,20 @@ def run_fabric_scenario(
     dimension: int = 6,
     journal=None,
     metrics=None,
+    mesh=None,
+    pipelined: bool = False,
 ) -> Dict[str, Any]:
     """One seeded fabric run; returns per-claim fingerprints, isolation
     accounting, and the injection log.  Pure function of ``seed`` (plus
     the shape arguments) — ``tools/fabric_smoke.py`` runs it twice and
-    asserts the fingerprints match byte-for-byte."""
+    asserts the fingerprints match byte-for-byte.
+
+    ``mesh`` pins the 2-D claim-cube dispatch mesh
+    (``"<claims>x<oracles>"``, docs/FABRIC.md §mesh — the shard-smoke
+    gate runs this scenario meshed and unmeshed and asserts IDENTICAL
+    per-claim fingerprints, the sharded path being bitwise-exact);
+    ``pipelined`` turns on the double-buffered dispatch (its own
+    fingerprint family: consensus events land one cycle later)."""
     from svoc_tpu.io.comment_store import CommentStore
     from svoc_tpu.io.scraper import SyntheticSource
     from svoc_tpu.utils.events import EventJournal
@@ -138,6 +147,8 @@ def run_fabric_scenario(
         metrics=metrics,
         lineage_scope="fab",
         max_claims_per_batch=n_claims,
+        mesh=mesh,
+        pipelined=pipelined,
     )
     for name in names:
         multi.add_claim(

@@ -54,6 +54,8 @@ class MultiSession:
         max_claims_per_batch: int = 8,
         sanitized_dispatch: bool = False,
         consensus_impl: Optional[str] = None,
+        mesh=None,
+        pipelined: bool = False,
         clock: Optional[Callable[[], float]] = None,
         adapter_factory=None,
     ):
@@ -86,6 +88,12 @@ class MultiSession:
         #: want a non-default impl must pass it explicitly — the impl
         #: choice is part of the replay's config (docs/FABRIC.md
         #: §replay), like the fresh journal/registry/pinned scope.
+        #: ``mesh`` pins the 2-D (claim × oracle) dispatch mesh the
+        #: same way (``"<claims>x<oracles>"`` | jax Mesh | ``"off"``;
+        #: None = ``SVOC_MESH`` env > PERF_DECISIONS.json > unsharded
+        #: — docs/FABRIC.md §mesh), and ``pipelined`` turns on the
+        #: double-buffered pull-mode dispatch (consensus k-1 overlaps
+        #: fetch k; drain with :meth:`flush`).
         self.router = ClaimRouter(
             self.registry,
             max_claims_per_batch=max_claims_per_batch,
@@ -93,6 +101,8 @@ class MultiSession:
             journal=journal,
             sanitized_dispatch=sanitized_dispatch,
             consensus_impl=consensus_impl,
+            mesh=mesh,
+            pipelined=pipelined,
         )
         for spec in specs:
             self.add_claim(spec)
@@ -224,8 +234,17 @@ class MultiSession:
         return self.router.step(feeds=feeds)
 
     def run(self, cycles: int) -> List[Dict]:
-        """``cycles`` steps; returns the per-step reports."""
-        return [self.step() for _ in range(cycles)]
+        """``cycles`` steps; returns the per-step reports.  A pipelined
+        router drains its one-cycle consensus tail afterwards, so the
+        last cycle's write-backs are visible to the caller."""
+        reports = [self.step() for _ in range(cycles)]
+        self.flush()
+        return reports
+
+    def flush(self) -> int:
+        """Drain pipelined in-flight consensus write-backs
+        (:meth:`ClaimRouter.flush`); no-op when unpipelined."""
+        return self.router.flush()
 
     # -- views ---------------------------------------------------------------
 
@@ -240,6 +259,13 @@ class MultiSession:
         return {
             "steps": self.router.steps,
             "n_claims": len(self.registry),
+            # The pinned dispatch routing (docs/FABRIC.md §mesh): an
+            # operator can tell a mesh-sharded box from a single-device
+            # one — and a pallas-routed one from XLA — straight from
+            # /api/state.
+            "consensus_impl": self.router.consensus_impl,
+            "mesh": self.router.mesh_spec,
+            "pipelined": self.router.pipelined,
             "claims": self.claims_state(),
         }
 

@@ -1,0 +1,694 @@
+"""2-D (claim × oracle) sharded claim-cube consensus + fleet generation.
+
+ROADMAP item 4 made real for the fabric: the ``[C, N, M]`` gated claim
+cube of docs/FABRIC.md — until now a single-device dispatch — shards
+over a 2-D mesh (:func:`svoc_tpu.parallel.mesh.claim_mesh`,
+``SVOC_MESH=<claims>x<oracles>``):
+
+- **claim axis** — pure data parallelism: claims are independent
+  markets, so there are ZERO cross-claim collectives; a ``claim``-axis
+  shard serves ``C / mesh_claims`` claims and never sees its siblings.
+- **oracle axis** — the :mod:`svoc_tpu.parallel.sharded` all_gather
+  discipline generalized to carry the claim axis and the PR 4/6
+  quarantine masks ``ok[C, N]``: the two-pass estimator's medians and
+  rank mask need a global per-claim view, so the body all-gathers the
+  ``[Cl, N, M]`` block over the oracle axis (KBs per claim — rides
+  ICI) and runs the LITERAL single-device gated kernel on it, while
+  the per-oracle bootstrap fleet generation and the at-rest cube
+  storage stay on the device-local ``N / mesh_oracles`` shard.
+
+**Exact-parity contract.** Sharded-vs-single parity on the DISPATCH
+path is BITWISE (``parity_max_abs_diff == 0.0``, the ``bench.py
+--claims C --mesh CxO`` acceptance bar).  That bar is unforgiving:
+float addition is non-associative, so psum-of-partial-sums reductions
+(the ``sharded.py`` body shape) differ from the single-device
+reduction in the last ulp — and it is not just reduction order:
+merely *adding* an ``all_gather``/``dynamic_slice`` around the
+otherwise-identical kernel changes XLA's fusion rounding (a measured
+one-ulp ``reliability_second_pass`` divergence on the constrained
+config killed two drafts of this body, including an
+``optimization_barrier``-fenced one).  Therefore:
+
+- :func:`sharded_claims_consensus_fn` — the fabric's host-fed cube
+  dispatch — partitions the CLAIM axis only: each shard runs the
+  literal :func:`consensus_step_gated_batched` program on its
+  ``[Cl, N, M]`` slice with zero collectives in the body, so the
+  compiled per-claim math is the single-device program and parity is
+  exact by construction (pinned in ``tests/test_claim_shard.py``).
+  The oracle axis replicates a host-fed block — partitioning it buys
+  a host-fed dispatch nothing and measurably breaks bitwise parity.
+- :func:`sharded_fleet_claims_fn` — the simulation path, where the
+  cube is BORN on device — shards generation over both axes and
+  all-gathers each claim's ``[N, M]`` block for the consensus (the
+  arxiv 2112.09017 on-chip-block regime); its parity contract is the
+  ``_fleet_body`` one: results are bitwise INVARIANT across mesh
+  factorizations (1x1 included), not bitwise-equal to the separately
+  compiled host-path program.
+
+This is the arxiv 2004.13336 partition split applied with the
+opposite emphasis: the replicated computation (per-claim consensus
+over KB-sized blocks) is cheap, so it is the per-oracle generation
+work and the cube's at-rest footprint that get partitioned — and the
+claim axis, with zero cross-claim collectives, that carries the
+throughput scaling.
+
+**Sharded fleet generation.** No replica ever materializes the full
+``[C, N, M]`` cube: each device generates only its local
+``[Cl, Nl, M]`` bootstrap-resample block, keyed by GLOBAL claim and
+oracle indices (:func:`svoc_tpu.sim.generators.claim_fleet_keys`,
+crc32-salted ``fold_in`` — the ``_fleet_body`` contract of
+``parallel/sharded.py``) so the fleet is bitwise identical however it
+is sharded.  The gathered per-claim ``[N, M]`` median block is the
+largest array any replica holds: ``C/mesh_claims × N × M`` floats,
+``1/mesh_claims`` of the cube.
+
+``consensus_impl`` composition (docs/FABRIC.md §consensus_impl): the
+Pallas fused kernel runs PER-SHARD inside shard_map when the oracle
+axis is unsharded (``mesh_oracles == 1`` — each shard then holds whole
+fleets for its claims); an oracle-sharded mesh cannot feed it partial
+fleets, so a pallas route there is a counted
+``consensus_pallas_fallback{reason="sharded_unsupported"}`` and the XLA
+body serves, never silently.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from svoc_tpu.consensus.kernel import (
+    ConsensusConfig,
+    ConsensusOutput,
+    _mask_padded_claims,
+    consensus_step_gated_batched,
+)
+from svoc_tpu.parallel.mesh import CLAIM_AXIS, ORACLE_AXIS
+from svoc_tpu.parallel.sharded import shard_map
+from svoc_tpu.robustness.sanitize import (
+    quarantine_mask_claims,
+    quarantine_mask_jax,
+)
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_registry
+
+_log = logging.getLogger("svoc_tpu.parallel.claim_shard")
+
+#: Counter for cube dispatches the mesh cannot shard (a fleet size not
+#: divisible by the oracle axis, a claim count the caller failed to pad
+#: — see :func:`svoc_tpu.consensus.batch.pad_claim_cube`'s
+#: ``multiple_of``): the dispatch falls back to the single-device cube
+#: and is COUNTED, never silent — the ``shard-smoke`` gate asserts this
+#: stays at zero for a mesh-pinned scenario.
+SHARD_FALLBACK_COUNTER = "claim_shard_fallback"
+#: Counter for dispatches the mesh actually served (the smoke's
+#: "sharding really ran" witness).
+SHARD_DISPATCH_COUNTER = "claim_shard_dispatches"
+
+
+def claims_out_specs(oracle_sharded: bool = False) -> ConsensusOutput:
+    """PartitionSpecs of the shard-mapped claim cube: per-claim fields
+    sharded over the claim axis; per-oracle fields over both axes on
+    the fleet path (``oracle_sharded=True``), claim-only on the
+    host-fed dispatch path."""
+    per_oracle = (
+        P(CLAIM_AXIS, ORACLE_AXIS) if oracle_sharded else P(CLAIM_AXIS, None)
+    )
+    return ConsensusOutput(
+        essence=P(CLAIM_AXIS),
+        essence_first_pass=P(CLAIM_AXIS),
+        reliability_first_pass=P(CLAIM_AXIS),
+        reliability_second_pass=P(CLAIM_AXIS),
+        reliable=per_oracle,
+        quadratic_risk=per_oracle,
+        skewness=P(CLAIM_AXIS),
+        kurtosis=P(CLAIM_AXIS),
+        interval_valid=P(CLAIM_AXIS),
+    )
+
+
+def _host_cube_body(cfg: ConsensusConfig, gate=None):
+    """shard_map body of the host-fed cube dispatch: ``[Cl, N, M]``
+    claim slices through the LITERAL single-device batched kernel —
+    zero collectives, so the compiled per-claim math (and therefore
+    every output bit) matches the single-device program (the
+    exact-parity contract in the module docstring).  ``gate=(lo, hi)``
+    fuses the in-graph quarantine twin (the
+    ``claims_consensus_sanitized`` composition) — each shard holds its
+    claims' full blocks, so the gate needs no collective either."""
+
+    def body(values_local, ok_local, claim_mask_local):
+        if gate is not None:
+            ok_local = quarantine_mask_claims(
+                values_local, gate[0], gate[1]
+            )
+        out = consensus_step_gated_batched(values_local, ok_local, cfg)
+        out = _mask_padded_claims(out, claim_mask_local)
+        if gate is not None:
+            return out, ok_local
+        return out
+
+    return body
+
+
+def sharded_claims_consensus_fn(mesh: Mesh, cfg: ConsensusConfig):
+    """Jitted gated claim-cube consensus with ``values [C, N, M]`` /
+    ``ok [C, N]`` / ``claim_mask [C]`` partitioned over the mesh claim
+    axis (pure data parallelism — zero cross-claim collectives).
+
+    ``C`` must divide by the mesh claim axis (pad with
+    :func:`svoc_tpu.consensus.batch.pad_claim_cube` ``multiple_of=``).
+    Semantics — including padded-row invalidation via the shared
+    ``_mask_padded_claims`` — are BITWISE identical to the
+    single-device
+    :func:`svoc_tpu.consensus.kernel.consensus_step_gated_claims`
+    dispatch (``tests/test_claim_shard.py`` pins 0.0 max-abs-diff,
+    both configs).
+    """
+    body = _host_cube_body(cfg)
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(CLAIM_AXIS, None, None),
+            P(CLAIM_AXIS, None),
+            P(CLAIM_AXIS),
+        ),
+        out_specs=claims_out_specs(),
+        check_rep=False,
+    )
+    return jax.jit(
+        mapped,
+        in_shardings=(
+            NamedSharding(mesh, P(CLAIM_AXIS, None, None)),
+            NamedSharding(mesh, P(CLAIM_AXIS, None)),
+            NamedSharding(mesh, P(CLAIM_AXIS)),
+        ),
+    )
+
+
+def sharded_claims_sanitized_fn(
+    mesh: Mesh,
+    cfg: ConsensusConfig,
+    lo: Optional[float],
+    hi: Optional[float],
+):
+    """Claim-sharded twin of
+    :func:`svoc_tpu.consensus.batch.claims_consensus_sanitized`: the
+    in-graph quarantine gate and the gated kernel fused in ONE
+    shard-mapped program per micro-batch, returning ``(output, ok)``
+    so the router's admission accounting still reads the traced
+    masks."""
+    body = _host_cube_body(cfg, gate=(lo, hi))
+    # The gate recomputes ok in-graph, so the mapped surface takes
+    # (values, claim_mask) only — the body's ok operand is unused.
+    mapped = shard_map(
+        lambda v, m: body(v, None, m),
+        mesh=mesh,
+        in_specs=(P(CLAIM_AXIS, None, None), P(CLAIM_AXIS)),
+        out_specs=(claims_out_specs(), P(CLAIM_AXIS, None)),
+        check_rep=False,
+    )
+    return jax.jit(
+        mapped,
+        in_shardings=(
+            NamedSharding(mesh, P(CLAIM_AXIS, None, None)),
+            NamedSharding(mesh, P(CLAIM_AXIS)),
+        ),
+    )
+
+
+def _pallas_claims_body(cfg: ConsensusConfig):
+    """shard_map body for a claims-only mesh (oracle axis == 1): each
+    shard holds whole fleets for its claims, so the fused Pallas kernel
+    (docs/PARALLELISM.md §pallas-consensus) runs per-shard unchanged."""
+    from svoc_tpu.ops import pallas_consensus as pallas_ops
+
+    def body(values_local, ok_local, claim_mask_local):
+        return pallas_ops.fused_consensus_gated_claims(
+            values_local, ok_local, claim_mask_local, cfg
+        )
+
+    return body
+
+
+def sharded_claims_pallas_fn(mesh: Mesh, cfg: ConsensusConfig):
+    """Jitted claims-only-sharded dispatch of the fused Pallas kernel —
+    the ``consensus_impl="pallas"`` × sharding composition for meshes
+    whose oracle axis is 1.  Eligibility (fleet size, backend,
+    interpret opt-in) is the dispatcher's job; see
+    :meth:`ClaimShardDispatcher.dispatch_gated`."""
+    body = _pallas_claims_body(cfg)
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(CLAIM_AXIS, None, None),
+            P(CLAIM_AXIS, None),
+            P(CLAIM_AXIS),
+        ),
+        out_specs=ConsensusOutput(
+            essence=P(CLAIM_AXIS),
+            essence_first_pass=P(CLAIM_AXIS),
+            reliability_first_pass=P(CLAIM_AXIS),
+            reliability_second_pass=P(CLAIM_AXIS),
+            reliable=P(CLAIM_AXIS, None),
+            quadratic_risk=P(CLAIM_AXIS, None),
+            skewness=P(CLAIM_AXIS),
+            kurtosis=P(CLAIM_AXIS),
+            interval_valid=P(CLAIM_AXIS),
+        ),
+        check_rep=False,
+    )
+    return jax.jit(
+        mapped,
+        in_shardings=(
+            NamedSharding(mesh, P(CLAIM_AXIS, None, None)),
+            NamedSharding(mesh, P(CLAIM_AXIS, None)),
+            NamedSharding(mesh, P(CLAIM_AXIS)),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sharded bootstrap-resample fleet generation over the claim cube.
+# ---------------------------------------------------------------------------
+
+
+def _fleet_cube_body(cfg: ConsensusConfig, gate=None):
+    """Consensus half of the 2-D-sharded fleet step: the device-local
+    ``[Cl, Nl, M]`` generated shard is all-gathered per claim over the
+    oracle axis (``[Cl, N, M]`` — the only collective) and runs the
+    batched gated kernel; per-oracle outputs slice back to the local
+    rows.  ``gate=(lo, hi)`` computes admission masks on the gathered
+    block (no extra collective).  Parity contract: bitwise INVARIANT
+    across mesh factorizations (module docstring), certified in
+    ``tests/test_claim_shard.py``."""
+
+    def body(values_local, ok_local, claim_mask_local):
+        n_local = values_local.shape[1]
+        ax = jax.lax.axis_index(ORACLE_AXIS)
+        values = jax.lax.all_gather(
+            values_local, ORACLE_AXIS, axis=1, tiled=True
+        )
+        if gate is not None:
+            ok = jax.vmap(
+                lambda v: quarantine_mask_jax(v, gate[0], gate[1])
+            )(values)
+            ok_local = jax.lax.dynamic_slice_in_dim(
+                ok, ax * n_local, n_local, axis=1
+            )
+        else:
+            ok = jax.lax.all_gather(
+                ok_local, ORACLE_AXIS, axis=1, tiled=True
+            )
+        out = consensus_step_gated_batched(values, ok, cfg)
+        out = _mask_padded_claims(out, claim_mask_local)
+        out = out._replace(
+            reliable=jax.lax.dynamic_slice_in_dim(
+                out.reliable, ax * n_local, n_local, axis=1
+            ),
+            quadratic_risk=jax.lax.dynamic_slice_in_dim(
+                out.quadratic_risk, ax * n_local, n_local, axis=1
+            ),
+        )
+        if gate is not None:
+            return out, ok_local
+        return out
+
+    return body
+
+
+def one_claim_fleet(
+    key,
+    window: jnp.ndarray,
+    n_oracles: int,
+    n_failing: int,
+    subset_size: int,
+    oracle_idx: jnp.ndarray,
+):
+    """One claim's bootstrap fleet rows for the GLOBAL oracle indices
+    ``oracle_idx`` — the ``_fleet_body`` contract
+    (``parallel/sharded.py``): the failing-slot permutation derives
+    from the claim key replicated on every shard, and every oracle's
+    stream is keyed by its global index, so the generated fleet is
+    bitwise identical however (and whether) it is sharded.  Shared by
+    the shard_map body and the single-device reference below — one
+    implementation, no drift."""
+    w = window.shape[0]
+    perm = jax.random.permutation(jax.random.fold_in(key, 0), n_oracles)
+    failing_slot = (
+        jnp.zeros(n_oracles, bool).at[perm[:n_failing]].set(True)
+    )
+
+    def one_oracle(i):
+        k = jax.random.fold_in(key, i + 1)
+        k_fail, k_boot = jax.random.split(k)
+        fail_val = jax.random.uniform(k_fail, (window.shape[1],))
+        idx = jax.random.choice(
+            k_boot, w, shape=(subset_size,), replace=False
+        )
+        boot_val = jnp.mean(window[idx], axis=0)
+        return jnp.where(failing_slot[i], fail_val, boot_val)
+
+    values = jax.vmap(one_oracle)(oracle_idx)
+    honest = ~failing_slot[oracle_idx]
+    return values, honest
+
+
+def fleet_claims_reference(
+    keys: jnp.ndarray,
+    windows: jnp.ndarray,
+    n_oracles: int,
+    n_failing: int,
+    subset_size: int = 10,
+):
+    """Single-device fleet cube ``(values [C, N, M], honest [C, N])``
+    from per-claim keys (:func:`svoc_tpu.sim.generators.claim_fleet_keys`)
+    — the parity oracle the sharded generation is bitwise-tested
+    against."""
+    idx = jnp.arange(n_oracles)
+    return jax.vmap(
+        lambda k, win: one_claim_fleet(
+            k, win, n_oracles, n_failing, subset_size, idx
+        )
+    )(keys, windows)
+
+
+def sharded_fleet_claims_fn(
+    mesh: Mesh,
+    cfg: ConsensusConfig,
+    n_oracles: int,
+    subset_size: int = 10,
+    gate: Optional[Tuple[Optional[float], Optional[float]]] = None,
+):
+    """Jitted end-to-end sharded claim simulation: per-claim windows →
+    per-shard bootstrap fleets → 2-D-sharded gated consensus.
+
+    ``(keys [C, 2] uint32, windows [C, W, M]) →
+    (ConsensusOutput, honest [C, N])`` (plus ``admitted [C, N]`` when
+    ``gate=(lo, hi)`` wires the in-graph quarantine).  The fleet only
+    ever exists as device-local ``[Cl, Nl, M]`` shards — no replica
+    materializes the full cube (``tests/test_claim_shard.py`` asserts
+    the live-bytes bound via the PR 1 ``jax.live_arrays`` gauge).
+    """
+    mesh_claims = mesh.shape[CLAIM_AXIS]
+    mesh_oracles = mesh.shape[ORACLE_AXIS]
+    if n_oracles % mesh_oracles:
+        raise ValueError(
+            f"n_oracles={n_oracles} not divisible by the mesh oracle "
+            f"axis {mesh_oracles}"
+        )
+    del mesh_claims  # claim divisibility is checked by shard_map itself
+    consensus = _fleet_cube_body(cfg, gate=gate)
+
+    def step(keys_local, windows_local):
+        n_local = n_oracles // mesh_oracles
+        ax = jax.lax.axis_index(ORACLE_AXIS)
+        oracle_idx = ax * n_local + jnp.arange(n_local)
+        values_local, honest_local = jax.vmap(
+            lambda k, win: one_claim_fleet(
+                k, win, n_oracles, cfg.n_failing, subset_size, oracle_idx
+            )
+        )(keys_local, windows_local)
+        c_local = values_local.shape[0]
+        claim_mask_local = jnp.ones(c_local, dtype=bool)
+        if gate is not None:
+            out, ok_local = consensus(
+                values_local, None, claim_mask_local
+            )
+            return out, honest_local, ok_local
+        ones = jnp.ones((c_local, n_local), dtype=bool)
+        return consensus(values_local, ones, claim_mask_local), honest_local
+
+    per_oracle = P(CLAIM_AXIS, ORACLE_AXIS)
+    if gate is not None:
+        out_specs = (
+            claims_out_specs(oracle_sharded=True),
+            per_oracle,
+            per_oracle,
+        )
+    else:
+        out_specs = (claims_out_specs(oracle_sharded=True), per_oracle)
+    mapped = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(P(CLAIM_AXIS, None), P(CLAIM_AXIS, None, None)),
+        out_specs=out_specs,
+        check_rep=False,
+    )
+    return jax.jit(
+        mapped,
+        in_shardings=(
+            NamedSharding(mesh, P(CLAIM_AXIS, None)),
+            NamedSharding(mesh, P(CLAIM_AXIS, None, None)),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# The fabric-facing dispatcher: mesh resolved once, fallbacks counted.
+# ---------------------------------------------------------------------------
+
+
+class ClaimShardDispatcher:
+    """The mesh-aware claim-cube dispatch tier the
+    :class:`~svoc_tpu.fabric.router.ClaimRouter` owns.
+
+    Built ONCE at router construction with the pinned mesh (the replay
+    rule of docs/FABRIC.md §mesh — the mesh, like ``consensus_impl``,
+    is part of a seeded replay's config and must not drift mid-run).
+    ``dispatch_gated`` returns device arrays WITHOUT a host sync, so
+    the router's double-buffered (pipelined) mode can overlap the
+    collectives with the next micro-batch's host work.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        *,
+        consensus_impl: Optional[str] = None,
+        metrics: Optional[MetricsRegistry] = None,
+    ):
+        if CLAIM_AXIS not in mesh.shape or ORACLE_AXIS not in mesh.shape:
+            raise ValueError(
+                f"claim-shard mesh needs axes ({CLAIM_AXIS!r}, "
+                f"{ORACLE_AXIS!r}); got {tuple(mesh.shape)}"
+            )
+        self.mesh = mesh
+        self.consensus_impl = consensus_impl
+        self._metrics = metrics or _default_registry
+        self._fns: Dict[tuple, object] = {}
+        self._lock = threading.Lock()
+        self._warned: set = set()
+
+    @property
+    def claim_size(self) -> int:
+        return int(self.mesh.shape[CLAIM_AXIS])
+
+    @property
+    def oracle_size(self) -> int:
+        return int(self.mesh.shape[ORACLE_AXIS])
+
+    @property
+    def spec_str(self) -> str:
+        """The ``SVOC_MESH`` form of the pinned mesh, for snapshots."""
+        return f"{self.claim_size}x{self.oracle_size}"
+
+    def _fallback(self, reason: str, detail: str = "") -> None:
+        self._metrics.counter(
+            SHARD_FALLBACK_COUNTER, labels={"reason": reason}
+        ).add(1)
+        with self._lock:
+            if reason in self._warned:
+                return
+            self._warned.add(reason)
+        _log.warning(
+            "claim-cube dispatch fell back to the single-device path "
+            "(mesh=%s, reason=%s%s); further fallbacks are counted in "
+            "%s{reason=%s} without logging",
+            self.spec_str,
+            reason,
+            f": {detail}" if detail else "",
+            SHARD_FALLBACK_COUNTER,
+            reason,
+        )
+
+    def _sharded_fn(self, key, builder):
+        with self._lock:
+            fn = self._fns.get(key)
+        if fn is None:
+            fn = builder()
+            with self._lock:
+                self._fns.setdefault(key, fn)
+                fn = self._fns[key]
+        return fn
+
+    def _gated_fn(self, cfg: ConsensusConfig, pallas: bool):
+        return self._sharded_fn(
+            ("gated", cfg, pallas),
+            lambda: (
+                sharded_claims_pallas_fn(self.mesh, cfg)
+                if pallas
+                else sharded_claims_consensus_fn(self.mesh, cfg)
+            ),
+        )
+
+    def _sanitized_fn(self, cfg: ConsensusConfig, lo, hi):
+        return self._sharded_fn(
+            ("sanitized", cfg, lo, hi),
+            lambda: sharded_claims_sanitized_fn(self.mesh, cfg, lo, hi),
+        )
+
+    def shardable(self, n_claims: int, n_oracles: int) -> Optional[str]:
+        """None when the cube fits the mesh, else the fallback reason."""
+        if n_claims % self.claim_size:
+            return "claim_indivisible"
+        if n_oracles % self.oracle_size:
+            return "oracle_indivisible"
+        return None
+
+    def dispatch_gated(
+        self, values, ok, claim_mask, cfg: ConsensusConfig
+    ) -> ConsensusOutput:
+        """One mesh-sharded gated cube dispatch (device outputs, no
+        sync).  A cube the mesh cannot shard falls back — counted — to
+        the single-device :func:`claims_consensus_gated` path, which
+        itself honors ``consensus_impl``."""
+        from svoc_tpu.consensus import batch as _batch
+
+        values = jnp.asarray(values)
+        ok = jnp.asarray(ok)
+        claim_mask = jnp.asarray(claim_mask)
+        c, n, _m = values.shape
+        reason = self.shardable(c, n)
+        if reason is not None:
+            self._fallback(reason, detail=f"cube {c}x{n}")
+            return _batch.claims_consensus_gated(
+                values,
+                ok,
+                claim_mask,
+                cfg,
+                consensus_impl=self.consensus_impl,
+                metrics=self._metrics,
+            )
+        pallas = _batch._pallas_route(
+            values,
+            cfg,
+            self.consensus_impl,
+            self._metrics,
+            "sharded_claims_consensus",
+        )
+        if pallas and self.oracle_size > 1:
+            # Partial fleets cannot feed the fused kernel: an
+            # oracle-sharded pallas route is a counted fallback to the
+            # XLA sharded body (docs/FABRIC.md §consensus_impl).
+            from svoc_tpu.consensus.dispatch import report_pallas_fallback
+
+            report_pallas_fallback(
+                "sharded_unsupported",
+                op="sharded_claims_consensus",
+                detail=f"mesh {self.spec_str} shards the oracle axis",
+                metrics=self._metrics,
+            )
+            pallas = False
+        try:
+            out = self._gated_fn(cfg, pallas)(values, ok, claim_mask)
+        except Exception as e:  # noqa: BLE001 — counted, then the single-device path re-raises real input errors
+            if pallas:
+                _batch._pallas_broke(
+                    values, cfg, e, self._metrics, "sharded_claims_consensus"
+                )
+                out = self._gated_fn(cfg, False)(values, ok, claim_mask)
+            else:
+                self._fallback("shard_error", detail=f"{type(e).__name__}: {e}")
+                return _batch.claims_consensus_gated(
+                    values,
+                    ok,
+                    claim_mask,
+                    cfg,
+                    consensus_impl="xla",
+                    metrics=self._metrics,
+                )
+        self._metrics.counter(SHARD_DISPATCH_COUNTER).add(1)
+        return out
+
+    def dispatch_sanitized(
+        self, values, claim_mask, cfg: ConsensusConfig, lo, hi
+    ):
+        """Mesh-sharded gate+consensus fusion
+        (:func:`sharded_claims_sanitized_fn`) — the serving tier's
+        dispatch shape.  Returns ``(ConsensusOutput, ok)`` device
+        arrays, no sync.  Falls back (counted) to the single-device
+        :func:`claims_consensus_sanitized` when the cube does not fit
+        the mesh.  A pallas route composes as in
+        :func:`claims_consensus_sanitized`: the traced gate's masks
+        feed the per-shard fused kernel when the oracle axis is
+        unsharded, else ``sharded_unsupported``."""
+        from svoc_tpu.consensus import batch as _batch
+
+        values = jnp.asarray(values)
+        claim_mask = jnp.asarray(claim_mask)
+        c, n, _m = values.shape
+        reason = self.shardable(c, n)
+        if reason is not None:
+            self._fallback(reason, detail=f"cube {c}x{n}")
+            return _batch.claims_consensus_sanitized(
+                values,
+                claim_mask,
+                cfg,
+                lo,
+                hi,
+                consensus_impl=self.consensus_impl,
+                metrics=self._metrics,
+            )
+        pallas = _batch._pallas_route(
+            values,
+            cfg,
+            self.consensus_impl,
+            self._metrics,
+            "sharded_claims_sanitized",
+        )
+        if pallas and self.oracle_size > 1:
+            from svoc_tpu.consensus.dispatch import report_pallas_fallback
+
+            report_pallas_fallback(
+                "sharded_unsupported",
+                op="sharded_claims_sanitized",
+                detail=f"mesh {self.spec_str} shards the oracle axis",
+                metrics=self._metrics,
+            )
+            pallas = False
+        try:
+            if pallas:
+                ok = _batch._quarantine_claims_jit(values, lo, hi)
+                out = self._gated_fn(cfg, True)(values, ok, claim_mask)
+            else:
+                out, ok = self._sanitized_fn(cfg, lo, hi)(
+                    values, claim_mask
+                )
+        except Exception as e:  # noqa: BLE001 — counted, then the single-device path re-raises real input errors
+            if pallas:
+                _batch._pallas_broke(
+                    values, cfg, e, self._metrics, "sharded_claims_sanitized"
+                )
+                out, ok = self._sanitized_fn(cfg, lo, hi)(
+                    values, claim_mask
+                )
+            else:
+                self._fallback("shard_error", detail=f"{type(e).__name__}: {e}")
+                return _batch.claims_consensus_sanitized(
+                    values,
+                    claim_mask,
+                    cfg,
+                    lo,
+                    hi,
+                    consensus_impl="xla",
+                    metrics=self._metrics,
+                )
+        self._metrics.counter(SHARD_DISPATCH_COUNTER).add(1)
+        return out, ok

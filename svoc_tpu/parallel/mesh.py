@@ -26,6 +26,24 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
+#: Axis names of the 2-D claim-cube mesh (docs/PARALLELISM.md
+#: §sharded-claims): claims are pure data parallelism, oracles carry
+#: the consensus collectives.
+CLAIM_AXIS = "claim"
+ORACLE_AXIS = "oracle"
+
+#: ``SVOC_MESH=<claims>x<oracles>`` — the operator override for
+#: :func:`claim_mesh` (resolution order lives in
+#: :func:`svoc_tpu.consensus.dispatch.resolve_claim_mesh`).
+CLAIM_MESH_ENV = "SVOC_MESH"
+
+
+class MeshConfigError(ValueError):
+    """A claim-mesh spec failed validation (bad ``SVOC_MESH`` form, a
+    committed record naming more devices than exist).  Raised with the
+    spec, the expected form, and the device inventory in the message."""
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
     """A named mesh factorization, e.g. ``MeshSpec(("data", "oracle"), (2, 4))``."""
@@ -54,6 +72,83 @@ def make_mesh(
         )
     grid = np.array(devs[:need]).reshape(spec.axis_sizes)
     return Mesh(grid, spec.axis_names)
+
+
+def parse_claim_mesh(spec) -> Optional[Tuple[int, int]]:
+    """``"<claims>x<oracles>"`` → ``(claims, oracles)``; ``None`` /
+    ``""`` / ``"none"`` / ``"off"`` → ``None`` (unsharded dispatch).
+    Accepts an already-parsed 2-tuple unchanged.  Anything else raises
+    :class:`MeshConfigError` naming the expected form."""
+    if spec is None:
+        return None
+    if isinstance(spec, (tuple, list)):
+        if len(spec) != 2:
+            raise MeshConfigError(
+                f"claim mesh tuple must be (claims, oracles), got {spec!r}"
+            )
+        claims, oracles = spec
+    else:
+        text = str(spec).strip().lower()
+        if text in ("", "none", "off"):
+            return None
+        parts = text.split("x")
+        if len(parts) != 2:
+            raise MeshConfigError(
+                f"claim mesh spec {spec!r} is not of the form "
+                f"<claims>x<oracles> (e.g. {CLAIM_MESH_ENV}=2x4)"
+            )
+        try:
+            claims, oracles = (int(p) for p in parts)
+        except ValueError:
+            raise MeshConfigError(
+                f"claim mesh spec {spec!r} has non-integer axis sizes "
+                f"(expected e.g. {CLAIM_MESH_ENV}=2x4)"
+            ) from None
+    if claims < 1 or oracles < 1:
+        raise MeshConfigError(
+            f"claim mesh axes must be >= 1, got {claims}x{oracles}"
+        )
+    return int(claims), int(oracles)
+
+
+def claim_mesh(
+    spec, devices: Optional[Sequence[jax.Device]] = None
+) -> Optional[Mesh]:
+    """The 2-D ``(claim, oracle)`` mesh factory for the sharded claim
+    cube (:mod:`svoc_tpu.parallel.claim_shard`).
+
+    ``spec`` is a ``"<claims>x<oracles>"`` string (the ``SVOC_MESH``
+    form — resolution order env > PERF_DECISIONS.json > unsharded
+    lives in :func:`svoc_tpu.consensus.dispatch.resolve_claim_mesh`),
+    a ``(claims, oracles)`` tuple, or ``None``/``"none"``/``"off"``
+    for no mesh (single-device dispatch).  Returns ``None`` for the
+    unsharded case, else a :class:`Mesh` with axes
+    ``(CLAIM_AXIS, ORACLE_AXIS)``.
+
+    Multi-host launch mode (stub): a pod launch calls
+    :func:`init_distributed` ONCE before any backend use, after which
+    ``jax.devices()`` here is the GLOBAL device set and the same spec
+    factorizes chips across hosts — no further transport code.  CPU
+    tier-1 simulates devices via
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8``; the error
+    below names that knob so a laptop run is self-explaining.
+    """
+    parsed = parse_claim_mesh(spec)
+    if parsed is None:
+        return None
+    claims, oracles = parsed
+    devs = list(devices if devices is not None else jax.devices())
+    if claims * oracles > len(devs):
+        raise MeshConfigError(
+            f"claim mesh {claims}x{oracles} needs {claims * oracles} "
+            f"devices, only {len(devs)} available — on CPU simulate "
+            "devices with XLA_FLAGS=--xla_force_host_platform_device_"
+            "count=<n> (set before the first jax import); on a pod "
+            "call parallel.mesh.init_distributed() first"
+        )
+    return make_mesh(
+        MeshSpec((CLAIM_AXIS, ORACLE_AXIS), (claims, oracles)), devs
+    )
 
 
 def best_mesh(

@@ -402,6 +402,10 @@ class ServingTier:
             # surfaced so an operator can tell a pallas-routed box from
             # an XLA one without reading PERF_DECISIONS.json.
             "consensus_impl": self.multi.router.consensus_impl,
+            # The pinned (claim × oracle) dispatch mesh, or None for
+            # the single-device path (docs/FABRIC.md §mesh) — same
+            # replay-pinning contract as the impl above.
+            "mesh": self.multi.router.mesh_spec,
             "queues": self.frontend.depths(),
             "submitted": reg.family_total("serving_submitted"),
             "admitted": reg.family_total("serving_admitted"),

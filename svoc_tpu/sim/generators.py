@@ -45,6 +45,29 @@ def claim_seed(base_seed: int, claim_id) -> int:
     return ((mixed >> 32) ^ mixed) & 0xFFFFFFFF
 
 
+#: ``fold_in`` salt separating the per-claim key stream from the
+#: per-oracle streams the claim keys later fold (``fold_in(claim_key,
+#: 0)`` is the failing-slot permutation, ``i + 1`` the oracle streams —
+#: the ``_fleet_body`` contract of ``parallel/sharded.py``).  crc32 of
+#: a stable string — NOT ``hash()``, which Python randomizes per
+#: process — masked to an int32-safe word so ``fold_in`` accepts it.
+FLEET_CLAIM_SALT = zlib.crc32(b"svoc.fleet.claim") & 0x7FFFFFFF
+
+
+def claim_fleet_keys(key, n_claims: int):
+    """Per-claim PRNG keys ``[n_claims, 2]`` for the sharded claim-cube
+    fleet (:mod:`svoc_tpu.parallel.claim_shard`): each claim's stream
+    is keyed by its GLOBAL claim index off a crc32-salted fold of the
+    base key, so the generated fleet cube is bitwise identical however
+    — and whether — the (claim × oracle) mesh shards it.  The claim
+    axis twin of the global-oracle-index keying the oracle-sharded
+    ``_fleet_body`` already guarantees."""
+    salted = jax.random.fold_in(key, FLEET_CLAIM_SALT)
+    return jax.vmap(lambda i: jax.random.fold_in(salted, i))(
+        jnp.arange(n_claims)
+    )
+
+
 def beta_mode(a: float, b: float) -> float:
     """Mode of Beta(a, b) — the essence under the constrained model
     (notebook ``beta_mode``; ``documentation/README.md:72-76``)."""

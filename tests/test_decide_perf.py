@@ -387,3 +387,169 @@ def test_dry_run_writes_nothing(tmp_path, monkeypatch):
     )
     assert decide_perf.main(["--dry-run"]) == 0
     assert not (tmp_path / "PERF_DECISIONS.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# Grid-format evidence (ISSUE 11 satellite): the claims A/B grid and
+# the sharded-cube sweep flow through decide() instead of hand edits.
+# ---------------------------------------------------------------------------
+
+
+def _claims_grid(platform, mode, speedup, match=True):
+    return {
+        "artifact": "claim-cube pallas-vs-xla A/B grid",
+        "platform": platform,
+        "items": [
+            {
+                "metric": "claim-cube consensus 64x1024x6",
+                "detail": {
+                    "device_topology": {"platform": platform.split("-")[0]},
+                    "pallas_ab": {
+                        "pallas_mode": mode,
+                        "pallas_hung": False,
+                        "pallas_vs_xla_speedup": speedup,
+                        "pallas_info": {"essence_match_xla": match},
+                    },
+                },
+            }
+        ],
+    }
+
+
+def test_claims_grid_tpu_compiled_win_routes_pallas():
+    grid = _claims_grid("tpu", "compiled", 4.2)
+    decisions, evidence = decide_perf.decide({}, claims_grid=grid)
+    assert decisions["consensus_impl"] == "pallas"
+    assert evidence["consensus_impl"]["pallas_vs_xla_speedup"] == 4.2
+
+
+def test_claims_grid_interpret_only_records_xla_walkover():
+    grid = _claims_grid("cpu-smoke", "interpret", None)
+    decisions, evidence = decide_perf.decide({}, claims_grid=grid)
+    assert decisions["consensus_impl"] == "xla"
+    assert "walkover" in evidence["consensus_impl"]
+    assert evidence["consensus_impl"]["tpu_grid"] is False
+
+
+def test_claims_grid_never_overrides_config6_measurement():
+    c6 = tpu_result(1.0)
+    c6["detail"].update(
+        pallas_vs_xla_speedup=2.0,
+        pallas_hung=False,
+        pallas_info={"essence_match_xla": True},
+        pallas_kernel_active=True,
+    )
+    grid = _claims_grid("cpu-smoke", "interpret", None)
+    decisions, _ = decide_perf.decide(
+        {"bench_config6": c6}, claims_grid=grid
+    )
+    # The real measurement wins; the grid walkover never demotes it.
+    assert decisions["consensus_impl"] == "pallas"
+
+
+def _shard_grid(platform, verdict, parity=True, items=()):
+    return {
+        "artifact": "sharded claim-cube mesh sweep (ISSUE 11)",
+        "platform": platform,
+        "parity_all_zero": parity,
+        "scaling_verdict": verdict,
+        "scaling_vs_1x1": {"1x1": 1.0, "4x1": 1.9},
+        "scaling_blocker": None if verdict == "scales" else "1 core",
+        "items": list(items),
+    }
+
+
+def _shard_item(mesh, cps, platform="tpu"):
+    return {
+        "rc": 0,
+        "detail": {
+            "mesh": mesh,
+            "sharded_claims_per_s": cps,
+            "parity_max_abs_diff": 0.0,
+            "device_topology": {"platform": platform},
+        },
+    }
+
+
+def test_shard_grid_tpu_scaling_routes_best_mesh():
+    grid = _shard_grid(
+        "tpu",
+        "scales",
+        items=[_shard_item("1x1", 1000.0), _shard_item("4x1", 1900.0)],
+    )
+    decisions, evidence = decide_perf.decide({}, shard_grid=grid)
+    assert decisions["claim_mesh"] == "4x1"
+    assert evidence["claim_mesh"]["best_mesh_claims_per_s"] == 1900.0
+
+
+def test_shard_grid_cpu_null_records_none():
+    grid = _shard_grid(
+        "cpu-simulated-devices",
+        "null",
+        items=[
+            _shard_item("1x1", 1000.0, "cpu"),
+            _shard_item("4x1", 900.0, "cpu"),
+        ],
+    )
+    decisions, evidence = decide_perf.decide({}, shard_grid=grid)
+    assert decisions["claim_mesh"] == "none"
+    assert evidence["claim_mesh"]["scaling_blocker"] == "1 core"
+    assert evidence["claim_mesh"]["tpu_grid"] is False
+
+
+def test_shard_grid_parity_breakage_never_routes_a_mesh():
+    grid = _shard_grid(
+        "tpu",
+        "scales",
+        parity=False,
+        items=[_shard_item("1x1", 1000.0), _shard_item("4x1", 1900.0)],
+    )
+    decisions, _ = decide_perf.decide({}, shard_grid=grid)
+    assert decisions["claim_mesh"] == "none"
+
+
+def test_resolve_claim_mesh_consumes_the_committed_record(tmp_path):
+    from svoc_tpu.consensus.dispatch import resolve_claim_mesh
+
+    record = tmp_path / "PERF_DECISIONS.json"
+    record.write_text(json.dumps({"claim_mesh": "4x1"}))
+    assert resolve_claim_mesh(path=str(record)) == "4x1"
+    record.write_text(json.dumps({"claim_mesh": "none"}))
+    assert resolve_claim_mesh(path=str(record)) is None
+
+
+def test_claims_grid_walkover_never_demotes_prior_measured_pallas(
+    tmp_path, monkeypatch
+):
+    """Queue artifacts reset + committed CPU grid present: the grid's
+    xla walkover must not overwrite a PRIOR measured pallas routing
+    through the prior-merge (code-review r11)."""
+    out = tmp_path / "PERF_DECISIONS.json"
+    out.write_text(
+        json.dumps(
+            {
+                "consensus_impl": "pallas",
+                "evidence": {
+                    "consensus_impl": {"pallas_vs_xla_speedup": 4.0}
+                },
+            }
+        )
+    )
+    monkeypatch.setattr(decide_perf, "REPO", str(tmp_path))
+    monkeypatch.setattr(decide_perf, "OUT", str(out))
+    monkeypatch.setattr(decide_perf, "latest_tpu_results", lambda paths: {})
+    monkeypatch.setattr(
+        decide_perf, "config6_hang_evidence", lambda paths: None
+    )
+    grid = _claims_grid("cpu-smoke", "interpret", None)
+    monkeypatch.setattr(
+        decide_perf,
+        "load_grid",
+        lambda path: grid if "CLAIMS" in path else _shard_grid(
+            "cpu-simulated-devices", "null", items=[]
+        ),
+    )
+    assert decide_perf.main([]) == 0
+    record = json.loads(out.read_text())
+    assert record["consensus_impl"] == "pallas"  # the measurement stands
+    assert record["claim_mesh"] == "none"

@@ -13,12 +13,28 @@ committed measurements — not an editorial choice:
 - ``consensus_impl`` — "pallas" iff config 6 measured the fused kernel
   on the TPU backend with ``pallas_vs_xla_speedup > 1``, no hang, and
   XLA-matching essence; "xla" otherwise (including by walkover when
-  the Mosaic compile hung — the VERDICT r2 decision rule).
+  the Mosaic compile hung — the VERDICT r2 decision rule).  The
+  ``BENCH_CLAIMS_r06.json`` claim-cube grid is a second evidence
+  source (ISSUE 11 satellite): a TPU-compiled grid point with a
+  ``pallas_vs_xla_speedup > 1`` and matching essence flips to pallas;
+  a grid holding only interpret/CPU points records the xla walkover
+  with the artifact named — the committed r06 walkover flows through
+  this machinery instead of a hand edit.
+- ``claim_mesh`` — the 2-D (claim × oracle) dispatch mesh
+  (docs/PARALLELISM.md §sharded-claims), from the
+  ``BENCH_SHARD_r07.json`` sweep: the best-throughput mesh iff the
+  sweep ran on TPU with ``parity_all_zero`` and ``scaling_verdict ==
+  "scales"`` (≥1.5× at 1→4 devices, fixed total work); ``"none"``
+  otherwise — including the honest-null CPU sweep (1-core container:
+  simulated devices cannot add compute) and any parity breakage, with
+  the blocker recorded as evidence.
 
 A decision is only derived from results whose ``detail.backend`` is
 ``"tpu"`` with no fallback/small-mode label; with no qualifying
 measurements the tool writes nothing (exit 3) — the defaults in
-``bench.py`` stay in force.
+``bench.py`` stay in force.  (The grid-derived walkovers above are the
+exception: they record the HONEST NULL — "measured, no win" — which
+is itself a decision, per the r06 precedent.)
 
 Usage::
 
@@ -155,6 +171,118 @@ def config6_hang_evidence(paths):
     return None
 
 
+def load_grid(path):
+    """Load a bench grid artifact (``BENCH_CLAIMS_r06.json`` /
+    ``BENCH_SHARD_r07.json``: ``{"artifact", "platform"/"date",
+    "items": [bench lines], ...}``) or None when absent/malformed."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or not isinstance(data.get("items"), list):
+        return None
+    return data
+
+
+def grid_is_tpu(grid: dict) -> bool:
+    """A grid measured on real chips: every successful item's stamped
+    ``device_topology.platform`` is ``"tpu"`` (pre-topology artifacts
+    fall back to the artifact-level platform string)."""
+    topos = [
+        it.get("detail", {}).get("device_topology")
+        for it in grid["items"]
+        if isinstance(it, dict) and isinstance(it.get("detail"), dict)
+    ]
+    if any(isinstance(t, dict) for t in topos):
+        return all(
+            isinstance(t, dict) and t.get("platform") == "tpu"
+            for t in topos
+        )
+    return str(grid.get("platform", "")).strip().lower().startswith("tpu")
+
+
+def claims_grid_consensus_evidence(grid):
+    """``(decision_or_None, evidence)`` from the claim-cube A/B grid.
+
+    A TPU-compiled point with a real speedup and matching essence flips
+    to pallas (best point wins); anything else — interpret mode, CPU,
+    hangs — is the recorded xla walkover.  Returns ``(None, None)``
+    when there is no grid."""
+    if grid is None:
+        return None, None
+    wins = []
+    modes = set()
+    for item in grid["items"]:
+        if not isinstance(item, dict):
+            continue
+        ab = item.get("detail", {}).get("pallas_ab")
+        if not isinstance(ab, dict):
+            continue
+        modes.add(ab.get("pallas_mode"))
+        speedup = ab.get("pallas_vs_xla_speedup")
+        if (
+            grid_is_tpu(grid)
+            and ab.get("pallas_mode") == "compiled"
+            and not ab.get("pallas_hung")
+            and speedup is not None
+            and speedup > 1.0
+            and ab.get("pallas_info", {}).get("essence_match_xla", False)
+        ):
+            wins.append((speedup, item))
+    if wins:
+        speedup, item = max(wins, key=lambda w: w[0])
+        return "pallas", {
+            "source": "claims-grid",
+            "pallas_vs_xla_speedup": speedup,
+            "shape": item.get("metric"),
+        }
+    return "xla", {
+        "source": "claims-grid",
+        "walkover": (
+            "no TPU-compiled pallas win in the claims grid "
+            f"(modes seen: {sorted(str(m) for m in modes)})"
+        ),
+        "tpu_grid": grid_is_tpu(grid),
+    }
+
+
+def shard_grid_mesh_decision(grid):
+    """``(decision_or_None, evidence)`` for the ``claim_mesh`` routing
+    from the sharded-cube sweep.  Routing through a mesh needs ALL of:
+    a TPU sweep, bitwise parity on every point, and the ≥1.5× 1→4
+    scaling verdict; everything else records ``"none"`` with the
+    sweep's own verdict/blocker as evidence (the honest null IS the
+    decision — a 1-core CPU container cannot measure scaling, and the
+    unsharded default must stay routed until real chips overturn it)."""
+    if grid is None:
+        return None, None
+    parity = bool(grid.get("parity_all_zero"))
+    verdict = grid.get("scaling_verdict")
+    scaling = grid.get("scaling_vs_1x1") or {}
+    evidence = {
+        "source": grid.get("artifact", "shard-grid"),
+        "parity_all_zero": parity,
+        "scaling_verdict": verdict,
+        "scaling_vs_1x1": scaling,
+        "scaling_blocker": grid.get("scaling_blocker"),
+        "tpu_grid": grid_is_tpu(grid),
+    }
+    if grid_is_tpu(grid) and parity and verdict == "scales":
+        best = None
+        for item in grid["items"]:
+            if not isinstance(item, dict) or item.get("rc") != 0:
+                continue
+            detail = item.get("detail", {})
+            cps = detail.get("sharded_claims_per_s")
+            if cps and (best is None or cps > best[0]):
+                best = (cps, detail.get("mesh"))
+        if best and best[1] and best[1] != "1x1":
+            evidence["best_mesh_claims_per_s"] = best[0]
+            return str(best[1]), evidence
+    return "none", evidence
+
+
 def load_flash_verdict(repo: str):
     """The on-TPU flash numerics verdict from FLASH_PARITY.json
     (``tools/flash_probe.py --parity-only``), or None when unmeasured.
@@ -170,8 +298,15 @@ def load_flash_verdict(repo: str):
     return None
 
 
-def decide(results: dict, flash_verdict=None, c6_hang=None) -> tuple:
-    """``(decisions, evidence)`` from qualifying TPU results only."""
+def decide(
+    results: dict,
+    flash_verdict=None,
+    c6_hang=None,
+    claims_grid=None,
+    shard_grid=None,
+) -> tuple:
+    """``(decisions, evidence)`` from qualifying TPU results (plus the
+    grid walkover rules — module docstring)."""
     decisions = {}
     evidence = {}
 
@@ -247,6 +382,21 @@ def decide(results: dict, flash_verdict=None, c6_hang=None) -> tuple:
             "walkover": "measurement timed out on hardware",
             **c6_hang,
         }
+    else:
+        # Third evidence source: the claim-cube A/B grid (ISSUE 11
+        # satellite) — a TPU-compiled win flips to pallas; an
+        # interpret/CPU-only grid records the xla walkover.
+        grid_impl, grid_evidence = claims_grid_consensus_evidence(
+            claims_grid
+        )
+        if grid_impl:
+            decisions["consensus_impl"] = grid_impl
+            evidence["consensus_impl"] = grid_evidence
+
+    mesh_decision, mesh_evidence = shard_grid_mesh_decision(shard_grid)
+    if mesh_decision is not None:
+        decisions["claim_mesh"] = mesh_decision
+        evidence["claim_mesh"] = mesh_evidence
 
     return decisions, evidence
 
@@ -263,7 +413,11 @@ def main(argv=None) -> int:
     # MERGE with the committed record: a run that can only re-derive a
     # subset of the decisions (e.g. queue artifacts were reset and only
     # the hang evidence survives) must not silently drop a previously
-    # measured flagship_variant back to bench.py's default.
+    # measured flagship_variant back to bench.py's default.  The same
+    # protection applies to consensus_impl below: a claims-grid
+    # WALKOVER (committed CPU/interpret grid — always present, never a
+    # measurement) fills absence only and must not demote a prior
+    # measured routing.
     prior_decisions, prior_evidence = {}, {}
     try:
         with open(OUT) as f:
@@ -275,7 +429,13 @@ def main(argv=None) -> int:
             prior_decisions = {
                 k: v
                 for k, v in prior.items()
-                if k in ("flagship_variant", "consensus_impl", "flash_numerics")
+                if k
+                in (
+                    "flagship_variant",
+                    "consensus_impl",
+                    "flash_numerics",
+                    "claim_mesh",
+                )
             }
     except (OSError, ValueError):
         pass
@@ -293,7 +453,25 @@ def main(argv=None) -> int:
         results,
         flash_verdict,
         config6_hang_evidence(paths + [os.path.join(REPO, "TPU_PROBE.json")]),
+        claims_grid=load_grid(os.path.join(REPO, "BENCH_CLAIMS_r06.json")),
+        shard_grid=load_grid(os.path.join(REPO, "BENCH_SHARD_r07.json")),
     )
+    if (
+        "consensus_impl" in prior_decisions
+        and evidence.get("consensus_impl", {}).get("source")
+        == "claims-grid"
+        and "walkover" in evidence.get("consensus_impl", {})
+    ):
+        # The grid walkover is a statement of NO evidence — when queue
+        # artifacts were reset but the committed record still carries a
+        # measured decision, the measurement stands.
+        decisions.pop("consensus_impl")
+        evidence.pop("consensus_impl")
+        print(
+            "[decide_perf] claims-grid walkover suppressed: the prior "
+            "measured consensus_impl stands"
+        )
+
     if not decisions:
         print("[decide_perf] no qualifying TPU measurements — nothing written")
         return 3

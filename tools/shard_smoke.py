@@ -1,0 +1,160 @@
+"""Sharded claim-cube smoke: mesh-pinned replay identity as a CI gate
+(``make shard-smoke``; docs/PARALLELISM.md §sharded-claims).
+
+The seeded fabric scenario (4 claims × 8 oracles — 8 so the 2×4 mesh's
+oracle axis divides the fleet) runs THREE times on the 8-device
+simulated CPU mesh:
+
+1. twice MESH-PINNED (``mesh="2x4"``) with fresh journals/registries
+   and the pinned lineage scope — byte-identical per-claim journal
+   fingerprints, the replay witness covering scheduling AND the
+   sharded dispatch;
+2. once UNMESHED — and its per-claim fingerprints must equal the
+   meshed ones byte-for-byte: the sharded dispatch path is
+   bitwise-exact vs the single-device cube
+   (``parallel/claim_shard.py`` exact-parity contract), so pinning a
+   mesh may never change what the fabric journals.
+
+The gate also asserts the mesh actually served (nonzero
+``claim_shard_dispatches``, zero ``claim_shard_fallback`` — a silently
+falling-back mesh would pass the fingerprint checks vacuously) and
+that the scenario's Byzantine accounting (offender replaced, siblings
+clean) survives the sharded path.
+
+Usage::
+
+    python tools/shard_smoke.py [--seed 0] [--out SHARD_SMOKE.json]
+"""
+
+from __future__ import annotations
+
+import os
+
+# Off-TPU by construction, with the 8-device simulated mesh pinned
+# BEFORE the first jax import (the mesh needs the device count; the
+# axon sitecustomize pins the platform, so go through jax.config too —
+# tools/fabric_smoke.py discipline).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MESH = "2x4"
+N_ORACLES = 8  # divisible by the mesh oracle axis
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--cycles", type=int, default=10)
+    p.add_argument("--out", default="SHARD_SMOKE.json")
+    args = p.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from svoc_tpu.fabric.scenario import run_fabric_scenario
+    from svoc_tpu.utils.metrics import MetricsRegistry
+
+    def meshed_run():
+        metrics = MetricsRegistry()
+        result = run_fabric_scenario(
+            args.seed,
+            cycles=args.cycles,
+            n_oracles=N_ORACLES,
+            mesh=MESH,
+            metrics=metrics,
+        )
+        result["shard_dispatches"] = metrics.family_total(
+            "claim_shard_dispatches"
+        )
+        result["shard_fallbacks"] = metrics.family_total(
+            "claim_shard_fallback"
+        )
+        return result
+
+    first = meshed_run()
+    second = meshed_run()
+    # mesh="off", not None: None would re-resolve SVOC_MESH / the
+    # committed claim_mesh record, and a pinned environment would turn
+    # the control run sharded too — the meshed==unmeshed witness must
+    # compare against the EXPLICITLY unsharded path.
+    unmeshed = run_fabric_scenario(
+        args.seed, cycles=args.cycles, n_oracles=N_ORACLES, mesh="off"
+    )
+
+    claim_ids = sorted(first["claims"])
+    meshed_identical = {
+        cid: (
+            first["claims"][cid]["fingerprint"]
+            == second["claims"][cid]["fingerprint"]
+        )
+        for cid in claim_ids
+    }
+    mesh_vs_single = {
+        cid: (
+            first["claims"][cid]["fingerprint"]
+            == unmeshed["claims"][cid]["fingerprint"]
+        )
+        for cid in claim_ids
+    }
+    checks = {
+        "meshed_replay_identical": all(meshed_identical.values()),
+        "meshed_journal_identical": (
+            first["journal_fingerprint"] == second["journal_fingerprint"]
+        ),
+        # The exact-parity contract made observable: a pinned mesh
+        # changes WHERE the cube computes, never what it computes.
+        "meshed_equals_unmeshed": all(mesh_vs_single.values())
+        and first["journal_fingerprint"] == unmeshed["journal_fingerprint"],
+        "journal_nonempty": first["journal_events"] > 0,
+        # The mesh really served: a cube the mesh could not shard would
+        # pass the fingerprint checks through the (also-exact) fallback
+        # path — the gate requires zero fallbacks and a dispatch per
+        # fabric cycle.
+        "sharded_dispatches_happened": first["shard_dispatches"]
+        >= args.cycles,
+        "zero_shard_fallbacks": first["shard_fallbacks"] == 0,
+        "injections_happened": first["injection_count"] > 0,
+        "offender_replaced": first["offender_replaced"],
+        "siblings_clean": first["siblings_clean"],
+    }
+    report = {
+        "seed": args.seed,
+        "cycles": args.cycles,
+        "mesh": MESH,
+        "n_oracles": N_ORACLES,
+        "checks": checks,
+        "per_claim_meshed_identical": meshed_identical,
+        "per_claim_mesh_vs_single": mesh_vs_single,
+        "shard_dispatches": first["shard_dispatches"],
+        "shard_fallbacks": first["shard_fallbacks"],
+        "injection_count": first["injection_count"],
+        "journal_fingerprint": first["journal_fingerprint"],
+        "ok": all(checks.values()),
+    }
+    with open(args.out + ".tmp", "w") as f:
+        json.dump(report, f, indent=1)
+    os.replace(args.out + ".tmp", args.out)
+    for name, passed in checks.items():
+        print(f"[shard-smoke] {'PASS' if passed else 'FAIL'} {name}")
+    print(
+        f"[shard-smoke] {'OK' if report['ok'] else 'FAILED'} — "
+        f"mesh {MESH}, {first['shard_dispatches']:.0f} sharded "
+        f"dispatches, fingerprints {'stable' if report['ok'] else 'UNSTABLE'}"
+        f" ({args.out})"
+    )
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
