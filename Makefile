@@ -31,8 +31,13 @@ web:
 
 # Static analysis (docs/STATIC_ANALYSIS.md): the AST-based JAX hazard
 # gate — trace purity, host-sync, recompile, donation, fixed-point and
-# shared-state rules.  Imports no JAX, runs in ~2 s, exits non-zero on
-# any non-baselined finding or stale baseline entry.
+# shared-state rules, plus the interprocedural SVOC008–012 pass
+# (call-graph + lock model: replay pinning, leaf-lock discipline,
+# durability ordering).  Imports no JAX; warm runs reuse the
+# content-hash findings cache (.svoclint_cache.json, gitignored) and
+# parse nothing.  Exits non-zero on any non-baselined finding or stale
+# baseline entry.  `python tools/svoclint.py --changed` is the
+# sub-second pre-commit loop.
 lint:
 	$(PY) tools/svoclint.py svoc_tpu tools
 

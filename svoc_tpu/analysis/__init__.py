@@ -32,13 +32,31 @@ Rules (docs/STATIC_ANALYSIS.md has bad/good examples for each):
   (``emit_event`` / ``journal.emit``) inside a jit-traced body; events
   are host-side only (``svoc_tpu/utils/events.py``).
 
+Interprocedural rules (``callgraph.py`` resolves module-qualified
+defs/calls package-wide, ``concurrency.py`` models lock acquisition;
+``interrules.py`` holds the rules; findings carry a ``path_trace``
+naming the call chain that justifies them):
+
+- **SVOC008 wall-clock-in-fingerprinted-path** — ``time.time()`` &
+  friends reachable from journal-emit data or fingerprint derivation.
+- **SVOC009 process-randomized-draw** — ``hash()`` / unseeded
+  ``random.*`` / set iteration in seed/key/fingerprint paths.
+- **SVOC010 emit-under-lock** — ``journal.emit`` reachable while a
+  non-journal lock is held (the leaf-lock contract), plus
+  lock-acquisition cycles.
+- **SVOC011 unpinned-replay-knob** — env/PERF_DECISIONS knob reads
+  reachable from step/dispatch/fetch bodies instead of ``__init__``.
+- **SVOC012 durability-ordering** — rename without directory fsync;
+  durability-path writes without fsync.
+
 Entry points: :func:`svoc_tpu.analysis.engine.analyze_paths` (the CLI
-``tools/svoclint.py`` wraps it) and
+``tools/svoclint.py`` wraps it, with a ``.svoclint_cache.json``
+content-hash cache so warm runs never re-parse unchanged files) and
 :func:`svoc_tpu.analysis.engine.analyze_source` (what the tests feed
 fixture snippets through).
 """
 
-from svoc_tpu.analysis.findings import Baseline, Finding
+from svoc_tpu.analysis.findings import Baseline, Finding, suggest_rebase
 from svoc_tpu.analysis.engine import (
     AnalysisReport,
     analyze_paths,
@@ -56,4 +74,5 @@ __all__ = [
     "analyze_paths",
     "analyze_source",
     "iter_python_files",
+    "suggest_rebase",
 ]

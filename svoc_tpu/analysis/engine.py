@@ -1,9 +1,19 @@
-"""File walking, per-module analysis, and report assembly.
+"""File walking, per-module + whole-package analysis, report assembly.
 
 ``analyze_paths`` is the whole pipeline minus baseline policy (the CLI
-owns that): discover ``*.py`` files, parse each, build its
-:class:`~svoc_tpu.analysis.jitmap.JitMap`, run every rule, drop
-suppressed findings, and return an :class:`AnalysisReport`.
+owns that): discover ``*.py`` files, parse each (or reuse the
+content-hash cache), build its :class:`~svoc_tpu.analysis.jitmap.JitMap`,
+run every per-module rule, then fold the per-module
+:class:`~svoc_tpu.analysis.callgraph.ModuleSummary` extracts into one
+:class:`~svoc_tpu.analysis.callgraph.Program` and run the
+interprocedural rules (SVOC008–012) over it, drop suppressed findings,
+and return an :class:`AnalysisReport`.
+
+Two-phase shape: phase 1 is embarrassingly per-file (and therefore
+cacheable — ``.svoclint_cache.json`` keys on content hash, so a warm
+run parses nothing); phase 2 is cross-file by definition and always
+runs fresh, but consumes only the compact summaries, so it costs
+milliseconds, not re-parses.
 
 Import cost discipline: this module (and everything it pulls in) must
 import neither JAX nor the analyzed code — ``make lint`` runs on boxes
@@ -17,9 +27,12 @@ import ast
 import dataclasses
 import os
 import time
-from typing import Iterable, Iterator, List, Optional, Set, Tuple
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
+from svoc_tpu.analysis.cache import FileEntry, FindingsCache, source_digest
+from svoc_tpu.analysis.callgraph import ModuleSummary, Program, summarize_module
 from svoc_tpu.analysis.findings import Finding, SuppressionIndex
+from svoc_tpu.analysis.interrules import PACKAGE_RULES, PackageContext
 from svoc_tpu.analysis.jitmap import JitMap
 from svoc_tpu.analysis.rules import ALL_RULES
 
@@ -54,6 +67,10 @@ class AnalysisReport:
     #: rel paths of every analyzed file — baseline rewrites use this to
     #: preserve entries for files OUTSIDE the analyzed subset
     analyzed_paths: List[str] = dataclasses.field(default_factory=list)
+    #: files that actually went through ``ast.parse`` this run — a warm
+    #: cache run reports 0 here (the cache test's behavioral assert)
+    parsed: int = 0
+    cache_hits: int = 0
 
     @property
     def all_findings(self) -> List[Finding]:
@@ -102,15 +119,6 @@ def _relpath(path: str, root: Optional[str]) -> str:
     return path.replace(os.sep, "/")
 
 
-def analyze_module(path: str, source: str) -> List[Finding]:
-    """Run every rule over one module's source; suppressions applied."""
-    unit = _build_unit(path, source)
-    if isinstance(unit, Finding):
-        return [unit]
-    findings, _suppressed = _run_rules(unit)
-    return findings
-
-
 def _build_unit(path: str, source: str):
     try:
         tree = ast.parse(source, filename=path)
@@ -156,23 +164,79 @@ def _run_rules(unit: ModuleUnit) -> Tuple[List[Finding], int]:
     return kept, len(out) - len(kept)
 
 
+def _run_package_rules(
+    summaries: List[ModuleSummary],
+    lines_by_path: Dict[str, List[str]],
+    suppressions: Dict[str, SuppressionIndex],
+) -> Tuple[List[Finding], int]:
+    """The interprocedural phase: one Program over every summary."""
+    program = Program(summaries)
+    ctx = PackageContext(lines_by_path)
+    raw: List[Finding] = []
+    for rule in PACKAGE_RULES:
+        raw.extend(rule(program, ctx))
+    seen = set()
+    deduped: List[Finding] = []
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule, f.message)):
+        key = (f.rule, f.path, f.line, f.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        deduped.append(f)
+    kept: List[Finding] = []
+    n_suppressed = 0
+    for f in deduped:
+        idx = suppressions.get(f.path)
+        if idx is not None and idx.is_suppressed(f.rule, f.line):
+            n_suppressed += 1
+        else:
+            kept.append(f)
+    return kept, n_suppressed
+
+
+def analyze_module(path: str, source: str) -> List[Finding]:
+    """Run every rule — per-module AND interprocedural, over this one
+    module as the whole program — on one source; suppressions applied."""
+    unit = _build_unit(path, source)
+    if isinstance(unit, Finding):
+        return [unit]
+    findings, _suppressed = _run_rules(unit)
+    summary = summarize_module(path, unit.tree, unit.tags)
+    pkg, _pkg_suppressed = _run_package_rules(
+        [summary], {path: unit.lines}, {path: unit.suppressions}
+    )
+    return sorted(
+        findings + pkg, key=lambda f: (f.line, f.col, f.rule, f.message)
+    )
+
+
 def analyze_source(source: str, path: str = "fixture.py") -> List[Finding]:
     """Test/tooling entry point: analyze one source string."""
     return analyze_module(path, source)
 
 
 def analyze_paths(
-    paths: Iterable[str], root: Optional[str] = None
+    paths: Iterable[str],
+    root: Optional[str] = None,
+    cache_path: Optional[str] = None,
 ) -> AnalysisReport:
     """Analyze every ``*.py`` under ``paths``; paths in findings are
-    relative to ``root`` (default: the current working directory)."""
+    relative to ``root`` (default: the current working directory).
+    With ``cache_path``, unchanged files (by content hash) skip parsing
+    and the per-module rules entirely — the interprocedural pass runs
+    either way, over the (possibly cached) summaries."""
     root = root or os.getcwd()
     t0 = time.perf_counter()
+    cache = FindingsCache(cache_path) if cache_path else None
     findings: List[Finding] = []
     parse_errors: List[Finding] = []
     analyzed: List[str] = []
+    summaries: List[ModuleSummary] = []
+    lines_by_path: Dict[str, List[str]] = {}
+    suppressions: Dict[str, SuppressionIndex] = {}
     suppressed = 0
     files = 0
+    parsed = 0
     for fpath in iter_python_files(paths):
         files += 1
         rel = _relpath(fpath, root)
@@ -193,13 +257,63 @@ def analyze_paths(
                 )
             )
             continue
+        lines_by_path[rel] = source.splitlines()
+        if cache is not None:
+            sha = source_digest(source)
+            entry = cache.lookup(rel, sha)
+            if entry is not None:
+                findings.extend(entry.findings)
+                if entry.parse_error is not None:
+                    parse_errors.append(entry.parse_error)
+                suppressed += entry.suppressed
+                if entry.summary is not None:
+                    summaries.append(entry.summary)
+                suppressions[rel] = SuppressionIndex.from_dict(
+                    entry.suppressions
+                )
+                continue
+        parsed += 1
         unit = _build_unit(rel, source)
         if isinstance(unit, Finding):
             parse_errors.append(unit)
+            if cache is not None:
+                cache.store(
+                    rel,
+                    FileEntry(
+                        sha=sha,
+                        findings=[],
+                        parse_error=unit,
+                        suppressed=0,
+                        summary=None,
+                        suppressions={},
+                    ),
+                )
             continue
         kept, n_suppressed = _run_rules(unit)
         findings.extend(kept)
         suppressed += n_suppressed
+        summary = summarize_module(rel, unit.tree, unit.tags)
+        summaries.append(summary)
+        suppressions[rel] = unit.suppressions
+        if cache is not None:
+            cache.store(
+                rel,
+                FileEntry(
+                    sha=sha,
+                    findings=kept,
+                    parse_error=None,
+                    suppressed=n_suppressed,
+                    summary=summary,
+                    suppressions=unit.suppressions.to_dict(),
+                ),
+            )
+    pkg_findings, pkg_suppressed = _run_package_rules(
+        summaries, lines_by_path, suppressions
+    )
+    findings.extend(pkg_findings)
+    suppressed += pkg_suppressed
+    if cache is not None:
+        cache.save(root=root)
     return AnalysisReport(
         findings=findings,
         files=files,
@@ -207,4 +321,6 @@ def analyze_paths(
         duration_s=time.perf_counter() - t0,
         parse_errors=parse_errors,
         analyzed_paths=analyzed,
+        parsed=parsed,
+        cache_hits=cache.hits if cache is not None else 0,
     )

@@ -61,12 +61,19 @@ class Finding:
     #: snippets (a bare ``jax.jit(`` opener) so a new finding elsewhere
     #: in the file can't silently consume a dead grandfather entry
     context: str = ""
+    #: Interprocedural findings only (SVOC008–012): the call chain that
+    #: justifies the finding, entry first, hazard last.  Empty for the
+    #: per-module rules.  NOT part of the baseline key — a refactor of
+    #: an intermediate hop must not orphan a grandfathered entry.
+    path_trace: Tuple[str, ...] = ()
 
     def baseline_key(self) -> Tuple[str, str, str, str]:
         return (self.rule, self.path, self.snippet, self.context)
 
     def to_dict(self) -> Dict[str, object]:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        d["path_trace"] = list(self.path_trace)
+        return d
 
     def render(self) -> str:
         text = (
@@ -77,6 +84,8 @@ class Finding:
             text += f"\n    hint: {self.hint}"
         if self.snippet:
             text += f"\n    | {self.snippet}"
+        for hop in self.path_trace:
+            text += f"\n    via: {hop}"
         return text
 
 
@@ -149,6 +158,27 @@ class SuppressionIndex:
             return True
         rules = self.line_disables.get(line, ())
         return rule in rules or "ALL" in rules
+
+    # -- cache round-trip (svoc_tpu.analysis.cache) -------------------------
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "lines": {
+                str(k): sorted(v) for k, v in self.line_disables.items()
+            },
+            "file": sorted(self.file_disables),
+            "tags": sorted(self.tags),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, object]) -> "SuppressionIndex":
+        idx = cls.__new__(cls)
+        idx.line_disables = {
+            int(k): set(v) for k, v in dict(d.get("lines", {})).items()
+        }
+        idx.file_disables = set(d.get("file", ()))
+        idx.tags = set(d.get("tags", ()))
+        return idx
 
 
 class Baseline:
@@ -246,3 +276,29 @@ class Baseline:
                     }
                 )
         return new, matched, stale
+
+
+def suggest_rebase(
+    stale_entry: Dict[str, str], findings: Iterable[Finding]
+) -> Optional[Finding]:
+    """The nearest CURRENT finding a stale baseline entry probably
+    meant: same rule + path, closest snippet by similarity.  A stale
+    entry usually means the grandfathered statement was *edited*, not
+    fixed — naming the likely successor turns a bare failure into an
+    actionable rebase ("update the entry's snippet/context to this").
+    Returns None when nothing with the same rule+path exists (the
+    finding really was fixed — delete the entry)."""
+    import difflib
+
+    rule = stale_entry.get("rule", "")
+    path = stale_entry.get("path", "")
+    old_snippet = stale_entry.get("snippet", "")
+    candidates = [f for f in findings if f.rule == rule and f.path == path]
+    if not candidates:
+        return None
+    return max(
+        candidates,
+        key=lambda f: difflib.SequenceMatcher(
+            None, old_snippet, f.snippet
+        ).ratio(),
+    )

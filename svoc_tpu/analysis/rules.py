@@ -126,6 +126,51 @@ RULE_DOCS: Dict[str, Dict[str, str]] = {
             "execution"
         ),
     },
+    # -- interprocedural rules (svoc_tpu/analysis/interrules.py) ----------
+    "SVOC008": {
+        "name": "wall-clock-in-fingerprinted-path",
+        "severity": "error",
+        "summary": (
+            "time.time/monotonic/perf_counter/datetime.now reachable "
+            "from journal-emit data or a fingerprint derivation — "
+            "seeded replays stop digesting identically"
+        ),
+    },
+    "SVOC009": {
+        "name": "process-randomized-draw",
+        "severity": "error",
+        "summary": (
+            "hash() / unseeded random.* / set iteration in seed, key, "
+            "or fingerprint derivation paths — the crc32+explicit-key "
+            "discipline, enforced"
+        ),
+    },
+    "SVOC010": {
+        "name": "emit-under-lock",
+        "severity": "warning",
+        "summary": (
+            "a call path reaches journal.emit (subscribers run on the "
+            "emitting thread) while a non-journal lock is held; also "
+            "lock-acquisition cycles (ABBA)"
+        ),
+    },
+    "SVOC011": {
+        "name": "unpinned-replay-knob",
+        "severity": "warning",
+        "summary": (
+            "os.environ / resolve_consensus_impl / resolve_claim_mesh / "
+            "SVOC_* reads reachable from step/dispatch/fetch bodies "
+            "instead of __init__-time pinning"
+        ),
+    },
+    "SVOC012": {
+        "name": "durability-ordering",
+        "severity": "error",
+        "summary": (
+            "os.replace/rename with no reachable fsync_dir, or a "
+            "durability-path file write with no fsync before returning"
+        ),
+    },
 }
 
 

@@ -359,7 +359,7 @@ def _pallas_route(
         validate_consensus_impl(consensus_impl)
         if consensus_impl is not None
         else resolve_consensus_impl()
-    )
+    )  # svoclint: disable=SVOC011 -- deliberate: the fabric/serving path pins the impl at ClaimRouter construction and passes it in; the None fallback serves one-shot library callers only (docs/FABRIC.md §replay)
     if impl != "pallas":
         return False
     _c, n, dim = values.shape
@@ -367,7 +367,7 @@ def _pallas_route(
     if reason is None and (n, dim, cfg) in _MOSAIC_BROKEN:
         reason = "mosaic_error"
     if reason is None and jax.default_backend() != "tpu":
-        if not pallas_interpret_opt_in():
+        if not pallas_interpret_opt_in():  # svoclint: disable=SVOC011 -- deliberate: the interpret opt-in is a parity/test tool toggled per process by the pallas-parity harness; caching it would break the toggle and it is never set in production serving
             # Interpreter mode is a parity tool, not a serving path: a
             # pallas-routed CPU box serves the XLA graph and SAYS so.
             reason = "non_tpu"

@@ -89,7 +89,7 @@ def pallas_max_oracles() -> int:
     importer before it could even reach the XLA fallback."""
     return env_int(
         "SVOC_PALLAS_MAX_ORACLES", _PALLAS_MAX_ORACLES_DEFAULT, minimum=1
-    )
+    )  # svoclint: disable=SVOC011 -- deliberate: parsed-at-first-USE is this knob's documented contract (a malformed value must raise PallasConfigError at use, not at import); the value is env-stable within a run
 
 
 def __getattr__(name: str):
@@ -114,7 +114,7 @@ def fused_fallback_reason(
         # median; other smooth modes take the XLA path so semantics
         # never depend on fleet size.
         return "smooth_mode"
-    if n_oracles > pallas_max_oracles():
+    if n_oracles > pallas_max_oracles():  # svoclint: disable=SVOC011 -- deliberate: see pallas_max_oracles — typed first-use parsing is the contract; tests retune the cap per case
         return "fleet_too_large"
     if n_oracles > _RANK_BLOCK and n_oracles % _RANK_BLOCK != 0:
         # Fleets above the rank block must tile it evenly.

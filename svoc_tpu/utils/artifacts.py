@@ -1,0 +1,41 @@
+"""Atomic, durable JSON artifact writes — the one tmp+replace helper.
+
+Every smoke/bench/campaign tool publishes its artifact the same way:
+``json.dump`` to ``<path>.tmp`` then ``os.replace`` so a concurrent
+reader (the driver, ``hw_watch``, a human ``cat``) never sees a torn
+file.  Twelve hand-rolled copies of that pattern all skipped the
+durability half — no fsync of the data, no fsync of the directory —
+which svoclint SVOC012 now flags: after a crash the rename can
+resurrect the pre-rename layout, and a resumable journal like
+``HW_CAMPAIGN.json`` (whose whole point is surviving interruption)
+could roll back to a state older than work already done.
+
+:func:`atomic_write_json` is the shared replacement: tmp write →
+flush → ``os.fsync`` → ``os.replace`` → ``fsync_dir`` — the same
+ordering as ``utils/checkpoint.save_snapshot``, minus the snapshot
+codec.  Costs one fdatasync per artifact publication (microseconds to
+low milliseconds, on paths that write at most once per smoke run or
+campaign flush — never on a serving hot path).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from svoc_tpu.utils.events import fsync_dir
+
+
+def atomic_write_json(path: str, payload: Any, indent: int = 1) -> None:
+    """Write ``payload`` as JSON at ``path``: whole-or-absent (tmp +
+    rename) AND durable (file fsync before the rename, directory fsync
+    after it, so a crash can neither tear the file nor resurrect the
+    previous one)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=indent)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(path)

@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from svoc_tpu.utils.artifacts import atomic_write_json
 from svoc_tpu.utils.events import EventJournal, EventRecord
 from svoc_tpu.utils.events import journal as _default_journal
 from svoc_tpu.utils.metrics import MetricsRegistry
@@ -216,10 +217,9 @@ def build_bundle(
             out_dir,
             f"postmortem-{trigger.replace('/', '_')}-{_next_bundle_id():03d}.json",
         )
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(bundle, f, indent=1)
-    os.replace(tmp, path)
+    # Durable, not just atomic (svoclint SVOC012): a bundle exists to
+    # outlive the incident — including a host that dies right after.
+    atomic_write_json(path, bundle)
     return path
 
 

@@ -974,12 +974,18 @@ def test_whole_package_run_is_clean_and_fast():
 
 
 def test_every_documented_rule_has_a_registered_doc():
-    assert sorted(RULE_DOCS) == [f"SVOC00{i}" for i in range(1, 8)]
+    # SVOC001–007 per-module + SVOC008–012 interprocedural
+    assert sorted(RULE_DOCS) == [f"SVOC{i:03d}" for i in range(1, 13)]
     for doc in RULE_DOCS.values():
         assert doc["severity"] in ("error", "warning")
 
 
 def _run_cli(args, cwd=REPO_ROOT):
+    # Tests must never touch the repo's real findings cache: default to
+    # --no-cache unless the test explicitly exercises caching.
+    args = list(args)
+    if "--cache" not in args and "--no-cache" not in args:
+        args.append("--no-cache")
     return subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "tools", "svoclint.py"), *args],
         capture_output=True,
@@ -1021,6 +1027,29 @@ _INJECTED = {
     "SVOC007": (
         "import jax\nfrom svoc_tpu.utils.events import emit_event\n\n"
         "@jax.jit\ndef f(x):\n    emit_event('x')\n    return x\n"
+    ),
+    "SVOC008": (
+        "import time\nfrom svoc_tpu.utils.events import emit_event\n\n"
+        "def report(n):\n"
+        "    emit_event('consensus.result', n=n, at=time.time())\n"
+    ),
+    "SVOC009": (
+        "def derive_seed(claim_id):\n    return hash(claim_id) & 0xFFFF\n"
+    ),
+    "SVOC010": (
+        "import threading\nfrom svoc_tpu.utils.events import emit_event\n\n"
+        "_lock = threading.Lock()\n\ndef commit(n):\n    with _lock:\n"
+        "        emit_event('commit.sent', sent=n)\n"
+    ),
+    "SVOC011": (
+        "import os\n\nclass Router:\n    def step(self):\n"
+        "        return os.environ.get('SVOC_CONSENSUS_IMPL')\n"
+    ),
+    "SVOC012": (
+        "import json, os\n\ndef publish(path, payload):\n"
+        "    with open(path + '.tmp', 'w') as f:\n"
+        "        json.dump(payload, f)\n"
+        "    os.replace(path + '.tmp', path)\n"
     ),
 }
 
@@ -1168,3 +1197,826 @@ def test_linting_never_imports_jax():
         timeout=120,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# SVOC008 — wall-clock-in-fingerprinted-path (interprocedural)
+# ---------------------------------------------------------------------------
+
+
+def test_svoc008_flags_wall_clock_inline_in_emit_data():
+    findings = analyze_source(
+        src(
+            """
+            import time
+            from svoc_tpu.utils.events import emit_event
+
+            def report(n):
+                emit_event("consensus.result", n=n, at=time.time())
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC008"]
+    assert findings[0].path_trace  # interprocedural findings carry a trace
+
+
+def test_svoc008_flags_wall_clock_through_a_helper_with_path_trace():
+    findings = analyze_source(
+        src(
+            """
+            import time
+            from svoc_tpu.utils.events import emit_event
+
+            def stamp():
+                return time.time()
+
+            def report(n):
+                emit_event("consensus.result", n=n, at=stamp())
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC008"]
+    trace = " | ".join(findings[0].path_trace)
+    assert "stamp" in trace and "time.time" in trace
+
+
+def test_svoc008_flags_fingerprint_path_reaching_clock():
+    findings = analyze_source(
+        src(
+            """
+            import time
+
+            def fingerprint_payload(data):
+                return {"data": data, "at": time.time()}
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC008"]
+
+
+def test_svoc008_negative_clock_outside_emit_data_and_bare_time_method():
+    findings = analyze_source(
+        src(
+            """
+            import time
+            from svoc_tpu.utils.events import emit_event
+            from svoc_tpu.utils.metrics import registry as metrics
+
+            def report(n):
+                t0 = time.perf_counter()
+                emit_event("consensus.result", n=n)
+                with metrics.timer("latency").time():
+                    pass
+                return time.perf_counter() - t0
+            """
+        )
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SVOC009 — process-randomized-draw (interprocedural)
+# ---------------------------------------------------------------------------
+
+
+def test_svoc009_flags_hash_random_and_set_iteration_in_seed_paths():
+    findings = analyze_source(
+        src(
+            """
+            import random
+
+            def derive_seed(claim_id):
+                return hash(claim_id) & 0xFFFF
+
+            def jitter_seed():
+                return int(random.random() * 1e6)
+
+            def mix_seed(ids):
+                total = 0
+                for i in set(ids):
+                    total ^= i
+                return total
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC009"]
+    assert len(findings) == 3
+
+
+def test_svoc009_flags_draw_reached_through_a_helper():
+    findings = analyze_source(
+        src(
+            """
+            def _salt(x):
+                return hash(x)
+
+            def claim_seed(base, claim_id):
+                return base ^ _salt(claim_id)
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC009"]
+    assert any("claim_seed" in h for f in findings for h in f.path_trace)
+
+
+def test_svoc009_negative_crc32_seeded_random_and_sorted_set():
+    findings = analyze_source(
+        src(
+            """
+            import random
+            import zlib
+
+            def claim_seed(base, claim_id):
+                return zlib.crc32(repr(claim_id).encode()) ^ base
+
+            def jitter_seed(seed):
+                return random.Random(seed).random()
+
+            def mix_seed(ids):
+                return sum(i for i in sorted(set(ids)))
+            """
+        )
+    )
+    assert findings == []
+
+
+def test_svoc009_negative_outside_seed_paths():
+    # hash()/set iteration in NON-derivation functions is ordinary code
+    findings = analyze_source(
+        src(
+            """
+            def bucket(x):
+                return hash(x) % 8
+
+            def union(ids):
+                return [i for i in set(ids)]
+            """
+        )
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SVOC010 — emit-under-lock / lock-order (interprocedural)
+# ---------------------------------------------------------------------------
+
+_LEAF_LOCK_VIOLATION = """
+import threading
+from svoc_tpu.utils.events import emit_event
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _publish(self, n):
+        emit_event("consensus.result", n=n)
+
+    def commit(self, n):
+        with self._lock:
+            self._publish(n)
+"""
+
+
+def test_svoc010_flags_emit_reached_while_lock_held():
+    findings = analyze_source(src(_LEAF_LOCK_VIOLATION))
+    assert rules_of(findings) == ["SVOC010"]
+    (f,) = findings
+    assert "_lock" in f.message
+    trace = " | ".join(f.path_trace)
+    assert "_publish" in trace and "emit" in trace
+
+
+def test_svoc010_flags_direct_emit_under_lock():
+    findings = analyze_source(
+        src(
+            """
+            import threading
+            from svoc_tpu.utils.events import emit_event
+
+            _lock = threading.Lock()
+
+            def commit(n):
+                with _lock:
+                    emit_event("commit.sent", sent=n)
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC010"]
+
+
+def test_svoc010_negative_queue_and_flush_after_release():
+    findings = analyze_source(
+        src(
+            """
+            import threading
+            from svoc_tpu.utils.events import emit_event
+
+            class Breaker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._pending = []
+
+                def record(self, n):
+                    with self._lock:
+                        self._pending.append(n)
+                    for n in self._pending:
+                        emit_event("breaker.transition", n=n)
+            """
+        )
+    )
+    assert findings == []
+
+
+def test_svoc010_negative_journal_internal_locks_are_leaves():
+    # The journal holding its OWN lock around the ring append is the
+    # design — utils/events.py locks are exempt.
+    findings = analyze_source(
+        src(
+            """
+            import threading
+
+            class EventJournal:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def emit(self, event_type, **data):
+                    with self._lock:
+                        self._ring.append((event_type, data))
+            """
+        ),
+        path="svoc_tpu/utils/events.py",
+    )
+    assert findings == []
+
+
+def test_svoc010_flags_lock_acquisition_cycle():
+    findings = analyze_source(
+        src(
+            """
+            import threading
+
+            a_lock = threading.Lock()
+            b_lock = threading.Lock()
+
+            def one():
+                with a_lock:
+                    with b_lock:
+                        pass
+
+            def two():
+                with b_lock:
+                    with a_lock:
+                        pass
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC010"]
+    assert any("cycle" in f.message for f in findings)
+
+
+def test_svoc010_negative_consistent_lock_order():
+    findings = analyze_source(
+        src(
+            """
+            import threading
+
+            a_lock = threading.Lock()
+            b_lock = threading.Lock()
+
+            def one():
+                with a_lock:
+                    with b_lock:
+                        pass
+
+            def two():
+                with a_lock:
+                    with b_lock:
+                        pass
+            """
+        )
+    )
+    assert findings == []
+
+
+def test_svoc010_flags_interprocedural_lock_cycle():
+    # f holds A and calls g which takes B; h holds B and calls k which
+    # takes A — the cycle spans four functions.
+    findings = analyze_source(
+        src(
+            """
+            import threading
+
+            a_lock = threading.Lock()
+            b_lock = threading.Lock()
+
+            def take_b():
+                with b_lock:
+                    pass
+
+            def take_a():
+                with a_lock:
+                    pass
+
+            def one():
+                with a_lock:
+                    take_b()
+
+            def two():
+                with b_lock:
+                    take_a()
+            """
+        )
+    )
+    assert "SVOC010" in rules_of(findings)
+    assert any("cycle" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# SVOC011 — unpinned-replay-knob (interprocedural)
+# ---------------------------------------------------------------------------
+
+_PER_STEP_ENV_READ = """
+import os
+
+class Router:
+    def step(self):
+        return os.environ.get("SVOC_CONSENSUS_IMPL")
+"""
+
+_PINNED_ENV_READ = """
+import os
+
+class Router:
+    def __init__(self):
+        self._impl = os.environ.get("SVOC_CONSENSUS_IMPL")
+
+    def step(self):
+        return self._impl
+"""
+
+
+def test_svoc011_pinned_vs_per_step_env_read_pair():
+    flagged = analyze_source(src(_PER_STEP_ENV_READ))
+    assert rules_of(flagged) == ["SVOC011"]
+    assert "pinned" in flagged[0].message or "pinned" in flagged[0].hint
+    assert analyze_source(src(_PINNED_ENV_READ)) == []
+
+
+def test_svoc011_flags_knob_resolution_through_helpers():
+    findings = analyze_source(
+        src(
+            """
+            from svoc_tpu.consensus.dispatch import resolve_consensus_impl
+
+            def _route():
+                return resolve_consensus_impl()
+
+            class Dispatcher:
+                def dispatch_gated(self, values):
+                    return _route()
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC011"]
+    trace = " | ".join(findings[0].path_trace)
+    assert "dispatch_gated" in trace and "resolve_consensus_impl" in trace
+
+
+def test_svoc011_negative_non_svoc_env_and_non_entry_functions():
+    findings = analyze_source(
+        src(
+            """
+            import os
+
+            def configure():
+                # not a step/dispatch/fetch body: resolution-time read
+                return os.environ.get("SVOC_CONSENSUS_IMPL")
+
+            class Router:
+                def step(self):
+                    return os.environ.get("HOME")  # not a replay knob
+            """
+        )
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# SVOC012 — durability-ordering
+# ---------------------------------------------------------------------------
+
+
+def test_svoc012_flags_replace_without_fsync():
+    findings = analyze_source(
+        src(
+            """
+            import json, os
+
+            def publish(path, payload):
+                with open(path + ".tmp", "w") as f:
+                    json.dump(payload, f)
+                os.replace(path + ".tmp", path)
+            """
+        )
+    )
+    assert rules_of(findings) == ["SVOC012"]
+    assert "fsync" in findings[0].message
+
+
+def test_svoc012_negative_fsynced_replace():
+    findings = analyze_source(
+        src(
+            """
+            import json, os
+            from svoc_tpu.utils.events import fsync_dir
+
+            def publish(path, payload):
+                with open(path + ".tmp", "w") as f:
+                    json.dump(payload, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(path + ".tmp", path)
+                fsync_dir(path)
+            """
+        )
+    )
+    assert findings == []
+
+
+def test_svoc012_flags_durability_path_write_without_fsync():
+    findings = analyze_source(
+        src(
+            """
+            import json
+
+            class WAL:
+                def append(self, record):
+                    self._f.write(json.dumps(record) + "\\n")
+                    self._f.flush()
+            """
+        ),
+        path="svoc_tpu/durability/wal.py",
+    )
+    assert rules_of(findings) == ["SVOC012"]
+
+
+def test_svoc012_negative_durability_write_with_fsync_and_non_durability_scope():
+    fsynced = src(
+        """
+        import json, os
+
+        class WAL:
+            def append(self, record):
+                self._f.write(json.dumps(record) + "\\n")
+                self._f.flush()
+                os.fsync(self._f.fileno())
+        """
+    )
+    assert analyze_source(fsynced, path="svoc_tpu/durability/wal.py") == []
+    # the same unfsynced write OUTSIDE durability scope is ordinary I/O
+    plain = src(
+        """
+        import json
+
+        class Exporter:
+            def append(self, record):
+                self._f.write(json.dumps(record) + "\\n")
+        """
+    )
+    assert analyze_source(plain, path="svoc_tpu/utils/export.py") == []
+
+
+# ---------------------------------------------------------------------------
+# call-graph resolution units
+# ---------------------------------------------------------------------------
+
+
+def test_callgraph_resolution_local_imported_self_and_alias():
+    import ast as _ast
+
+    from svoc_tpu.analysis.callgraph import Program, summarize_module
+
+    helpers = summarize_module(
+        "pkg/helpers.py",
+        _ast.parse(
+            src(
+                """
+                def derive(x):
+                    return x
+
+                class Store:
+                    def persist(self):
+                        pass
+                """
+            )
+        ),
+    )
+    main = summarize_module(
+        "pkg/main.py",
+        _ast.parse(
+            src(
+                """
+                from pkg.helpers import derive
+                from pkg import helpers as h
+
+                def local():
+                    pass
+
+                class Engine:
+                    def helper_method(self):
+                        pass
+
+                    def run(self, store):
+                        local()
+                        derive(1)
+                        h.derive(2)
+                        self.helper_method()
+                        store.persist()
+                        store.commit()
+                """
+            )
+        ),
+    )
+    program = Program([helpers, main])
+    run = next(f for f in main.functions if f.name == "run")
+    calls = {c.name or c.leaf: c for c in run.calls}
+    resolve = lambda c: program.resolve(main, c, run)
+    assert resolve(calls["local"]) == "pkg/main.py::local"
+    assert resolve(calls["derive"]) == "pkg/helpers.py::derive"
+    assert resolve(calls["h.derive"]) == "pkg/helpers.py::derive"
+    assert resolve(calls["self.helper_method"]) == "pkg/main.py::Engine.helper_method"
+    # unique-method fallback: persist is defined by exactly one class
+    assert resolve(calls["store.persist"]) == "pkg/helpers.py::Store.persist"
+    # blacklisted common method: conn.commit must never cross-resolve
+    assert resolve(calls["store.commit"]) is None
+
+
+def test_cross_module_interprocedural_finding_via_analyze_paths(tmp_path):
+    (tmp_path / "clocks.py").write_text(
+        "import time\n\n\ndef stamp():\n    return time.time()\n"
+    )
+    (tmp_path / "reporter.py").write_text(
+        "from clocks import stamp\n"
+        "from svoc_tpu.utils.events import emit_event\n\n\n"
+        "def report(n):\n"
+        "    emit_event('consensus.result', n=n, at=stamp())\n"
+    )
+    report = analyze_paths([str(tmp_path)], root=str(tmp_path))
+    rules = rules_of(report.all_findings)
+    assert rules == ["SVOC008"]
+    (f,) = report.all_findings
+    assert f.path == "reporter.py"
+    assert any("clocks.py" in hop for hop in f.path_trace)
+
+
+def test_interprocedural_findings_respect_inline_suppressions():
+    findings = analyze_source(
+        src(
+            """
+            import threading
+            from svoc_tpu.utils.events import emit_event
+
+            _lock = threading.Lock()
+
+            def commit(n):
+                with _lock:
+                    emit_event("commit.sent", sent=n)  # svoclint: disable=SVOC010 -- no subscriber re-enters
+            """
+        )
+    )
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# findings cache (.svoclint_cache.json)
+# ---------------------------------------------------------------------------
+
+
+def _make_tree(root, n=60):
+    for i in range(n):
+        body = "\n".join(
+            f"def fn_{i}_{j}(x):\n    return x + {j}\n" for j in range(20)
+        )
+        (root / f"mod_{i:03d}.py").write_text(
+            f'"""module {i}"""\nimport json\n\n{body}\n'
+        )
+
+
+def test_cache_cold_parses_warm_does_not_and_is_faster(tmp_path):
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    _make_tree(tree, n=120)
+    cache = str(tmp_path / "cache.json")
+    cold = analyze_paths([str(tree)], root=str(tmp_path), cache_path=cache)
+    assert cold.parsed == cold.files == 120
+    assert cold.cache_hits == 0
+    warm = analyze_paths([str(tree)], root=str(tmp_path), cache_path=cache)
+    assert warm.parsed == 0
+    assert warm.cache_hits == 120
+    assert warm.all_findings == cold.all_findings
+    # the cache exists to buy time: a warm run skips every parse.
+    # Wall-clock on a loaded single-core box can stall any ONE run, so
+    # the timing claim is best-of-3 warm vs the single cold run.
+    warm_times = [warm.duration_s] + [
+        analyze_paths(
+            [str(tree)], root=str(tmp_path), cache_path=cache
+        ).duration_s
+        for _ in range(2)
+    ]
+    assert min(warm_times) < cold.duration_s
+
+
+def test_cache_invalidates_only_the_edited_file(tmp_path):
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    _make_tree(tree, n=10)
+    cache = str(tmp_path / "cache.json")
+    analyze_paths([str(tree)], root=str(tmp_path), cache_path=cache)
+    (tree / "mod_003.py").write_text(
+        "import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n"
+    )
+    r = analyze_paths([str(tree)], root=str(tmp_path), cache_path=cache)
+    assert r.parsed == 1 and r.cache_hits == 9
+    assert rules_of(r.all_findings) == ["SVOC001"]
+
+
+def test_cache_subset_run_does_not_evict_other_entries(tmp_path):
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    _make_tree(tree, n=8)
+    cache = str(tmp_path / "cache.json")
+    analyze_paths([str(tree)], root=str(tmp_path), cache_path=cache)
+    # a one-file subset run rewrites the cache...
+    analyze_paths(
+        [str(tree / "mod_000.py")], root=str(tmp_path), cache_path=cache
+    )
+    # ...but the full tree is still warm afterwards
+    r = analyze_paths([str(tree)], root=str(tmp_path), cache_path=cache)
+    assert r.parsed == 0 and r.cache_hits == 8
+
+
+def test_cache_version_mismatch_invalidates(tmp_path):
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    _make_tree(tree, n=4)
+    cache = str(tmp_path / "cache.json")
+    analyze_paths([str(tree)], root=str(tmp_path), cache_path=cache)
+    data = json.load(open(cache))
+    data["ruleset"] = "older-ruleset"
+    json.dump(data, open(cache, "w"))
+    r = analyze_paths([str(tree)], root=str(tmp_path), cache_path=cache)
+    assert r.parsed == 4 and r.cache_hits == 0
+
+
+def test_cli_cache_flag_round_trip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n")
+    cache = str(tmp_path / "cache.json")
+    first = _run_cli([str(bad), "--no-baseline", "--cache", cache])
+    second = _run_cli([str(bad), "--no-baseline", "--cache", cache])
+    assert first.returncode == second.returncode == 1
+    assert "SVOC001" in second.stdout
+    assert "0 parsed" in second.stdout  # warm run, same findings
+
+
+# ---------------------------------------------------------------------------
+# --changed mode
+# ---------------------------------------------------------------------------
+
+
+def _git(cwd, *args):
+    return subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        capture_output=True,
+        text=True,
+        cwd=cwd,
+        timeout=30,
+    )
+
+
+def test_changed_mode_lints_only_files_differing_from_main(tmp_path):
+    if _git(tmp_path, "--version").returncode != 0:
+        pytest.skip("git unavailable")
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    assert _git(repo, "init", "-q", "-b", "main").returncode == 0
+    bad = "import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n"
+    (repo / "committed_bad.py").write_text(bad)
+    (repo / "touched.py").write_text("x = 1\n")
+    _git(repo, "add", "-A")
+    assert _git(repo, "commit", "-q", "-m", "seed").returncode == 0
+    # committed_bad is UNCHANGED vs main; touched gains a violation
+    (repo / "touched.py").write_text(bad)
+    proc = _run_cli(
+        [str(repo), "--changed", "--no-baseline", "--no-cache",
+         "--root", str(repo)],
+        cwd=str(repo),
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "touched.py" in proc.stdout
+    assert "committed_bad.py" not in proc.stdout
+
+
+def test_changed_mode_clean_when_nothing_changed(tmp_path):
+    if _git(tmp_path, "--version").returncode != 0:
+        pytest.skip("git unavailable")
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    assert _git(repo, "init", "-q", "-b", "main").returncode == 0
+    (repo / "mod.py").write_text("x = 1\n")
+    _git(repo, "add", "-A")
+    assert _git(repo, "commit", "-q", "-m", "seed").returncode == 0
+    proc = _run_cli(
+        [str(repo), "--changed", "--no-baseline", "--no-cache",
+         "--root", str(repo)],
+        cwd=str(repo),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no changed python files" in proc.stdout
+
+
+def test_changed_mode_falls_back_to_full_tree_without_git(tmp_path):
+    # --root points at a directory that is not a git repo (and has no
+    # main ref): --changed must lint the FULL tree, loudly.
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n")
+    env_dir = tmp_path  # no .git anywhere up to /tmp... but the repo
+    # itself is one; point --root at tmp_path so merge-base runs there
+    proc = _run_cli(
+        [str(bad), "--changed", "--no-baseline", "--no-cache",
+         "--root", str(env_dir)],
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "SVOC001" in proc.stdout
+    assert "full tree" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# stale-entry rebase suggestions
+# ---------------------------------------------------------------------------
+
+
+def test_stale_baseline_entry_suggests_nearest_rebase(tmp_path):
+    original = "import jax\n\n@jax.jit\ndef f(x):\n    return np.asarray(x)\n"
+    edited = "import jax\n\n@jax.jit\ndef f(x):\n    return np.asarray(x * 2)\n"
+    mod = tmp_path / "mod.py"
+    mod.write_text("import numpy as np\n" + original)
+    bl = tmp_path / "bl.json"
+    proc = _run_cli([str(mod), "--baseline", str(bl), "--write-baseline",
+                     "--no-cache", "--root", str(tmp_path)])
+    assert proc.returncode == 0
+    mod.write_text("import numpy as np\n" + edited)
+    proc = _run_cli([str(mod), "--baseline", str(bl), "--no-cache",
+                     "--root", str(tmp_path)])
+    assert proc.returncode == 1
+    assert "stale baseline entry" in proc.stdout
+    assert "suggested rebase" in proc.stdout
+    assert "np.asarray(x * 2)" in proc.stdout
+    # ...and the JSON form carries the suggestion structurally
+    proc = _run_cli([str(mod), "--baseline", str(bl), "--no-cache",
+                     "--format", "json", "--root", str(tmp_path)])
+    payload = json.loads(proc.stdout)
+    (entry,) = payload["stale_baseline_entries"]
+    assert entry["suggested_rebase"]["snippet"] == "return np.asarray(x * 2)"
+
+
+def test_stale_entry_with_no_successor_suggests_nothing(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import jax\nimport numpy as np\n\n@jax.jit\ndef f(x):\n"
+        "    return np.asarray(x)\n"
+    )
+    bl = tmp_path / "bl.json"
+    _run_cli([str(mod), "--baseline", str(bl), "--write-baseline",
+              "--no-cache", "--root", str(tmp_path)])
+    mod.write_text("x = 1\n")  # finding truly fixed
+    proc = _run_cli([str(mod), "--baseline", str(bl), "--no-cache",
+                     "--root", str(tmp_path)])
+    assert proc.returncode == 1
+    assert "stale baseline entry" in proc.stdout
+    assert "suggested rebase" not in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# JSON schema: path_trace
+# ---------------------------------------------------------------------------
+
+
+def test_json_findings_carry_path_trace_for_interprocedural_rules(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_INJECTED["SVOC010"])
+    proc = _run_cli([str(bad), "--no-baseline", "--no-cache",
+                     "--format", "json"])
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    (finding,) = payload["findings"]
+    assert finding["rule"] == "SVOC010"
+    assert isinstance(finding["path_trace"], list) and finding["path_trace"]
+    # per-module findings carry an EMPTY trace, same schema
+    bad2 = tmp_path / "bad2.py"
+    bad2.write_text(_INJECTED["SVOC001"])
+    proc = _run_cli([str(bad2), "--no-baseline", "--no-cache",
+                     "--format", "json"])
+    payload = json.loads(proc.stdout)
+    assert payload["findings"][0]["path_trace"] == []

@@ -36,6 +36,7 @@ import json  # noqa: E402
 import sys  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
 
 
 def main(argv=None) -> int:
@@ -68,9 +69,7 @@ def main(argv=None) -> int:
         "run": first,
         "replay_fingerprint": second["fingerprint"],
     }
-    with open(args.out + ".tmp", "w") as f:
-        json.dump(artifact, f, indent=1)
-    os.replace(args.out + ".tmp", args.out)
+    atomic_write_json(args.out, artifact)
     print(
         json.dumps(
             {

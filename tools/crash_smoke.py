@@ -48,6 +48,8 @@ import tempfile  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
+
 TOTAL_STEPS = 8
 
 
@@ -213,9 +215,7 @@ def main(argv=None) -> int:
             for point in CRASH_POINTS
         },
     }
-    with open(args.out + ".tmp", "w") as f:
-        json.dump(artifact, f, indent=1)
-    os.replace(args.out + ".tmp", args.out)
+    atomic_write_json(args.out, artifact)
     for name, passed in sorted(all_checks.items()):
         print(f"  {'PASS' if passed else 'FAIL'}  {name}")
     print(

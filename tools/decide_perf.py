@@ -53,6 +53,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "PERF_DECISIONS.json")
 
 sys.path.insert(0, REPO)
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
 from bench import LOSSLESS_VARIANT_CONFIGS  # noqa: E402
 
 # {item_name: variant} derived from bench.py's single mapping so the
@@ -515,10 +516,7 @@ def main(argv=None) -> int:
     }
     print(json.dumps(record, indent=1))
     if not args.dry_run:
-        tmp = OUT + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(record, f, indent=1)
-        os.replace(tmp, OUT)
+        atomic_write_json(OUT, record)
         print(f"[decide_perf] wrote {OUT}")
     return 0
 

@@ -48,6 +48,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
 
 import jax
 import jax.numpy as jnp
@@ -163,10 +164,7 @@ def parity_only():
         ),
         "captured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
     }
-    tmp = "FLASH_PARITY.json.tmp"
-    with open(tmp, "w") as fh:
-        json.dump(verdict, fh, indent=1)
-    os.replace(tmp, "FLASH_PARITY.json")
+    atomic_write_json("FLASH_PARITY.json", verdict)
     print(json.dumps({"verdict": verdict["verdict"]}), flush=True)
     # A completed adjudication is a SUCCESS whichever way it lands —
     # "diverged" is a valid decision outcome (it routes the flagship
@@ -185,10 +183,7 @@ def main():
         """Flush after every stage: the 2026-07-30 on-chip run hung in
         this probe (suspect: the FA-2 backward Mosaic compile) and lost
         every number because the file was written only at the end."""
-        tmp = "FLASH_PROBE.json.tmp"
-        with open(tmp, "w") as fh:
-            json.dump(results, fh, indent=1)
-        os.replace(tmp, "FLASH_PROBE.json")
+        atomic_write_json("FLASH_PROBE.json", results)
 
     h, d = 12, 64
     for b, t in ((256, 128), (8, 512), (8, 2048), (2, 8192)):

@@ -43,6 +43,8 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
 
 from hw_queue import (  # noqa: E402
     BENCH_TIMEOUT_MARGIN_S,
@@ -246,10 +248,7 @@ def main(argv=None) -> int:
 
     def flush(note=""):
         state["updated_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
-        tmp = OUT + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(state, f, indent=1)
-        os.replace(tmp, OUT)
+        atomic_write_json(OUT, state)
         if note:
             print(f"[campaign] {note}", flush=True)
 

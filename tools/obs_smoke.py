@@ -39,6 +39,7 @@ import sys  # noqa: E402
 import tempfile  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
 
 
 def _audit_linkage(journal) -> dict:
@@ -215,9 +216,7 @@ def main(argv=None) -> int:
         "session": session_leg,
         "overhead": overhead,
     }
-    with open(args.out + ".tmp", "w") as f:
-        json.dump(artifact, f, indent=1)
-    os.replace(args.out + ".tmp", args.out)
+    atomic_write_json(args.out, artifact)
     print(
         json.dumps(
             {

@@ -39,6 +39,7 @@ import sys  # noqa: E402
 import time  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
 
 
 def _jsonable_sweep(sweep):
@@ -140,9 +141,7 @@ def main(argv=None) -> int:
     t0 = time.monotonic()
     artifact = run(args.seed, args.smoke)
     artifact["elapsed_s"] = round(time.monotonic() - t0, 2)
-    with open(out_path + ".tmp", "w") as f:
-        json.dump(artifact, f, indent=1)
-    os.replace(out_path + ".tmp", out_path)
+    atomic_write_json(out_path, artifact)
     summary = {
         "robustness_cert": "ok" if artifact["ok"] else "FAILED",
         "mode": artifact["mode"],

@@ -41,6 +41,7 @@ import json  # noqa: E402
 import sys  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
 
 
 def main(argv=None) -> int:
@@ -99,9 +100,7 @@ def main(argv=None) -> int:
         "journal_fingerprint": first["journal_fingerprint"],
         "journal_events": first["journal_events"],
     }
-    with open(args.out + ".tmp", "w") as f:
-        json.dump(artifact, f, indent=1)
-    os.replace(args.out + ".tmp", args.out)
+    atomic_write_json(args.out, artifact)
     for name, passed in checks.items():
         print(f"  {'PASS' if passed else 'FAIL'}  {name}")
     print(

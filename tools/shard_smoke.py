@@ -46,6 +46,7 @@ import json  # noqa: E402
 import sys  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
 
 MESH = "2x4"
 N_ORACLES = 8  # divisible by the mesh oracle axis
@@ -142,9 +143,7 @@ def main(argv=None) -> int:
         "journal_fingerprint": first["journal_fingerprint"],
         "ok": all(checks.values()),
     }
-    with open(args.out + ".tmp", "w") as f:
-        json.dump(report, f, indent=1)
-    os.replace(args.out + ".tmp", args.out)
+    atomic_write_json(args.out, report)
     for name, passed in checks.items():
         print(f"[shard-smoke] {'PASS' if passed else 'FAIL'} {name}")
     print(

@@ -49,6 +49,7 @@ import threading  # noqa: E402
 import time  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
 
 import jax  # noqa: E402
 
@@ -221,9 +222,7 @@ def main(argv=None) -> int:
     }
 
     def flush():
-        with open(args.out + ".tmp", "w") as f:
-            json.dump(artifact, f, indent=1)
-        os.replace(args.out + ".tmp", args.out)
+        atomic_write_json(args.out, artifact)
 
     console.query("auto_resume on")
     out = console.query("live_mode on")

@@ -5,6 +5,7 @@ Usage::
 
     python tools/svoclint.py svoc_tpu tools                # text report
     python tools/svoclint.py svoc_tpu tools --format json  # machine form
+    python tools/svoclint.py --changed                     # pre-commit loop
     python tools/svoclint.py svoc_tpu --write-baseline     # grandfather
     python tools/svoclint.py --list-rules
 
@@ -12,6 +13,16 @@ Exit codes: **0** clean (every finding fixed, suppressed, or baselined),
 **1** non-baselined findings (or stale baseline entries — baselines only
 shrink), **2** usage/internal error.  ``make lint`` runs this over
 ``svoc_tpu tools`` with the checked-in ``tools/svoclint_baseline.json``.
+
+Two speed paths keep iteration sub-second as the repo grows:
+``--changed`` lints only files differing from ``git merge-base HEAD
+main`` (falling back to the full tree when git is unavailable), and the
+content-hash findings cache (``.svoclint_cache.json``, gitignored; keyed
+by rule-set version + file sha256) lets warm full runs skip parsing
+unchanged files entirely.  The interprocedural rules (SVOC008–012) run
+fresh every time over the cached per-module summaries — their findings
+carry a ``path_trace`` (the call chain that justifies the finding) in
+both text (``via:`` lines) and JSON output.
 
 No JAX import anywhere on this path (enforced by
 tests/test_svoclint.py): linting must cost sub-seconds on a CPU-only
@@ -24,6 +35,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -34,11 +46,14 @@ from svoc_tpu.analysis import (  # noqa: E402 (path bootstrap above)
     Baseline,
     RULE_DOCS,
     analyze_paths,
+    suggest_rebase,
 )
+from svoc_tpu.analysis.cache import CACHE_BASENAME  # noqa: E402
 
 # Anchored to the repo (not the CWD): running the linter from another
 # directory must still honor the checked-in baseline.
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "svoclint_baseline.json")
+DEFAULT_CACHE = os.path.join(REPO_ROOT, CACHE_BASENAME)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -79,6 +94,25 @@ def build_parser() -> argparse.ArgumentParser:
         help="write all current findings to the baseline file and exit 0",
     )
     p.add_argument(
+        "--changed",
+        action="store_true",
+        help="lint only files differing from `git merge-base HEAD main` "
+        "(plus untracked), restricted to the given paths; falls back to "
+        "the full tree when git is unavailable.  Stale baseline entries "
+        "outside the changed subset are ignored (the full run owns them).",
+    )
+    p.add_argument(
+        "--cache",
+        default=DEFAULT_CACHE,
+        help="findings-cache path (content-hash keyed; skips re-parsing "
+        f"unchanged files; default: {DEFAULT_CACHE})",
+    )
+    p.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="disable the findings cache for this run",
+    )
+    p.add_argument(
         "--list-rules", action="store_true", help="print the rule table and exit"
     )
     p.add_argument(
@@ -93,8 +127,65 @@ def build_parser() -> argparse.ArgumentParser:
 def _list_rules() -> int:
     for rule_id in sorted(RULE_DOCS):
         doc = RULE_DOCS[rule_id]
-        print(f"{rule_id}  {doc['name']:24s} [{doc['severity']}] {doc['summary']}")
+        print(f"{rule_id}  {doc['name']:32s} [{doc['severity']}] {doc['summary']}")
     return 0
+
+
+def _git_changed_files(root: str):
+    """Repo-root-relative paths of ``*.py`` files differing from the
+    merge-base with main (ACMR) plus untracked files, or None when git
+    (or the main ref) is unavailable — the caller falls back to the
+    full tree, never to silence."""
+
+    def run(cwd, *args):
+        proc = subprocess.run(
+            ["git", *args], capture_output=True, text=True, cwd=cwd,
+            timeout=30,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr.strip() or "git failed")
+        return proc.stdout
+
+    try:
+        # git reports diff paths relative to the TOPLEVEL, whatever cwd
+        # the command ran from — resolve against it, not args.root, or
+        # a non-toplevel --root silently drops every tracked change.
+        top = run(root, "rev-parse", "--show-toplevel").strip()
+        base = run(top, "merge-base", "HEAD", "main").strip()
+        diff = run(
+            top, "diff", "--name-only", "--diff-filter=ACMR", base,
+            "--", "*.py",
+        )
+        untracked = run(
+            top, "ls-files", "--others", "--exclude-standard", "--", "*.py"
+        )
+    except (RuntimeError, OSError, subprocess.SubprocessError):
+        return None
+    files = [l.strip() for l in (diff + untracked).splitlines() if l.strip()]
+    return sorted(os.path.join(top, f) for f in set(files))
+
+
+def _restrict_to_changed(paths, root):
+    """``(files, fell_back)``: the changed files under ``paths``, or
+    the original paths when git is unavailable."""
+    changed = _git_changed_files(root)
+    if changed is None:
+        print(
+            "svoclint: --changed requested but git/main unavailable — "
+            "linting the full tree",
+            file=sys.stderr,
+        )
+        return list(paths), True
+    roots = [os.path.abspath(p) for p in paths]
+    out = []
+    for full in changed:  # already absolute (toplevel-joined)
+        if not os.path.exists(full):
+            continue  # deleted files have nothing to lint
+        for r in roots:
+            if full == r or full.startswith(r + os.sep):
+                out.append(full)
+                break
+    return out, False
 
 
 def main(argv=None) -> int:
@@ -107,7 +198,18 @@ def main(argv=None) -> int:
             print(f"svoclint: path does not exist: {path}", file=sys.stderr)
             return 2
 
-    report = analyze_paths(args.paths, root=args.root)
+    paths = list(args.paths)
+    changed_subset = False
+    if args.changed:
+        paths, fell_back = _restrict_to_changed(paths, args.root)
+        changed_subset = not fell_back
+        if changed_subset and not paths:
+            print("svoclint: clean — no changed python files under the "
+                  "given paths")
+            return 0
+
+    cache_path = None if args.no_cache else args.cache
+    report = analyze_paths(paths, root=args.root, cache_path=cache_path)
     findings = report.all_findings
 
     baseline_path = args.baseline or (
@@ -182,6 +284,20 @@ def main(argv=None) -> int:
             print(f"svoclint: bad baseline {baseline_path}: {e}", file=sys.stderr)
             return 2
         findings, baselined, stale = baseline.split(findings)
+        if changed_subset:
+            # A --changed run sees only a slice of the tree: entries
+            # for files OUTSIDE the slice are not stale, they are
+            # simply unobserved — the full run owns their lifecycle.
+            analyzed = set(report.analyzed_paths)
+            stale = [e for e in stale if e.get("path") in analyzed]
+
+    # Stale-entry diagnostics: the grandfathered statement was usually
+    # EDITED, not fixed — name the likely successor so the failure is
+    # an actionable one-line rebase instead of an archaeology session.
+    all_current = report.all_findings
+    suggestions = {
+        id(e): suggest_rebase(e, all_current) for e in stale
+    }
 
     if args.format == "json":
         payload = {
@@ -192,8 +308,20 @@ def main(argv=None) -> int:
                 "suppressed": report.suppressed,
                 "stale_baseline_entries": len(stale),
                 "files": report.files,
+                "parsed": report.parsed,
+                "cache_hits": report.cache_hits,
             },
-            "stale_baseline_entries": stale,
+            "stale_baseline_entries": [
+                dict(
+                    e,
+                    suggested_rebase=(
+                        suggestions[id(e)].to_dict()
+                        if suggestions[id(e)] is not None
+                        else None
+                    ),
+                )
+                for e in stale
+            ],
             "duration_s": round(report.duration_s, 3),
         }
         print(json.dumps(payload, indent=2))
@@ -205,12 +333,21 @@ def main(argv=None) -> int:
                 f"stale baseline entry (finding no longer present — remove "
                 f"it): {entry['rule']} {entry['path']} | {entry['snippet']}"
             )
+            hint = suggestions[id(entry)]
+            if hint is not None:
+                print(
+                    f"    suggested rebase -> same rule+path at "
+                    f"{hint.path}:{hint.line}: | {hint.snippet}\n"
+                    "    (update the entry's snippet/context to match, "
+                    "or fix the finding and delete the entry)"
+                )
         status = "clean" if not findings and not stale else "FAILED"
         print(
             f"svoclint: {status} — {len(findings)} new, {len(baselined)} "
             f"baselined, {report.suppressed} suppressed, {len(stale)} stale "
             f"baseline entr{'y' if len(stale) == 1 else 'ies'} "
-            f"({report.files} files in {report.duration_s:.2f}s)"
+            f"({report.files} files, {report.parsed} parsed, "
+            f"in {report.duration_s:.2f}s)"
         )
 
     return 1 if findings or stale else 0

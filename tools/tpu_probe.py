@@ -33,6 +33,10 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
 
 PROBES: dict = {}
 
@@ -259,10 +263,7 @@ def main(argv=None) -> int:
         item timeout, operator) must not lose completed probes."""
         print(json.dumps(r), flush=True)
         results.append(r)
-        tmp = out_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(results, f, indent=1)
-        os.replace(tmp, out_path)
+        atomic_write_json(out_path, results)
 
     for name in names:
         extra = {}
