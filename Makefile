@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving bench-shard campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke fabric-smoke serving-smoke crash-smoke shard-smoke pallas-parity clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving bench-shard bench-hotpath campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke fabric-smoke serving-smoke crash-smoke shard-smoke hotpath-smoke pallas-parity clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -123,6 +123,18 @@ pallas-parity:
 shard-smoke:
 	$(PY) tools/shard_smoke.py
 
+# Zero-sync hot-path gate (docs/PARALLELISM.md §host-overhead): the
+# seeded 4-claim fabric scenario twice with device-resident staging +
+# donated dispatch + the batched commit plane pinned ON — byte-identical
+# per-claim fingerprints across the two runs AND against an unoptimized
+# control (the optimizations are bit-identical numerics + identical
+# journal events, never a fingerprint family), quarantine cycles
+# produce COUNTED commit_batch_fallback{reason=skip_slots}, and a clean
+# 4-claim leg pays C·cycles batched commit RPCs (one per claim-cycle,
+# not one per oracle).  Seconds on CPU.
+hotpath-smoke:
+	$(PY) tools/hotpath_smoke.py
+
 # Crash-consistency gate (docs/RESILIENCE.md §durability): the seeded
 # serving scenario SIGKILLed at 3 fault points (mid-WAL-append,
 # between tx i and i+1, post-commit pre-snapshot) in subprocesses,
@@ -138,7 +150,7 @@ crash-smoke:
 # convergence gates (I/O-plane, then data-plane), then the flight
 # recorder, then the fabric and serving tiers, then crash consistency,
 # then the suite.
-verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke fabric-smoke shard-smoke serving-smoke crash-smoke test
+verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke fabric-smoke shard-smoke serving-smoke hotpath-smoke crash-smoke test
 
 # End-of-round gate: lint + the driver-contract guards FIRST (fast,
 # loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
@@ -153,6 +165,7 @@ presnapshot:
 	$(MAKE) fabric-smoke
 	$(MAKE) shard-smoke
 	$(MAKE) serving-smoke
+	$(MAKE) hotpath-smoke
 	$(MAKE) crash-smoke
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_graft_entry.py tests/test_bench.py -q
@@ -175,6 +188,15 @@ bench-serving:
 # whose cores can't back the simulated devices).
 bench-shard:
 	$(PY) bench.py --shard-sweep --claims 64 --claims-oracles 256
+
+# Host-overhead hot-path A/B (docs/PARALLELISM.md §host-overhead):
+# per-cycle host ms by stage (stage/h2d/dispatch/sync/journal/commit)
+# and commit RPCs per claim-cycle, baseline vs device-resident+batched,
+# WAL-attached, fingerprint-identity-gated → BENCH_HOTPATH_r08.json
+# (CPU-honest; parsed by tools/decide_perf.py into the commit_mode
+# routing decision).
+bench-hotpath:
+	$(PY) bench_hotpath.py
 
 # Round-long liveness-gated hardware measurement campaign (resumes its
 # HW_CAMPAIGN.json journal; run in the background for the whole round).

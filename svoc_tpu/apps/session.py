@@ -153,6 +153,17 @@ class SessionConfig:
     #: this together with a FRESH ``journal=`` (else two sessions'
     #: audit records merge, the exact bug the scope exists to stop).
     lineage_scope: Optional[str] = None
+    #: Commit-plane mode (docs/RESILIENCE.md §batched-commits):
+    #: ``"per_tx"`` keeps the reference's one-signed-tx-per-oracle
+    #: loop; ``"batched"`` sends a clean fleet as ONE chain RPC (and,
+    #: with a WAL attached, one fsynced intent per cycle instead of one
+    #: per tx) with counted per-tx fallbacks — identical chain state
+    #: and journal events either way.  None resolves env >
+    #: PERF_DECISIONS.json > per_tx ONCE at construction
+    #: (:func:`svoc_tpu.consensus.dispatch.resolve_commit_mode`) — the
+    #: WAL record family a seeded crash replay produces depends on the
+    #: mode, so it must not drift mid-run (the PR 9/11 pinning rule).
+    commit_mode: Optional[str] = None
 
 
 def _default_contract(cfg: SessionConfig) -> OracleConsensusContract:
@@ -252,6 +263,20 @@ class Session:
         #: EXACTLY the stranded suffix on restart — zero duplicate txs.
         #: None = the in-memory-only sessions of PRs 1–7, unchanged.
         self.wal = None
+        #: The resolved commit-plane mode, pinned at construction (the
+        #: replay rule — see ``SessionConfig.commit_mode``).  Resolving
+        #: here keeps the env/record read OFF the commit hot path
+        #: (svoclint SVOC011 discipline).
+        from svoc_tpu.consensus.dispatch import (
+            resolve_commit_mode,
+            validate_commit_mode,
+        )
+
+        self.commit_mode = (
+            validate_commit_mode(self.config.commit_mode, "SessionConfig")
+            if self.config.commit_mode is not None
+            else resolve_commit_mode()
+        )
         #: Last gate verdict over the fetched fleet (written with the
         #: predictions it describes, under the session lock).
         self.last_quarantine: Optional[QuarantineReport] = None
@@ -680,14 +705,14 @@ class Session:
         tx, journaled as ``commit.deferred`` so the serving tier's defer
         is auditable on the block's lineage."""
         from svoc_tpu.consensus import wsad_engine as eng
-        from svoc_tpu.ops.fixedpoint import to_wsad
+        from svoc_tpu.ops.fixedpoint import to_wsad, to_wsad_rows
 
         try:
             eng.two_pass_consensus(
-                [
-                    [to_wsad(float(x)) for x in row]
-                    for row in np.asarray(predictions)
-                ],
+                # Vectorized wsad quantization (one numpy truncation,
+                # bit-identical to the per-element ``to_wsad`` loop —
+                # docs/PARALLELISM.md §host-overhead).
+                to_wsad_rows(np.asarray(predictions)),
                 constrained=self.config.constrained,
                 n_failing=self.config.n_failing,
                 max_spread=to_wsad(self.config.max_spread),
@@ -921,6 +946,7 @@ class Session:
                     journal=self.journal,
                     lineage=lineage,
                     wal=wal_cycle,
+                    commit_mode=self.commit_mode,
                 )  # svoclint: disable=SVOC010 -- deliberate: the retry/resume loop journals per-attempt outcomes INSIDE the whole-fleet atomicity the commit lock provides; no journal subscriber re-enters the commit path
             except ChainCommitError as e:
                 # resilient_sent is the TRUE landed-tx count (committed
@@ -958,23 +984,24 @@ class Session:
         missed) records ``None`` — the commit loop will fail that tx
         with its usual codec semantics, and the reconciler treats the
         slot like a skip.  The encode here is deliberately repeated by
-        the per-tx loop (digest parity REQUIRES the WAL payload and
+        the commit plane (digest parity REQUIRES the WAL payload and
         the wire payload to be the same encoding; the cost is
-        microseconds against a signed tx).  WAL append failures
+        microseconds against a signed tx) — both sides now route
+        through the same vectorized
+        :func:`svoc_tpu.ops.fixedpoint.encode_matrix`, the per-element
+        ``encode_vector`` loop's bit-identical replacement
+        (docs/PARALLELISM.md §host-overhead).  WAL append failures
         propagate unwrapped — "no durable intent, no tx", and a disk
         problem must not feed the CHAIN breaker."""
-        from svoc_tpu.ops.fixedpoint import encode_vector
+        from svoc_tpu.ops.fixedpoint import encode_matrix
 
         skip_set = frozenset(int(i) for i in skip)
-        payloads = []
-        for i, p in enumerate(np.asarray(predictions)):
-            if i in skip_set:
-                payloads.append(None)
-                continue
-            try:
-                payloads.append(encode_vector(p))
-            except Exception:
-                payloads.append(None)
+        encoded = encode_matrix(
+            np.asarray(predictions, dtype=np.float64), on_error="none"
+        )
+        payloads = [
+            None if i in skip_set else row for i, row in enumerate(encoded)
+        ]
         return self.wal.cycle(
             lineage,
             claim=self.config.claim,

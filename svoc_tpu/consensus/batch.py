@@ -312,6 +312,25 @@ def _claims_consensus_gated_xla(
     return consensus_step_gated_claims(values, ok, claim_mask, cfg)
 
 
+# The donated twin (docs/PARALLELISM.md §host-overhead): the claim cube
+# is by far the largest buffer the fabric moves per cycle, and the
+# device-resident router re-uploads it every cycle from a reusable host
+# staging buffer — donating the upload lets the allocator recycle its
+# device memory for the outputs instead of growing the live set each
+# dispatch.  Same traced program as the undonated twin (donation is a
+# buffer-aliasing hint, never a numerics change); callers must treat
+# the donated array as CONSUMED (SVOC004) — the router rebinds a fresh
+# upload every cycle and never re-reads it.
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def _claims_consensus_gated_xla_donated(
+    values: jnp.ndarray,  # [C, N, M] — donated
+    ok: jnp.ndarray,  # [C, N]
+    claim_mask: jnp.ndarray,  # [C]
+    cfg: ConsensusConfig,
+) -> ConsensusOutput:
+    return consensus_step_gated_claims(values, ok, claim_mask, cfg)
+
+
 # ``lo``/``hi`` are static floats: they come from a SanitizeConfig (one
 # or two distinct values per process — the constrained [0,1] gate and
 # the unconstrained codec-only gate), not per-request data, so they
@@ -321,6 +340,23 @@ def _claims_consensus_gated_xla(
 @partial(jax.jit, static_argnames=("cfg", "lo", "hi"))
 def _claims_consensus_sanitized_xla(
     values: jnp.ndarray,  # [C, N, M]
+    claim_mask: jnp.ndarray,  # [C]
+    cfg: ConsensusConfig,
+    lo: Optional[float],
+    hi: Optional[float],
+):
+    ok = quarantine_mask_claims(values, lo, hi)
+    return consensus_step_gated_claims(values, ok, claim_mask, cfg), ok
+
+
+# Donated twin of the fused gate+consensus program — the cube feeds the
+# in-graph gate AND the kernel inside ONE traced program, so donation
+# is safe here exactly because the fusion already removed the second
+# consumer (the pallas route keeps the cube alive across two programs
+# and therefore never donates).
+@partial(jax.jit, static_argnames=("cfg", "lo", "hi"), donate_argnums=(0,))
+def _claims_consensus_sanitized_xla_donated(
+    values: jnp.ndarray,  # [C, N, M] — donated
     claim_mask: jnp.ndarray,  # [C]
     cfg: ConsensusConfig,
     lo: Optional[float],
@@ -426,12 +462,19 @@ def claims_consensus_gated(
     cfg: ConsensusConfig,
     consensus_impl: Optional[str] = None,
     metrics=None,
+    donate: bool = False,
 ) -> ConsensusOutput:
     """One fused dispatch of the GATED two-pass consensus over a claim
     micro-batch with precomputed per-claim admission masks (the host
     gate's verdicts, re-used on device).  ``consensus_impl`` as in
     :func:`claims_consensus`; the XLA graph remains the parity oracle
-    (``make pallas-parity``)."""
+    (``make pallas-parity``).
+
+    ``donate=True`` routes the XLA path through the donated twin (the
+    device-resident router's steady-state dispatch — the caller must
+    never re-read ``values`` after this call).  A pallas route ignores
+    the hint: its cube is not re-uploaded per cycle the same way, and
+    numerics are unaffected either way."""
     if _pallas_route(
         values, cfg, consensus_impl, metrics, "claims_consensus_gated"
     ):
@@ -441,7 +484,9 @@ def claims_consensus_gated(
             )
         except Exception as e:  # noqa: BLE001 — counted, then XLA re-raises real input errors
             _pallas_broke(values, cfg, e, metrics, "claims_consensus_gated")
-    return _claims_consensus_gated_xla(values, ok, claim_mask, cfg)
+    if not donate:
+        return _claims_consensus_gated_xla(values, ok, claim_mask, cfg)
+    return _claims_consensus_gated_xla_donated(values, ok, claim_mask, cfg)
 
 
 def claims_consensus_sanitized(
@@ -452,6 +497,7 @@ def claims_consensus_sanitized(
     hi: Optional[float],
     consensus_impl: Optional[str] = None,
     metrics=None,
+    donate: bool = False,
 ):
     """Gate + consensus fused into ONE traced program per micro-batch:
     the vmapped quarantine gate
@@ -461,7 +507,10 @@ def claims_consensus_sanitized(
     so the caller can still account per-claim admissions.  The pallas
     route keeps the no-host-round-trip property: the traced gate's
     masks feed the fused kernel's jit directly (two dispatches, zero
-    fetches between them)."""
+    fetches between them).  ``donate=True`` as in
+    :func:`claims_consensus_gated` — XLA path only; the pallas route
+    feeds the cube to TWO programs (gate jit + fused kernel) and must
+    keep it alive."""
     if _pallas_route(
         values, cfg, consensus_impl, metrics, "claims_consensus_sanitized"
     ):
@@ -477,4 +526,10 @@ def claims_consensus_sanitized(
             _pallas_broke(
                 values, cfg, e, metrics, "claims_consensus_sanitized"
             )
-    return _claims_consensus_sanitized_xla(values, claim_mask, cfg, lo, hi)
+    if not donate:
+        return _claims_consensus_sanitized_xla(
+            values, claim_mask, cfg, lo, hi
+        )
+    return _claims_consensus_sanitized_xla_donated(
+        values, claim_mask, cfg, lo, hi
+    )

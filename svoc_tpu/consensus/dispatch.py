@@ -162,6 +162,47 @@ def pallas_interpret_opt_in() -> bool:
     return os.environ.get(PALLAS_INTERPRET_ENV) == "1"
 
 
+ALLOWED_COMMIT_MODES = ("per_tx", "batched")
+COMMIT_MODE_ENV = "SVOC_COMMIT_MODE"
+
+
+class CommitModeError(ValueError):
+    """An unknown commit-plane mode was requested (env override or a
+    corrupt committed record)."""
+
+
+def validate_commit_mode(mode: str, source: str = "caller") -> str:
+    if mode not in ALLOWED_COMMIT_MODES:
+        allowed = ", ".join(repr(v) for v in ALLOWED_COMMIT_MODES)
+        raise CommitModeError(
+            f"commit_mode {mode!r} (from {source}) is not a known commit "
+            f"mode: allowed values are {allowed}; set {COMMIT_MODE_ENV} "
+            "to override the committed PERF_DECISIONS.json record"
+        )
+    return mode
+
+
+def resolve_commit_mode(path: Optional[str] = None) -> str:
+    """The commit-plane routing twin of :func:`resolve_consensus_impl`
+    (docs/RESILIENCE.md §batched-commits): ``SVOC_COMMIT_MODE`` env >
+    the committed ``PERF_DECISIONS.json`` ``commit_mode`` record
+    (written by ``tools/decide_perf.py`` from the measured
+    ``BENCH_HOTPATH`` host-overhead A/B, never by hand) > ``"per_tx"``.
+
+    ``"batched"`` sends a claim's whole fleet payload as ONE chain RPC
+    (:meth:`svoc_tpu.io.chain.ChainAdapter.update_predictions_batched`)
+    with a counted, never-silent per-tx fallback
+    (``commit_batch_fallback{reason=}``); ``"per_tx"`` keeps the
+    reference's one-signed-tx-per-oracle loop.  Both produce identical
+    journal events and chain state — the mode only changes the RPC and
+    WAL-record granularity, so it must be resolved ONCE per Session
+    (the WAL family of a seeded crash replay depends on it)."""
+    mode, source = perf_decision(
+        "commit_mode", "per_tx", COMMIT_MODE_ENV, path=path
+    )
+    return validate_commit_mode(mode, source)
+
+
 #: ``SVOC_MESH=<claims>x<oracles>`` — operator override for the claim
 #: mesh (kept in sync with ``svoc_tpu.parallel.mesh.CLAIM_MESH_ENV``;
 #: duplicated literal so this resolver keeps importing no jax).
@@ -192,10 +233,65 @@ def resolve_claim_mesh(path: Optional[str] = None) -> Optional[str]:
 # ---------------------------------------------------------------------------
 
 FALLBACK_COUNTER = "consensus_pallas_fallback"
+BATCH_FALLBACK_COUNTER = "commit_batch_fallback"
 
-_log = logging.getLogger("svoc_tpu.consensus.pallas")
-_log_lock = threading.Lock()
-_logged_reasons: set = set()
+
+class _FallbackReporter:
+    """Counted, never-silent fallback accounting with a one-shot log
+    per reason (a steady-state fallback must not spam the log at
+    dispatch/commit rate; the counter carries the rate).  One
+    parameterized instance per fallback family — the pallas→XLA route
+    and the batched→per-tx commit plane share the machinery instead of
+    duplicating it."""
+
+    def __init__(self, counter: str, logger_name: str, what: str):
+        self.counter = counter
+        self._log = logging.getLogger(logger_name)
+        self._what = what
+        self._lock = threading.Lock()
+        self._logged_reasons: set = set()
+
+    def report(
+        self,
+        reason: str,
+        *,
+        op: str,
+        detail: str = "",
+        metrics: Optional[MetricsRegistry] = None,
+    ) -> None:
+        (metrics or _default_registry).counter(
+            self.counter, labels={"reason": reason}
+        ).add(1)
+        with self._lock:
+            if reason in self._logged_reasons:
+                return
+            self._logged_reasons.add(reason)
+        self._log.warning(
+            "%s fell back to %s (reason=%s%s); further fallbacks are "
+            "counted in %s{reason=%s} without logging",
+            op,
+            self._what,
+            reason,
+            f": {detail}" if detail else "",
+            self.counter,
+            reason,
+        )
+
+    def reset(self) -> None:
+        with self._lock:
+            self._logged_reasons.clear()
+
+
+_pallas_reporter = _FallbackReporter(
+    FALLBACK_COUNTER,
+    "svoc_tpu.consensus.pallas",
+    "the XLA consensus kernel",
+)
+_batch_reporter = _FallbackReporter(
+    BATCH_FALLBACK_COUNTER,
+    "svoc_tpu.io.chain.batch",
+    "the per-tx loop",
+)
 
 
 def report_pallas_fallback(
@@ -206,8 +302,7 @@ def report_pallas_fallback(
     metrics: Optional[MetricsRegistry] = None,
 ) -> None:
     """Count one pallas→XLA fallback and log the FIRST occurrence of
-    each reason (one-shot — a steady-state fallback must not spam the
-    log at dispatch rate; the counter carries the rate).
+    each reason.
 
     Reasons: ``fleet_too_large`` (over ``SVOC_PALLAS_MAX_ORACLES``),
     ``unaligned_fleet`` (fleet not a multiple of the rank block),
@@ -219,25 +314,37 @@ def report_pallas_fallback(
     kernel, the XLA sharded body serves instead;
     :mod:`svoc_tpu.parallel.claim_shard`).
     """
-    (metrics or _default_registry).counter(
-        FALLBACK_COUNTER, labels={"reason": reason}
-    ).add(1)
-    with _log_lock:
-        if reason in _logged_reasons:
-            return
-        _logged_reasons.add(reason)
-    _log.warning(
-        "%s fell back to the XLA consensus kernel (reason=%s%s); "
-        "further fallbacks are counted in %s{reason=%s} without logging",
-        op,
-        reason,
-        f": {detail}" if detail else "",
-        FALLBACK_COUNTER,
-        reason,
-    )
+    _pallas_reporter.report(reason, op=op, detail=detail, metrics=metrics)
 
 
 def reset_fallback_log() -> None:
-    """Re-arm the one-shot log (tests)."""
-    with _log_lock:
-        _logged_reasons.clear()
+    """Re-arm the one-shot pallas log (tests)."""
+    _pallas_reporter.reset()
+
+
+def report_batch_fallback(
+    reason: str,
+    *,
+    detail: str = "",
+    metrics: Optional[MetricsRegistry] = None,
+) -> None:
+    """Count one batched-commit → per-tx fallback and log the FIRST
+    occurrence of each reason — the commit plane's twin of
+    :func:`report_pallas_fallback` (no silent mode degradation:
+    docs/RESILIENCE.md §batched-commits).
+
+    Reasons: ``unsupported`` (the backend has no batched entrypoint —
+    Sepolia, chaos wrappers), ``skip_slots`` (quarantine refusals force
+    tx granularity: the batched entrypoint commits a contiguous caller
+    range), ``batch_error`` (the single batched RPC failed mid-fleet;
+    the resume loop re-sends the stranded suffix per tx),
+    ``uncertified`` (a raise-mode backend declined before mutation).
+    """
+    _batch_reporter.report(
+        reason, op="batched fleet commit", detail=detail, metrics=metrics
+    )
+
+
+def reset_batch_fallback_log() -> None:
+    """Re-arm the one-shot batched-commit log (tests)."""
+    _batch_reporter.reset()

@@ -4,18 +4,30 @@ On restart the WAL may hold cycles with no ``done`` record — the
 process died mid-commit.  For every such cycle the reconciler
 classifies each eligible slot (docs/RESILIENCE.md §durability):
 
-=====================  ======================================  =========
-evidence               meaning                                 action
-=====================  ======================================  =========
-``landed`` record      tx durably confirmed before the crash   none
-chain digest == WAL    tx landed; the landed append was lost   none
-chain digest != WAL    the slot still holds the previous       resend
-                       block's value — the tx never went out
-chain read fails       backend unreachable: cannot prove       none (re-
-                       either way                              run later)
-``skip`` / no payload  quarantined or unencodable slot — the   none
-                       original commit would not have sent it
-=====================  ======================================  =========
+=======================  ====================================  =========
+evidence                 meaning                               action
+=======================  ====================================  =========
+``landed`` record        tx durably confirmed before the       none
+                         crash
+``landed_batch`` record  slot applied by a batched single-RPC  none
+                         commit (docs/RESILIENCE.md
+                         §batched-commits) — one record covers
+                         the whole applied range
+chain digest == WAL      tx landed; the landed append was      none
+                         lost
+chain digest != WAL      the slot still holds the previous     resend
+                         block's value — the tx never went out
+chain read fails         backend unreachable: cannot prove     none (re-
+                         either way                            run later)
+``skip`` / no payload    quarantined or unencodable slot —     none
+                         the original commit would not have
+                         sent it
+=======================  ====================================  =========
+
+A batched attempt killed between its single RPC and its
+``landed_batch`` append leaves an ``intent_batch`` with no landed
+record — every slot then classifies through the chain-digest columns
+above, exactly like a per-tx intent whose landed append was lost.
 
 Only *stranded* slots are resent — a slot is never resent on missing
 evidence, so a kill at ANY point (including during a previous
@@ -44,10 +56,18 @@ from svoc_tpu.durability.wal import CommitIntentWAL, payload_digest
 
 #: Slot classifications (the decision table above).
 LANDED_DURABLE = "landed_durable"
+LANDED_BATCH = "landed_batch"
 LANDED_CHAIN = "landed_chain"
 STRANDED = "stranded"
 UNKNOWN = "unknown"
 SKIPPED = "skipped"
+
+#: Every classification, in decision-table order — the one tuple the
+#: counts/report/gate logic share so a new outcome cannot be added
+#: half-way.
+CLASSIFICATIONS = (
+    LANDED_DURABLE, LANDED_BATCH, LANDED_CHAIN, STRANDED, UNKNOWN, SKIPPED
+)
 
 
 @dataclasses.dataclass
@@ -80,12 +100,7 @@ class CycleReconciliation:
             "claim": self.claim,
             "closed": self.closed,
             "slots": [s.as_dict() for s in self.slots],
-            "counts": {
-                c: self.count(c)
-                for c in (
-                    LANDED_DURABLE, LANDED_CHAIN, STRANDED, UNKNOWN, SKIPPED
-                )
-            },
+            "counts": {c: self.count(c) for c in CLASSIFICATIONS},
         }
 
 
@@ -114,8 +129,7 @@ class ReconcileReport:
             1
             for c in self.cycles
             for s in c.slots
-            if s.classification
-            not in (LANDED_DURABLE, LANDED_CHAIN, STRANDED, UNKNOWN, SKIPPED)
+            if s.classification not in CLASSIFICATIONS
         )
 
     def as_dict(self) -> Dict[str, Any]:
@@ -143,14 +157,24 @@ def wal_cycles(records: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
                 "payloads": list(r.get("payloads", [])),
                 "intents": {},
                 "landed": set(),
+                "landed_batch": set(),
                 "done": False,
                 "failed": None,
             }
         elif lineage in cycles:
             if kind == "intent":
                 cycles[lineage]["intents"][int(r["slot"])] = r.get("digest")
+            elif kind == "intent_batch":
+                # Batch intents pin the attempted range; digests live in
+                # the cycle-open payload matrix, which classification
+                # reads anyway.
+                for slot in r.get("slots", []):
+                    cycles[lineage]["intents"].setdefault(int(slot), None)
             elif kind == "landed":
                 cycles[lineage]["landed"].add(int(r["slot"]))
+            elif kind == "landed_batch":
+                for slot in r.get("slots", []):
+                    cycles[lineage]["landed_batch"].add(int(slot))
             elif kind == "done":
                 # A failure-closed cycle is NOT done for durability
                 # purposes: its outcome was an error, its stranded
@@ -215,6 +239,12 @@ def reconcile_wal(
             if slot in cyc["landed"]:
                 verdicts.append(SlotVerdict(slot, oracle, LANDED_DURABLE))
                 continue
+            if slot in cyc["landed_batch"]:
+                # Applied by a batched single-RPC commit — durably
+                # recorded, never resent (docs/RESILIENCE.md
+                # §batched-commits).
+                verdicts.append(SlotVerdict(slot, oracle, LANDED_BATCH))
+                continue
             if (
                 adapter is None
                 or chain_rows is None
@@ -263,9 +293,7 @@ def reconcile_wal(
             lineage=lineage,
             claim=cyc["claim"],
             closed=closed,
-            **{c: rec.count(c) for c in (
-                LANDED_DURABLE, LANDED_CHAIN, STRANDED, UNKNOWN, SKIPPED
-            )},
+            **{c: rec.count(c) for c in CLASSIFICATIONS},
             resent=sum(1 for v in verdicts if v.resent),
         )
     return ReconcileReport(cycles=out)

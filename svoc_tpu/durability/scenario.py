@@ -170,6 +170,14 @@ def run_durable_scenario(
         sanitized_dispatch=True,
         clock=clock,
         adapter_factory=adapter_factory,
+        # The kill/restart matrix targets the PER-TX WAL record family
+        # (counter-based fault points on the Nth ``intent`` record and
+        # the Nth logged tx) — pin the plane like the impl/mesh, so a
+        # committed ``commit_mode: "batched"`` record cannot change
+        # which instruction the Nth fault fires at (docs/RESILIENCE.md
+        # §batched-commits; the batched family's mid-batch kill is
+        # covered by tests/test_hotpath.py).
+        commit_mode="per_tx",
     )
     for name in names:
         multi.add_claim(specs[name])

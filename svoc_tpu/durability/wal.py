@@ -331,6 +331,45 @@ class WALCycle:
             {"kind": "landed", "lineage": self.lineage, "slot": int(slot)}
         )
 
+    # -- the batched commit plane (docs/RESILIENCE.md §batched-commits) ------
+
+    def intent_batch(self, slots: Sequence[int]) -> None:
+        """ONE fsynced intent covering a whole batched attempt: the
+        cycle-open record already journals every slot's payload, so the
+        batch intent only pins WHICH slots the single RPC is about to
+        carry ("no durable intent, no tx" at batch granularity — one
+        fsync instead of N).  No per-slot cursor is maintained: a
+        failed batched RPC reports its own failure index
+        (``BatchTxError`` → ``ChainCommitError.sent_count``), and a
+        crash mid-batch leaves the chain digest as the per-slot
+        witness, exactly the reconciler's existing columns."""
+        self._last_intent = None
+        self._last_intent_landed = False
+        self.wal._append(
+            {
+                "kind": "intent_batch",
+                "lineage": self.lineage,
+                "slots": [int(s) for s in slots],
+                "attempt": self._attempt,
+            }
+        )
+
+    def landed_batch(self, slots: Sequence[int]) -> None:
+        """The batched twin of :meth:`landed`: one fsynced record marks
+        every slot the single RPC durably applied (the whole batch on
+        success; the applied prefix when the RPC failed mid-fleet).
+        The restart reconciler classifies these slots ``landed_batch``
+        — same no-resend action as per-tx ``landed`` records."""
+        slots = [int(s) for s in slots]
+        self._attempt_landed += len(slots)
+        self.wal._append(
+            {
+                "kind": "landed_batch",
+                "lineage": self.lineage,
+                "slots": slots,
+            }
+        )
+
     def done(
         self,
         sent: int,

@@ -42,6 +42,7 @@ import numpy as np
 
 from svoc_tpu.apps.session import DegenerateBlockError, EmptyStoreError
 from svoc_tpu.consensus.batch import (
+    _PAD_VALUE,
     claims_consensus_gated,
     claims_consensus_sanitized,
     pad_claim_cube,
@@ -57,6 +58,31 @@ from svoc_tpu.resilience.breaker import CircuitOpenError
 from svoc_tpu.utils.metrics import MetricsRegistry
 from svoc_tpu.utils.metrics import registry as _default_registry
 from svoc_tpu.utils.metrics import stage_span
+
+
+_DONATION_WARNING_FILTERED = False
+_DONATION_WARNING_LOCK = threading.Lock()
+
+
+def _filter_donation_warning_once() -> None:
+    """Install the donated-buffers warning filter AT MOST ONCE per
+    process (an opt-in of ``device_resident=True``): donation is a
+    best-effort hint and XLA warns per compiled shape on backends whose
+    output layouts can't alias the cube (CPU notably) — expected here,
+    and the counterfactual is log spam in every seeded smoke run.  The
+    once-guard keeps repeated router constructions from growing the
+    warnings filter list unboundedly; the repo's only donating call
+    sites are the consensus/batch.py twins this router drives."""
+    global _DONATION_WARNING_FILTERED
+    with _DONATION_WARNING_LOCK:
+        if _DONATION_WARNING_FILTERED:
+            return
+        _DONATION_WARNING_FILTERED = True
+    import warnings
+
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable"
+    )
 
 
 def resolve_journal(journal):
@@ -86,6 +112,46 @@ class _PendingGroup:
         self.lineages = lineages
 
 
+class _GroupStaging:
+    """Reusable host staging for one (n_oracles, dim, cfg) dispatch
+    group (``ClaimRouter(device_resident=True)``, docs/PARALLELISM.md
+    §host-overhead): the claim cube, admission masks, and activity mask
+    live in pre-allocated arrays updated IN PLACE each cycle, so the
+    steady state allocates nothing on the host — the old path rebuilt
+    ``np.stack`` + ``pad_claim_cube`` concatenations every cycle.
+
+    Padding rows are written ONCE at allocation (neutral fill,
+    all-admitted, inactive) and re-established only for rows a
+    shrinking micro-batch strands (``active`` tracks the high-water
+    mark), exactly matching :func:`pad_claim_cube`'s per-cycle output
+    bit-for-bit — the replay-fingerprint contract of the resident path.
+    """
+
+    __slots__ = ("values", "ok", "mask", "active")
+
+    def __init__(self, bucket: int, n: int, m: int):
+        self.values = np.full((bucket, n, m), _PAD_VALUE, dtype=np.float32)
+        self.ok = np.ones((bucket, n), dtype=bool)
+        self.mask = np.zeros(bucket, dtype=bool)
+        self.active = 0
+
+    def load(self, blocks, oks) -> None:
+        """Write this cycle's blocks into rows ``[0, C)`` (the float64→
+        float32 cast in ``np.copyto`` is the same rounding
+        ``np.asarray(..., float32)`` applied on the unstaged path) and
+        restore pad state on rows the previous, larger batch used."""
+        c = len(blocks)
+        for i, block in enumerate(blocks):
+            np.copyto(self.values[i], block, casting="same_kind")
+            np.copyto(self.ok[i], oks[i])
+        if self.active > c:
+            self.values[c : self.active] = _PAD_VALUE
+            self.ok[c : self.active] = True
+        self.mask[:c] = True
+        self.mask[c:] = False
+        self.active = c
+
+
 class ClaimRouter:
     """Multiplexes fetch → vectorize → consensus → commit across the
     registry's claims.  ``step()`` is the single-threaded scheduling
@@ -103,6 +169,7 @@ class ClaimRouter:
         consensus_impl: Optional[str] = None,
         mesh=None,
         pipelined: bool = False,
+        device_resident: bool = False,
     ):
         if max_claims_per_batch < 1:
             raise ValueError("max_claims_per_batch must be >= 1")
@@ -148,6 +215,27 @@ class ClaimRouter:
         #: its smoke fingerprints) is byte-identical when off.
         self.pipelined = pipelined
         self._inflight: List[_PendingGroup] = []
+        #: Zero-allocation steady-state dispatch (docs/PARALLELISM.md
+        #: §host-overhead): each (N, M, cfg) group's staging cube lives
+        #: in a reusable pinned host buffer updated in place, the H2D
+        #: upload is an explicit copy (the staging buffer is mutated
+        #: next cycle, so it must never alias a live device array), and
+        #: the unsharded XLA dispatch DONATES the uploaded cube so the
+        #: allocator recycles its device memory for the outputs
+        #: (SVOC004 discipline: the upload is rebound fresh every cycle
+        #: and never re-read).  Bit-identical to the unstaged path —
+        #: ``make hotpath-smoke`` pins fingerprint identity — so unlike
+        #: ``pipelined`` it is NOT its own fingerprint family; off by
+        #: default purely so the A/B in ``bench_hotpath.py`` keeps an
+        #: honest baseline.
+        self.device_resident = bool(device_resident)
+        if self.device_resident:
+            _filter_donation_warning_once()
+        self._staging: Dict[Any, _GroupStaging] = {}
+        #: Donation rides only the unsharded XLA dispatch: the sharded
+        #: program manages its own buffers, and the pallas route feeds
+        #: the cube to two programs (see claims_consensus_sanitized).
+        self._donate = self.device_resident and self._shard is None
         #: Fuse gate + consensus into ONE traced program per micro-batch
         #: (:func:`svoc_tpu.consensus.batch.claims_consensus_sanitized`)
         #: instead of reusing the host gate's per-claim verdicts.  The
@@ -418,23 +506,41 @@ class ClaimRouter:
         lineages = []
         blocks = []
         oks = []
-        for state in members:
-            session = state.session
-            with session.lock:
-                predictions = session.predictions
-                quarantine = session.last_quarantine
-                lineages.append(session.last_lineage)
-            blocks.append(np.asarray(predictions, dtype=np.float32))
-            oks.append(
-                np.asarray(quarantine.ok, dtype=bool)
-                if quarantine is not None
-                else np.ones(predictions.shape[0], dtype=bool)
-            )
-        values, ok, claim_mask = pad_claim_cube(
-            np.stack(blocks),
-            np.stack(oks),
-            multiple_of=self._shard.claim_size if self._shard else 1,
-        )
+        with stage_span("fabric_stage"):
+            for state in members:
+                session = state.session
+                with session.lock:
+                    predictions = session.predictions
+                    quarantine = session.last_quarantine
+                    lineages.append(session.last_lineage)
+                blocks.append(predictions)
+                oks.append(
+                    np.asarray(quarantine.ok, dtype=bool)
+                    if quarantine is not None
+                    else np.ones(predictions.shape[0], dtype=bool)
+                )
+            multiple = self._shard.claim_size if self._shard else 1
+            if self.device_resident:
+                # In-place staging: zero fresh host allocation in the
+                # steady state (the stack/pad path below rebuilds three
+                # arrays per cycle).  Accounting keeps the per-claim
+                # ``oks`` arrays — only the dispatch inputs are staged,
+                # so nothing downstream aliases the reused buffers.
+                staging = self._group_staging(blocks, cfg, multiple)
+                staging.load(blocks, oks)
+                values, ok, claim_mask = (
+                    staging.values,
+                    staging.ok,
+                    staging.mask,
+                )
+            else:
+                values, ok, claim_mask = pad_claim_cube(
+                    np.stack(
+                        [np.asarray(b, dtype=np.float32) for b in blocks]
+                    ),
+                    np.stack(oks),
+                    multiple_of=multiple,
+                )
         # The journaled batch_bucket is the MESH-INDEPENDENT pow2
         # bucket, not values.shape[0]: mesh padding (multiple_of above)
         # can grow the dispatched cube (e.g. 2 claims on a 4-wide or
@@ -454,93 +560,164 @@ class ClaimRouter:
 
             bounds = SanitizeConfig.for_consensus(cfg.constrained)
             if self._shard is not None:
+                values_in, _ok_in, mask_in = self._shard_inputs(
+                    values, ok, claim_mask
+                )
                 out, ok_traced = self._shard.dispatch_sanitized(
-                    values, claim_mask, cfg, bounds.lo, bounds.hi
+                    values_in, mask_in, cfg, bounds.lo, bounds.hi
                 )
             else:
-                out, ok_traced = claims_consensus_sanitized(
-                    jnp.asarray(values),
-                    jnp.asarray(claim_mask),
-                    cfg,
-                    bounds.lo,
-                    bounds.hi,
-                    consensus_impl=self.consensus_impl,
-                    metrics=self._metrics,
-                )
+                with stage_span("fabric_h2d"):
+                    values_dev = self._h2d(values)
+                    mask_dev = self._h2d(claim_mask)
+                with stage_span("fabric_dispatch"):
+                    out, ok_traced = claims_consensus_sanitized(
+                        values_dev,
+                        mask_dev,
+                        cfg,
+                        bounds.lo,
+                        bounds.hi,
+                        consensus_impl=self.consensus_impl,
+                        metrics=self._metrics,
+                        donate=self._donate,
+                    )
             # The traced masks become the accounting source (fetched in
             # _finish_group along with the outputs).
             oks = ok_traced
         elif self._shard is not None:
-            out = self._shard.dispatch_gated(values, ok, claim_mask, cfg)
-        else:
-            out = claims_consensus_gated(
-                jnp.asarray(values),
-                jnp.asarray(ok),
-                jnp.asarray(claim_mask),
-                cfg,
-                consensus_impl=self.consensus_impl,
-                metrics=self._metrics,
+            values_in, ok_in, mask_in = self._shard_inputs(
+                values, ok, claim_mask
             )
+            out = self._shard.dispatch_gated(values_in, ok_in, mask_in, cfg)
+        else:
+            with stage_span("fabric_h2d"):
+                values_dev = self._h2d(values)
+                ok_dev = self._h2d(ok)
+                mask_dev = self._h2d(claim_mask)
+            with stage_span("fabric_dispatch"):
+                out = claims_consensus_gated(
+                    values_dev,
+                    ok_dev,
+                    mask_dev,
+                    cfg,
+                    consensus_impl=self.consensus_impl,
+                    metrics=self._metrics,
+                    donate=self._donate,
+                )
         return _PendingGroup(
             members, cfg, out, oks, journal_bucket, lineages
         )
 
+    def _group_staging(self, blocks, cfg, multiple: int) -> _GroupStaging:
+        """The (shape, config) group's reusable staging buffers, sized
+        to this cycle's pow2 bucket.  Reallocation happens only when
+        the bucket crosses a power of two (or the mesh multiple) — the
+        steady state reuses one allocation per group for the process
+        lifetime."""
+        n, m = np.shape(blocks[0])
+        bucket = pow2_bucket(len(blocks), multiple_of=multiple)
+        key = (n, m, cfg)
+        staging = self._staging.get(key)
+        if staging is None or staging.values.shape[0] != bucket:
+            staging = _GroupStaging(bucket, n, m)
+            self._staging[key] = staging
+        return staging
+
+    def _h2d(self, array):
+        """Host→device upload for one dispatch input.  Device-resident
+        staging buffers are mutated in place next cycle, and
+        ``jnp.asarray`` ZERO-COPIES writeable host memory on the CPU
+        backend — so the resident path copies explicitly (the copy IS
+        the upload; the donated dispatch then recycles its device
+        memory).  The unstaged path keeps its historical zero-copy
+        ``asarray`` of per-cycle fresh arrays."""
+        if self.device_resident:
+            return jnp.array(array)
+        return jnp.asarray(array)
+
+    def _shard_inputs(self, values, ok, claim_mask):
+        """The sharded dispatcher manages its own device placement (and
+        may hold arrays across the pipelined window) — hand it private
+        copies when the inputs are reused staging buffers."""
+        if not self.device_resident:
+            return values, ok, claim_mask
+        return np.array(values), np.array(ok), np.array(claim_mask)
+
     def _finish_group(self, pending: _PendingGroup) -> None:
         """Host-sync one dispatched group and write each member's
         per-claim slice back (consensus state, journal, metrics)."""
+        from svoc_tpu.utils.rounding import round6_list
+
         members = pending.members
         out = pending.out
         oks = pending.oks
-        if not isinstance(oks, list):
-            # Sanitized dispatch: the traced in-graph masks (still on
-            # device, padded to the bucket) are the accounting source.
-            oks = list(np.asarray(oks)[: len(members)])  # svoclint: disable=SVOC001
-        # ONE host sync for the whole micro-batch — the claim axis
-        # amortizes the dispatch/fetch overhead that a per-claim loop
-        # pays C times (bench.py --claims).
-        essence = np.asarray(out.essence)  # svoclint: disable=SVOC001
-        essence1 = np.asarray(out.essence_first_pass)
-        rel1 = np.asarray(out.reliability_first_pass)
-        rel2 = np.asarray(out.reliability_second_pass)
-        reliable = np.asarray(out.reliable)
-        valid = np.asarray(out.interval_valid)
+        c = len(members)
+        with stage_span("fabric_sync"):
+            if not isinstance(oks, list):
+                # Sanitized dispatch: the traced in-graph masks (still
+                # on device, padded to the bucket) are the accounting
+                # source.
+                oks = list(np.asarray(oks)[:c])  # svoclint: disable=SVOC001
+            # ONE host sync for the whole micro-batch — the claim axis
+            # amortizes the dispatch/fetch overhead that a per-claim
+            # loop pays C times (bench.py --claims).
+            essence = np.asarray(out.essence)  # svoclint: disable=SVOC001
+            essence1 = np.asarray(out.essence_first_pass)
+            rel1 = np.asarray(out.reliability_first_pass)
+            rel2 = np.asarray(out.reliability_second_pass)
+            reliable = np.asarray(out.reliable)
+            valid = np.asarray(out.interval_valid)
         journal = self._resolve_journal()
         bucket = pending.bucket
-        for i, state in enumerate(members):
-            lineage = pending.lineages[i]
-            n_admitted = int(np.sum(oks[i]))
-            slice_ = {
-                "essence": [round(float(x), 6) for x in essence[i]],
-                "essence_first_pass": [
-                    round(float(x), 6) for x in essence1[i]
-                ],
-                "reliability_first_pass": round(float(rel1[i]), 6),
-                "reliability_second_pass": round(float(rel2[i]), 6),
-                "reliable": [bool(b) for b in reliable[i]],
-                "interval_valid": bool(valid[i]),
-                "admitted": n_admitted,
-            }
-            state.last_consensus = slice_
-            journal.emit(
-                "fabric.consensus",
-                lineage=lineage,
-                claim=state.spec.claim_id,
-                interval_valid=slice_["interval_valid"],
-                admitted=n_admitted,
-                n_reliable=int(np.sum(reliable[i])),
-                batch_claims=len(members),
-                batch_bucket=bucket,
-            )
-            labels = {"claim": state.spec.claim_id}
-            self._metrics.counter(
-                "claim_slots_inspected", labels=labels
-            ).add(int(oks[i].shape[0]))
-            self._metrics.counter(
-                "claim_slots_quarantined", labels=labels
-            ).add(int(oks[i].shape[0]) - n_admitted)
-            self._metrics.gauge(
-                "claim_interval_valid", labels=labels
-            ).set(1.0 if slice_["interval_valid"] else 0.0)
+        with stage_span("fabric_journal"):
+            # Vectorized write-back (docs/PARALLELISM.md
+            # §host-overhead): every journaled float rounds through ONE
+            # numpy pass instead of a Python call per element per claim
+            # — bit-identical to the old per-element loop
+            # (utils/rounding.round6's exactness contract; the replay
+            # fingerprints pin it).
+            essence_rows = round6_list(essence[:c])
+            essence1_rows = round6_list(essence1[:c])
+            rel1_vals = round6_list(rel1[:c])
+            rel2_vals = round6_list(rel2[:c])
+            reliable_rows = reliable[:c].tolist()
+            valid_flags = valid[:c].tolist()
+            n_reliable = reliable[:c].sum(axis=1).tolist()
+            admitted = np.stack(oks).sum(axis=1).tolist()
+            inspected = [int(np.shape(ok_row)[0]) for ok_row in oks]
+            for i, state in enumerate(members):
+                lineage = pending.lineages[i]
+                n_admitted = int(admitted[i])
+                slice_ = {
+                    "essence": essence_rows[i],
+                    "essence_first_pass": essence1_rows[i],
+                    "reliability_first_pass": rel1_vals[i],
+                    "reliability_second_pass": rel2_vals[i],
+                    "reliable": reliable_rows[i],
+                    "interval_valid": valid_flags[i],
+                    "admitted": n_admitted,
+                }
+                state.last_consensus = slice_
+                journal.emit(
+                    "fabric.consensus",
+                    lineage=lineage,
+                    claim=state.spec.claim_id,
+                    interval_valid=slice_["interval_valid"],
+                    admitted=n_admitted,
+                    n_reliable=int(n_reliable[i]),
+                    batch_claims=c,
+                    batch_bucket=bucket,
+                )
+                labels = {"claim": state.spec.claim_id}
+                self._metrics.counter(
+                    "claim_slots_inspected", labels=labels
+                ).add(inspected[i])
+                self._metrics.counter(
+                    "claim_slots_quarantined", labels=labels
+                ).add(inspected[i] - n_admitted)
+                self._metrics.gauge(
+                    "claim_interval_valid", labels=labels
+                ).set(1.0 if slice_["interval_valid"] else 0.0)
 
     def _commit_claim(self, state: ClaimState) -> None:
         """One resilient commit + supervisor fold + SLO pass for one

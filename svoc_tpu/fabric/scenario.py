@@ -91,6 +91,8 @@ def run_fabric_scenario(
     metrics=None,
     mesh=None,
     pipelined: bool = False,
+    device_resident: bool = False,
+    commit_mode: Optional[str] = None,
 ) -> Dict[str, Any]:
     """One seeded fabric run; returns per-claim fingerprints, isolation
     accounting, and the injection log.  Pure function of ``seed`` (plus
@@ -102,7 +104,15 @@ def run_fabric_scenario(
     gate runs this scenario meshed and unmeshed and asserts IDENTICAL
     per-claim fingerprints, the sharded path being bitwise-exact);
     ``pipelined`` turns on the double-buffered dispatch (its own
-    fingerprint family: consensus events land one cycle later)."""
+    fingerprint family: consensus events land one cycle later).
+
+    ``device_resident`` + ``commit_mode`` pin the host-overhead
+    optimizations (docs/PARALLELISM.md §host-overhead): NEITHER is a
+    fingerprint family — ``make hotpath-smoke`` runs this scenario
+    optimized and unoptimized and asserts byte-identical per-claim
+    fingerprints (the batched commit plane emits the per-tx plane's
+    exact journal events; staging + donation are bit-identical
+    numerics)."""
     from svoc_tpu.io.comment_store import CommentStore
     from svoc_tpu.io.scraper import SyntheticSource
     from svoc_tpu.utils.events import EventJournal
@@ -149,6 +159,8 @@ def run_fabric_scenario(
         max_claims_per_batch=n_claims,
         mesh=mesh,
         pipelined=pipelined,
+        device_resident=device_resident,
+        commit_mode=commit_mode,
     )
     for name in names:
         multi.add_claim(

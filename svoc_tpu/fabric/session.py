@@ -56,6 +56,8 @@ class MultiSession:
         consensus_impl: Optional[str] = None,
         mesh=None,
         pipelined: bool = False,
+        device_resident: bool = False,
+        commit_mode: Optional[str] = None,
         clock: Optional[Callable[[], float]] = None,
         adapter_factory=None,
     ):
@@ -94,6 +96,16 @@ class MultiSession:
         #: — docs/FABRIC.md §mesh), and ``pipelined`` turns on the
         #: double-buffered pull-mode dispatch (consensus k-1 overlaps
         #: fetch k; drain with :meth:`flush`).
+        #: Commit-plane mode for every claim session this fabric builds
+        #: (``"per_tx"`` | ``"batched"``; None = env > the committed
+        #: PERF_DECISIONS.json ``commit_mode`` record > per_tx, resolved
+        #: once per Session — docs/RESILIENCE.md §batched-commits).
+        #: Pinned here like ``consensus_impl``: the WAL record family a
+        #: seeded crash replay produces depends on it.
+        self._commit_mode = commit_mode
+        #: ``device_resident`` turns on the zero-allocation staging +
+        #: donated dispatch (docs/PARALLELISM.md §host-overhead) —
+        #: bit-identical outputs, so NOT a fingerprint family.
         self.router = ClaimRouter(
             self.registry,
             max_claims_per_batch=max_claims_per_batch,
@@ -103,6 +115,7 @@ class MultiSession:
             consensus_impl=consensus_impl,
             mesh=mesh,
             pipelined=pipelined,
+            device_resident=device_resident,
         )
         for spec in specs:
             self.add_claim(spec)
@@ -147,6 +160,7 @@ class MultiSession:
             seed=seed,
             claim=spec.claim_id,
             lineage_scope=self._lineage_scope,
+            commit_mode=self._commit_mode,
         )
         if store is None:
             store = (
@@ -266,6 +280,7 @@ class MultiSession:
             "consensus_impl": self.router.consensus_impl,
             "mesh": self.router.mesh_spec,
             "pipelined": self.router.pipelined,
+            "device_resident": self.router.device_resident,
             "claims": self.claims_state(),
         }
 

@@ -36,8 +36,11 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Protocol, Sequence, Tuple
 
+import numpy as np
+
 from svoc_tpu.consensus.state import ContractError, OracleConsensusContract
 from svoc_tpu.ops.fixedpoint import (
+    encode_matrix,
     encode_vector,
     fwsad_to_float,
     wsad_to_felt,
@@ -78,6 +81,22 @@ class ChainCommitError(RuntimeError):
         super().__init__(
             f"commit failed at oracle {failed_oracle!r} after "
             f"{committed}/{total} transactions: {cause}"
+        )
+
+
+class BatchCommitUnsupported(RuntimeError):
+    """A fleet commit cannot run as ONE batched RPC — the caller must
+    take the per-tx loop instead (ALWAYS counted:
+    ``commit_batch_fallback{reason=}``, docs/RESILIENCE.md
+    §batched-commits).  Raised BEFORE any chain mutation or WAL record,
+    so falling back is always safe."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        self.detail = detail
+        super().__init__(
+            f"batched commit unavailable ({reason})"
+            + (f": {detail}" if detail else "")
         )
 
 
@@ -160,6 +179,30 @@ class LocalChainBackend:
             )
         else:
             raise KeyError(f"unknown invoke function {function_name!r}")
+
+    def update_predictions_batched(
+        self,
+        callers: Sequence[int],
+        predictions: Sequence[Sequence[int]],
+    ) -> int:
+        """The commit plane's ONE-RPC fleet entrypoint
+        (docs/RESILIENCE.md §batched-commits): one backend call carries
+        every (caller, felt payload) pair, with the EXACT sequential
+        per-tx semantics (a mid-fleet panic raises
+        :class:`svoc_tpu.consensus.state.BatchTxError` with the failed
+        index; the prefix IS applied — chain semantics, no rollback).
+
+        Unlike :meth:`invoke_update_predictions_batch` (the ≥64-fleet
+        throughput path), this uses ``on_uncertified="sequential"``:
+        the RPC-count contract is the point, so an uncertifiable batch
+        runs the exact engine per tx INSIDE the one call instead of
+        bouncing back to N adapter-level RPCs."""
+        return self.contract.update_predictions_batch(
+            callers,
+            predictions,
+            encoding="felt",
+            on_uncertified="sequential",
+        )
 
     def invoke_update_predictions_batch(
         self,
@@ -610,8 +653,20 @@ class ChainAdapter:
 
     # -- writes (client/contract.py:200-264) -------------------------------
 
+    @staticmethod
+    def _count_rpc(mode: str, n: int = 1) -> None:
+        """Commit-plane RPC accounting (``chain_commit_rpcs{mode=}``,
+        process registry — ``bench_hotpath.py`` and ``make
+        hotpath-smoke`` assert the batched plane pays 1 per claim-cycle
+        where the tx plane pays N).  Lazy import: chain I/O must stay
+        importable without the metrics plane."""
+        from svoc_tpu.utils.metrics import registry as _metrics
+
+        _metrics.counter("chain_commit_rpcs", labels={"mode": mode}).add(n)
+
     @_atomic
     def invoke_update_prediction(self, oracle_address, prediction) -> None:
+        self._count_rpc("tx")
         self.backend.invoke(
             oracle_address,
             "update_prediction",
@@ -694,9 +749,145 @@ class ChainAdapter:
         """Pre-encoded twin of :meth:`invoke_update_prediction` — the
         WAL path encodes once, journals the felts, then signs the SAME
         payload (digest in the log must equal digest on the wire)."""
+        self._count_rpc("tx")
         self.backend.invoke(
             oracle_address, "update_prediction", prediction=felts
         )
+
+    def update_predictions_batched(
+        self,
+        predictions: Sequence,
+        *,
+        start: int = 0,
+        skip: Sequence[int] = (),
+        lineage: Optional[str] = None,
+        wal=None,
+    ) -> int:
+        """ONE chain RPC carrying the fleet's whole payload
+        (docs/RESILIENCE.md §batched-commits): the batched commit plane
+        behind ``commit_mode="batched"``.  Identical chain state,
+        journal events, and failure accounting as the per-tx loop —
+        only the RPC and WAL-record granularity change (N→1 and
+        2N→2 per clean cycle).
+
+        Raises :class:`BatchCommitUnsupported` — BEFORE any mutation or
+        WAL record — when the plane cannot run as one RPC: the backend
+        has no ``update_predictions_batched`` entrypoint (Sepolia's
+        per-account signing, chaos wrappers) or ``skip`` holds
+        quarantined slots (the batched entrypoint commits a contiguous
+        caller range).  The caller counts the fallback
+        (``commit_batch_fallback{reason=}``) and reruns per tx.
+
+        ``wal`` (a :class:`svoc_tpu.durability.wal.WALCycle`): the
+        cycle-open record already carries the full payload matrix, so
+        ONE fsynced ``intent_batch`` covers the whole attempt before
+        the RPC and one ``landed_batch`` records it after — on a
+        mid-batch failure the applied prefix is recorded durably before
+        the error propagates, and the restart reconciler classifies
+        ``landed_batch`` slots exactly like per-tx ``landed`` ones.
+
+        A mid-fleet failure raises :class:`ChainCommitError` with the
+        per-tx path's exact accounting (``committed`` = absolute failed
+        index, ``sent_count`` = txs this attempt landed); a malformed
+        prediction is THAT tx's failure after the prefix commits, as in
+        the per-tx loop.
+        """
+        skip_set = frozenset(int(i) for i in skip)
+        if skip_set:
+            raise BatchCommitUnsupported(
+                "skip_slots",
+                f"{len(skip_set)} quarantined slot(s) force tx granularity",
+            )
+        batched_invoke = getattr(
+            self.backend, "update_predictions_batched", None
+        )
+        if batched_invoke is None:
+            raise BatchCommitUnsupported(
+                "unsupported", type(self.backend).__name__
+            )
+        from svoc_tpu.utils.metrics import stage_span
+
+        with stage_span("commit", lineage=lineage):
+            oracles = self.call_oracle_list()
+            total = min(len(oracles), len(predictions))
+            if not 0 <= start <= total:
+                raise ValueError(f"start={start} outside [0, {total}]")
+            # Vectorized felt encode, per-tx error semantics: the first
+            # malformed row truncates the batch — its prefix commits,
+            # then the failure surfaces at that tx's absolute index
+            # with the original codec exception as cause.
+            encoded = encode_matrix(
+                np.asarray(predictions, dtype=np.float64)[start:total],
+                on_error="none",
+            )
+            felts: List[List[int]] = []
+            codec_failure = None
+            for t, row in enumerate(encoded, start=start):
+                if row is None:
+                    try:
+                        encode_vector(predictions[t])
+                        cause: Exception = ValueError(
+                            "prediction has no felt encoding"
+                        )
+                    except Exception as e:  # noqa: BLE001 — the real codec error
+                        cause = e
+                    codec_failure = (t, cause)
+                    break
+                felts.append(row)
+            slots = list(range(start, start + len(felts)))
+            sent = 0
+            if felts:
+                if wal is not None:
+                    # One durable intent for the whole batch ("no
+                    # durable intent, no tx" at batch granularity); WAL
+                    # append failures propagate unwrapped, before the
+                    # RPC, exactly like the per-tx hook contract.
+                    wal.intent_batch(slots)
+                from svoc_tpu.consensus.state import (
+                    BatchNotCertified,
+                    BatchTxError,
+                )
+
+                self._count_rpc("batch")
+                # Bounded work on the local simulator (one certified
+                # sweep, or the exact engine in-place for uncertifiable
+                # batches) — held under the adapter lock like the
+                # throughput batch path.
+                with self._lock:
+                    try:
+                        sent = batched_invoke(
+                            oracles[start : start + len(felts)], felts
+                        )
+                    except BatchNotCertified as e:
+                        # A "raise"-mode backend refused BEFORE any
+                        # mutation; the already-journaled batch intent
+                        # is harmless (the reconciler digest-classifies
+                        # intents without landed records).
+                        raise BatchCommitUnsupported(
+                            "uncertified", str(e)
+                        ) from e
+                    except BatchTxError as e:
+                        if wal is not None and e.index > 0:
+                            wal.landed_batch(slots[: e.index])
+                        raise ChainCommitError(
+                            committed=start + e.index,
+                            total=total,
+                            failed_oracle=e.oracle_address,
+                            cause=e.cause,
+                            sent_count=e.index,
+                        ) from e
+                if wal is not None:
+                    wal.landed_batch(slots)
+            if codec_failure is not None:
+                t, cause = codec_failure
+                raise ChainCommitError(
+                    committed=start + sent,
+                    total=total,
+                    failed_oracle=oracles[t],
+                    cause=cause,
+                    sent_count=sent,
+                ) from cause
+            return sent
 
     def _update_all_the_predictions(
         self,
@@ -757,6 +948,7 @@ class ChainAdapter:
                 except Exception as e:
                     codec_failure = (t, e)
                     break
+            self._count_rpc("batch")
             # The fast path is bounded work (one device sweep + one
             # golden recompute) — safe to hold the adapter lock for.
             # An UNCERTIFIED batch raises before any mutation, and the

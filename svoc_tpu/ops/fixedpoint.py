@@ -218,6 +218,89 @@ def encode_vector(xs) -> list[int]:
     return [float_to_fwsad(float(x)) for x in np.asarray(xs).ravel()]
 
 
+#: Largest |x·1e6| the vectorized int64 truncation lane may handle —
+#: beyond it the cast would wrap, so those rows take the exact
+#: arbitrary-precision per-element lane instead.
+_INT64_SAFE: float = float(2**62)
+
+
+def _wsad_fast_rows(scaled: np.ndarray) -> np.ndarray:
+    """Rows of a pre-scaled (×1e6) float64 block that the int64
+    truncation lane encodes exactly: finite and within the safe cast
+    window.  ``np.trunc`` on the identical float64 product is
+    bit-identical to Python's ``int(x * 1e6)`` (both truncate toward
+    zero), so the two lanes can never disagree — the lane split is
+    purely about int64 range and error semantics."""
+    with np.errstate(invalid="ignore", over="ignore"):
+        return np.all(
+            np.isfinite(scaled) & (np.abs(scaled) < _INT64_SAFE), axis=1
+        )
+
+
+def to_wsad_rows(matrix) -> list[list[int]]:
+    """Vectorized :func:`to_wsad` over a ``[N, M]`` float block — the
+    commit path's per-element ``int(x * 1e6)`` loop collapsed into one
+    numpy truncation (bit-identical results; non-finite or huge rows
+    fall back to the exact per-element lane, *including* its
+    exceptions, so error semantics don't change with the speedup)."""
+    arr = np.atleast_2d(np.asarray(matrix, dtype=np.float64))
+    scaled = arr * 1e6
+    fast = _wsad_fast_rows(scaled)
+    if bool(np.all(fast)):
+        return np.trunc(scaled).astype(np.int64).tolist()
+    out: list = [None] * arr.shape[0]
+    idx = np.flatnonzero(fast)
+    if idx.size:
+        lists = np.trunc(scaled[idx]).astype(np.int64).tolist()
+        for j, i in enumerate(idx):
+            out[i] = lists[j]
+    for i in np.flatnonzero(~fast):
+        out[i] = [to_wsad(float(x)) for x in arr[i]]
+    return out
+
+
+def encode_matrix(matrix, on_error: str | None = None) -> list:
+    """Vectorized :func:`encode_vector` over a ``[N, M]`` float block:
+    one numpy truncation for every encodable row, the exact
+    per-element codec for the rest.
+
+    ``on_error=None`` (default) mirrors a ``[encode_vector(row) for
+    row]`` loop exactly — a malformed row raises the same exception at
+    the same row.  ``on_error="none"`` is the WAL cycle-open contract
+    (:meth:`svoc_tpu.apps.session.Session._open_wal_cycle`): a row with
+    no signable payload becomes ``None`` instead of raising.
+    """
+    arr = np.asarray(matrix, dtype=np.float64)
+    if arr.ndim != 2:
+        raise ValueError(f"encode_matrix needs [N, M], got {arr.shape}")
+    scaled = arr * 1e6
+    fast = _wsad_fast_rows(scaled)
+    out: list = [None] * arr.shape[0]
+    idx = np.flatnonzero(fast)
+    if idx.size:
+        wsad = np.trunc(scaled[idx]).astype(np.int64)
+        lists = wsad.tolist()
+        if wsad.size and int(wsad.min()) < 0:
+            # Negative wsad wraps around the felt prime (252-bit Python
+            # ints — only the rare negative rows pay the per-element
+            # wrap; constrained fleets never do).
+            lists = [
+                [x if x >= 0 else x + FELT_PRIME for x in row]
+                for row in lists
+            ]
+        for j, i in enumerate(idx):
+            out[i] = lists[j]
+    for i in np.flatnonzero(~fast):
+        if on_error == "none":
+            try:
+                out[i] = encode_vector(arr[i])
+            except Exception:
+                out[i] = None
+        else:
+            out[i] = encode_vector(arr[i])
+    return out
+
+
 def decode_vector(felts) -> np.ndarray:
     """felt252 calldata → float vector."""
     return np.array([fwsad_to_float(int(f)) for f in felts], dtype=np.float64)

@@ -33,7 +33,12 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
-from svoc_tpu.io.chain import ChainAdapter, ChainCommitError
+from svoc_tpu.consensus.dispatch import report_batch_fallback
+from svoc_tpu.io.chain import (
+    BatchCommitUnsupported,
+    ChainAdapter,
+    ChainCommitError,
+)
 from svoc_tpu.resilience.breaker import CircuitBreaker, CircuitOpenError
 from svoc_tpu.utils.metrics import MetricsRegistry
 from svoc_tpu.utils.metrics import registry as _default_registry
@@ -188,6 +193,7 @@ def commit_fleet_with_resume(
     journal=None,
     lineage: Optional[str] = None,
     wal=None,
+    commit_mode: str = "per_tx",
 ) -> CommitOutcome:
     """Commit the whole fleet, resuming across partial failures.
 
@@ -236,6 +242,21 @@ def commit_fleet_with_resume(
     success, stranded-complete, deadline, breaker, transport — closes
     the cycle (``done``); only a kill leaves it open for the restart
     reconciler (docs/RESILIENCE.md §durability).
+
+    ``commit_mode="batched"`` (docs/RESILIENCE.md §batched-commits)
+    sends the FIRST attempt as one batched RPC carrying the whole
+    fleet payload
+    (:meth:`~svoc_tpu.io.chain.ChainAdapter.update_predictions_batched`;
+    with a WAL riding, one fsynced ``intent_batch``/``landed_batch``
+    pair instead of 2N per-tx records).  Every way the batched plane
+    cannot serve is a COUNTED fallback to the per-tx loop
+    (``commit_batch_fallback{reason=}``, never silent): an unsupported
+    backend or quarantine ``skip`` slots fall back within the same
+    attempt (identical journal events to ``per_tx`` mode — the seeded
+    fingerprint-identity contract), and a mid-batch chain failure
+    (``reason="batch_error"``) resumes the stranded suffix through the
+    exact per-tx retry machinery below.  Chain state, journal events,
+    and ``CommitOutcome`` accounting are identical across modes.
     """
     reg = registry or _default_registry
     if journal is None:
@@ -260,6 +281,10 @@ def commit_fleet_with_resume(
     attempts = 0
     consecutive: Dict[int, int] = {}
     stranded: List[Any] = []
+    #: One batched attempt at most: after a mid-batch failure the
+    #: resume machinery below owns the stranded suffix per tx (the
+    #: batched entrypoint has no skip/strand vocabulary).
+    use_batched = commit_mode == "batched"
     while True:
         if breaker is not None and not breaker.allow():
             journal.emit(
@@ -278,13 +303,43 @@ def commit_fleet_with_resume(
         if wal is not None:
             wal.new_attempt(start)
         t0 = clock()
+        batched_attempt, use_batched = use_batched, False
         try:
-            n = adapter.update_all_the_predictions(
-                predictions, start=start, skip=skip, lineage=lineage,
-                on_intent=wal.intent if wal is not None else None,
-                on_landed=wal.landed if wal is not None else None,
-            )
+            if batched_attempt:
+                try:
+                    n = adapter.update_predictions_batched(
+                        predictions, start=start, skip=skip,
+                        lineage=lineage, wal=wal,
+                    )
+                except BatchCommitUnsupported as e:
+                    # Same attempt, per-tx plane: identical journal
+                    # events and attempt accounting to per_tx mode —
+                    # only the counted fallback (and the RPC count)
+                    # tells the modes apart.
+                    report_batch_fallback(
+                        e.reason, detail=e.detail, metrics=reg
+                    )
+                    batched_attempt = False
+                    n = adapter.update_all_the_predictions(
+                        predictions, start=start, skip=skip,
+                        lineage=lineage,
+                        on_intent=wal.intent if wal is not None else None,
+                        on_landed=wal.landed if wal is not None else None,
+                    )
+            else:
+                n = adapter.update_all_the_predictions(
+                    predictions, start=start, skip=skip, lineage=lineage,
+                    on_intent=wal.intent if wal is not None else None,
+                    on_landed=wal.landed if wal is not None else None,
+                )
         except ChainCommitError as e:
+            if batched_attempt:
+                # The single RPC failed mid-fleet: the stranded suffix
+                # re-enters the per-tx resume machinery below — a mode
+                # degradation, so it is counted, never silent.
+                report_batch_fallback(
+                    "batch_error", detail=str(e.cause), metrics=reg
+                )
             landed = _landed(e, start, wal)
             if breaker is not None:
                 # Progress credit: an attempt that LANDED txs before

@@ -11,7 +11,12 @@ virtual-time scenario behind ``make serving-smoke``
 """
 
 from svoc_tpu.serving.batcher import MicroBatcher
-from svoc_tpu.serving.cache import ResultCache, content_key
+from svoc_tpu.serving.cache import (
+    ResultCache,
+    content_key,
+    content_key_from_digest,
+    text_digest,
+)
 from svoc_tpu.serving.frontend import (
     AdmissionConfig,
     AdmissionController,
@@ -31,4 +36,6 @@ __all__ = [
     "ServingRequest",
     "ServingTier",
     "content_key",
+    "content_key_from_digest",
+    "text_digest",
 ]

@@ -84,6 +84,28 @@ class MicroBatcher:
             )
         return picked
 
+    def vectorize_requests(
+        self, requests: Sequence[ServingRequest]
+    ) -> np.ndarray:
+        """``[K, M]`` sentiment vectors for one micro-batch,
+        deduplicated on each request's ADMISSION-TIME content digest
+        (docs/SERVING.md §hash-once): a hot comment submitted to
+        several claims before its first completion — the dedup cache
+        only helps ACROSS steps — is forwarded once and fanned back
+        out, and the dedup key is the sha256 the frontend already
+        computed, so no byte of text is hashed (or dict-keyed) a
+        second time on the hot path."""
+        seen: Dict[str, int] = {}
+        texts: List[str] = []
+        for request in requests:
+            if request.digest not in seen:
+                seen[request.digest] = len(texts)
+                texts.append(request.text)
+        vectors = self._vectorize_unique(texts)
+        if len(texts) == len(requests):
+            return vectors
+        return vectors[[seen[r.digest] for r in requests]]
+
     def vectorize(self, texts: Sequence[str]) -> np.ndarray:
         """Texts → ``[K, M]`` sentiment vectors through the packed
         cross-claim forward when the vectorizer is a
@@ -91,11 +113,10 @@ class MicroBatcher:
         gauges), plain call otherwise (injected test/scenario
         vectorizers).
 
-        Duplicate texts within one micro-batch (a hot comment
-        submitted to several claims before its first completion — the
-        dedup cache only helps ACROSS steps) are forwarded once and
-        fanned back out, so repeats never burn the packed-segment
-        headroom the batch exists to fill."""
+        Duplicate texts within one micro-batch are forwarded once and
+        fanned back out.  Raw-text convenience twin of
+        :meth:`vectorize_requests` (which dedups on the admission-time
+        digest instead of re-keying the full text)."""
         texts = list(texts)
         unique = list(dict.fromkeys(texts))
         vectors = self._vectorize_unique(unique)

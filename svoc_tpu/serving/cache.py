@@ -37,14 +37,35 @@ from svoc_tpu.utils.metrics import MetricsRegistry
 from svoc_tpu.utils.metrics import registry as _default_registry
 
 
+def text_digest(text: str) -> str:
+    """sha256 of the raw comment text — computed ONCE per request at
+    admission (docs/SERVING.md §hash-once) and threaded through every
+    consumer: the cache key derives from it, the batcher's in-batch
+    dedup compares it, and the audit trail can carry it without ever
+    re-reading the text.  This is the only place serving hashes
+    variable-length content; everything downstream hashes (or
+    compares) the fixed-size digest."""
+    return hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()
+
+
+def content_key_from_digest(claim_id: str, digest: str) -> str:
+    """The cache key for a text whose :func:`text_digest` is already
+    known — the submit path computes the digest once and derives the
+    key from it (hashing a fixed 64-char digest, never the text
+    again).  Keys stay claim-scoped: two claims submitting the same
+    text do NOT share an entry."""
+    return hashlib.sha256(
+        f"{claim_id}\x00{digest}".encode()
+    ).hexdigest()[:24]
+
+
 def content_key(claim_id: str, text: str) -> str:
     """The cache key: a stable digest of ``(claim, comment text)``.
     Hash-based (not the raw text) so keys are fixed-size and never leak
-    comment content into metrics labels or logs."""
-    digest = hashlib.sha256(
-        f"{claim_id}\x00{text}".encode("utf-8", "replace")
-    ).hexdigest()
-    return digest[:24]
+    comment content into metrics labels or logs.  One-shot convenience
+    over :func:`text_digest` + :func:`content_key_from_digest` — hot
+    paths that already hold the digest use the latter directly."""
+    return content_key_from_digest(claim_id, text_digest(text))
 
 
 class ResultCache:

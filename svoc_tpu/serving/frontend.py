@@ -44,9 +44,14 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from svoc_tpu.serving.cache import ResultCache, content_key
+from svoc_tpu.serving.cache import (
+    ResultCache,
+    content_key_from_digest,
+    text_digest,
+)
 from svoc_tpu.utils.metrics import MetricsRegistry
 from svoc_tpu.utils.metrics import registry as _default_registry
+from svoc_tpu.utils.rounding import round6_list
 
 
 @dataclasses.dataclass(frozen=True)
@@ -137,6 +142,7 @@ class ServingRequest:
         "seq",
         "request_id",
         "lineage",
+        "digest",
         "key",
         "t_submit",
         "vector",
@@ -150,15 +156,23 @@ class ServingRequest:
         lineage: str,
         t_submit: float,
         key: Optional[str] = None,
+        digest: Optional[str] = None,
     ):
         self.claim = claim
         self.text = text
         self.seq = seq
         self.request_id = f"{claim}:{seq}"
         self.lineage = lineage
-        # The submit path already hashed the text for its cache probe —
-        # reuse that digest instead of hashing twice per miss.
-        self.key = key if key is not None else content_key(claim, text)
+        # Hash-once (docs/SERVING.md §hash-once): the submit path
+        # hashed the text at admission; the digest rides the request so
+        # the cache key, the batcher's in-batch dedup, and any audit
+        # surface reuse it instead of re-hashing the text per consumer.
+        self.digest = digest if digest is not None else text_digest(text)
+        self.key = (
+            key
+            if key is not None
+            else content_key_from_digest(claim, self.digest)
+        )
         self.t_submit = t_submit
         self.vector: Optional[np.ndarray] = None
 
@@ -228,7 +242,11 @@ class ServingFrontend:
         # (``blk<scope>-<claim>-rq<seq>``): per-claim journal slices and
         # fingerprints cover serving traffic with no new partition key.
         lineage = f"{prefix}-rq{seq:06x}"
-        key = content_key(claim_id, text)
+        # The ONE content hash per request (docs/SERVING.md
+        # §hash-once): everything downstream — cache key, in-batch
+        # dedup, lineage audit — derives from this digest.
+        digest = text_digest(text)
+        key = content_key_from_digest(claim_id, digest)
         cached = self.cache.get(key)
         if cached is not None:
             self._metrics.counter(
@@ -246,11 +264,12 @@ class ServingFrontend:
                 "claim": claim_id,
                 "request_id": f"{claim_id}:{seq}",
                 "lineage": lineage,
-                "vector": [round(float(x), 6) for x in cached],
+                "vector": round6_list(cached),
                 "consensus": state.last_consensus,
             }
         request = ServingRequest(
-            claim_id, text, seq, lineage, self._clock(), key=key
+            claim_id, text, seq, lineage, self._clock(), key=key,
+            digest=digest,
         )
         with self._lock:
             q = self._queues.setdefault(claim_id, deque())

@@ -193,9 +193,10 @@ class ServingTier:
             try:
                 with stage_span("serving_batch"):
                     try:
-                        vectors = self.batcher.vectorize(
-                            [r.text for r in requests]
-                        )
+                        # Dedup keys on the admission-time digest —
+                        # the text is never re-hashed after submit
+                        # (docs/SERVING.md §hash-once).
+                        vectors = self.batcher.vectorize_requests(requests)
                     except Exception:
                         vectors = None
                 if vectors is None:

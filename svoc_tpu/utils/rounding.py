@@ -1,0 +1,90 @@
+"""Vectorized 6-decimal rounding with exact Python-`round` parity.
+
+The journal write-back contract (docs/OBSERVABILITY.md §events) rounds
+every float the fabric journals to 6 decimals with Python's
+``round(float(x), 6)`` — and seeded replay fingerprints digest those
+values byte-for-byte, so ANY drift in the rounding is a replay break.
+The original per-element loop paid one Python call per element per
+claim per cycle (``fabric/router.py`` pre-PR-13); this module is the
+one-sync vectorized replacement that is *bit-identical* to the loop.
+
+Why not plain ``np.round``: numpy rounds by scaling
+(``rint(x * 10^6) / 10^6``) while CPython rounds the exact decimal
+expansion of the binary float (``double_round`` via ``_Py_dg_dtoa``).
+The scaled product carries up to ~0.5 ulp of error, so a value whose
+true scaled fraction sits within ~1e-10 of a half-boundary can round
+differently — ``0.0000005`` is the canonical divergence.  Consensus
+essences are arbitrary float mantissas; across thousands of journaled
+values a divergence is a *when*, not an *if*.
+
+The fix is a two-lane design:
+
+- the bulk lane is ``np.round`` (one vectorized pass, no Python calls);
+- every element whose scaled fractional part lands within
+  ``_HALF_WINDOW`` of a half-boundary — the only region where the two
+  implementations can disagree — is re-rounded through Python's
+  ``round``.  The window (1e-6 of scaled-unit space, i.e. ~2e-6 of the
+  fraction axis) is ~4 orders of magnitude wider than the maximum
+  scaling error, and statistically selects ~0.0002 % of real-valued
+  inputs, so the slow lane is almost always empty.
+
+Non-finite values pass through both lanes identically (``np.round`` and
+``round(x, 6)`` both return NaN/±Inf unchanged for ``ndigits`` given).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+#: Half-boundary proximity (in scaled units, i.e. multiples of 1e-6)
+#: below which the exact Python rounding adjudicates.  Must stay far
+#: above the ~1e-10 worst-case scaling error and far below 0.5.
+_HALF_WINDOW = 1e-6
+
+#: Magnitude above which the scaled product ``x * 1e6`` leaves the
+#: float64 integer-exact range (2^53) and ``np.round``'s divide-back
+#: DOUBLE-ROUNDS — and the half-boundary distance computed below
+#: degenerates, so the fixup lane cannot flag the divergence.  Every
+#: such value routes straight to Python's exact rounding instead
+#: (2^52/1e6, a 2× guard under the true 2^53/1e6 edge).  Journaled
+#: essences are tiny in practice, but the unconstrained codec-only gate
+#: admits values up to the i128 window — the parity contract must hold
+#: there too.
+_BIG = float(2**52) / 1e6
+
+
+def round6(values) -> np.ndarray:
+    """Round a float array to 6 decimals, bit-identical to mapping
+    Python's ``round(float(x), 6)`` over every element (the journal
+    write-back contract).  Returns a float64 array of the input shape;
+    scalars become 0-d arrays (use :func:`round6_scalar` for a Python
+    float)."""
+    arr = np.asarray(values, dtype=np.float64)
+    out = np.round(arr, 6)
+    with np.errstate(invalid="ignore", over="ignore"):
+        scaled = arr * 1e6
+        # Distance of the scaled value from the nearest half-boundary;
+        # NaN/Inf propagate to NaN here and compare False (fast lane).
+        frac = np.abs(scaled - np.floor(scaled) - 0.5)
+        risky = (frac < _HALF_WINDOW) | (np.abs(arr) >= _BIG)
+    if np.any(risky):
+        flat_out = out.reshape(-1)
+        flat_in = arr.reshape(-1)
+        for i in np.flatnonzero(risky.reshape(-1)):
+            flat_out[i] = round(float(flat_in[i]), 6)
+    return out
+
+
+def round6_scalar(x) -> float:
+    """``round(float(x), 6)`` — the scalar twin, for call sites that
+    journal a single reliability/ratio value."""
+    return round(float(x), 6)
+
+
+def round6_list(values) -> List:
+    """The journal-payload form: :func:`round6` then ``tolist()`` —
+    plain Python floats (1-D input) or nested lists (2-D), exactly what
+    the per-element ``[round(float(x), 6) for x in row]`` loops built."""
+    return round6(values).tolist()

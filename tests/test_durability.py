@@ -409,8 +409,12 @@ def make_session(tmp_path=None, wal=None):
 
     store = CommentStore()
     store.save(SyntheticSource(batch=120, seed=7)())
+    # This suite pins the PER-TX WAL record family (per-slot
+    # intent/landed mechanics) regardless of the committed commit_mode
+    # record — the batched family (intent_batch/landed_batch) has its
+    # own coverage in tests/test_hotpath.py.
     session = Session(
-        config=SessionConfig(),
+        config=SessionConfig(commit_mode="per_tx"),
         store=store,
         vectorizer=fake_sentiment_vectorizer,
         journal=EventJournal(registry=MetricsRegistry()),

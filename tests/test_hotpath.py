@@ -1,0 +1,550 @@
+"""Zero-sync hot path (PR 13, docs/PARALLELISM.md §host-overhead):
+device-resident dispatch, the vectorized write-back's exactness
+contract, and the batched commit plane's parity/WAL/reconcile
+semantics."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from svoc_tpu.consensus.state import BatchTxError
+from svoc_tpu.durability.wal import CommitIntentWAL
+from svoc_tpu.fabric.registry import ClaimSpec
+from svoc_tpu.fabric.session import MultiSession
+from svoc_tpu.io.chain import (
+    BatchCommitUnsupported,
+    ChainAdapter,
+    LocalChainBackend,
+)
+from svoc_tpu.utils.events import EventJournal
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as process_registry
+from svoc_tpu.utils.rounding import round6, round6_list
+
+
+# ---------------------------------------------------------------------------
+# round6: the write-back's bit-exactness contract
+# ---------------------------------------------------------------------------
+
+
+class TestRound6:
+    def test_matches_python_round_on_random_bulk(self):
+        rng = np.random.default_rng(0)
+        arr = rng.uniform(-2, 2, size=20000)
+        got = round6(arr)
+        want = np.array([round(float(x), 6) for x in arr])
+        assert (got == want).all()
+
+    def test_matches_python_round_on_half_boundaries(self):
+        """The divergence region: np.round alone disagrees with Python
+        round on a large fraction of half-boundary-adjacent values —
+        the fixup lane must close ALL of them."""
+        rng = np.random.default_rng(1)
+        ks = rng.integers(0, 2_000_000, size=20000)
+        adv = (2 * ks + 1) * 5e-7  # decimal ...5 at the 7th place
+        ties = np.arange(1, 2001, 2) / 128.0  # exactly representable ties
+        near = adv + rng.uniform(-1e-9, 1e-9, size=adv.size)
+        for arr in (adv, ties, near):
+            got = round6(arr)
+            want = np.array([round(float(x), 6) for x in arr])
+            assert (got == want).all()
+        # the fixup lane is load-bearing: plain np.round must diverge
+        # somewhere in this set, else the test lost its teeth
+        plain = np.round(adv, 6)
+        want = np.array([round(float(x), 6) for x in adv])
+        assert (plain != want).any()
+
+    def test_matches_python_round_on_huge_magnitudes(self):
+        """Above ~2^53/1e6 the scaled product leaves float64's
+        integer-exact range and np.round double-rounds (review finding:
+        the half-boundary lane cannot flag these) — the magnitude lane
+        must route them to Python's exact rounding."""
+        repro = np.array([9826986099.587141, -9826986099.587141])
+        got = round6(repro)
+        want = np.array([round(float(x), 6) for x in repro])
+        assert (got == want).all()
+        rng = np.random.default_rng(12)
+        big = rng.uniform(1e9, 1e12, size=5000) * rng.choice(
+            [-1.0, 1.0], size=5000
+        )
+        got = round6(big)
+        want = np.array([round(float(x), 6) for x in big])
+        assert (got == want).all()
+
+    def test_non_finite_and_shapes(self):
+        special = np.array([np.nan, np.inf, -np.inf, 0.0, -0.0])
+        got = round6(special)
+        assert np.isnan(got[0]) and got[1] == np.inf and got[2] == -np.inf
+        rows = round6_list(np.array([[0.1234565, 0.5], [1.5e-7, -2.25]]))
+        assert rows == [
+            [round(0.1234565, 6), 0.5],
+            [round(1.5e-7, 6), -2.25],
+        ]
+        assert all(isinstance(x, float) for row in rows for x in row)
+
+
+class TestVectorizedEncode:
+    def test_encode_matrix_matches_per_row_loop(self):
+        from svoc_tpu.ops.fixedpoint import encode_matrix, encode_vector
+
+        rng = np.random.default_rng(2)
+        m = rng.uniform(-3, 3, size=(32, 6))
+        assert encode_matrix(m) == [encode_vector(r) for r in m]
+
+    def test_encode_matrix_on_error_none_marks_bad_rows(self):
+        from svoc_tpu.ops.fixedpoint import encode_matrix, encode_vector
+
+        m = np.full((4, 3), 0.25)
+        m[1, 0] = np.nan
+        m[3] = 1e60  # finite but beyond the int64 fast lane
+        got = encode_matrix(m, on_error="none")
+        assert got[0] == encode_vector(m[0])
+        assert got[1] is None
+        assert got[3] == encode_vector(m[3])  # exact lane still encodes
+        with pytest.raises(ValueError):
+            encode_matrix(m)  # default mirrors the raising loop
+
+    def test_to_wsad_rows_matches_loop(self):
+        from svoc_tpu.ops.fixedpoint import to_wsad, to_wsad_rows
+
+        rng = np.random.default_rng(3)
+        m = rng.uniform(-5, 5, size=(16, 4))
+        assert to_wsad_rows(m) == [
+            [to_wsad(float(x)) for x in row] for row in m
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Donation safety
+# ---------------------------------------------------------------------------
+
+
+class TestDonationSafety:
+    def test_donated_cube_is_consumed_and_outputs_match(self):
+        """The donated twin must (a) produce the undonated program's
+        exact outputs and (b) actually consume its input — re-reading
+        a donated buffer is the SVOC004 bug class, and the runtime
+        enforces it where donation is supported."""
+        import jax.numpy as jnp
+
+        from svoc_tpu.consensus.batch import claims_consensus_gated
+        from svoc_tpu.consensus.kernel import ConsensusConfig
+
+        rng = np.random.default_rng(4)
+        values = rng.uniform(0, 1, size=(4, 8, 6)).astype(np.float32)
+        ok = np.ones((4, 8), dtype=bool)
+        mask = np.array([True, True, True, False])
+        cfg = ConsensusConfig(n_failing=2, constrained=True)
+
+        plain = claims_consensus_gated(
+            jnp.asarray(values), jnp.asarray(ok), jnp.asarray(mask), cfg
+        )
+        donated_in = jnp.array(values)
+        donated = claims_consensus_gated(
+            donated_in, jnp.asarray(ok), jnp.asarray(mask), cfg,
+            donate=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.essence), np.asarray(donated.essence)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.reliable), np.asarray(donated.reliable)
+        )
+        if donated_in.is_deleted():
+            with pytest.raises(RuntimeError):
+                np.asarray(donated_in)
+
+    def test_staging_reuse_does_not_corrupt_prior_outputs(self):
+        """In-place staging mutation across cycles must never alias a
+        live dispatch's inputs/outputs (the CPU zero-copy hazard the
+        explicit H2D copy exists for): consecutive device-resident
+        cycles must reproduce the unstaged cycles' journal exactly."""
+        multi_a = _tiny_multi(device_resident=True, scope="stga")
+        multi_b = _tiny_multi(device_resident=False, scope="stga")
+        multi_a.run(4)
+        multi_b.run(4)
+        assert {
+            c: multi_a.claim_fingerprint(c) for c in multi_a.claim_ids()
+        } == {
+            c: multi_b.claim_fingerprint(c) for c in multi_b.claim_ids()
+        }
+
+
+# ---------------------------------------------------------------------------
+# Fabric fingerprint identity (both consensus configs)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_multi(
+    *,
+    device_resident: bool = False,
+    commit_mode: str = "per_tx",
+    scope: str = "hp",
+    constrained_only: bool = False,
+    wal_path=None,
+):
+    from conftest import fake_sentiment_vectorizer
+    from svoc_tpu.io.comment_store import CommentStore
+    from svoc_tpu.io.scraper import SyntheticSource
+    from svoc_tpu.sim.generators import claim_seed
+
+    def store_factory(claim_id):
+        store = CommentStore()
+        store.save(
+            SyntheticSource(batch=80, seed=claim_seed(11, claim_id))()
+        )
+        return store
+
+    multi = MultiSession(
+        base_seed=11,
+        vectorizer=fake_sentiment_vectorizer,
+        store_factory=store_factory,
+        journal=EventJournal(),
+        metrics=MetricsRegistry(),
+        lineage_scope=scope,
+        max_claims_per_batch=4,
+        device_resident=device_resident,
+        commit_mode=commit_mode,
+    )
+    multi.add_claim(ClaimSpec(claim_id="alpha", n_oracles=8))
+    multi.add_claim(ClaimSpec(claim_id="beta", n_oracles=8))
+    if not constrained_only:
+        # The unconstrained estimator config rides the same cube in its
+        # own (N, M, cfg) group — "both configs" in one fabric.
+        multi.add_claim(
+            ClaimSpec(
+                claim_id="gamma",
+                n_oracles=8,
+                constrained=False,
+                max_spread=10.0,
+            )
+        )
+    if wal_path is not None:
+        multi.attach_wal(CommitIntentWAL(str(wal_path)))
+    return multi
+
+
+class TestFingerprintIdentity:
+    def test_optimized_equals_baseline_both_configs(self):
+        """device_resident + batched commits are NOT a fingerprint
+        family: constrained AND unconstrained claims must digest
+        byte-identically against the unoptimized path."""
+        base = _tiny_multi()
+        opt = _tiny_multi(device_resident=True, commit_mode="batched")
+        base.run(5)
+        opt.run(5)
+        for cid in base.claim_ids():
+            assert base.claim_fingerprint(cid) == opt.claim_fingerprint(
+                cid
+            ), cid
+
+    def test_wal_attached_identity(self, tmp_path):
+        """The batched plane's WAL records differ (intent_batch /
+        landed_batch) but the JOURNAL must not — fingerprints stay
+        identical with a WAL riding both runs."""
+        base = _tiny_multi(
+            scope="hpw", wal_path=tmp_path / "a.wal",
+            constrained_only=True,
+        )
+        opt = _tiny_multi(
+            scope="hpw", wal_path=tmp_path / "b.wal",
+            device_resident=True, commit_mode="batched",
+            constrained_only=True,
+        )
+        base.run(4)
+        opt.run(4)
+        for cid in base.claim_ids():
+            assert base.claim_fingerprint(cid) == opt.claim_fingerprint(cid)
+        # and the WAL record FAMILIES are what changed
+        base_kinds = {r["kind"] for r in base._wal.records()}
+        opt_kinds = {r["kind"] for r in opt._wal.records()}
+        assert "intent" in base_kinds and "landed" in base_kinds
+        assert "intent_batch" in opt_kinds and "landed_batch" in opt_kinds
+        assert "intent" not in opt_kinds
+
+
+# ---------------------------------------------------------------------------
+# Batched commit plane: parity, RPC accounting, fallbacks
+# ---------------------------------------------------------------------------
+
+
+def _adapter_pair(n_oracles=8, dimension=4):
+    from svoc_tpu.consensus.state import OracleConsensusContract
+
+    def contract():
+        return OracleConsensusContract(
+            admins=[0xA0, 0xA1, 0xA2],
+            oracles=[0x10 + i for i in range(n_oracles)],
+            required_majority=2,
+            n_failing_oracles=2,
+            constrained=True,
+            dimension=dimension,
+        )
+
+    return (
+        ChainAdapter(LocalChainBackend(contract())),
+        ChainAdapter(LocalChainBackend(contract())),
+    )
+
+
+def _rpc_counts():
+    return {
+        mode: process_registry.counter(
+            "chain_commit_rpcs", labels={"mode": mode}
+        ).count
+        for mode in ("tx", "batch")
+    }
+
+
+class TestBatchedCommitParity:
+    def test_state_parity_and_rpc_counts(self):
+        from svoc_tpu.resilience.retry import commit_fleet_with_resume
+
+        per_tx, batched = _adapter_pair()
+        rng = np.random.default_rng(5)
+        before = _rpc_counts()
+        for _cycle in range(3):
+            block = rng.uniform(0.05, 0.95, size=(8, 4))
+            out_a = commit_fleet_with_resume(per_tx, block)
+            out_b = commit_fleet_with_resume(
+                batched, block, commit_mode="batched"
+            )
+            assert out_a == out_b
+        after = _rpc_counts()
+        assert after["tx"] - before["tx"] == 3 * 8
+        assert after["batch"] - before["batch"] == 3
+        # bit-identical final chain state
+        assert (
+            per_tx.get_the_predictions() == batched.get_the_predictions()
+        )
+
+    def test_unsupported_backend_falls_back_counted(self):
+        from svoc_tpu.resilience.retry import commit_fleet_with_resume
+
+        class WrappedBackend:
+            """A chaos-wrapper-shaped backend: forwards the protocol
+            trio only — no batched entrypoint."""
+
+            def __init__(self, inner):
+                self.inner = inner
+
+            def call(self, fn):
+                return self.inner.call(fn)
+
+            def call_as(self, caller, fn):
+                return self.inner.call_as(caller, fn)
+
+            def invoke(self, caller, fn, /, **kwargs):
+                return self.inner.invoke(caller, fn, **kwargs)
+
+        plain, _ = _adapter_pair()
+        wrapped = ChainAdapter(WrappedBackend(plain.backend))
+        rng = np.random.default_rng(6)
+        block = rng.uniform(0.05, 0.95, size=(8, 4))
+        fallback = process_registry.counter(
+            "commit_batch_fallback", labels={"reason": "unsupported"}
+        )
+        before = fallback.count
+        out = commit_fleet_with_resume(
+            wrapped, block, commit_mode="batched"
+        )
+        assert out.complete and out.sent == 8
+        assert fallback.count == before + 1
+
+    def test_skip_slots_force_per_tx_counted(self):
+        from svoc_tpu.resilience.retry import commit_fleet_with_resume
+
+        adapter, _ = _adapter_pair()
+        rng = np.random.default_rng(7)
+        block = rng.uniform(0.05, 0.95, size=(8, 4))
+        fallback = process_registry.counter(
+            "commit_batch_fallback", labels={"reason": "skip_slots"}
+        )
+        before = fallback.count
+        out = commit_fleet_with_resume(
+            adapter, block, skip=(3,), commit_mode="batched"
+        )
+        assert out.sent == 7 and out.total == 7
+        assert fallback.count == before + 1
+
+    def test_adapter_raises_unsupported_before_any_mutation(self):
+        adapter, _ = _adapter_pair()
+        with pytest.raises(BatchCommitUnsupported) as ei:
+            adapter.update_predictions_batched(
+                np.full((8, 4), 0.5), skip=(1,)
+            )
+        assert ei.value.reason == "skip_slots"
+
+    def test_commit_mode_resolution(self, tmp_path, monkeypatch):
+        import json
+
+        from svoc_tpu.consensus.dispatch import (
+            CommitModeError,
+            resolve_commit_mode,
+            validate_commit_mode,
+        )
+
+        record = tmp_path / "PERF_DECISIONS.json"
+        monkeypatch.delenv("SVOC_COMMIT_MODE", raising=False)
+        assert resolve_commit_mode(str(record)) == "per_tx"  # absent
+        record.write_text(json.dumps({"commit_mode": "batched"}))
+        assert resolve_commit_mode(str(record)) == "batched"
+        monkeypatch.setenv("SVOC_COMMIT_MODE", "per_tx")
+        assert resolve_commit_mode(str(record)) == "per_tx"  # env wins
+        monkeypatch.setenv("SVOC_COMMIT_MODE", "bogus")
+        with pytest.raises(CommitModeError):
+            resolve_commit_mode(str(record))
+        with pytest.raises(CommitModeError):
+            validate_commit_mode("nope")
+
+
+# ---------------------------------------------------------------------------
+# WAL + reconciler: the batched record family
+# ---------------------------------------------------------------------------
+
+
+class _MidBatchDeath:
+    """A backend whose batched entrypoint applies a prefix and then
+    dies WITHOUT reporting — the process-kill shape for the batch
+    plane (the adapter's landed_batch-of-prefix append is the last
+    durable record)."""
+
+    def __init__(self, inner: LocalChainBackend, fail_at: int):
+        self.inner = inner
+        self.fail_at = fail_at
+
+    def call(self, fn):
+        return self.inner.call(fn)
+
+    def call_as(self, caller, fn):
+        return self.inner.call_as(caller, fn)
+
+    def invoke(self, caller, fn, /, **kwargs):
+        return self.inner.invoke(caller, fn, **kwargs)
+
+    def update_predictions_batched(self, callers, predictions):
+        k = self.fail_at
+        self.inner.update_predictions_batched(
+            list(callers)[:k], list(predictions)[:k]
+        )
+        raise BatchTxError(k, list(callers)[k], RuntimeError("rpc died"))
+
+
+class TestBatchedWalReconcile:
+    def _fleet_block(self, n=8, m=4, seed=8):
+        rng = np.random.default_rng(seed)
+        return rng.uniform(0.05, 0.95, size=(n, m))
+
+    def test_mid_batch_kill_reconciles_prefix_landed_suffix_stranded(
+        self, tmp_path
+    ):
+        from svoc_tpu.durability.reconcile import (
+            LANDED_BATCH,
+            STRANDED,
+            reconcile_wal,
+        )
+        from svoc_tpu.ops.fixedpoint import encode_matrix
+
+        plain, _ = _adapter_pair()
+        dying = ChainAdapter(_MidBatchDeath(plain.backend, fail_at=5))
+        block = self._fleet_block()
+        wal = CommitIntentWAL(str(tmp_path / "wal.jsonl"))
+        oracles = dying.call_oracle_list()
+        cycle = wal.cycle(
+            "blk-test-000001",
+            oracles=oracles,
+            payloads=encode_matrix(block),
+        )
+        cycle.new_attempt(0)
+        with pytest.raises(Exception) as ei:
+            dying.update_predictions_batched(
+                block, lineage="blk-test-000001", wal=cycle
+            )
+        assert getattr(ei.value, "sent_count", None) == 5
+        # Simulate the kill: no done record, no in-process resume.
+        kinds = [r["kind"] for r in wal.records()]
+        assert kinds == ["cycle", "intent_batch", "landed_batch"]
+        assert wal.records()[-1]["slots"] == [0, 1, 2, 3, 4]
+
+        report = reconcile_wal(
+            wal,
+            lambda _claim: plain,
+            journal=EventJournal(registry=MetricsRegistry()),
+            registry=MetricsRegistry(),
+        )
+        (cyc,) = report.cycles
+        by_class = {}
+        for v in cyc.slots:
+            by_class.setdefault(v.classification, []).append(v.slot)
+        assert by_class[LANDED_BATCH] == [0, 1, 2, 3, 4]
+        assert by_class[STRANDED] == [5, 6, 7]
+        assert all(
+            v.resent for v in cyc.slots if v.classification == STRANDED
+        )
+        assert cyc.closed and report.unaccounted == 0
+        # resent payloads landed: the chain now holds the whole block
+        assert plain.get_the_predictions() == encode_matrix(block)
+
+    def test_kill_between_rpc_and_landed_batch_uses_chain_digest(
+        self, tmp_path
+    ):
+        """intent_batch with NO landed record: every slot classifies
+        through the chain-digest columns — the applied batch reads
+        landed_chain, nothing is resent, zero duplicates."""
+        from svoc_tpu.durability.reconcile import LANDED_CHAIN, reconcile_wal
+        from svoc_tpu.ops.fixedpoint import encode_matrix
+
+        adapter, _ = _adapter_pair()
+        block = self._fleet_block(seed=9)
+        payloads = encode_matrix(block)
+        wal = CommitIntentWAL(str(tmp_path / "wal.jsonl"))
+        cycle = wal.cycle(
+            "blk-test-000002",
+            oracles=adapter.call_oracle_list(),
+            payloads=payloads,
+        )
+        cycle.new_attempt(0)
+        cycle.intent_batch(range(8))
+        # the RPC itself landed...
+        adapter.backend.update_predictions_batched(
+            adapter.call_oracle_list(), payloads
+        )
+        # ...and the process died before landed_batch.
+        report = reconcile_wal(
+            wal,
+            lambda _claim: adapter,
+            journal=EventJournal(registry=MetricsRegistry()),
+            registry=MetricsRegistry(),
+        )
+        (cyc,) = report.cycles
+        assert {v.classification for v in cyc.slots} == {LANDED_CHAIN}
+        assert report.resent == 0 and cyc.closed
+
+    def test_completed_lineage_dedup_after_batched_done(self, tmp_path):
+        """A batched cycle's done record feeds the exactly-once replay
+        dedup exactly like a per-tx one."""
+        from svoc_tpu.resilience.retry import commit_fleet_with_resume
+
+        adapter, _ = _adapter_pair()
+        block = self._fleet_block(seed=10)
+        wal = CommitIntentWAL(str(tmp_path / "wal.jsonl"))
+        oracles = adapter.call_oracle_list()
+        from svoc_tpu.ops.fixedpoint import encode_matrix
+
+        cycle = wal.cycle(
+            "blk-test-000003",
+            oracles=oracles,
+            payloads=encode_matrix(block),
+        )
+        out = commit_fleet_with_resume(
+            adapter, block, commit_mode="batched", wal=cycle,
+            lineage="blk-test-000003",
+        )
+        assert out.complete
+        assert "blk-test-000003" in wal.completed_lineages()

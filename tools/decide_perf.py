@@ -20,6 +20,15 @@ committed measurements — not an editorial choice:
   a grid holding only interpret/CPU points records the xla walkover
   with the artifact named — the committed r06 walkover flows through
   this machinery instead of a hand edit.
+- ``commit_mode`` — the commit plane's RPC granularity
+  (docs/RESILIENCE.md §batched-commits), from the committed
+  ``BENCH_HOTPATH_r08.json`` host-overhead A/B: ``"batched"`` iff the
+  bench measured fingerprint-identical runs, one batched RPC per
+  claim-cycle (against N per-tx), and a ≥2× commit-stage speedup —
+  HOST-side evidence, so unlike the device decisions it qualifies on
+  the CPU container (the ISSUE 13 premise: host overhead is honestly
+  measurable here); ``"per_tx"`` otherwise, with the failed check
+  recorded as the blocker.
 - ``claim_mesh`` — the 2-D (claim × oracle) dispatch mesh
   (docs/PARALLELISM.md §sharded-claims), from the
   ``BENCH_SHARD_r07.json`` sweep: the best-throughput mesh iff the
@@ -186,6 +195,20 @@ def load_grid(path):
     return data
 
 
+def load_hotpath_grid(path):
+    """Load the host-overhead A/B artifact (``BENCH_HOTPATH_r08.json``:
+    a flat ``{"checks", "commit", ...}`` record, not an items grid) or
+    None when absent/malformed."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or not isinstance(data.get("checks"), dict):
+        return None
+    return data
+
+
 def grid_is_tpu(grid: dict) -> bool:
     """A grid measured on real chips: every successful item's stamped
     ``device_topology.platform`` is ``"tpu"`` (pre-topology artifacts
@@ -284,6 +307,38 @@ def shard_grid_mesh_decision(grid):
     return "none", evidence
 
 
+def hotpath_commit_decision(grid):
+    """``(decision_or_None, evidence)`` for the ``commit_mode`` routing
+    from the host-overhead A/B (``bench_hotpath.py``).  Host-side
+    measurement: no TPU gate — the bench runs WAL-attached on the
+    serving container's own commit plane, which is exactly where the
+    win (or its absence) applies."""
+    if grid is None:
+        return None, None
+    checks = grid.get("checks")
+    if not isinstance(checks, dict):
+        return None, None
+    commit = grid.get("commit") if isinstance(grid.get("commit"), dict) else {}
+    evidence = {
+        "source": grid.get("artifact", "BENCH_HOTPATH"),
+        "commit_speedup": commit.get("speedup"),
+        "rpcs_per_claim_cycle": commit.get("rpcs_per_claim_cycle"),
+        "fingerprint_identical": checks.get("fingerprint_identical"),
+        "host_measured": True,
+    }
+    required = (
+        "fingerprint_identical",
+        "baseline_rpcs_per_claim_cycle_is_n",
+        "batched_rpcs_per_claim_cycle_is_1",
+        "commit_speedup_ge_2",
+    )
+    failed = [k for k in required if not checks.get(k)]
+    if not failed:
+        return "batched", evidence
+    evidence["blocker"] = f"failed checks: {failed}"
+    return "per_tx", evidence
+
+
 def load_flash_verdict(repo: str):
     """The on-TPU flash numerics verdict from FLASH_PARITY.json
     (``tools/flash_probe.py --parity-only``), or None when unmeasured.
@@ -305,6 +360,7 @@ def decide(
     c6_hang=None,
     claims_grid=None,
     shard_grid=None,
+    hotpath_grid=None,
 ) -> tuple:
     """``(decisions, evidence)`` from qualifying TPU results (plus the
     grid walkover rules — module docstring)."""
@@ -399,6 +455,11 @@ def decide(
         decisions["claim_mesh"] = mesh_decision
         evidence["claim_mesh"] = mesh_evidence
 
+    commit_decision, commit_evidence = hotpath_commit_decision(hotpath_grid)
+    if commit_decision is not None:
+        decisions["commit_mode"] = commit_decision
+        evidence["commit_mode"] = commit_evidence
+
     return decisions, evidence
 
 
@@ -436,6 +497,7 @@ def main(argv=None) -> int:
                     "consensus_impl",
                     "flash_numerics",
                     "claim_mesh",
+                    "commit_mode",
                 )
             }
     except (OSError, ValueError):
@@ -456,6 +518,9 @@ def main(argv=None) -> int:
         config6_hang_evidence(paths + [os.path.join(REPO, "TPU_PROBE.json")]),
         claims_grid=load_grid(os.path.join(REPO, "BENCH_CLAIMS_r06.json")),
         shard_grid=load_grid(os.path.join(REPO, "BENCH_SHARD_r07.json")),
+        hotpath_grid=load_hotpath_grid(
+            os.path.join(REPO, "BENCH_HOTPATH_r08.json")
+        ),
     )
     if (
         "consensus_impl" in prior_decisions

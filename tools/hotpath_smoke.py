@@ -1,0 +1,179 @@
+"""Zero-sync hot-path smoke: the host-overhead optimizations as a CI
+gate (``make hotpath-smoke``; docs/PARALLELISM.md §host-overhead).
+
+The seeded 4-claim fabric scenario runs TWICE with the optimized hot
+path pinned on — device-resident staging + donated dispatch
+(``device_resident=True``) and the batched commit plane
+(``commit_mode="batched"``) — plus ONE unoptimized control run.  The
+gate asserts:
+
+1. **Replay identity under optimization** — the two optimized runs'
+   per-claim journal fingerprints digest byte-identically.
+2. **Not a fingerprint family** — the optimized fingerprints equal the
+   unoptimized control's (the shard-smoke meshed==unmeshed precedent):
+   staging/donation are bit-identical numerics and the batched commit
+   plane emits the per-tx plane's exact journal events, so the
+   optimizations must be invisible to seeded replays.
+3. **Counted, never-silent fallbacks** — the scenario's quarantined
+   cycles force tx granularity on the offender claim, and every such
+   degradation shows up in ``commit_batch_fallback{reason=
+   "skip_slots"}``.
+4. **N→1 RPCs** — a clean (quarantine-free) 4-claim × 8-oracle leg
+   commits C·cycles batched RPCs and ZERO per-tx RPCs: the chain pays
+   one commit RPC per claim-cycle, not one per oracle.
+
+Usage::
+
+    python tools/hotpath_smoke.py [--seed 0] [--out HOTPATH_SMOKE.json]
+"""
+
+from __future__ import annotations
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import argparse  # noqa: E402
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
+
+
+def clean_leg_rpcs(seed: int, claims: int, cycles: int, oracles: int):
+    """Quarantine-free batched fabric leg; returns the process-registry
+    commit-RPC deltas (the adapter counts RPCs globally by design —
+    seeded replays don't fingerprint metrics)."""
+    from svoc_tpu.fabric.registry import ClaimSpec
+    from svoc_tpu.fabric.scenario import (
+        _claim_names,
+        deterministic_vectorizer,
+    )
+    from svoc_tpu.fabric.session import MultiSession
+    from svoc_tpu.io.comment_store import CommentStore
+    from svoc_tpu.io.scraper import SyntheticSource
+    from svoc_tpu.sim.generators import claim_seed
+    from svoc_tpu.utils.events import EventJournal
+    from svoc_tpu.utils.metrics import MetricsRegistry
+    from svoc_tpu.utils.metrics import registry as process_registry
+
+    def store_factory(claim_id: str) -> CommentStore:
+        store = CommentStore()
+        store.save(
+            SyntheticSource(batch=100, seed=claim_seed(seed, claim_id))()
+        )
+        return store
+
+    multi = MultiSession(
+        base_seed=seed,
+        vectorizer=deterministic_vectorizer,
+        store_factory=store_factory,
+        journal=EventJournal(),
+        metrics=MetricsRegistry(),
+        lineage_scope="hps",
+        max_claims_per_batch=claims,
+        device_resident=True,
+        commit_mode="batched",
+    )
+    for name in _claim_names(claims):
+        multi.add_claim(ClaimSpec(claim_id=name, n_oracles=oracles))
+
+    def counts():
+        return {
+            mode: process_registry.counter(
+                "chain_commit_rpcs", labels={"mode": mode}
+            ).count
+            for mode in ("tx", "batch")
+        }
+
+    before = counts()
+    multi.run(cycles)
+    after = counts()
+    return {mode: after[mode] - before[mode] for mode in after}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--cycles", type=int, default=10)
+    p.add_argument("--out", default="HOTPATH_SMOKE.json")
+    args = p.parse_args(argv)
+
+    from svoc_tpu.fabric.scenario import run_fabric_scenario
+    from svoc_tpu.utils.metrics import registry as process_registry
+
+    def fallback_count() -> float:
+        return process_registry.counter(
+            "commit_batch_fallback", labels={"reason": "skip_slots"}
+        ).count
+
+    fallbacks_before = fallback_count()
+    opt1 = run_fabric_scenario(
+        args.seed, cycles=args.cycles,
+        device_resident=True, commit_mode="batched",
+    )
+    opt2 = run_fabric_scenario(
+        args.seed, cycles=args.cycles,
+        device_resident=True, commit_mode="batched",
+    )
+    fallbacks_delta = fallback_count() - fallbacks_before
+    control = run_fabric_scenario(args.seed, cycles=args.cycles)
+
+    claim_ids = sorted(opt1["claims"])
+    rpc_claims, rpc_cycles, rpc_oracles = 4, 4, 8
+    rpcs = clean_leg_rpcs(args.seed, rpc_claims, rpc_cycles, rpc_oracles)
+
+    checks = {
+        "optimized_replay_identical": all(
+            opt1["claims"][c]["fingerprint"]
+            == opt2["claims"][c]["fingerprint"]
+            for c in claim_ids
+        )
+        and opt1["journal_fingerprint"] == opt2["journal_fingerprint"],
+        "optimized_equals_unoptimized": all(
+            opt1["claims"][c]["fingerprint"]
+            == control["claims"][c]["fingerprint"]
+            for c in claim_ids
+        )
+        and opt1["journal_fingerprint"] == control["journal_fingerprint"],
+        "injections_happened": opt1["injection_count"] > 0,
+        "quarantine_fallbacks_counted": fallbacks_delta > 0,
+        # One commit RPC per claim-cycle on the clean leg — C, not C×N.
+        "rpcs_batch_is_claim_cycles": (
+            rpcs["batch"] == rpc_claims * rpc_cycles
+        ),
+        "rpcs_tx_is_zero": rpcs["tx"] == 0,
+    }
+    ok = all(checks.values())
+    artifact = {
+        "seed": args.seed,
+        "cycles": args.cycles,
+        "checks": checks,
+        "ok": ok,
+        "clean_leg": {
+            "claims": rpc_claims,
+            "cycles": rpc_cycles,
+            "oracles": rpc_oracles,
+            "rpcs": rpcs,
+        },
+        "skip_slot_fallbacks": fallbacks_delta,
+        "journal_fingerprint": opt1["journal_fingerprint"],
+        "per_claim_fingerprints": {
+            c: opt1["claims"][c]["fingerprint"] for c in claim_ids
+        },
+    }
+    atomic_write_json(args.out, artifact)
+    for name, passed in checks.items():
+        print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+    print(
+        f"hotpath-smoke {'OK' if ok else 'FAILED'}: "
+        f"{len(claim_ids)} claims × {args.cycles} cycles optimized twice "
+        f"+ control, fingerprints identical, clean leg "
+        f"{int(rpcs['batch'])} batched RPCs for "
+        f"{rpc_claims * rpc_cycles} claim-cycles -> {args.out}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
