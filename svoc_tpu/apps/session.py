@@ -834,6 +834,55 @@ class Session:
             predictions = self.predictions
             lineage = self.last_lineage
             source = self._block_source
+        if self.wal is not None and lineage is not None:
+            # Under the commit lock: the reconciler resends chain txs,
+            # and two concurrent commits racing this guard would both
+            # classify the same slot stranded and double-send it — the
+            # exact duplicate the guard exists to prevent.  Re-checked
+            # inside the lock; the block completes (and releases)
+            # before the commit section re-acquires below, and a loser
+            # of the race then sees the cycle closed.
+            with self._commit_lock:
+                open_here = lineage in self.wal.open_lineages()
+                if open_here:
+                    from svoc_tpu.durability.reconcile import reconcile_wal
+
+                    # An OPEN cycle for this lineage: a previous life
+                    # died mid-commit and the recovery reconcile could
+                    # not close it (a faulted resend, missing
+                    # evidence).  Its txs may be durably on chain —
+                    # blind re-execution would double-send them (the
+                    # fuzzer capture behind tests/fixtures/chaos_corpus
+                    # /duplicate-txs-reconcile-error.json), so resolve
+                    # the cycle through the reconciler's evidence
+                    # columns instead; on success the replayed-lineage
+                    # path below dedups exactly as for a cleanly-closed
+                    # cycle.
+                    reconcile_wal(
+                        self.wal,
+                        lambda _claim: self.adapter,
+                        journal=self.journal,
+                        lineages={lineage},
+                    )  # svoclint: disable=SVOC010 -- deliberate: the reconciler journals its per-cycle verdicts inside the whole-fleet atomicity this guard shares with the commit path; no subscriber re-enters commit
+            if open_here and lineage not in self.wal.completed_lineages():
+                metrics.counter("chain_commit_failures").add(1)
+                self.journal.emit(
+                    "commit.failed",
+                    lineage=lineage,
+                    reason="open_cycle_unresolved",
+                    sent=0,
+                )
+                raise ChainCommitError(
+                    committed=0,
+                    total=len(predictions),
+                    failed_oracle=None,
+                    cause=RuntimeError(
+                        "open WAL cycle unresolved — refusing to "
+                        "blind re-commit a lineage whose txs may "
+                        "already be on chain"
+                    ),
+                    sent_count=0,
+                )
         if (
             self.wal is not None
             and lineage is not None

@@ -23,6 +23,12 @@ from svoc_tpu.durability.chainlog import (
     read_chain_log,
     replay_chain_log,
 )
+from svoc_tpu.durability.faultspace import (
+    FaultController,
+    FaultEvent,
+    declare,
+    fault_point,
+)
 from svoc_tpu.durability.reconcile import (
     ReconcileReport,
     reconcile_wal,
@@ -43,6 +49,10 @@ from svoc_tpu.durability.wal import (
 __all__ = [
     "CommitIntentWAL",
     "DurableLocalBackend",
+    "FaultController",
+    "FaultEvent",
+    "declare",
+    "fault_point",
     "GracefulDrain",
     "ReconcileReport",
     "RecoveryError",

@@ -45,8 +45,57 @@ import os
 from typing import Any, Dict, List, Optional
 
 from svoc_tpu.consensus.state import OracleConsensusContract
+from svoc_tpu.durability.faultspace import (
+    SMOKE_CRASH,
+    SMOKE_FUZZ,
+    armed,
+    declare,
+    fault_point,
+    torn_line_write,
+)
 from svoc_tpu.durability.wal import payload_digest, read_wal, seal_jsonl
 from svoc_tpu.io.chain import LocalChainBackend
+
+#: The simulated chain's fault surface (docs/RESILIENCE.md
+#: §fault-surface): the apply→log window and the record boundaries of
+#: the batched plane.
+CHAINLOG_TX_POST_APPLY = declare(
+    "chainlog.tx.post_apply",
+    owner="svoc_tpu/durability/chainlog.py",
+    invariant="a tx killed between in-memory apply and the log append "
+    "evaporated — the restart must classify it stranded and resend",
+    actions=("kill", "torn"),
+    smokes=(SMOKE_FUZZ,),
+    modes=("per_tx",),
+)
+CHAINLOG_TX_POST_FSYNC = declare(
+    "chainlog.tx.post_fsync",
+    owner="svoc_tpu/durability/chainlog.py",
+    invariant="a tx durably on chain whose WAL landed record was never "
+    "written must classify landed via the chain digest, never resend",
+    actions=("kill",),
+    smokes=(SMOKE_FUZZ, SMOKE_CRASH),
+    modes=("per_tx",),
+)
+CHAIN_BATCH_PRE_LOG = declare(
+    "chain.batch.pre_log",
+    owner="svoc_tpu/durability/chainlog.py",
+    invariant="a whole batch killed between apply and the first log "
+    "append evaporated — every slot must classify stranded and resend",
+    actions=("kill",),
+    smokes=(SMOKE_FUZZ,),
+    modes=("batched",),
+)
+CHAIN_BATCH_MID_FLEET = declare(
+    "chain.batch.mid_fleet",
+    owner="svoc_tpu/durability/chainlog.py",
+    invariant="a batched commit killed mid-log leaves a durable tx "
+    "prefix: the reconciler must classify it landed (chain digest / "
+    "landed_batch) and resend only the suffix",
+    actions=("kill",),
+    smokes=(SMOKE_FUZZ, SMOKE_CRASH),
+    modes=("batched",),
+)
 
 
 class DurableLocalBackend:
@@ -57,11 +106,6 @@ class DurableLocalBackend:
         self.log_path = log_path
         seal_jsonl(log_path)  # a torn tail is a tx that never landed
         self._f = None
-        #: Crash-harness hook (``tools/crash_smoke.py``): called with
-        #: the record AFTER it was fsynced — the "between tx i and
-        #: i+1" kill point (the tx is durably on chain, the WAL's
-        #: landed record is not yet written).
-        self.crash_hook = None
 
     # The supervisor's locality probe and the fault injector both walk
     # ``.backend`` chains — expose the wrapped backend the same way.
@@ -96,9 +140,19 @@ class DurableLocalBackend:
         elif function_name == "vote_for_a_proposition":
             record["which_admin"] = int(kwargs["which_admin"])
             record["support"] = bool(kwargs["support_his_proposition"])
+        # The apply→log window: a kill here evaporates the tx (the
+        # in-memory state dies with the process) — indistinguishable
+        # from the tx never landing; ``torn`` leaves the power-cut
+        # half-record ``seal_jsonl`` repairs.
+        fault_point(
+            CHAINLOG_TX_POST_APPLY,
+            payload={"fn": function_name},
+            torn=lambda: self._torn_append(record),
+        )
         self._append(record)
-        if self.crash_hook is not None:
-            self.crash_hook(record)
+        # The tx is durably on chain; the WAL's landed record is not
+        # yet written (the old ``inter_tx`` kill point, now named).
+        fault_point(CHAINLOG_TX_POST_FSYNC, payload={"fn": function_name})
 
     def update_predictions_batched(
         self, callers, predictions
@@ -121,10 +175,23 @@ class DurableLocalBackend:
                         "digest": payload_digest(felts),
                     }
                 )
-            self._append_many(records)
-            if self.crash_hook is not None:
-                for record in records:
-                    self.crash_hook(record)
+            if records:
+                # The whole applied batch is about to hit the log — a
+                # kill here evaporates every tx at once.
+                fault_point(CHAIN_BATCH_PRE_LOG, payload={"n": len(records)})
+            if armed():
+                # Chaos harness: per-record append + fsync so a
+                # mid-fleet kill leaves exactly the durable prefix a
+                # real external chain would (the production one-fsync
+                # batch has no observable mid-point to kill at).
+                for i, record in enumerate(records):
+                    self._append(record)
+                    fault_point(
+                        CHAIN_BATCH_MID_FLEET,
+                        payload={"fn": "update_prediction", "index": i},
+                    )
+            else:
+                self._append_many(records)
 
         from svoc_tpu.consensus.state import BatchTxError
 
@@ -140,6 +207,14 @@ class DurableLocalBackend:
 
     def _append(self, record: Dict[str, Any]) -> None:
         self._append_many([record])
+
+    def _torn_append(self, record: Dict[str, Any]) -> None:
+        """The ``torn`` writer for this log's fault points — the shared
+        power-cut primitive; the caller (the armed controller) SIGKILLs
+        immediately after."""
+        if self._f is None:
+            self._f = open(self.log_path, "a")
+        torn_line_write(self._f, record)
 
     def _append_many(self, records) -> None:
         if not records:

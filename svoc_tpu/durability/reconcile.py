@@ -17,6 +17,14 @@ chain digest == WAL      tx landed; the landed append was      none
                          lost
 chain digest != WAL      the slot still holds the previous     resend
                          block's value — the tx never went out
+chain digest == a NEWER  a later cycle for the same claim      none —
+cycle's payload for the  legitimately owns the slot now;       ``super-
+same slot                resending this cycle's stale payload  seded``
+                         would regress chain data AND, when an
+                         earlier partial reconcile already
+                         resent it, double-send (fuzzer
+                         capture: tests/fixtures/chaos_corpus/
+                         duplicate-txs-reconcile-error.json)
 chain read fails         backend unreachable: cannot prove     none (re-
                          either way                            run later)
 ``skip`` / no payload    quarantined or unencodable slot —     none
@@ -52,13 +60,38 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, List, Optional
 
+from svoc_tpu.durability.faultspace import SMOKE_FUZZ, declare, fault_point
 from svoc_tpu.durability.wal import CommitIntentWAL, payload_digest
+
+#: The reconciler's own fault surface (kills DURING recovery — the
+#: restart-storm class; docs/RESILIENCE.md §fault-surface).
+RECONCILE_PRE_RESEND = declare(
+    "reconcile.pre_resend",
+    owner="svoc_tpu/durability/reconcile.py",
+    invariant="a resend that faults or dies leaves the slot stranded-"
+    "and-accounted; the cycle is conservatively held open, never "
+    "double-sent",
+    actions=("kill", "error"),
+    smokes=(SMOKE_FUZZ,),
+    stage="recovery",
+)
+RECONCILE_MID_CYCLE = declare(
+    "reconcile.mid_cycle",
+    owner="svoc_tpu/durability/reconcile.py",
+    invariant="a kill after a cycle's resends but before its close is "
+    "idempotent — the next reconcile sees the resent slots landed via "
+    "the chain witness and finishes",
+    actions=("kill",),
+    smokes=(SMOKE_FUZZ,),
+    stage="recovery",
+)
 
 #: Slot classifications (the decision table above).
 LANDED_DURABLE = "landed_durable"
 LANDED_BATCH = "landed_batch"
 LANDED_CHAIN = "landed_chain"
 STRANDED = "stranded"
+SUPERSEDED = "superseded"
 UNKNOWN = "unknown"
 SKIPPED = "skipped"
 
@@ -66,7 +99,8 @@ SKIPPED = "skipped"
 #: counts/report/gate logic share so a new outcome cannot be added
 #: half-way.
 CLASSIFICATIONS = (
-    LANDED_DURABLE, LANDED_BATCH, LANDED_CHAIN, STRANDED, UNKNOWN, SKIPPED
+    LANDED_DURABLE, LANDED_BATCH, LANDED_CHAIN, STRANDED, SUPERSEDED,
+    UNKNOWN, SKIPPED,
 )
 
 
@@ -160,6 +194,7 @@ def wal_cycles(records: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
                 "landed_batch": set(),
                 "done": False,
                 "failed": None,
+                "superseded": set(),
             }
         elif lineage in cycles:
             if kind == "intent":
@@ -182,6 +217,9 @@ def wal_cycles(records: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
                 # set excludes it (wal.completed_lineages).
                 cycles[lineage]["done"] = "failed" not in r
                 cycles[lineage]["failed"] = r.get("failed")
+                cycles[lineage]["superseded"] = set(
+                    int(s) for s in r.get("superseded", [])
+                )
     return cycles
 
 
@@ -192,6 +230,7 @@ def reconcile_wal(
     resend: bool = True,
     journal=None,
     registry=None,
+    lineages=None,
 ) -> ReconcileReport:
     """Reconcile every open cycle in ``wal`` against the chain.
 
@@ -199,19 +238,41 @@ def reconcile_wal(
     :class:`~svoc_tpu.io.chain.ChainAdapter` (claim is None for
     single-claim sessions).  With ``resend=True`` stranded slots are
     re-sent from the WAL's recorded payloads; cycles with nothing left
-    unknown are closed.  Emits one ``durability.reconcile`` journal
+    unknown are closed.  ``lineages`` (a set) restricts the pass to
+    those cycles — the session's pre-re-execution guard resolves ONE
+    lineage this way — while supersession evidence still reads the
+    full record fold.  Emits one ``durability.reconcile`` journal
     event per open cycle and counts outcomes into
     ``wal_reconciled{outcome=}``.
     """
-    from svoc_tpu.fabric.router import resolve_journal
+    from svoc_tpu.utils.events import resolve_journal
     from svoc_tpu.utils.metrics import registry as _default_registry
 
     j = resolve_journal(journal)
     reg = registry if registry is not None else _default_registry
     out: List[CycleReconciliation] = []
-    for lineage, cyc in wal_cycles(wal.records()).items():
+    ordered = list(wal_cycles(wal.records()).items())
+    for idx, (lineage, cyc) in enumerate(ordered):
         if cyc["done"]:
             continue
+        if lineages is not None and lineage not in lineages:
+            continue
+        # Supersession evidence: payload digests of LATER cycles for
+        # the same claim, per slot.  Commits are sequential per claim,
+        # so a later cycle record means the system moved past this one
+        # — if the chain now holds a newer cycle's value, this cycle's
+        # stale payload must never be resent (decision table above).
+        # All relevant records are in the active log: rotation refuses
+        # while this cycle is open.
+        newer_digests: Dict[int, set] = {}
+        for _lin2, cyc2 in ordered[idx + 1:]:
+            if cyc2["claim"] != cyc["claim"]:
+                continue
+            for slot2, payload2 in enumerate(cyc2["payloads"]):
+                if payload2 is not None:
+                    newer_digests.setdefault(slot2, set()).add(
+                        payload_digest(payload2)
+                    )
         try:
             adapter = adapter_for(cyc["claim"])
         except Exception:
@@ -256,12 +317,27 @@ def reconcile_wal(
                 verdicts.append(SlotVerdict(slot, oracle, UNKNOWN))
                 continue
             on_chain = chain_rows[slot]
-            if payload_digest(on_chain) == payload_digest(payload):
+            chain_digest = payload_digest(on_chain)
+            if chain_digest == payload_digest(payload):
                 verdicts.append(SlotVerdict(slot, oracle, LANDED_CHAIN))
+                continue
+            if chain_digest in newer_digests.get(slot, ()):
+                # A later cycle's value owns the slot: resending this
+                # cycle's stale payload would regress chain data and —
+                # when an earlier partial reconcile already resent it —
+                # duplicate the tx.
+                verdicts.append(SlotVerdict(slot, oracle, SUPERSEDED))
                 continue
             verdict = SlotVerdict(slot, oracle, STRANDED)
             if resend:
                 try:
+                    # An injected ``error`` here is a resend that
+                    # faulted (conservative hold); a ``kill`` is the
+                    # restart-storm window before the resend went out.
+                    fault_point(
+                        RECONCILE_PRE_RESEND,
+                        payload={"lineage": lineage, "slot": slot},
+                    )
                     adapter._invoke_prediction_felts(oracle, payload)
                     verdict.resent = True
                 except Exception as e:
@@ -269,6 +345,10 @@ def reconcile_wal(
                     # accounted; the cycle stays open for a later pass.
                     verdict.resend_error = repr(e)
             verdicts.append(verdict)
+        # The restart-storm window: resends for THIS cycle are on chain
+        # but its close (and every later cycle) has not happened — a
+        # kill here must be idempotent across the next recovery.
+        fault_point(RECONCILE_MID_CYCLE, payload={"lineage": lineage})
         unknown = sum(1 for v in verdicts if v.classification == UNKNOWN)
         failed_resend = sum(
             1 for v in verdicts if v.classification == STRANDED and not v.resent
@@ -279,6 +359,10 @@ def reconcile_wal(
                 lineage,
                 sent=sum(1 for v in verdicts if v.resent),
                 note="reconciled",
+                superseded=[
+                    v.slot for v in verdicts
+                    if v.classification == SUPERSEDED
+                ],
             )
         rec = CycleReconciliation(
             lineage=lineage, claim=cyc["claim"], slots=verdicts, closed=closed

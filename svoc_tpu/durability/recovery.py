@@ -27,6 +27,12 @@ import os
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from svoc_tpu.durability.faultspace import (
+    SMOKE_CRASH,
+    SMOKE_FUZZ,
+    declare,
+    fault_point,
+)
 from svoc_tpu.durability.reconcile import ReconcileReport, reconcile_wal
 from svoc_tpu.utils.checkpoint import (
     load_snapshot,
@@ -37,11 +43,73 @@ from svoc_tpu.utils.checkpoint import (
 
 SNAPSHOT_NAME = "snapshot.json"
 
+#: The recovery path's own kill window (the restart-storm class): the
+#: journal ring is restored and fingerprint-checked, but counters are
+#: not re-seeded and the WAL is not reconciled — a second recovery must
+#: start over idempotently.
+RECOVERY_POST_RESTORE = declare(
+    "recovery.post_restore",
+    owner="svoc_tpu/durability/recovery.py",
+    invariant="a kill mid-recovery (ring restored, counters not "
+    "re-seeded, WAL not reconciled) must leave a state a second "
+    "recovery brings to the identical fixpoint",
+    actions=("kill",),
+    smokes=(SMOKE_FUZZ, SMOKE_CRASH),
+    stage="recovery",
+)
+
 
 class RecoveryError(RuntimeError):
     """Recovery found torn/contradictory durable state (a fingerprint
     discontinuity between the snapshot's journal ring and its recorded
     digest) — refusing to roll forward on corrupt history."""
+
+
+def roll_forward_journal(
+    journal,
+    payload: Optional[Dict[str, Any]],
+    trace_path: Optional[str],
+) -> Dict[str, int]:
+    """Restore the journal from a snapshot's recorded ring and roll it
+    forward from the fsynced trace tail — the journal half of
+    :meth:`RecoveryManager.recover`, shared with the chaos-fuzz child
+    harness (``svoc_tpu/durability/fuzz.py``) so the fuzzer exercises
+    the REAL restore/continuity code, not a reimplementation.
+
+    Asserts fingerprint continuity (the ring must re-digest to the
+    snapshot's recorded fingerprint — :class:`RecoveryError` otherwise)
+    and fires ``recovery.post_restore`` between the restore and
+    whatever the caller does next (counter re-seed, WAL reconcile).
+    Returns ``{"journal_events": ..., "tail_events": ...}``.
+    """
+    from svoc_tpu.utils.events import read_trace_events
+
+    snap_seq = 0
+    ring: List[Dict[str, Any]] = []
+    if payload is not None:
+        ring = payload.get("journal", {}).get("events", [])
+        recorded_fp = payload.get("journal", {}).get("fingerprint")
+        snap_seq = int(payload.get("journal", {}).get("last_seq", 0))
+        journal.restore(ring)
+        if recorded_fp is not None and journal.fingerprint() != recorded_fp:
+            raise RecoveryError(
+                "journal ring fingerprint diverges from the snapshot's "
+                "recorded digest — refusing to roll forward on corrupt "
+                "history"
+            )
+    fault_point(RECOVERY_POST_RESTORE)
+    tail: List[Dict[str, Any]] = []
+    if trace_path is not None and os.path.exists(trace_path):
+        tail = read_trace_events(trace_path, since_seq=snap_seq)
+        if tail:
+            journal.restore(
+                (journal.export_ring() if snap_seq else []) + tail
+            )
+    return {
+        "journal_events": len(ring),
+        "tail_events": len(tail),
+        "tail": tail,
+    }
 
 
 class RecoveryManager:
@@ -76,7 +144,7 @@ class RecoveryManager:
         return os.path.join(self.out_dir, SNAPSHOT_NAME)
 
     def _journal(self):
-        from svoc_tpu.fabric.router import resolve_journal
+        from svoc_tpu.utils.events import resolve_journal
 
         return resolve_journal(self.multi.journal)
 
@@ -160,8 +228,6 @@ class RecoveryManager:
         with NO snapshot on disk (first-crash-before-first-snapshot:
         everything restores empty and the WAL reconcile still runs).
         """
-        from svoc_tpu.utils.events import read_trace_events
-
         journal = self._journal()
         report: Dict[str, Any] = {
             "snapshot": None,
@@ -173,7 +239,7 @@ class RecoveryManager:
             "lost_requests": 0,
             "reconcile": None,
         }
-        snap_seq = 0
+        payload = None
         if os.path.exists(self.snapshot_path):
             payload = load_snapshot(self.snapshot_path)
             report["snapshot"] = self.snapshot_path
@@ -184,17 +250,14 @@ class RecoveryManager:
             # survive every future snapshot until an operator (or a
             # later restore into a roster that has them) claims them.
             self._unclaimed.update(payload.get("unclaimed") or {})
-            ring = payload.get("journal", {}).get("events", [])
-            recorded_fp = payload.get("journal", {}).get("fingerprint")
-            snap_seq = int(payload.get("journal", {}).get("last_seq", 0))
-            journal.restore(ring)
-            if recorded_fp is not None and journal.fingerprint() != recorded_fp:
-                raise RecoveryError(
-                    "journal ring fingerprint diverges from the snapshot's "
-                    "recorded digest — refusing to roll forward on corrupt "
-                    "history"
-                )
-            report["journal_events"] = len(ring)
+        # Ring restore + fingerprint continuity + trace-tail roll-forward
+        # (fires ``recovery.post_restore`` between restore and the
+        # re-seeding below — the restart-storm kill window).
+        rolled = roll_forward_journal(journal, payload, trace_path)
+        report["journal_events"] = rolled["journal_events"]
+        report["tail_events"] = rolled["tail_events"]
+        tail = rolled["tail"]
+        if payload is not None:
             self._metrics.restore_counters(payload.get("counters", []))
             if payload.get("clock") is not None:
                 report["restored_clock"] = float(payload["clock"])
@@ -202,14 +265,6 @@ class RecoveryManager:
                 report["requeued"] = self.tier.restore_serving_state(
                     payload["serving"]
                 )
-        tail: List[Dict[str, Any]] = []
-        if trace_path is not None and os.path.exists(trace_path):
-            tail = read_trace_events(trace_path, since_seq=snap_seq)
-            if tail:
-                journal.restore(
-                    (journal.export_ring() if snap_seq else []) + tail
-                )
-            report["tail_events"] = len(tail)
         report["lost_requests"] = self._account_lost_requests(journal, tail)
         if self.wal is not None:
             rec: ReconcileReport = reconcile_wal(
@@ -339,7 +394,7 @@ class GracefulDrain:
         )
 
     def _resolve_journal(self):
-        from svoc_tpu.fabric.router import resolve_journal
+        from svoc_tpu.utils.events import resolve_journal
 
         if self._journal is not None:
             return resolve_journal(self._journal)

@@ -21,53 +21,107 @@ durability contract over the artifacts:
 Everything is a pure function of ``seed`` + the crash point: arrivals
 key off :func:`claim_seed` PER ITERATION (so a re-run of a half-dead
 cycle redraws identically), time is a virtual clock persisted in the
-snapshot, and the fault points are COUNTER-based (the Nth WAL intent,
-the Nth landed tx, the Nth serving step), never timing-based.
+snapshot, and the fault points are NAMED registry points fired at the
+Nth matching firing (:mod:`svoc_tpu.durability.faultspace` — the crc32
+counting discipline), never timing-based.
 
-Crash points (``crash_point=``):
+Crash points (``crash_point=``; each maps onto one named fault point —
+the pre-PR-14 ad-hoc counter hooks, now registry events):
 
-- ``"mid_wal_append"`` — tears the Nth intent record in half (half the
-  JSON line, fsynced, then SIGKILL): the restart must ignore the torn
-  tail and classify the slot by chain digest.
-- ``"inter_tx"`` — SIGKILL right after the Nth ``update_prediction``
-  hit the chain log (tx durably on chain, WAL ``landed`` record never
-  written): the restart must classify it landed via the chain witness
-  and NOT resend.
-- ``"pre_snapshot"`` — SIGKILL at the end of serving step N, after the
-  commits but before the cadence snapshot: the restart rolls forward
-  from an older snapshot purely on the journal tail + WAL.
+- ``"mid_wal_append"`` — ``torn`` at ``wal.intent.pre_fsync``: the Nth
+  intent record torn in half (half the JSON line, fsynced, SIGKILL);
+  the restart must ignore the torn tail and classify the slot by chain
+  digest.
+- ``"inter_tx"`` — ``kill`` at ``chainlog.tx.post_fsync`` (matched on
+  ``fn="update_prediction"``): SIGKILL right after the Nth prediction
+  tx hit the chain log (tx durably on chain, WAL ``landed`` record
+  never written); the restart must classify it landed via the chain
+  witness and NOT resend.
+- ``"pre_snapshot"`` — ``kill`` at ``serving.step.post``: SIGKILL at
+  the end of serving step N, after the commits but before the cadence
+  snapshot; the restart rolls forward from an older snapshot purely on
+  the journal tail + WAL.
+- ``"batch_mid_fleet"`` — ``kill`` at ``chain.batch.mid_fleet`` with
+  ``commit_mode="batched"``: SIGKILL while the one-RPC batched commit
+  logs its txs — the reconciler must classify the durable prefix via
+  its ``landed_batch``/chain-digest columns and resend only the
+  suffix (the PR 13 gap, closed end-to-end).
+- ``"recovery_storm"`` — ``kill`` at ``recovery.post_restore``: a
+  SECOND SIGKILL during :meth:`RecoveryManager.recover` (journal ring
+  restored, counters not re-seeded, WAL not reconciled); the next
+  recovery must be idempotent.
 """
 
 from __future__ import annotations
 
 import os
-import signal
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from svoc_tpu.consensus.state import OracleConsensusContract
+from svoc_tpu.durability import faultspace
 from svoc_tpu.durability.chainlog import (
     DurableLocalBackend,
     duplicate_predictions,
     read_chain_log,
     replay_chain_log,
 )
+from svoc_tpu.durability.faultspace import FaultEvent
 from svoc_tpu.durability.recovery import GracefulDrain, RecoveryManager
 from svoc_tpu.durability.wal import CommitIntentWAL
 from svoc_tpu.fabric.registry import ClaimSpec
 from svoc_tpu.fabric.scenario import _claim_names, deterministic_vectorizer
 from svoc_tpu.sim.generators import claim_seed
 
-CRASH_POINTS = ("mid_wal_append", "inter_tx", "pre_snapshot")
+#: The single-kill crash points (STORM_POINT is the two-kill leg's
+#: second phase) — derived from CRASH_EVENTS below so the two can
+#: never drift.
+STORM_POINT = "recovery_storm"
 
 #: Default counter thresholds per crash point — deep enough into the
-#: run that several cycles committed and at least one snapshot landed.
-DEFAULT_CRASH_AT = {"mid_wal_append": 12, "inter_tx": 10, "pre_snapshot": 5}
+#: run that several cycles committed and at least one snapshot landed
+#: (``batch_mid_fleet``'s 10 lands mid-way through the second claim's
+#: 7-record batch; ``recovery_storm`` fires on the recovery child's one
+#: and only restore).
+DEFAULT_CRASH_AT = {
+    "mid_wal_append": 12,
+    "inter_tx": 10,
+    "pre_snapshot": 5,
+    "batch_mid_fleet": 10,
+    "recovery_storm": 1,
+}
 
+#: Crash point → named registry event (the refactor off the ad-hoc
+#: counter hooks: the three original points remain reachable by name,
+#: with identical counting semantics).
+CRASH_EVENTS = {
+    "mid_wal_append": lambda n: FaultEvent(
+        point="wal.intent.pre_fsync", nth=n, action="torn"
+    ),
+    "inter_tx": lambda n: FaultEvent(
+        point="chainlog.tx.post_fsync", nth=n, action="kill",
+        match={"fn": "update_prediction"},
+    ),
+    "pre_snapshot": lambda n: FaultEvent(
+        point="serving.step.post", nth=n, action="kill"
+    ),
+    "batch_mid_fleet": lambda n: FaultEvent(
+        point="chain.batch.mid_fleet", nth=n, action="kill"
+    ),
+    "recovery_storm": lambda n: FaultEvent(
+        point="recovery.post_restore", nth=n, action="kill"
+    ),
+}
 
-def _die() -> None:  # pragma: no cover — the harness child only
-    os.kill(os.getpid(), signal.SIGKILL)
+CRASH_POINTS = tuple(p for p in CRASH_EVENTS if p != STORM_POINT)
+
+#: Commit plane per crash point: the original matrix targets the
+#: PER-TX WAL record family; ``batch_mid_fleet`` exists precisely to
+#: kill the batched family mid-RPC.  Pinned like the impl/mesh — the
+#: WAL record family is replay-relevant (docs/RESILIENCE.md
+#: §batched-commits).
+CRASH_COMMIT_MODE = {"batch_mid_fleet": "batched"}
 
 
 def _spec_contract(spec: ClaimSpec, n_admins: int = 3) -> OracleConsensusContract:
@@ -98,12 +152,16 @@ def run_durable_scenario(
     step_period_s: float = 0.1,
     crash_point: Optional[str] = None,
     crash_at: Optional[int] = None,
+    commit_mode: Optional[str] = None,
 ) -> Dict[str, Any]:
     """One scenario phase in ``workdir`` — fresh when the directory has
     no durable state, recovery otherwise.  With ``crash_point`` set the
-    process SIGKILLs itself at the seeded fault point (the call never
-    returns); without it the phase runs to ``total_steps``, drains
-    gracefully, and returns the result dict the harness asserts over.
+    process SIGKILLs itself at the named fault point's Nth firing (the
+    call never returns); without it the phase runs to ``total_steps``,
+    drains gracefully, and returns the result dict the harness asserts
+    over.  ``commit_mode`` (default ``per_tx``, or the crash point's
+    pinned plane) must be passed identically to every phase sharing a
+    work directory — the WAL record family is replay-relevant.
     """
     from svoc_tpu.fabric.session import MultiSession
     from svoc_tpu.serving.frontend import AdmissionConfig
@@ -114,12 +172,15 @@ def run_durable_scenario(
     from svoc_tpu.utils.postmortem import PostmortemMonitor
     from svoc_tpu.utils.slo import serving_slos
 
-    if crash_point is not None and crash_point not in CRASH_POINTS:
+    if crash_point is not None and crash_point not in CRASH_EVENTS:
         raise ValueError(f"unknown crash_point {crash_point!r}")
     crash_at = (
         crash_at
         if crash_at is not None
         else DEFAULT_CRASH_AT.get(crash_point or "", 0)
+    )
+    commit_mode = commit_mode or CRASH_COMMIT_MODE.get(
+        crash_point or "", "per_tx"
     )
     os.makedirs(workdir, exist_ok=True)
     # The journal trace is a durability artifact here — every emit must
@@ -170,14 +231,13 @@ def run_durable_scenario(
         sanitized_dispatch=True,
         clock=clock,
         adapter_factory=adapter_factory,
-        # The kill/restart matrix targets the PER-TX WAL record family
-        # (counter-based fault points on the Nth ``intent`` record and
-        # the Nth logged tx) — pin the plane like the impl/mesh, so a
-        # committed ``commit_mode: "batched"`` record cannot change
-        # which instruction the Nth fault fires at (docs/RESILIENCE.md
-        # §batched-commits; the batched family's mid-batch kill is
-        # covered by tests/test_hotpath.py).
-        commit_mode="per_tx",
+        # Pinned per leg like the impl/mesh (CRASH_COMMIT_MODE): a
+        # committed ``commit_mode: "batched"`` record must not change
+        # which instruction the Nth fault fires at.  The original three
+        # points target the per-tx family; ``batch_mid_fleet`` kills
+        # the batched plane end-to-end (docs/RESILIENCE.md
+        # §batched-commits).
+        commit_mode=commit_mode,
     )
     for name in names:
         multi.add_claim(specs[name])
@@ -199,76 +259,77 @@ def run_durable_scenario(
         multi, out_dir=workdir, wal=wal, tier=tier, clock=clock
     )
 
-    # ---- recovery (auto-detected from the durable artifacts) ----
-    recovered = os.path.exists(manager.snapshot_path) or bool(wal.records())
-    recovery_report = None
-    if recovered:
-        recovery_report = manager.recover(
-            adapters={
-                cid: multi.get(cid).session.adapter for cid in names
-            },
-            trace_path=trace_path,
+    # ---- arm the named fault point (BEFORE recovery: recovery_storm
+    # kills inside manager.recover itself) ----
+    events = (
+        [CRASH_EVENTS[crash_point](crash_at)]
+        if crash_point is not None
+        else []
+    )
+    controller = faultspace.arm(
+        faultspace.FaultController(
+            events, log_path=os.path.join(workdir, "fired.jsonl")
         )
-        if recovery_report["restored_clock"] is not None:
-            clock.now = recovery_report["restored_clock"]
+    )
+    try:
+        # The serving-step boundary fires unconditionally (the armed
+        # controller decides); registered BEFORE the cadence hook so a
+        # ``pre_snapshot`` kill lands after the step's commits but
+        # before its snapshot.
+        tier.post_step_hooks.append(
+            lambda _report: faultspace.fault_point(
+                faultspace.SERVING_STEP_POST
+            )
+        )
 
-    # ---- arm the seeded fault point ----
-    if crash_point == "mid_wal_append":
-        intent_count = [0]
+        # ---- recovery (auto-detected from the durable artifacts) ----
+        recovered = (
+            os.path.exists(manager.snapshot_path) or bool(wal.records())
+        )
+        recovery_report = None
+        if recovered:
+            recovery_report = manager.recover(
+                adapters={
+                    cid: multi.get(cid).session.adapter for cid in names
+                },
+                trace_path=trace_path,
+            )
+            if recovery_report["restored_clock"] is not None:
+                clock.now = recovery_report["restored_clock"]
+        journal.emit(
+            "chaos.armed",
+            commit_mode=commit_mode,
+            events=[e.as_dict() for e in events],
+        )
 
-        def wal_crash(kind: str, record: Dict[str, Any]) -> None:
-            if kind != "intent":
-                return
-            intent_count[0] += 1
-            if intent_count[0] == crash_at:
-                wal.simulate_torn_append(record)
-                _die()
+        manager.install_cadence(snapshot_every)
+        monitor = PostmortemMonitor(
+            out_dir=workdir, registry=metrics, journal=journal
+        ).install()
+        drainer = GracefulDrain(
+            manager=manager, monitor=monitor, journal=journal
+        )
 
-        wal.crash_hook = wal_crash
-    elif crash_point == "inter_tx":
-        tx_count = [0]
+        # ---- the serving loop (iteration-keyed seeded arrivals) ----
+        pool = [f"hot take {i} on the claim economy" for i in range(8)]
+        while tier.steps < total_steps:
+            step_no = tier.steps  # continues across restarts (restored)
+            clock.advance(step_period_s)
+            rng = np.random.default_rng(
+                claim_seed(seed, f"arrivals{step_no}")
+            )
+            for i in range(arrivals_per_step):
+                claim = names[int(rng.integers(0, len(names)))]
+                if rng.random() < 0.3:
+                    text = pool[int(rng.integers(0, len(pool)))]
+                else:
+                    text = f"comment {claim} step {step_no} #{i}"
+                tier.submit(claim, text)
+            tier.step()
 
-        def chain_crash(record: Dict[str, Any]) -> None:
-            if record.get("fn") != "update_prediction":
-                return
-            tx_count[0] += 1
-            if tx_count[0] == crash_at:
-                _die()
-
-        for backend in backends.values():
-            backend.crash_hook = chain_crash
-    elif crash_point == "pre_snapshot":
-
-        def step_crash(_report: Dict[str, Any]) -> None:
-            if tier.steps == crash_at:
-                _die()
-
-        # Registered BEFORE the cadence hook: the kill lands after the
-        # step's commits but before its snapshot.
-        tier.post_step_hooks.append(step_crash)
-
-    manager.install_cadence(snapshot_every)
-    monitor = PostmortemMonitor(
-        out_dir=workdir, registry=metrics, journal=journal
-    ).install()
-    drainer = GracefulDrain(manager=manager, monitor=monitor, journal=journal)
-
-    # ---- the serving loop (iteration-keyed seeded arrivals) ----
-    pool = [f"hot take {i} on the claim economy" for i in range(8)]
-    while tier.steps < total_steps:
-        step_no = tier.steps  # continues across restarts (restored)
-        clock.advance(step_period_s)
-        rng = np.random.default_rng(claim_seed(seed, f"arrivals{step_no}"))
-        for i in range(arrivals_per_step):
-            claim = names[int(rng.integers(0, len(names)))]
-            if rng.random() < 0.3:
-                text = pool[int(rng.integers(0, len(pool)))]
-            else:
-                text = f"comment {claim} step {step_no} #{i}"
-            tier.submit(claim, text)
-        tier.step()
-
-    drain_report = drainer.drain(reason="scenario_end")
+        drain_report = drainer.drain(reason="scenario_end")
+    finally:
+        faultspace.disarm()
 
     # ---- the result the harness asserts over ----
     chain: Dict[str, Any] = {}
@@ -297,6 +358,8 @@ def run_durable_scenario(
         "seed": seed,
         "recovered": recovered,
         "recovery": recovery_report,
+        "commit_mode": commit_mode,
+        "fault_points_fired": controller.counts(),
         "steps": tier.steps,
         "drain": drain_report,
         "chain": chain,

@@ -50,7 +50,54 @@ import os
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from svoc_tpu.durability.faultspace import (
+    SMOKE_CRASH,
+    SMOKE_FUZZ,
+    declare,
+    fault_point,
+    torn_line_write,
+)
 from svoc_tpu.utils.events import fsync_dir
+
+#: The WAL's fault surface: every record append is a durable boundary
+#: (a kill before the fsync returns may lose the record to a power cut
+#: — the ``torn`` action; a kill after it leaves exactly the durable
+#: prefix — ``kill``).  One point per record kind, because each kind
+#: threatens a different invariant.
+_WAL_POINT = {
+    kind: declare(
+        f"wal.{kind}.pre_fsync",
+        owner="svoc_tpu/durability/wal.py",
+        invariant=invariant,
+        actions=("kill", "torn"),
+        smokes=smokes,
+        modes=modes,
+    )
+    for kind, invariant, modes, smokes in (
+        ("cycle", "no durable cycle record => no intents, no txs",
+         ("per_tx", "batched"), (SMOKE_FUZZ,)),
+        ("intent", "no durable intent, no tx (per-tx granularity)",
+         ("per_tx",), (SMOKE_FUZZ, SMOKE_CRASH)),
+        ("landed", "a lost landed record must re-classify via the "
+         "chain digest, never resend", ("per_tx",), (SMOKE_FUZZ,)),
+        ("intent_batch", "no durable batch intent, no batch RPC",
+         ("batched",), (SMOKE_FUZZ,)),
+        ("landed_batch", "a lost landed_batch record must re-classify "
+         "via chain digests, never resend", ("batched",), (SMOKE_FUZZ,)),
+        ("done", "a cycle killed before its done record must reconcile "
+         "to the identical outcome", ("per_tx", "batched"), (SMOKE_FUZZ,)),
+    )
+}
+
+WAL_ROTATE_PRE_REPLACE = declare(
+    "wal.rotate.pre_replace",
+    owner="svoc_tpu/durability/wal.py",
+    invariant="rotation only follows a snapshot: a kill mid-rotate must "
+    "leave either the full active log or the full archive, never both "
+    "halves",
+    actions=("kill",),
+    smokes=(SMOKE_FUZZ,),
+)
 
 
 def payload_digest(felts: Sequence[int]) -> str:
@@ -110,21 +157,32 @@ class CommitIntentWAL:
         self.path = path
         self._lock = threading.Lock()
         self._f = None
-        #: Crash-harness hook (``tools/crash_smoke.py``): called with
-        #: ``(kind, record)`` under the lock BEFORE each append.  A
-        #: production WAL never sets it.
-        self.crash_hook: Optional[Callable[[str, Dict[str, Any]], None]] = None
         #: Lazily-loaded set of lineages with a ``done`` record — the
         #: exactly-once dedup key for snapshot-replay re-execution
         #: (:meth:`completed_lineages`).
         self._completed: Optional[set] = None
+        #: Lazily-loaded set of lineages with a ``cycle`` record and no
+        #: ``done`` record AT ALL — the session's pre-re-execution
+        #: guard (:meth:`open_lineages`); incrementally maintained so
+        #: the hot path never re-parses the log.
+        self._open: Optional[set] = None
         seal_jsonl(path)  # a torn tail from a previous life is NO record
         fsync_dir(self.path)
 
     def _append(self, record: Dict[str, Any]) -> None:
         with self._lock:
-            if self.crash_hook is not None:
-                self.crash_hook(record["kind"], record)
+            point = _WAL_POINT.get(record["kind"])
+            if point is not None:
+                # The named durable boundary (docs/RESILIENCE.md
+                # §fault-surface).  Inert unless a chaos harness armed a
+                # controller; ``torn`` writes half this record's line
+                # (fsynced, no newline) before the SIGKILL — the
+                # power-cut fault ``seal_jsonl`` repairs on reopen.
+                fault_point(
+                    point,
+                    payload={"lineage": record.get("lineage")},
+                    torn=lambda: self.simulate_torn_append(record),
+                )
             if self._f is None:
                 self._f = open(self.path, "a")
             self._f.write(json.dumps(record, sort_keys=True) + "\n")
@@ -136,19 +194,25 @@ class CommitIntentWAL:
                 and self._completed is not None
             ):
                 self._completed.add(record["lineage"])
+            if self._open is not None:
+                if record["kind"] == "cycle":
+                    self._open.add(record["lineage"])
+                elif record["kind"] == "done":
+                    # ANY done record (failure-closed included) makes
+                    # the outcome REPORTED — no longer "open".
+                    self._open.discard(record["lineage"])
 
     def simulate_torn_append(self, record: Dict[str, Any]) -> None:
         """CRASH-HARNESS ONLY: write *half* of the record's line (no
-        newline), fsync it, and return — the caller then SIGKILLs the
-        process, leaving exactly the torn tail a mid-append power cut
-        would.  Callers invoke this from ``crash_hook`` (the lock is
-        already held there)."""
+        newline), fsync it, and return — the ``torn`` writer the
+        ``wal.*.pre_fsync`` fault points hand the controller, which then
+        SIGKILLs the process, leaving exactly the torn tail a mid-append
+        power cut would (the lock is already held at the fire site).
+        The shared power-cut primitive lives in
+        :func:`svoc_tpu.durability.faultspace.torn_line_write`."""
         if self._f is None:
             self._f = open(self.path, "a")
-        line = json.dumps(record, sort_keys=True)
-        self._f.write(line[: max(1, len(line) // 2)])
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        torn_line_write(self._f, record)
 
     def close(self) -> None:
         with self._lock:
@@ -186,18 +250,26 @@ class CommitIntentWAL:
                 with contextlib.suppress(OSError):
                     self._f.close()
                 self._f = None
+            fault_point(WAL_ROTATE_PRE_REPLACE)
             if os.path.exists(self.path):
                 os.replace(self.path, self.path + ".1")
             self._completed = set()  # the active log is empty again
+            self._open = set()
         fsync_dir(self.path)
 
     def close_cycle(
-        self, lineage: str, sent: int = 0, note: Optional[str] = None
+        self,
+        lineage: str,
+        sent: int = 0,
+        note: Optional[str] = None,
+        superseded: Sequence[int] = (),
     ) -> None:
         """Append a ``done`` record for an EXISTING open cycle — the
         reconciler's close, after every slot was accounted (a crashed
         process's cycles have no live :class:`WALCycle` to call
-        ``done`` on)."""
+        ``done`` on).  ``superseded`` records the slots a NEWER cycle
+        owns (never sent, deliberately — the exactly-once auditors
+        exclude them like skips)."""
         record: Dict[str, Any] = {
             "kind": "done",
             "lineage": lineage,
@@ -206,6 +278,8 @@ class CommitIntentWAL:
         }
         if note is not None:
             record["note"] = note
+        if superseded:
+            record["superseded"] = sorted(int(s) for s in superseded)
         self._append(record)
 
     def records(self) -> List[Dict[str, Any]]:
@@ -241,6 +315,26 @@ class CommitIntentWAL:
                     if r.get("kind") == "done" and "failed" not in r
                 }
             return set(self._completed)
+
+    def open_lineages(self) -> set:
+        """Lineages with a ``cycle`` record and NO ``done`` record of
+        any kind in the active log — cycles a kill left for the
+        reconciler.  A lineage here must never be blind-re-executed:
+        its txs may be durably on chain with nothing reported
+        (``Session.commit_resilient``'s pre-re-execution guard;
+        failure-CLOSED cycles are deliberately absent — their outcome
+        was reported and the caller owns the retry).  Cached and
+        incrementally maintained; O(1) on the commit hot path."""
+        with self._lock:
+            if self._open is None:
+                opened, done = set(), set()
+                for r in read_wal(self.path):
+                    if r.get("kind") == "cycle":
+                        opened.add(r["lineage"])
+                    elif r.get("kind") == "done":
+                        done.add(r["lineage"])
+                self._open = opened - done
+            return set(self._open)
 
     def cycle(
         self,

@@ -86,13 +86,12 @@ def _filter_donation_warning_once() -> None:
 
 
 def resolve_journal(journal):
-    """An injected journal, or the lazily-imported process default —
-    the one resolver the router and the MultiSession facade share."""
-    if journal is not None:
-        return journal
-    from svoc_tpu.utils.events import journal as default_journal
+    """Re-export of :func:`svoc_tpu.utils.events.resolve_journal` (its
+    home since PR 14 — jax-free durability consumers resolve journals
+    without importing the fabric stack; fabric callers keep this name)."""
+    from svoc_tpu.utils.events import resolve_journal as _resolve
 
-    return default_journal
+    return _resolve(journal)
 
 
 class _PendingGroup:

@@ -49,6 +49,25 @@ from svoc_tpu.ops.fixedpoint import (
 #: Reference V3 transaction resource bounds (``client/contract.py:29-32``).
 RESOURCE_BOUND_L1_GAS = (259806, 153060543928007)
 
+_fault_point = None
+
+
+def _fire_fault_point(name: str, **kwargs) -> None:
+    """Fire a named fault point (docs/RESILIENCE.md §fault-surface).
+
+    The adapter's RPC boundaries are part of the chaos fuzzer's
+    surface, but ``durability/chainlog.py`` imports this module — a
+    top-level import back into the durability package would be
+    circular, so the hook binds lazily (the declarations live in
+    :mod:`svoc_tpu.durability.faultspace`).  One cached-global check
+    per signed tx when disarmed."""
+    global _fault_point
+    if _fault_point is None:
+        from svoc_tpu.durability.faultspace import fault_point
+
+        _fault_point = fault_point
+    _fault_point(name, **kwargs)
+
 
 class ChainCommitError(RuntimeError):
     """A commit loop failed mid-way: earlier txs ARE on chain.
@@ -666,6 +685,9 @@ class ChainAdapter:
 
     @_atomic
     def invoke_update_prediction(self, oracle_address, prediction) -> None:
+        _fire_fault_point(
+            "chain.tx.pre_invoke", payload={"fn": "update_prediction"}
+        )
         self._count_rpc("tx")
         self.backend.invoke(
             oracle_address,
@@ -749,6 +771,12 @@ class ChainAdapter:
         """Pre-encoded twin of :meth:`invoke_update_prediction` — the
         WAL path encodes once, journals the felts, then signs the SAME
         payload (digest in the log must equal digest on the wire)."""
+        # The signed-tx RPC boundary: an injected ``error`` here is the
+        # transport fault the retry/resume machinery must absorb; a
+        # ``kill`` leaves a durable intent whose tx never went out.
+        _fire_fault_point(
+            "chain.tx.pre_invoke", payload={"fn": "update_prediction"}
+        )
         self._count_rpc("tx")
         self.backend.invoke(
             oracle_address, "update_prediction", prediction=felts
@@ -848,6 +876,11 @@ class ChainAdapter:
                     BatchTxError,
                 )
 
+                # The one-RPC boundary of the batched plane: the batch
+                # intent is durable, the RPC has not gone out yet.
+                _fire_fault_point(
+                    "chain.batch.pre_rpc", payload={"n": len(felts)}
+                )
                 self._count_rpc("batch")
                 # Bounded work on the local simulator (one certified
                 # sweep, or the exact engine in-place for uncertifiable

@@ -82,17 +82,27 @@ class FaultSpec:
         return self.target is None or self.target == target
 
 
-def _crc(value: Any) -> int:
-    # repr() is stable for the address types that cross this boundary
-    # (ints, short strings); hash() is NOT (PYTHONHASHSEED).
+def crc_key(value: Any) -> int:
+    """Cross-process-stable key for an opaque value: ``repr()`` is
+    stable for the address/name types that cross this boundary (ints,
+    short strings); ``hash()`` is NOT (PYTHONHASHSEED).  The one keying
+    primitive every seeded-draw subsystem shares (fault plans here, the
+    fault-space fuzzer's schedule draws, ``sim.generators.claim_seed``'s
+    sibling) — svoclint SVOC009 enforces the discipline."""
     return zlib.crc32(repr(value).encode())
 
 
-def _mix(*parts: int) -> int:
+def mix_key(*parts: int) -> int:
+    """Fold integer key parts into one 64-bit draw seed (FNV-style)."""
     h = 0
     for p in parts:
         h = (h * 1_000_003 + (int(p) & 0xFFFFFFFFFFFFFFFF)) & 0xFFFFFFFFFFFFFFFF
     return h
+
+
+# Internal aliases predating the public names.
+_crc = crc_key
+_mix = mix_key
 
 
 class FaultPlan:

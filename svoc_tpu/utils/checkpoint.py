@@ -36,6 +36,14 @@ from typing import Any, Dict, Optional
 from svoc_tpu.consensus.state import OracleConsensusContract
 from svoc_tpu.train.trainer import TrainState
 
+#: The snapshot promotion boundary (docs/RESILIENCE.md §fault-surface).
+#: Declared in :mod:`svoc_tpu.durability.faultspace` (importing the
+#: durability package from here at module top would cycle through
+#: ``durability/__init__`` → ``recovery`` → this module); the names are
+#: bound here so :func:`save_snapshot` fires them by constant.
+SNAPSHOT_PRE_RENAME = "snapshot.pre_rename"
+SNAPSHOT_POST_RENAME = "snapshot.post_rename"
+
 
 # ---------------------------------------------------------------------------
 # Training state (orbax)
@@ -509,6 +517,7 @@ def save_snapshot(path: str, payload: Dict[str, Any]) -> None:
     a snapshot either exists whole or not at all, and the rename is
     durable before we return (the recovery manager may rotate the WAL
     immediately after, trusting the snapshot exists)."""
+    from svoc_tpu.durability.faultspace import fault_point
     from svoc_tpu.utils.events import _json_safe, fsync_dir
 
     tmp = path + ".tmp"
@@ -516,8 +525,13 @@ def save_snapshot(path: str, payload: Dict[str, Any]) -> None:
         json.dump(_json_safe(payload), f)
         f.flush()
         os.fsync(f.fileno())
+    # A kill here leaves only the .tmp — recovery must use the PREVIOUS
+    # snapshot plus the journal tail + WAL, never the half-promoted one.
+    fault_point(SNAPSHOT_PRE_RENAME)
     os.replace(tmp, path)
     fsync_dir(path)
+    # Snapshot durable, caller's follow-up (WAL rotation) not yet run.
+    fault_point(SNAPSHOT_POST_RENAME)
 
 
 def load_snapshot(path: str) -> Dict[str, Any]:

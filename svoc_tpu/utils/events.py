@@ -672,6 +672,18 @@ def emit_event(
     return journal.emit(event_type, lineage=lineage, **data)
 
 
+def resolve_journal(injected) -> EventJournal:
+    """An injected journal, or the process default — the one resolver
+    the fabric router, the MultiSession facade, the durability plane,
+    and the chaos harnesses share.  Lives here (not in fabric.router,
+    its pre-PR-14 home, which re-exports it) so jax-free consumers —
+    the WAL reconciler inside a chaos-fuzz child — can resolve a
+    journal without importing the fabric stack."""
+    if injected is not None:
+        return injected
+    return journal
+
+
 # ---------------------------------------------------------------------------
 # Per-block audit assembly
 # ---------------------------------------------------------------------------

@@ -1,0 +1,647 @@
+"""Deterministic fault-space fuzzer (ISSUE 14; docs/RESILIENCE.md
+§fault-surface): the named registry, the seed-driven schedule explorer,
+the invariant oracles, shrinking, and the committed regression corpus.
+
+The subprocess tests here ride the deliberately jax-free durable-plane
+child harness (~1 s per child) — tier-1 affordable; the full
+fabric/serving kill matrix stays in ``make crash-smoke``.
+"""
+
+import dataclasses
+import json
+import os
+import tempfile
+
+import pytest
+
+from svoc_tpu.durability import faultspace, fuzz
+from svoc_tpu.durability.faultspace import (
+    FaultController,
+    FaultEvent,
+    read_fired_log,
+)
+from svoc_tpu.resilience.faults import InjectedFault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS_DIR = os.path.join(REPO, "tests", "fixtures", "chaos_corpus")
+DOC = os.path.join(REPO, "docs", "RESILIENCE.md")
+
+SURFACE = faultspace.load_surface()
+
+
+# ---------------------------------------------------------------------------
+# Registry + controller
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_surface_nonempty_and_sorted(self):
+        names = list(SURFACE)
+        assert names == sorted(names)
+        assert len(names) >= 15
+
+    def test_identical_redeclaration_is_idempotent(self):
+        spec = SURFACE["wal.intent.pre_fsync"]
+        assert (
+            faultspace.declare(
+                spec.name,
+                owner=spec.owner,
+                invariant=spec.invariant,
+                actions=spec.actions,
+                smokes=spec.smokes,
+                modes=spec.modes,
+                stage=spec.stage,
+            )
+            == spec.name
+        )
+
+    def test_conflicting_redeclaration_raises(self):
+        spec = SURFACE["wal.intent.pre_fsync"]
+        with pytest.raises(ValueError, match="different spec"):
+            faultspace.declare(
+                spec.name,
+                owner=spec.owner,
+                invariant="something else entirely",
+                actions=spec.actions,
+                smokes=spec.smokes,
+            )
+
+    def test_every_point_names_a_smoke(self):
+        # The can't-silently-escape contract: a declared durable
+        # boundary must name the harness that witnesses it.
+        for name, spec in SURFACE.items():
+            assert spec.smokes, f"{name} declares no reaching smoke"
+
+    def test_every_owner_module_exists(self):
+        for name, spec in SURFACE.items():
+            assert os.path.exists(
+                os.path.join(REPO, spec.owner)
+            ), f"{name} owner {spec.owner} missing"
+
+    def test_invalid_specs_rejected(self):
+        with pytest.raises(ValueError):
+            faultspace.FaultPointSpec(
+                name="x", owner="y", invariant="z",
+                actions=("explode",), smokes=("fuzz",),
+            )
+        with pytest.raises(ValueError):
+            faultspace.FaultPointSpec(
+                name="x", owner="y", invariant="z",
+                actions=("kill",), smokes=("nope",),
+            )
+        with pytest.raises(ValueError):
+            FaultEvent(point="p", nth=0)
+        with pytest.raises(ValueError):
+            FaultEvent(point="p", action="frobnicate")
+
+
+class TestController:
+    def _arm(self, events, tmp_path, die):
+        ctl = FaultController(
+            events,
+            log_path=str(tmp_path / "fired.jsonl"),
+            die=die,
+        )
+        faultspace.arm(ctl)
+        return ctl
+
+    def test_disarmed_fault_point_is_noop(self):
+        assert not faultspace.armed()
+        faultspace.fault_point("wal.intent.pre_fsync")  # no controller
+
+    def test_nth_counting_and_kill(self, tmp_path):
+        died = []
+        ctl = self._arm(
+            [FaultEvent(point="wal.intent.pre_fsync", nth=3,
+                        action="kill")],
+            tmp_path, die=lambda: died.append(True),
+        )
+        try:
+            for _ in range(5):
+                faultspace.fault_point("wal.intent.pre_fsync")
+            # Fires exactly once, at the 3rd firing.
+            assert died == [True]
+            assert ctl.counts()["wal.intent.pre_fsync"] == 5
+            log = read_fired_log(str(tmp_path / "fired.jsonl"))
+            assert log["fired"] == ["wal.intent.pre_fsync"]
+            assert log["actions"] == [
+                {"kind": "action", "point": "wal.intent.pre_fsync",
+                 "action": "kill", "n": 3}
+            ]
+        finally:
+            faultspace.disarm()
+
+    def test_match_is_payload_subset(self, tmp_path):
+        died = []
+        self._arm(
+            [FaultEvent(point="chainlog.tx.post_fsync", nth=2,
+                        action="kill",
+                        match={"fn": "update_prediction"})],
+            tmp_path, die=lambda: died.append(True),
+        )
+        try:
+            fire = faultspace.fault_point
+            fire("chainlog.tx.post_fsync", payload={"fn": "vote"})
+            fire("chainlog.tx.post_fsync",
+                 payload={"fn": "update_prediction"})
+            assert not died  # one matching firing so far
+            fire("chainlog.tx.post_fsync",
+                 payload={"fn": "update_prediction"})
+            assert died == [True]
+        finally:
+            faultspace.disarm()
+
+    def test_error_action_raises_injected_fault(self, tmp_path):
+        self._arm(
+            [FaultEvent(point="chain.tx.pre_invoke", nth=1,
+                        action="error")],
+            tmp_path, die=lambda: pytest.fail("error must not die"),
+        )
+        try:
+            with pytest.raises(InjectedFault, match="chain.tx.pre_invoke"):
+                faultspace.fault_point("chain.tx.pre_invoke")
+            # Spent: subsequent firings pass.
+            faultspace.fault_point("chain.tx.pre_invoke")
+        finally:
+            faultspace.disarm()
+
+    def test_torn_action_writes_then_dies(self, tmp_path):
+        order = []
+        self._arm(
+            [FaultEvent(point="wal.intent.pre_fsync", nth=1,
+                        action="torn")],
+            tmp_path, die=lambda: order.append("die"),
+        )
+        try:
+            faultspace.fault_point(
+                "wal.intent.pre_fsync", torn=lambda: order.append("torn")
+            )
+            assert order == ["torn", "die"]
+        finally:
+            faultspace.disarm()
+
+    def test_torn_without_writer_is_loud(self, tmp_path):
+        self._arm(
+            [FaultEvent(point="wal.intent.pre_fsync", nth=1,
+                        action="torn")],
+            tmp_path, die=lambda: None,
+        )
+        try:
+            with pytest.raises(RuntimeError, match="no torn writer"):
+                faultspace.fault_point("wal.intent.pre_fsync")
+        finally:
+            faultspace.disarm()
+
+    def test_undeclared_point_raises_when_armed(self, tmp_path):
+        self._arm([], tmp_path, die=lambda: None)
+        try:
+            with pytest.raises(KeyError, match="undeclared"):
+                faultspace.fault_point("made.up.point")
+        finally:
+            faultspace.disarm()
+
+    def test_event_on_undeclared_point_rejected_at_arm(self):
+        with pytest.raises(KeyError):
+            FaultController([FaultEvent(point="made.up.point")])
+
+    def test_event_with_disallowed_action_rejected_at_arm(self):
+        # serving.step.post declares kill only.
+        with pytest.raises(ValueError, match="invalid at"):
+            FaultController(
+                [FaultEvent(point="serving.step.post", action="torn")]
+            )
+
+    def test_double_arm_refused(self, tmp_path):
+        self._arm([], tmp_path, die=lambda: None)
+        try:
+            with pytest.raises(RuntimeError, match="already armed"):
+                faultspace.arm(FaultController([]))
+        finally:
+            faultspace.disarm()
+
+    def test_colliding_same_point_events_both_execute(self, tmp_path):
+        # Two events sharing a point and an nth: one event acts per
+        # firing, and the loser executes at the NEXT eligible firing
+        # instead of being silently lost (review finding).
+        acted = []
+        self._arm(
+            [FaultEvent(point="chain.tx.pre_invoke", nth=2,
+                        action="error"),
+             FaultEvent(point="chain.tx.pre_invoke", nth=2,
+                        action="kill")],
+            tmp_path, die=lambda: acted.append("kill"),
+        )
+        try:
+            faultspace.fault_point("chain.tx.pre_invoke")
+            with pytest.raises(InjectedFault):
+                faultspace.fault_point("chain.tx.pre_invoke")
+            faultspace.fault_point("chain.tx.pre_invoke")
+            assert acted == ["kill"]
+        finally:
+            faultspace.disarm()
+
+    def test_unfired_events_reported(self, tmp_path):
+        ctl = self._arm(
+            [FaultEvent(point="wal.intent.pre_fsync", nth=99,
+                        action="kill")],
+            tmp_path, die=lambda: None,
+        )
+        try:
+            faultspace.fault_point("wal.intent.pre_fsync")
+            assert [e.nth for e in ctl.unfired_events()] == [99]
+        finally:
+            faultspace.disarm()
+
+
+# ---------------------------------------------------------------------------
+# Plan drawing
+# ---------------------------------------------------------------------------
+
+
+class TestDrawPlan:
+    def test_same_seed_same_plan(self):
+        assert fuzz.draw_plan(17, SURFACE) == fuzz.draw_plan(17, SURFACE)
+
+    def test_seeds_differ(self):
+        plans = {s: fuzz.draw_plan(s, SURFACE) for s in range(40)}
+        assert len({json.dumps(p.as_dict()) for p in plans.values()}) > 30
+
+    def test_directed_pass_covers_every_fuzz_point(self):
+        # Coverage by construction: seed i targets sorted point i.
+        points = fuzz.fuzz_points(SURFACE)
+        targeted = set()
+        for seed, name in enumerate(points):
+            plan = fuzz.draw_plan(seed, SURFACE)
+            assert any(e.point == name for e in plan.events), (
+                f"directed seed {seed} does not target {name}"
+            )
+            targeted.add(name)
+        assert targeted == set(points)
+
+    def test_drawn_events_always_valid(self):
+        # Every drawn event arms cleanly: point declared, action
+        # allowed, recovery-stage targets preceded by a phase-0 kill.
+        for seed in range(64):
+            plan = fuzz.draw_plan(seed, SURFACE)
+            FaultController(plan.events)  # raises on invalid draw
+            for e in plan.events:
+                spec = SURFACE[e.point]
+                if spec.stage == "recovery" and e.phase == 0:
+                    pytest.fail(
+                        f"seed {seed}: recovery-stage {e.point} drawn "
+                        f"at phase 0"
+                    )
+
+    def test_mode_compatibility(self):
+        for seed in range(64):
+            plan = fuzz.draw_plan(seed, SURFACE)
+            for e in plan.events:
+                spec = SURFACE[e.point]
+                if spec.stage == "run":
+                    assert plan.commit_mode in spec.modes, (
+                        f"seed {seed}: {e.point} unreachable in "
+                        f"{plan.commit_mode}"
+                    )
+
+    def test_plan_round_trip(self):
+        plan = fuzz.draw_plan(3, SURFACE)
+        assert fuzz.FuzzPlan.from_dict(
+            json.loads(json.dumps(plan.as_dict()))
+        ) == plan
+
+
+# ---------------------------------------------------------------------------
+# Shrinking + corpus mechanics (no subprocesses)
+# ---------------------------------------------------------------------------
+
+
+class TestShrink:
+    def _plan(self):
+        return fuzz.FuzzPlan(
+            seed=5, cycles=8,
+            events=(
+                FaultEvent(point="wal.intent.pre_fsync", nth=6,
+                           action="torn"),
+                FaultEvent(point="snapshot.pre_rename", nth=2,
+                           action="kill"),
+                FaultEvent(point="reconcile.mid_cycle", nth=1,
+                           action="kill", phase=1),
+            ),
+        )
+
+    def test_shrink_drops_irrelevant_events_and_cycles(self):
+        # "Fails" iff the torn-intent event survives and cycles >= 3.
+        def fails(p):
+            return p.cycles >= 3 and any(
+                e.point == "wal.intent.pre_fsync" for e in p.events
+            )
+
+        out = fuzz.shrink_plan(self._plan(), fails, budget=40)
+        small = out["plan"]
+        assert fails(small)
+        assert [e.point for e in small.events] == ["wal.intent.pre_fsync"]
+        assert small.cycles == 3
+        # nth shrinks toward 1 too.
+        assert small.events[0].nth == 1
+
+    def test_shrink_respects_budget(self):
+        calls = []
+
+        def fails(p):
+            calls.append(1)
+            return True
+
+        fuzz.shrink_plan(self._plan(), fails, budget=5)
+        assert len(calls) <= 5
+
+    def test_unshrinkable_plan_survives(self):
+        plan = fuzz.FuzzPlan(seed=1, cycles=2, events=())
+        out = fuzz.shrink_plan(plan, lambda p: True, budget=10)
+        assert out["plan"].cycles == 2
+
+    def test_corpus_round_trip(self, tmp_path):
+        plan = self._plan()
+        path = fuzz.write_corpus_entry(
+            str(tmp_path), plan, ["duplicate_txs: 2"], notes="unit"
+        )
+        assert os.path.basename(path) == "duplicate-txs-s5.json"
+        entries = fuzz.load_corpus(str(tmp_path))
+        assert len(entries) == 1
+        assert fuzz.FuzzPlan.from_dict(entries[0]["plan"]) == plan
+        assert entries[0]["expect"] == "pass"
+
+
+# ---------------------------------------------------------------------------
+# The child harness + invariant oracles (in-process, no kills)
+# ---------------------------------------------------------------------------
+
+
+class TestChildHarness:
+    def test_clean_run_invariants_and_determinism(self):
+        plan = fuzz.FuzzPlan(seed=11, cycles=3)
+        r1 = fuzz.run_fuzz_child(tempfile.mkdtemp(), plan, 0)
+        r2 = fuzz.run_fuzz_child(tempfile.mkdtemp(), plan, 0)
+        assert r1["duplicate_txs"] == 0
+        assert r1["wal_open_cycles"] == []
+        assert r1["lost_commits"] == []
+        assert r1["codec_divergences"] == 0
+        assert r1["final_unknown"] == 0
+        # Same plan, fresh directories: byte-identical fingerprints.
+        assert r1["fingerprint"] == r2["fingerprint"]
+        # Both commit planes' run-stage surface fires even fault-free.
+        assert "wal.intent.pre_fsync" in r1["fired"]
+        assert "snapshot.pre_rename" in r1["fired"]
+        assert "wal.rotate.pre_replace" in r1["fired"]
+
+    def test_batched_run_uses_batch_family(self):
+        plan = fuzz.FuzzPlan(seed=12, cycles=3, commit_mode="batched")
+        r = fuzz.run_fuzz_child(tempfile.mkdtemp(), plan, 0)
+        assert r["duplicate_txs"] == 0 and r["codec_divergences"] == 0
+        assert "wal.intent_batch.pre_fsync" in r["fired"]
+        assert "chain.batch.mid_fleet" in r["fired"]
+        assert "wal.intent.pre_fsync" not in r["fired"]
+
+    def test_check_invariants_flags_each_oracle(self):
+        base = {
+            "duplicate_txs": 0, "wal_open_cycles": [],
+            "lost_commits": [], "final_unknown": 0,
+            "final_unaccounted": 0, "codec_divergences": 0,
+        }
+        assert fuzz.check_invariants({"result": dict(base)}) == []
+        for key, bad, expect in [
+            ("duplicate_txs", 2, "duplicate_txs"),
+            ("wal_open_cycles", ["fz-x"], "open_cycles"),
+            ("lost_commits", [{"lineage": "x", "slot": 1}],
+             "lost_commits"),
+            ("final_unknown", 1, "unknown_slots"),
+            ("final_unaccounted", 1, "unaccounted_slots"),
+            ("codec_divergences", 3, "codec_divergences"),
+        ]:
+            result = dict(base)
+            result[key] = bad
+            violations = fuzz.check_invariants({"result": result})
+            assert len(violations) == 1 and expect in violations[0]
+
+    def test_codec_divergence_witness(self, tmp_path):
+        # A synthetic chain log with one non-canonical felt (inside the
+        # dead zone the codec refuses) must count as a divergence.
+        from svoc_tpu.ops.fixedpoint import FELT_PRIME
+
+        path = str(tmp_path / "chain-x.jsonl")
+        good = {"caller": 1, "fn": "update_prediction",
+                "prediction": [500000], "digest": "d"}
+        bad = {"caller": 1, "fn": "update_prediction",
+               "prediction": [FELT_PRIME - 10**40], "digest": "d"}
+        with open(path, "w") as f:
+            f.write(json.dumps(good) + "\n")
+            f.write(json.dumps(bad) + "\n")
+        assert fuzz._codec_divergences(path) == 1
+
+
+class TestSupersession:
+    """The fuzzer-captured stale-resend class (corpus entry
+    duplicate-txs-reconcile-error): the reconciler's `superseded`
+    verdict and the WAL's open-lineage guard."""
+
+    def _wal_with_open_then_newer(self, tmp_path):
+        from svoc_tpu.consensus.state import OracleConsensusContract
+        from svoc_tpu.durability.chainlog import DurableLocalBackend
+        from svoc_tpu.durability.wal import CommitIntentWAL
+        from svoc_tpu.io.chain import ChainAdapter
+        from svoc_tpu.ops.fixedpoint import encode_vector
+
+        oracles = [0x10 + i for i in range(5)]
+        contract = OracleConsensusContract(
+            admins=[0xA0, 0xA1, 0xA2], oracles=oracles,
+            required_majority=2, n_failing_oracles=1,
+            constrained=True, dimension=2,
+        )
+        backend = DurableLocalBackend(
+            contract, str(tmp_path / "chain.jsonl")
+        )
+        adapter = ChainAdapter(backend)
+        wal = CommitIntentWAL(str(tmp_path / "wal.jsonl"))
+        old = [
+            encode_vector([0.10 + 0.01 * i, 0.20 + 0.01 * i])
+            for i in range(5)
+        ]
+        new = [
+            encode_vector([0.50 + 0.01 * i, 0.60 + 0.01 * i])
+            for i in range(5)
+        ]
+        # Cycle A: opened, nothing landed, no done — a kill's leftovers.
+        wal.cycle("lin-a", claim=None, oracles=oracles, payloads=old)
+        # Cycle B: newer, fully landed on chain, cleanly done.
+        cyc_b = wal.cycle(
+            "lin-b", claim=None, oracles=oracles, payloads=new
+        )
+        for oracle, felts in zip(oracles, new):
+            adapter._invoke_prediction_felts(oracle, felts)
+        cyc_b.done(sent=5)
+        return wal, adapter, old, new
+
+    def test_reconciler_never_resends_superseded_slots(self, tmp_path):
+        from svoc_tpu.durability.chainlog import duplicate_predictions
+        from svoc_tpu.durability.reconcile import reconcile_wal
+
+        wal, adapter, old, new = self._wal_with_open_then_newer(tmp_path)
+        report = reconcile_wal(wal, lambda _c: adapter)
+        (cyc,) = report.cycles
+        assert cyc.lineage == "lin-a" and cyc.closed
+        assert cyc.count("superseded") == 5
+        assert report.resent == 0
+        # The done record carries the superseded slots for the audits.
+        done = [r for r in wal.records() if r.get("kind") == "done"
+                and r["lineage"] == "lin-a"]
+        assert done[-1]["superseded"] == [0, 1, 2, 3, 4]
+        assert duplicate_predictions(str(tmp_path / "chain.jsonl")) == []
+        # And the chain still holds the NEWER values (no stale-data
+        # regression from a resend of cycle A).
+        assert adapter.get_the_predictions() == new
+
+    def test_open_lineages_cached_guard(self, tmp_path):
+        from svoc_tpu.durability.reconcile import reconcile_wal
+
+        wal, adapter, _old, _new = self._wal_with_open_then_newer(tmp_path)
+        assert wal.open_lineages() == {"lin-a"}
+        assert "lin-b" not in wal.open_lineages()
+        # Failure-closed cycles are NOT open (outcome reported).
+        cyc_c = wal.cycle("lin-c", claim=None, oracles=[0x10],
+                          payloads=[[1]])
+        cyc_c.done(sent=0, failed="transport")
+        assert "lin-c" not in wal.open_lineages()
+        # A reconcile close drops the open lineage incrementally.
+        reconcile_wal(wal, lambda _c: adapter)
+        assert wal.open_lineages() == set()
+
+
+# ---------------------------------------------------------------------------
+# Subprocess: the restart-storm regression + the committed corpus
+# ---------------------------------------------------------------------------
+
+
+CORPUS = fuzz.load_corpus(CORPUS_DIR)
+
+
+class TestKillRestart:
+    def test_restart_storm_idempotent(self, tmp_path):
+        """ISSUE 14 satellite 3: SIGKILL during recovery — after the
+        reconciler's resends landed but before the cycle closed — then
+        a second recovery.  No duplicate resends (the chain witness),
+        every cycle closed, fingerprint continuity across the full
+        rerun."""
+        plan = fuzz.FuzzPlan(
+            seed=42, cycles=4,
+            events=(
+                FaultEvent(point="chainlog.tx.post_apply", nth=3,
+                           action="kill", phase=0),
+                FaultEvent(point="reconcile.mid_cycle", nth=1,
+                           action="kill", phase=1),
+            ),
+        )
+        checked = fuzz.run_and_check(plan, str(tmp_path))
+        assert checked["violations"] == []
+        assert checked["replay_identical"] is True
+        result = checked["run"]["result"]
+        # Three lives: crash, storming recovery, final recovery.
+        assert [p["killed"] for p in checked["run"]["phases"]] == [
+            True, True, False,
+        ]
+        # The second recovery saw the storm's resends as landed (chain
+        # witness) — zero duplicate txs IS the no-double-resend proof.
+        assert result["duplicate_txs"] == 0
+        assert result["wal_open_cycles"] == []
+        assert "reconcile.mid_cycle" in checked["fired"]["fired"]
+        assert "recovery.post_restore" in checked["fired"]["fired"]
+
+    def test_corpus_is_committed(self):
+        names = {e["name"] for e in CORPUS}
+        assert {
+            "torn-intent-restart-storm.json",
+            "batched-felt-mid-fleet.json",
+            "open-cycles-s2.json",
+        } <= names
+
+    @pytest.mark.parametrize(
+        "entry",
+        [e for e in CORPUS if e.get("tier1", True)],
+        ids=[e["name"] for e in CORPUS if e.get("tier1", True)],
+    )
+    def test_corpus_replays_green(self, entry, tmp_path):
+        """The regression contract: every committed corpus entry —
+        auto-shrunk minimal repros of past violations — replays with
+        zero invariant violations and byte-identical rerun
+        fingerprints."""
+        assert entry["expect"] == "pass"
+        violations = fuzz.replay_corpus_entry(entry, str(tmp_path))
+        assert violations == [], (
+            f"corpus entry {entry['name']} regressed: {violations}"
+        )
+
+
+@pytest.mark.slow
+class TestCorpusSlow:
+    _SLOW = [e for e in CORPUS if not e.get("tier1", True)]
+
+    @pytest.mark.parametrize(
+        "entry", _SLOW or [None],
+        ids=[e["name"] for e in _SLOW] or ["none"],
+    )
+    def test_corpus_replays_green_slow(self, entry, tmp_path):
+        if entry is None:
+            pytest.skip("no slow corpus entries")
+        violations = fuzz.replay_corpus_entry(entry, str(tmp_path))
+        assert violations == []
+
+
+# ---------------------------------------------------------------------------
+# Crash-scenario mapping + docs inventory
+# ---------------------------------------------------------------------------
+
+
+class TestCrashMapping:
+    def test_crash_events_target_declared_points(self):
+        # The scenario's named-point mapping, without importing the
+        # jax-heavy scenario module: tools/crash_smoke.py's LEG_POINT
+        # must name declared points with crash-smoke witness metadata.
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "crash_smoke", os.path.join(REPO, "tools", "crash_smoke.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        for leg, point in mod.LEG_POINT.items():
+            assert point in SURFACE, f"{leg} targets undeclared {point}"
+            assert faultspace.SMOKE_CRASH in SURFACE[point].smokes, (
+                f"{leg} targets {point} which does not name the crash "
+                f"smoke as a witness"
+            )
+        assert set(mod.LEGS) == set(mod.LEG_POINT)
+
+    def test_crash_witnessed_points_all_reachable(self):
+        # Every point claiming the crash smoke as witness is targeted
+        # by some leg (or fires on every recovery, like post_restore).
+        crash_points = {
+            n for n, s in SURFACE.items()
+            if faultspace.SMOKE_CRASH in s.smokes
+        }
+        assert crash_points == {
+            "wal.intent.pre_fsync", "chainlog.tx.post_fsync",
+            "serving.step.post", "chain.batch.mid_fleet",
+            "recovery.post_restore",
+        }
+
+
+class TestDocsInventory:
+    def test_every_declared_point_in_resilience_doc(self):
+        # The docs table and the registry are the same inventory: a
+        # point added without a doc row fails here, a doc row without a
+        # declaration is caught by the reverse scan.
+        with open(DOC) as f:
+            doc = f.read()
+        for name in SURFACE:
+            assert f"`{name}`" in doc, (
+                f"fault point {name} missing from docs/RESILIENCE.md "
+                f"fault-surface inventory"
+            )

@@ -1,0 +1,288 @@
+"""Deterministic fault-space fuzzer gate: ``make chaos-fuzz-smoke``
+(docs/RESILIENCE.md §fault-surface).
+
+Explores the declared fault surface
+(:mod:`svoc_tpu.durability.faultspace`) with seed-drawn kill/restart
+schedules (:mod:`svoc_tpu.durability.fuzz`): per seed, a crash+recover
+subprocess chain in one work directory — SIGKILL at the Nth firing of a
+named point, torn writes, injected chain faults, ``per_tx`` vs
+``batched`` commit mode, restart storms (a second kill mid-recovery) —
+then the invariant oracles over the recovered artifacts and a full
+same-seed rerun asserting byte-identical recovered fingerprints.
+
+The gate FAILS when:
+
+- any invariant oracle trips (duplicate txs, lost commits, unclosed
+  cycles, unknown slots with a reachable backend, codec divergences,
+  replay divergence, harness errors) — the failing plan is
+  **auto-shrunk** and written into the regression corpus
+  (``tests/fixtures/chaos_corpus/`` by default) for tier-1 to replay;
+- any ``"fuzz"``-smoke fault point never fired across the whole seed
+  budget (a durable boundary escaped exploration — 100 % declared-point
+  coverage is the acceptance bar);
+- the dedicated **felt-wire segment** (VERDICT item 9: a fault-free
+  ``commit_mode="batched"`` soak through the batched adapter's
+  ``encoding="felt"`` plane) reports any codec divergence.
+
+Children are deliberately jax-free (~1 s each — the point of the light
+durable-plane harness; the full fabric/serving stack keeps its own kill
+matrix in ``make crash-smoke``), so the default 32-seed budget runs in
+roughly a minute or two on this 1-core container.  ``--seeds N`` is the
+deep mode for detached runs.
+
+Usage::
+
+    python tools/chaos_fuzz.py [--seeds 32] [--jobs 3] [--out CHAOS_FUZZ.json]
+    python tools/chaos_fuzz.py --seeds 512 --base-dir /tmp/fuzz-deep   # deep
+    python tools/chaos_fuzz.py --child DIR --plan PLAN.json --phase N  # internal
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from svoc_tpu.durability import faultspace, fuzz  # noqa: E402
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
+
+DEFAULT_SEEDS = 32
+
+
+def child_main(args) -> int:
+    with open(args.plan) as f:
+        plan = fuzz.FuzzPlan.from_dict(json.load(f))
+    result = fuzz.run_fuzz_child(args.child, plan, args.phase)
+    atomic_write_json(os.path.join(args.child, fuzz.RESULT_NAME), result)
+    return 0
+
+
+def _seed_summary(seed: int, checked: dict) -> dict:
+    run = checked["run"]
+    result = run.get("result") or {}
+    return {
+        "seed": seed,
+        "plan": checked["plan"],
+        "phases": [
+            {"phase": p["phase"], "killed": p["killed"]}
+            for p in run["phases"]
+        ],
+        "violations": checked["violations"],
+        "replay_identical": checked["replay_identical"],
+        "fingerprint": result.get("fingerprint"),
+        "duplicate_txs": result.get("duplicate_txs"),
+        "codec_divergences": result.get("codec_divergences"),
+        "fired": checked["fired"]["fired"],
+        "actions": checked["fired"]["actions"],
+        # Reconstructed from the durable action log (a killed phase's
+        # remaining events die with its controller, so the surviving
+        # child's in-memory view alone would under-report).
+        "unfired_events": run.get("unexecuted_events", []),
+    }
+
+
+def felt_segment(base_dir: str) -> dict:
+    """VERDICT item 9: a fault-free batched soak — every commit rides
+    the one-RPC batched adapter, whose backend applies with
+    ``encoding="felt"`` — asserting zero codec divergences on the felt
+    wire (plus the standard oracles and replay identity)."""
+    plan = fuzz.FuzzPlan(
+        seed=9_000_000, commit_mode="batched", cycles=8,
+        label="felt_soak",
+    )
+    checked = fuzz.run_and_check(plan, os.path.join(base_dir, "felt-soak"))
+    result = checked["run"].get("result") or {}
+    return {
+        "plan": checked["plan"],
+        "violations": checked["violations"],
+        "replay_identical": checked["replay_identical"],
+        "codec_divergences": result.get("codec_divergences"),
+        "predictions_committed": sum(
+            c.get("predictions", 0)
+            for c in (result.get("chain") or {}).values()
+        ),
+        "ok": not checked["violations"]
+        and result.get("codec_divergences") == 0,
+    }
+
+
+def shrink_and_record(
+    seed: int, checked: dict, base_dir: str, corpus_dir: str, budget: int
+) -> dict:
+    """Auto-shrink a failing plan to a minimal repro and write it into
+    the regression corpus (``expect="pass"`` — the entry goes green
+    once the bug is fixed, and tier-1 replays it forever)."""
+    plan = fuzz.FuzzPlan.from_dict(checked["plan"])
+    need_replay = any(
+        v.startswith("replay_divergence") for v in checked["violations"]
+    )
+    trial_no = [0]
+
+    def fails(candidate: fuzz.FuzzPlan) -> bool:
+        trial_no[0] += 1
+        trial_dir = os.path.join(
+            base_dir, f"shrink-s{seed}-t{trial_no[0]:03d}"
+        )
+        return bool(
+            fuzz.run_and_check(
+                candidate, trial_dir, replay=need_replay
+            )["violations"]
+        )
+
+    shrunk = fuzz.shrink_plan(plan, fails, budget=budget)
+    # Record the SHRUNK plan's OWN violations: shrinking accepts any
+    # failing neighbor, so the minimal repro can reproduce a different
+    # failure class than the original seed did — the corpus entry must
+    # pin what the stored plan actually does.
+    final = fuzz.run_and_check(
+        shrunk["plan"],
+        os.path.join(base_dir, f"shrink-s{seed}-final"),
+        replay=need_replay,
+    )
+    captured = final["violations"] or checked["violations"]
+    path = fuzz.write_corpus_entry(
+        corpus_dir,
+        shrunk["plan"],
+        captured,
+        shrunk_from=plan,
+        notes=f"auto-shrunk from seed {seed} in {shrunk['trials']} trials "
+        f"by tools/chaos_fuzz.py (original seed's violations: "
+        f"{checked['violations']}); commit this entry WITH the fix so "
+        f"tier-1 replays it green",
+    )
+    return {
+        "seed": seed,
+        "corpus_entry": path,
+        "trials": shrunk["trials"],
+        "shrunk_plan": shrunk["plan"].as_dict(),
+    }
+
+
+def main(argv=None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seeds", type=int, default=DEFAULT_SEEDS)
+    p.add_argument("--jobs", type=int, default=3)
+    p.add_argument("--out", default="CHAOS_FUZZ.json")
+    p.add_argument("--base-dir", default=None,
+                   help="work area (default: fresh temp dir)")
+    p.add_argument(
+        "--corpus-dir",
+        default=os.path.join(repo_root, "tests", "fixtures", "chaos_corpus"),
+    )
+    p.add_argument("--shrink-budget", type=int, default=12)
+    p.add_argument("--child", default=None, help="(internal) phase workdir")
+    p.add_argument("--plan", default=None, help="(internal) plan JSON path")
+    p.add_argument("--phase", type=int, default=0)
+    args = p.parse_args(argv)
+    if args.child is not None:
+        return child_main(args)
+
+    surface = faultspace.load_surface()
+    fuzz_surface = fuzz.fuzz_points(surface)
+    base = args.base_dir or tempfile.mkdtemp(prefix="chaos-fuzz-")
+    os.makedirs(base, exist_ok=True)
+
+    plans = {seed: fuzz.draw_plan(seed, surface) for seed in
+             range(args.seeds)}
+    summaries = {}
+    with concurrent.futures.ThreadPoolExecutor(args.jobs) as pool:
+        futures = {
+            seed: pool.submit(
+                fuzz.run_and_check, plan, os.path.join(base, f"seed-{seed}")
+            )
+            for seed, plan in plans.items()
+        }
+        for seed, future in futures.items():
+            summaries[seed] = _seed_summary(seed, future.result())
+
+    felt = felt_segment(base)
+
+    # Coverage: every "fuzz"-smoke point must have fired somewhere.
+    coverage = {
+        name: sorted(
+            s["seed"] for s in summaries.values() if name in s["fired"]
+        )
+        for name in fuzz_surface
+    }
+    never_fired = sorted(n for n, seeds in coverage.items() if not seeds)
+
+    failing = {
+        seed: s for seed, s in summaries.items() if s["violations"]
+    }
+    shrunk_entries = []
+    for seed, s in sorted(failing.items()):
+        shrunk_entries.append(
+            shrink_and_record(
+                seed, s, base, args.corpus_dir, args.shrink_budget
+            )
+        )
+
+    checks = {
+        # The ISSUE 14 acceptance bar is absolute: a --seeds 4 dev run
+        # honestly FAILS this check rather than passing vacuously.
+        "seeds_explored_at_least_32": len(summaries) >= 32,
+        "declared_fuzz_points_all_fired": not never_fired,
+        "zero_invariant_violations": not failing,
+        "zero_duplicate_txs": all(
+            (s["duplicate_txs"] or 0) == 0 for s in summaries.values()
+        ),
+        "same_seed_rerun_fingerprints_identical": all(
+            s["replay_identical"] is True for s in summaries.values()
+        ),
+        "felt_segment_zero_codec_divergences": felt["ok"],
+    }
+    ok = all(checks.values())
+    artifact = {
+        "seeds": args.seeds,
+        "surface": {
+            name: {
+                "owner": spec.owner,
+                "invariant": spec.invariant,
+                "actions": list(spec.actions),
+                "smokes": list(spec.smokes),
+                "modes": list(spec.modes),
+                "stage": spec.stage,
+                "fired_in_seeds": coverage.get(name),
+            }
+            for name, spec in sorted(surface.items())
+        },
+        "coverage_never_fired": never_fired,
+        "felt_segment": felt,
+        "checks": checks,
+        "ok": ok,
+        "violations": {
+            seed: s["violations"] for seed, s in sorted(failing.items())
+        },
+        "shrunk": shrunk_entries,
+        "runs": [summaries[seed] for seed in sorted(summaries)],
+    }
+    atomic_write_json(args.out, artifact)
+    for name, passed in sorted(checks.items()):
+        print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+    if never_fired:
+        print(f"  never fired: {never_fired}")
+    for entry in shrunk_entries:
+        print(
+            f"  seed {entry['seed']} FAILED -> shrunk repro written to "
+            f"{entry['corpus_entry']} ({entry['trials']} trials); commit "
+            f"it with the fix so tier-1 replays it green"
+        )
+    n_actions = sum(len(s["actions"]) for s in summaries.values())
+    print(
+        f"chaos-fuzz {'OK' if ok else 'FAILED'}: {len(summaries)} seeds, "
+        f"{len(fuzz_surface)} fuzz-surface points "
+        f"({len(surface)} declared), {n_actions} fault actions executed, "
+        f"felt segment {'clean' if felt['ok'] else 'DIVERGED'} "
+        f"-> {args.out}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,34 +1,40 @@
 """Kill/restart chaos gate: crash-consistent durability as CI
-(``make crash-smoke``; docs/RESILIENCE.md §durability).
+(``make crash-smoke``; docs/RESILIENCE.md §durability + §fault-surface).
 
-For each seeded fault point — ``mid_wal_append`` (a commit-intent
-record torn in half mid-fsync), ``inter_tx`` (SIGKILL between tx *i*
-landing on the chain log and its WAL ``landed`` record), and
-``pre_snapshot`` (SIGKILL after a serving step's commits, before its
-cadence snapshot) — the harness:
+Each leg of the matrix is a chain of subprocess phases in one work
+directory, SIGKILLed at a NAMED fault point
+(:mod:`svoc_tpu.durability.faultspace`; the scenario maps each leg onto
+a registry event — ``svoc_tpu/durability/scenario.py``):
 
-1. runs the seeded serving scenario
-   (:func:`svoc_tpu.durability.scenario.run_durable_scenario`) in a
-   SUBPROCESS that SIGKILLs itself at the fault point (asserted: the
-   child died by SIGKILL, not cleanly);
-2. re-runs the same scenario in the same work directory: the child
-   auto-detects the durable state and recovers (snapshot restore →
-   fingerprint-checked journal ring → trace-tail replay → WAL
-   reconcile → resume serving → graceful drain);
-3. asserts over the recovered child's result:
-   **zero duplicate txs** in any chain log, **zero unknown and zero
-   unaccounted WAL slots** (the backend is reachable — every intent
-   classifies landed or stranded-resent), **zero unaccounted admitted
-   requests**, **zero open WAL cycles** after the drain.
+- ``mid_wal_append`` — ``torn`` @ ``wal.intent.pre_fsync`` (a
+  commit-intent record torn in half mid-fsync);
+- ``inter_tx`` — ``kill`` @ ``chainlog.tx.post_fsync`` (between tx *i*
+  landing on the chain log and its WAL ``landed`` record);
+- ``pre_snapshot`` — ``kill`` @ ``serving.step.post`` (after a serving
+  step's commits, before its cadence snapshot);
+- ``batch_mid_fleet`` — ``kill`` @ ``chain.batch.mid_fleet`` with
+  ``commit_mode="batched"``: the one-RPC batched commit killed while
+  logging its txs; the restart reconciler must classify the durable
+  prefix via its ``landed_batch``/chain-digest columns and resend only
+  the suffix (closing the PR 13 unit-test-only gap end-to-end);
+- ``recovery_storm`` — an ``inter_tx`` crash whose RECOVERY child is
+  itself killed at ``recovery.post_restore`` (ring restored, counters
+  not re-seeded, WAL not reconciled); the third child's recovery must
+  be idempotent.
 
-The FULL matrix runs twice; the recovered per-claim journal
-fingerprints must be byte-identical across the two matrix runs — the
-recovery path itself is part of the replay witness.
+After every chain's final (clean) child: **zero duplicate txs** in any
+chain log, **zero unknown and zero unaccounted WAL slots**, **zero
+unaccounted admitted requests**, **zero open WAL cycles**, and each
+leg's named fault point present in the durable fired log.  The FULL
+matrix runs twice; the recovered per-claim journal fingerprints must be
+byte-identical across the two matrix runs — the recovery path itself is
+part of the replay witness.
 
 Usage::
 
     python tools/crash_smoke.py [--seed 0] [--out CRASH_SMOKE.json]
-    python tools/crash_smoke.py --child <workdir> [--crash-point P]
+    python tools/crash_smoke.py --child <workdir> [--crash-point P] \\
+        [--commit-mode M]
 """
 
 from __future__ import annotations
@@ -48,9 +54,32 @@ import tempfile  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from svoc_tpu.durability.faultspace import read_fired_log  # noqa: E402
 from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
 
 TOTAL_STEPS = 8
+
+#: Leg → (phase chain, commit mode).  Every phase but the last must die
+#: by SIGKILL; the last recovers, drains, and writes result.json.
+LEGS = {
+    "mid_wal_append": (("mid_wal_append", None), "per_tx"),
+    "inter_tx": (("inter_tx", None), "per_tx"),
+    "pre_snapshot": (("pre_snapshot", None), "per_tx"),
+    "batch_mid_fleet": (("batch_mid_fleet", None), "batched"),
+    # The restart storm: crash, then kill the recovery itself, then a
+    # third child whose recovery must be idempotent.
+    "recovery_storm": (("inter_tx", "recovery_storm", None), "per_tx"),
+}
+
+#: The named point each leg must prove fired (the crash half of the
+#: declared-coverage contract; ``make chaos-fuzz-smoke`` owns the rest).
+LEG_POINT = {
+    "mid_wal_append": "wal.intent.pre_fsync",
+    "inter_tx": "chainlog.tx.post_fsync",
+    "pre_snapshot": "serving.step.post",
+    "batch_mid_fleet": "chain.batch.mid_fleet",
+    "recovery_storm": "recovery.post_restore",
+}
 
 
 def child_main(args) -> int:
@@ -61,6 +90,7 @@ def child_main(args) -> int:
         seed=args.seed,
         total_steps=TOTAL_STEPS,
         crash_point=args.crash_point,
+        commit_mode=args.commit_mode,
     )
     # Only the non-crashing (recovery / clean) phase reaches here.
     with open(os.path.join(args.child, "result.json"), "w") as f:
@@ -68,13 +98,17 @@ def child_main(args) -> int:
     return 0
 
 
-def spawn_child(workdir: str, seed: int, crash_point=None) -> subprocess.Popen:
+def spawn_child(
+    workdir: str, seed: int, crash_point=None, commit_mode=None
+) -> subprocess.Popen:
     cmd = [
         sys.executable, os.path.abspath(__file__),
         "--child", workdir, "--seed", str(seed),
     ]
     if crash_point is not None:
         cmd += ["--crash-point", crash_point]
+    if commit_mode is not None:
+        cmd += ["--commit-mode", commit_mode]
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     return subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -82,68 +116,72 @@ def spawn_child(workdir: str, seed: int, crash_point=None) -> subprocess.Popen:
     )
 
 
-def run_matrix(seed: int, crash_points, base_dir: str) -> dict:
-    """One full kill/restart matrix.  The fault points use disjoint
-    work directories, so the crash children run as one parallel wave
-    and the recovery children as a second — each child still pays the
-    full cold-process jax import (that isolation IS the experiment),
-    but the waves overlap it."""
+def run_matrix(seed: int, legs, base_dir: str) -> dict:
+    """One full kill/restart matrix.  The legs use disjoint work
+    directories, so each phase wave runs the legs in parallel — each
+    child still pays the full cold-process jax import (that isolation
+    IS the experiment), but the waves overlap it."""
     out = {
-        point: {"crash_point": point, "killed": None, "result": None,
-                "notes": []}
-        for point in crash_points
+        leg: {"crash_point": leg, "killed": [], "result": None,
+              "fired": None, "notes": []}
+        for leg in legs
     }
-    for point in crash_points:
-        os.makedirs(os.path.join(base_dir, point), exist_ok=True)
-    crash_procs = {
-        point: spawn_child(
-            os.path.join(base_dir, point), seed, crash_point=point
-        )
-        for point in crash_points
-    }
-    for point, proc in crash_procs.items():
-        _stdout, stderr = proc.communicate()
-        out[point]["killed"] = proc.returncode == -signal.SIGKILL
-        if not out[point]["killed"]:
-            out[point]["notes"].append(
-                f"child exited {proc.returncode}, expected SIGKILL; "
-                f"stderr tail: {stderr[-500:]}"
+    for leg in legs:
+        os.makedirs(os.path.join(base_dir, leg), exist_ok=True)
+    max_phases = max(len(LEGS[leg][0]) for leg in legs)
+    for phase in range(max_phases):
+        procs = {}
+        for leg in legs:
+            chain, commit_mode = LEGS[leg]
+            if phase >= len(chain):
+                continue
+            procs[leg] = (
+                spawn_child(
+                    os.path.join(base_dir, leg), seed,
+                    crash_point=chain[phase], commit_mode=commit_mode,
+                ),
+                chain[phase] is not None,  # expect SIGKILL?
             )
-    recover_procs = {
-        point: spawn_child(os.path.join(base_dir, point), seed)
-        for point in crash_points
-    }
-    for point, proc in recover_procs.items():
-        _stdout, stderr = proc.communicate()
-        if proc.returncode != 0:
-            out[point]["notes"].append(
-                f"recovery child exited {proc.returncode}; "
-                f"stderr tail: {stderr[-500:]}"
-            )
-        else:
-            with open(os.path.join(base_dir, point, "result.json")) as f:
-                out[point]["result"] = json.load(f)
+        for leg, (proc, expect_kill) in procs.items():
+            _stdout, stderr = proc.communicate()
+            killed = proc.returncode == -signal.SIGKILL
+            out[leg]["killed"].append(killed)
+            if expect_kill and not killed:
+                out[leg]["notes"].append(
+                    f"phase {phase} exited {proc.returncode}, expected "
+                    f"SIGKILL; stderr tail: {stderr[-500:]}"
+                )
+            elif not expect_kill:
+                if proc.returncode != 0:
+                    out[leg]["notes"].append(
+                        f"recovery phase exited {proc.returncode}; "
+                        f"stderr tail: {stderr[-500:]}"
+                    )
+                else:
+                    workdir = os.path.join(base_dir, leg)
+                    with open(os.path.join(workdir, "result.json")) as f:
+                        out[leg]["result"] = json.load(f)
+                    out[leg]["fired"] = read_fired_log(
+                        os.path.join(workdir, "fired.jsonl")
+                    )
     return out
 
 
 def check_matrix(matrix: dict) -> dict:
     checks = {}
-    for point, entry in matrix.items():
+    for leg, entry in matrix.items():
+        chain, _mode = LEGS[leg]
         r = entry["result"]
-        ok = (
-            entry["killed"]
-            and r is not None
-            and r["recovered"]
-            and r["duplicate_txs"] == 0
-            and all(c["duplicates"] == 0 for c in r["chain"].values())
-            and not r["wal_open_cycles"]
-            and r["requests"]["unaccounted"] == 0
-            and r["steps"] == TOTAL_STEPS
+        kills_ok = (
+            len(entry["killed"]) == len(chain)
+            and all(entry["killed"][:-1])
+            and not entry["killed"][-1]
         )
+        fired = (entry["fired"] or {}).get("fired", [])
         rec = (r or {}).get("recovery") or {}
         reconcile = rec.get("reconcile") or {}
-        checks[point] = {
-            "killed_by_sigkill": bool(entry["killed"]),
+        checks[leg] = {
+            "killed_by_sigkill": kills_ok,
             "recovered": bool(r and r["recovered"]),
             "zero_duplicate_txs": bool(r and r["duplicate_txs"] == 0),
             "zero_open_wal_cycles": bool(r and not r["wal_open_cycles"]),
@@ -153,10 +191,34 @@ def check_matrix(matrix: dict) -> dict:
                 r and r["requests"]["unaccounted"] == 0
             ),
             "ran_to_completion": bool(r and r["steps"] == TOTAL_STEPS),
-            "ok": ok,
+            "named_point_fired": LEG_POINT[leg] in fired,
             "notes": entry["notes"],
         }
+        if leg == "batch_mid_fleet":
+            # The PR 13 gap, closed: the mid-batch kill must classify
+            # through the reconciler's landed_batch/chain-digest
+            # columns — a durable prefix held (landed), a suffix resent.
+            counts = _reconcile_counts(reconcile)
+            checks[leg]["batch_prefix_landed"] = (
+                counts.get("landed_chain", 0)
+                + counts.get("landed_batch", 0)
+                + counts.get("landed_durable", 0)
+            ) >= 1
+            checks[leg]["batch_suffix_resent"] = (
+                reconcile.get("resent", 0) >= 1
+            )
+        checks[leg]["ok"] = all(
+            v for k, v in checks[leg].items() if k != "notes"
+        )
     return checks
+
+
+def _reconcile_counts(reconcile: dict) -> dict:
+    totals: dict = {}
+    for cyc in reconcile.get("cycles", []):
+        for k, v in (cyc.get("counts") or {}).items():
+            totals[k] = totals.get(k, 0) + v
+    return totals
 
 
 def main(argv=None) -> int:
@@ -166,32 +228,34 @@ def main(argv=None) -> int:
     p.add_argument("--child", default=None, help="(internal) scenario workdir")
     p.add_argument(
         "--crash-point", default=None,
-        choices=["mid_wal_append", "inter_tx", "pre_snapshot"],
+        choices=sorted({pt for chain, _ in LEGS.values()
+                        for pt in chain if pt}),
     )
+    p.add_argument("--commit-mode", default=None,
+                   choices=["per_tx", "batched"])
     args = p.parse_args(argv)
     if args.child is not None:
         return child_main(args)
 
-    from svoc_tpu.durability.scenario import CRASH_POINTS
-
+    legs = list(LEGS)
     base = tempfile.mkdtemp(prefix="crash-smoke-")
-    first = run_matrix(args.seed, CRASH_POINTS, os.path.join(base, "run1"))
-    second = run_matrix(args.seed, CRASH_POINTS, os.path.join(base, "run2"))
+    first = run_matrix(args.seed, legs, os.path.join(base, "run1"))
+    second = run_matrix(args.seed, legs, os.path.join(base, "run2"))
     checks = check_matrix(first)
 
     fingerprints = {}
-    for point in CRASH_POINTS:
-        r1 = first[point]["result"] or {}
-        r2 = second[point]["result"] or {}
+    for leg in legs:
+        r1 = first[leg]["result"] or {}
+        r2 = second[leg]["result"] or {}
         c1 = {c: v["fingerprint"] for c, v in (r1.get("claims") or {}).items()}
         c2 = {c: v["fingerprint"] for c, v in (r2.get("claims") or {}).items()}
-        fingerprints[point] = {
+        fingerprints[leg] = {
             "identical": bool(c1) and c1 == c2,
             "claims": c1,
         }
     all_checks = {
-        f"{point}.{name}": value
-        for point, ch in checks.items()
+        f"{leg}.{name}": value
+        for leg, ch in checks.items()
         for name, value in ch.items()
         if name not in ("ok", "notes")
     }
@@ -202,17 +266,19 @@ def main(argv=None) -> int:
     artifact = {
         "seed": args.seed,
         "total_steps": TOTAL_STEPS,
-        "crash_points": list(CRASH_POINTS),
+        "crash_points": legs,
         "checks": checks,
         "fingerprints": fingerprints,
         "ok": ok,
         "matrix": {
-            point: {
-                "killed": first[point]["killed"],
-                "notes": first[point]["notes"],
-                "result": first[point]["result"],
+            leg: {
+                "killed": first[leg]["killed"],
+                "commit_mode": LEGS[leg][1],
+                "fired": first[leg]["fired"],
+                "notes": first[leg]["notes"],
+                "result": first[leg]["result"],
             }
-            for point in CRASH_POINTS
+            for leg in legs
         },
     }
     atomic_write_json(args.out, artifact)
@@ -220,8 +286,9 @@ def main(argv=None) -> int:
         print(f"  {'PASS' if passed else 'FAIL'}  {name}")
     print(
         f"crash-smoke {'OK' if ok else 'FAILED'}: "
-        f"{len(CRASH_POINTS)} kill points x 2 matrix runs, "
-        f"0 duplicate txs asserted over the chain logs -> {args.out}"
+        f"{len(legs)} kill legs (incl. batched mid-fleet + restart "
+        f"storm) x 2 matrix runs, 0 duplicate txs asserted over the "
+        f"chain logs -> {args.out}"
     )
     return 0 if ok else 1
 
