@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving bench-shard bench-hotpath campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke fabric-smoke serving-smoke crash-smoke chaos-fuzz-smoke shard-smoke hotpath-smoke pallas-parity clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving bench-shard bench-hotpath bench-coldstart campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke fabric-smoke serving-smoke crash-smoke chaos-fuzz-smoke shard-smoke hotpath-smoke coldstart-smoke pallas-parity clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -135,6 +135,17 @@ shard-smoke:
 hotpath-smoke:
 	$(PY) tools/hotpath_smoke.py
 
+# Compile-plane gate (docs/PARALLELISM.md §compile-plane): the seeded
+# 4-claim fabric scenario three ways — unwarmed control, AOT-prewarmed
+# over a persistent compilation cache (the child then SIGKILLed), and
+# a fresh process restarted on the killed child's cache dir.  Asserts
+# byte-identical per-claim + whole-journal fingerprints across all
+# three (warmup never journals, never changes numerics) and ZERO
+# persistent-cache misses in the restarted child — a warm restart does
+# 0 fresh compiles.  ~1 min on CPU.
+coldstart-smoke:
+	$(PY) tools/coldstart_smoke.py
+
 # Crash-consistency gate (docs/RESILIENCE.md §durability): the seeded
 # serving scenario SIGKILLed at 5 NAMED fault-point legs
 # (mid-WAL-append torn intent, between tx i and i+1, post-commit
@@ -166,7 +177,7 @@ chaos-fuzz-smoke:
 # convergence gates (I/O-plane, then data-plane), then the flight
 # recorder, then the fabric and serving tiers, then crash consistency
 # and the fault-space fuzzer, then the suite.
-verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke fabric-smoke shard-smoke serving-smoke hotpath-smoke chaos-fuzz-smoke crash-smoke test
+verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke fabric-smoke shard-smoke serving-smoke hotpath-smoke coldstart-smoke chaos-fuzz-smoke crash-smoke test
 
 # End-of-round gate: lint + the driver-contract guards FIRST (fast,
 # loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
@@ -182,6 +193,7 @@ presnapshot:
 	$(MAKE) shard-smoke
 	$(MAKE) serving-smoke
 	$(MAKE) hotpath-smoke
+	$(MAKE) coldstart-smoke
 	$(MAKE) chaos-fuzz-smoke
 	$(MAKE) crash-smoke
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -214,6 +226,15 @@ bench-shard:
 # routing decision).
 bench-hotpath:
 	$(PY) bench_hotpath.py
+
+# Cold-start A/B (docs/PARALLELISM.md §compile-plane): first-request
+# latency on an unseen claim bucket, cold vs AOT-prewarmed vs a
+# persistent-compilation-cache hit across a literal process restart →
+# BENCH_COLDSTART_r09.json (CPU-honest, device_topology-stamped;
+# parsed by tools/decide_perf.py into the warmup_mode /
+# compilation_cache routing decisions).
+bench-coldstart:
+	$(PY) bench_coldstart.py
 
 # Round-long liveness-gated hardware measurement campaign (resumes its
 # HW_CAMPAIGN.json journal; run in the background for the whole round).

@@ -3267,6 +3267,11 @@ def main(argv=None) -> int:
         _pin_platform(platform)
         import jax
 
+        # Compile-plane series start counting before the first jit —
+        # the detail.compile digest below reads them.
+        from svoc_tpu.utils.metrics import install_compile_listener
+
+        install_compile_listener()
         result = CONFIGS[args.config](args.seconds, small, platform)
         result.setdefault("detail", {})
         result["detail"]["backend"] = jax.devices()[0].platform
@@ -3291,6 +3296,13 @@ def main(argv=None) -> int:
 
         if _journal.last_seq():
             result["detail"]["journal"] = _journal.summary()
+        # Compile-plane digest (docs/PARALLELISM.md §compile-plane):
+        # how much of the run went to XLA compiles vs persistent-cache
+        # retrievals — a bench dominated by compile time is measuring
+        # the wrong thing and the artifact should say so.
+        from svoc_tpu.utils.metrics import compile_snapshot as _compile
+
+        result["detail"]["compile"] = _compile()
         if fallback_reason:
             result["detail"]["backend_fallback"] = fallback_reason
         if small:

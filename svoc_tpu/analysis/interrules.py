@@ -482,6 +482,17 @@ def rule_svoc010(program: Program, ctx: PackageContext) -> List[Finding]:
 
 _ENTRY_RE = re.compile(r"^_?(step|serving_step|submit|fetch|drain|tick)$|^_?dispatch")
 
+#: Construction-time bodies EXEMPT from the per-step entry heuristic:
+#: the compile plane's prewarm/warmup workers deliberately name their
+#: unit-of-work ``step()`` (``PrewarmWorker.step`` walks one compile
+#: key), but warming is ahead-of-traffic construction work — it runs
+#: the same knob-resolution and jit paths a dispatch does, BEFORE any
+#: dispatch exists, so flagging it would force suppressions on every
+#: warmup body.  Matched against the QUALIFIED name: any function whose
+#: class or name says prewarm/warmup is construction-time by contract
+#: (docs/PARALLELISM.md §compile-plane).
+_CONSTRUCTION_RE = re.compile(r"(?i)prewarm|warmup")
+
 _KNOB_LEAVES = {
     "resolve_consensus_impl",
     "resolve_claim_mesh",
@@ -509,6 +520,8 @@ def rule_svoc011(program: Program, ctx: PackageContext) -> List[Finding]:
     for module in program.modules.values():
         for fs in module.functions:
             if not _ENTRY_RE.match(fs.name):
+                continue
+            if _CONSTRUCTION_RE.search(fs.qual):
                 continue
             entry = f"{module.path}::{fs.qual}"
             # collect EVERY knob read reachable from this entry (not

@@ -1,0 +1,82 @@
+"""The compile plane (docs/PARALLELISM.md §compile-plane).
+
+PRs 6–13 bounded the SHAPE universe the jitted dispatchers can see
+(pow2 claim buckets, construction-pinned impl/mesh/commit-mode) but
+nothing ever compiled AHEAD of traffic: the first request landing on a
+new shape paid the full trace+compile inside a serving step, and every
+process restart (the PR 8 crash/recovery story) paid the whole universe
+again.  This package closes that gap:
+
+- :mod:`svoc_tpu.compile.universe` — enumerate the reachable compile
+  keys from LIVE config (registry groups × pow2 buckets × resolved
+  impl/mesh/donate), never by guessing;
+- :mod:`svoc_tpu.compile.prewarm` — the AOT warmup worker that walks
+  that universe through ``jax.jit(...).lower(...).compile()`` on the
+  SAME jitted callables the router dispatches, with a bounded time
+  budget and ``compile_prewarm{outcome=}`` accounting;
+- :mod:`svoc_tpu.compile.cache` — the persistent on-disk XLA
+  compilation cache under the durability base dir (versioned salt,
+  size-capped eviction) that makes recovery restarts warm.
+
+The plane is OBSERVATION + AHEAD-OF-TIME work only: it never journals,
+never changes numerics, and seeded replay fingerprints are
+byte-identical with it on or off (``make coldstart-smoke`` is the
+gate).
+
+Re-exports are PEP 562 LAZY: ``universe``/``prewarm`` import
+``consensus.batch`` (and therefore jax) at module level, while
+``cache`` deliberately keeps jax inside function bodies — an eager
+``__init__`` would make ``from svoc_tpu.compile.cache import ...`` (the
+RecoveryManager constructor path, reachable from jax-free durable-plane
+consumers) pay the multi-second jax import for nothing.
+"""
+
+from typing import TYPE_CHECKING
+
+_EXPORTS = {
+    "cache_salt": "svoc_tpu.compile.cache",
+    "cache_stats": "svoc_tpu.compile.cache",
+    "enable_persistent_cache": "svoc_tpu.compile.cache",
+    "evict_cache": "svoc_tpu.compile.cache",
+    "kernel_revision": "svoc_tpu.compile.cache",
+    "persistent_cache_dir": "svoc_tpu.compile.cache",
+    "PrewarmConfig": "svoc_tpu.compile.prewarm",
+    "PrewarmWorker": "svoc_tpu.compile.prewarm",
+    "CompileKey": "svoc_tpu.compile.universe",
+    "dispatch_key": "svoc_tpu.compile.universe",
+    "enumerate_universe": "svoc_tpu.compile.universe",
+    "registry_groups": "svoc_tpu.compile.universe",
+}
+
+__all__ = sorted(_EXPORTS)
+
+if TYPE_CHECKING:  # pragma: no cover — the eager twins, for tooling
+    from svoc_tpu.compile.cache import (  # noqa: F401
+        cache_salt,
+        cache_stats,
+        enable_persistent_cache,
+        evict_cache,
+        kernel_revision,
+        persistent_cache_dir,
+    )
+    from svoc_tpu.compile.prewarm import (  # noqa: F401
+        PrewarmConfig,
+        PrewarmWorker,
+    )
+    from svoc_tpu.compile.universe import (  # noqa: F401
+        CompileKey,
+        dispatch_key,
+        enumerate_universe,
+        registry_groups,
+    )
+
+
+def __getattr__(name: str):
+    module_name = _EXPORTS.get(name)
+    if module_name is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    return getattr(importlib.import_module(module_name), name)

@@ -1,0 +1,256 @@
+"""Persistent on-disk XLA compilation cache (docs/PARALLELISM.md
+§compile-plane, docs/RESILIENCE.md §compile-cache).
+
+A process restart (crash recovery, deploy, drain/restart) loses every
+compiled executable: PR 8 made the CHAIN and journal state survive a
+kill, but the restarted process still re-paid the whole compile
+universe before serving its first request.  This module points JAX's
+persistent compilation cache (``jax_compilation_cache_dir``) at a
+directory UNDER the durability base dir, so compiled programs survive
+the same kill/restart cycle the WAL and snapshots do — a warm restart's
+backend compiles become millisecond cache retrievals
+(``bench_coldstart.py`` measures the ratio honestly on this host).
+
+Versioning: the cache lives under a SALT subdirectory covering the jax
+version and a digest of the repo's kernel-relevant sources
+(:func:`kernel_revision`).  JAX's own cache key already covers the
+serialized HLO, so a kernel edit would naturally miss — the salt exists
+so a jax upgrade or kernel rewrite INVALIDATES the old entries loudly
+(the stale salt dir is deleted at enable time) instead of leaving dead
+weight under the durability dir forever.
+
+Size cap: :func:`evict_cache` drops least-recently-USED entries (JAX
+maintains a ``*-atime`` touch file per entry) until the directory fits
+``max_bytes``; :meth:`~svoc_tpu.durability.recovery.RecoveryManager`
+runs it on its snapshot cadence.  The cache dir is durable state but
+NOT journal state: WAL rotation and trace rotation never touch it
+(docs/RESILIENCE.md).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_registry
+
+#: Subdirectory of the durability base dir holding every salt's cache.
+CACHE_DIRNAME = "xla_cache"
+
+#: Default size cap (bytes) for :func:`enable_persistent_cache` — a few
+#: hundred claim-cube programs at CPU sizes; TPU executables are larger
+#: but the cap is an operator knob, not a constant of nature.
+DEFAULT_MAX_BYTES = 256 * 1024 * 1024
+
+#: The kernel-relevant sources the salt digests: the modules whose
+#: edits change what the dispatched consensus programs COMPUTE (a
+#: rename elsewhere must not invalidate a warm fleet's cache).
+KERNEL_SOURCES = (
+    "consensus/kernel.py",
+    "consensus/batch.py",
+    "ops/sort.py",
+    "ops/stats.py",
+    "ops/select.py",
+    "ops/pallas_consensus.py",
+    "robustness/sanitize.py",
+    "parallel/claim_shard.py",
+)
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_state_lock = threading.Lock()
+#: The enabled cache dir (None until :func:`enable_persistent_cache`);
+#: status surfaces read it, nothing on a hot path does.
+_enabled_dir: Optional[str] = None
+
+
+def kernel_revision() -> str:
+    """sha256 over the kernel-relevant sources (sorted, content only)
+    — the repo half of the cache salt.  A missing file contributes its
+    name (a deleted kernel module IS a revision change)."""
+    digest = hashlib.sha256()
+    for rel in sorted(KERNEL_SOURCES):
+        digest.update(rel.encode())
+        path = os.path.join(_PKG_ROOT, rel)
+        try:
+            with open(path, "rb") as f:
+                digest.update(f.read())
+        except OSError:
+            digest.update(b"<absent>")
+    return digest.hexdigest()
+
+
+def cache_salt() -> str:
+    """``jax<version>-k<kernel digest>`` — the versioned subdirectory
+    name.  jax's own cache key also covers its version; the salt makes
+    the invalidation VISIBLE (stale dirs deleted, not just missed)."""
+    import jax
+
+    return f"jax{jax.__version__}-k{kernel_revision()[:12]}"
+
+
+def persistent_cache_dir(base_dir: str) -> str:
+    """The salted cache directory under ``base_dir`` (not created)."""
+    return os.path.join(base_dir, CACHE_DIRNAME, cache_salt())
+
+
+def enabled_cache_dir() -> Optional[str]:
+    """The directory a prior :func:`enable_persistent_cache` pointed
+    JAX at, or None — the status/snapshot surfaces' view."""
+    with _state_lock:
+        return _enabled_dir
+
+
+def enable_persistent_cache(
+    base_dir: str,
+    *,
+    max_bytes: int = DEFAULT_MAX_BYTES,
+    metrics: Optional[MetricsRegistry] = None,
+) -> Optional[str]:
+    """Point JAX's persistent compilation cache under ``base_dir``.
+
+    Creates the salted dir, DELETES sibling stale-salt dirs (the
+    versioned invalidation — an old jax/kernel revision's entries can
+    never be read again), drops the min-compile-time/min-entry-size
+    thresholds to zero (this host's CPU compiles are fast but a restart
+    re-pays ALL of them — restart warmth is the contract, not disk
+    thrift; the size cap bounds the disk side), and runs one eviction
+    pass.  Idempotent; re-enabling with the same base dir is a no-op
+    refresh.  Returns the cache dir, or None when the jax config
+    surface is absent (API drift degrades to a counted no-op, never a
+    crash — serving works uncached)."""
+    reg = metrics or _default_registry
+    target = persistent_cache_dir(base_dir)
+    try:
+        os.makedirs(target, exist_ok=True)
+        parent = os.path.dirname(target)
+        for name in os.listdir(parent):
+            stale = os.path.join(parent, name)
+            if stale != target and os.path.isdir(stale):
+                shutil.rmtree(stale, ignore_errors=True)
+                reg.counter(
+                    "compile_cache_invalidated", labels={"salt": name}
+                ).add(1)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", target)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_enable_compilation_cache", True)
+        # jax caches its cache OBJECT on first use and does not watch
+        # the config: re-pointing the dir (a second enable, tests, a
+        # manager built after an earlier one) silently keeps writing to
+        # the OLD dir without this reset (measured on jax 0.4.37).
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
+
+        _cc.reset_cache()
+    except (OSError, ImportError, AttributeError, ValueError) as e:
+        # ImportError included: jax.experimental.compilation_cache is a
+        # private-ish surface that has moved between jax versions — a
+        # relocation must degrade to uncached serving, never crash
+        # RecoveryManager construction (i.e. crash recovery itself).
+        reg.counter(
+            "compile_cache_errors", labels={"op": "enable"}
+        ).add(1)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "persistent compilation cache NOT enabled (%s: %s); serving "
+            "continues uncached — restarts stay cold",
+            type(e).__name__,
+            e,
+        )
+        return None
+    with _state_lock:
+        global _enabled_dir
+        _enabled_dir = target
+    evict_cache(target, max_bytes, metrics=reg)
+    return target
+
+
+def _entries(cache_dir: str) -> List[Tuple[str, float, int]]:
+    """``(entry_path, last_used, bytes)`` per cache entry.  JAX writes
+    a ``<key>-cache`` payload plus a ``<key>-atime`` touch file it
+    refreshes on every hit; last-used falls back to the payload's mtime
+    for entries whose atime twin is missing (a torn write — still
+    evictable)."""
+    out: List[Tuple[str, float, int]] = []
+    try:
+        names = os.listdir(cache_dir)
+    except OSError:
+        return out
+    present = set(names)
+    for name in names:
+        if name.endswith("-atime"):
+            continue
+        path = os.path.join(cache_dir, name)
+        try:
+            size = os.path.getsize(path)
+            atime_name = None
+            if name.endswith("-cache"):
+                candidate = name[: -len("-cache")] + "-atime"
+                if candidate in present:
+                    atime_name = candidate
+            if atime_name is not None:
+                last_used = os.path.getmtime(
+                    os.path.join(cache_dir, atime_name)
+                )
+            else:
+                last_used = os.path.getmtime(path)
+        except OSError:
+            continue
+        out.append((path, last_used, size))
+    return out
+
+
+def evict_cache(
+    cache_dir: str,
+    max_bytes: int,
+    *,
+    metrics: Optional[MetricsRegistry] = None,
+) -> Dict[str, float]:
+    """Least-recently-used eviction down to ``max_bytes``; returns the
+    post-eviction stats.  Evictions are counted
+    (``compile_cache_evictions``) and the resident size is a gauge
+    (``compile_cache_bytes``) — a cache silently thrashing its cap
+    would otherwise read as mysterious cold-start regressions."""
+    reg = metrics or _default_registry
+    entries = sorted(_entries(cache_dir), key=lambda e: e[1])
+    total = sum(size for _p, _t, size in entries)
+    evicted = 0
+    while entries and total > max_bytes:
+        path, _last_used, size = entries.pop(0)
+        try:
+            os.remove(path)
+            atime = path[: -len("-cache")] + "-atime" if path.endswith(
+                "-cache"
+            ) else None
+            if atime and os.path.exists(atime):
+                os.remove(atime)
+        except OSError:
+            continue
+        total -= size
+        evicted += 1
+    if evicted:
+        reg.counter("compile_cache_evictions").add(evicted)
+    reg.gauge("compile_cache_bytes").set(float(max(0, total)))
+    return {"bytes": float(max(0, total)), "evicted": float(evicted)}
+
+
+def cache_stats(cache_dir: Optional[str] = None) -> Dict[str, float]:
+    """``{entries, bytes}`` for the enabled (or given) cache dir — the
+    durability status panel's view.  Zeros when nothing is enabled."""
+    cache_dir = cache_dir if cache_dir is not None else enabled_cache_dir()
+    if not cache_dir:
+        return {"entries": 0.0, "bytes": 0.0}
+    entries = _entries(cache_dir)
+    return {
+        "entries": float(len(entries)),
+        "bytes": float(sum(size for _p, _t, size in entries)),
+    }

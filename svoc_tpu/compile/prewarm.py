@@ -1,0 +1,492 @@
+"""The AOT prewarm worker: compile the shape universe ahead of traffic.
+
+Cold start is the one latency the serving tier could not hide: the
+first request landing on a new (bucket, group, variant) pays trace +
+lower + XLA backend compile INSIDE a serving step — ~0.7 s on this
+host's CPU for one claim-cube program against a ~5 ms steady-state
+dispatch (``bench_coldstart.py``), and far worse on a real TPU's Mosaic
+pipeline.  The worker walks the enumerated universe
+(:mod:`svoc_tpu.compile.universe`) in priority order and, per key:
+
+1. **AOT-compiles** through ``fn.lower(shapes...).compile()`` on the
+   SAME module-level jitted callables the router dispatches
+   (:func:`svoc_tpu.consensus.batch.jit_dispatcher` — a parallel
+   re-jit would fill a different jit cache and the first dispatch
+   would recompile anyway), timing each into the
+   ``prewarm_compile_seconds`` histogram and populating the persistent
+   compilation cache when one is enabled
+   (:mod:`svoc_tpu.compile.cache`);
+2. **primes the dispatch path** with one all-padding dummy cube
+   (``claim_mask`` all-False — every output row is the kernel's forced
+   invalid/zero state) through the PUBLIC dispatch wrappers, so the
+   first real request doesn't even pay the re-lowering: trace cache,
+   jit dispatch cache, and (on a pallas/sharded route) the Mosaic /
+   shard_map caches are all hot.
+
+Accounting: every key ends in ``compile_prewarm{outcome=}`` —
+``compiled`` / ``primed`` / ``skipped`` / ``error`` /
+``budget_exhausted`` — and the
+worker NEVER journals: warmup must be invisible to seeded replay
+fingerprints (the ``make coldstart-smoke`` gate), so its only traces
+are metrics and compiled code.  The time budget bounds the walk;
+priority order means the cut falls on twin variants, not the
+serving-critical head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from svoc_tpu.compile.universe import (
+    CompileKey,
+    enumerate_universe,
+    registry_groups,
+    universe_summary,
+)
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_registry
+
+_log = logging.getLogger(__name__)
+
+PREWARM_COUNTER = "compile_prewarm"
+PREWARM_HISTOGRAM = "prewarm_compile_seconds"
+
+
+@dataclasses.dataclass(frozen=True)
+class PrewarmConfig:
+    """The worker's knobs.  ``budget_s=None`` walks the whole universe
+    (restart prewarms are cheap — persistent-cache retrievals);
+    ``prime=False`` stops after the AOT compile (populates the
+    persistent cache but leaves re-lowering to the first dispatch —
+    the bench's mid point).  Priming is the ONLY warmup a sharded or
+    pallas-routed key has (the AOT branch covers the unsharded XLA
+    twins), so ``prime=False`` counts such keys ``skipped`` and leaves
+    them cold rather than pretending."""
+
+    budget_s: Optional[float] = None
+    prime: bool = True
+    include_twins: bool = True
+
+    def __post_init__(self):
+        if self.budget_s is not None and self.budget_s <= 0:
+            raise ValueError("budget_s must be > 0 (or None)")
+
+
+class PrewarmWorker:
+    """Walks one router's compile universe; owns no thread until
+    :meth:`start` and never outlives :meth:`wait`.
+
+    The router's construction-pinned resolution (impl / mesh / donate /
+    gate fusion) is read ONCE here, at worker construction — the worker
+    inherits the replay-pinning discipline (docs/FABRIC.md §replay)
+    rather than re-resolving knobs per key.
+    """
+
+    def __init__(
+        self,
+        router,
+        registry,
+        *,
+        metrics: Optional[MetricsRegistry] = None,
+        config: Optional[PrewarmConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.router = router
+        self.registry = registry
+        self.config = config or PrewarmConfig()
+        self._metrics = metrics or _default_registry
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._warm: set = set()
+        self._universe: Optional[List[CompileKey]] = None
+        #: (N, M, cfg) group -> its PRIMARY keys (the pinned variant's
+        #: bucket ladder), cached at enumeration so the defer gate's
+        #: per-submit ``group_cold`` reads a list instead of re-deriving
+        #: dataclasses on the serving path.
+        self._primary: Dict[Any, List[CompileKey]] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._done = threading.Event()
+        self._started = False
+        self._report: Optional[Dict[str, Any]] = None
+
+    # -- the universe --------------------------------------------------------
+
+    def universe(
+        self,
+        refresh: bool = False,
+        include_twins: Optional[bool] = None,
+    ) -> List[CompileKey]:
+        """Enumerate (and cache) the router's compile universe from the
+        LIVE registry — claims added after construction are picked up
+        by ``refresh=True`` (the next :meth:`warm_all` call does).
+        ``include_twins`` overrides the config default PER WALK: the
+        synchronous recovery walk excludes twins for time-to-serve, and
+        the subsequent background walk re-includes them — one worker,
+        two walk shapes (the config would otherwise pin the first
+        caller's choice for the process lifetime)."""
+        with self._lock:
+            if self._universe is not None and not refresh:
+                return list(self._universe)
+        router = self.router
+        keys = enumerate_universe(
+            registry_groups(self.registry),
+            max_claims_per_batch=router.max_claims_per_batch,
+            sanitized_dispatch=router.sanitized_dispatch,
+            donate=router._donate,
+            impl=router.consensus_impl,
+            mesh=router.mesh_spec,
+            mesh_claim_size=(
+                router._shard.claim_size if router._shard else 1
+            ),
+            include_twins=(
+                include_twins
+                if include_twins is not None
+                else self.config.include_twins
+            ),
+        )
+        primary = {
+            group: self._primary_keys(*group)
+            for group in {k.group() for k in keys}
+        }
+        with self._lock:
+            self._universe = keys
+            self._primary = primary
+        return list(keys)
+
+    # -- warmth queries (router + serving frontend) --------------------------
+
+    def is_warm(self, key: CompileKey) -> bool:
+        with self._lock:
+            return key in self._warm
+
+    @property
+    def active(self) -> bool:
+        """True while a started walk has not finished — the serving
+        frontend's cold-shape deferral window (a worker that was never
+        started defers nothing: without a warmup in flight, waiting
+        would never end)."""
+        return self._started and not self._done.is_set()
+
+    def _primary_keys(self, n_oracles: int, dimension: int, cfg):
+        """The keys the PINNED router can actually dispatch for one
+        (N, M, cfg) group: the primary variant (the router's gate
+        fusion / donate / impl / mesh) across the bucket ladder.  Twin
+        variants exist in the universe for the NEXT restart's possible
+        config flips — this process can never dispatch them, so the
+        defer gate must not wait on them."""
+        from svoc_tpu.compile.universe import bucket_ladder, dispatch_key
+
+        router = self.router
+        sharded = router.mesh_spec is not None
+        ladder = bucket_ladder(
+            router.max_claims_per_batch,
+            multiple_of=router._shard.claim_size if router._shard else 1,
+        )
+        return [
+            dispatch_key(
+                sanitized=router.sanitized_dispatch,
+                sharded=sharded,
+                bucket=bucket,
+                n_oracles=n_oracles,
+                dimension=dimension,
+                cfg=cfg,
+                donate=router._donate,
+                impl=router.consensus_impl,
+                mesh=router.mesh_spec,
+            )
+            for bucket in ladder
+        ]
+
+    def group_cold(self, n_oracles: int, dimension: int, cfg) -> bool:
+        """Whether a (N, M, cfg) dispatch group can still hit a cold
+        compile while the walk is in flight — the claim-level question
+        the serving frontend's defer gate asks.  Gates on the PRIMARY
+        keys only (the variants the construction-pinned router can
+        actually dispatch): the walk warms those in its head phases,
+        so the defer window closes as soon as the group's real dispatch
+        surface is compiled, not when the restart-insurance twins at
+        the tail of the walk finish."""
+        if not self.active:
+            return False
+        group = (n_oracles, dimension, cfg)
+        with self._lock:
+            primary = self._primary.get(group)
+            if primary is not None:
+                # Membership checks under the lock — no per-request
+                # copy of the warm set on the submit path.
+                return any(k not in self._warm for k in primary)
+        # A claim registered after enumeration: its keys join the
+        # NEXT walk; until then it is genuinely cold.
+        primary = self._primary_keys(*group)
+        with self._lock:
+            self._primary.setdefault(group, primary)
+            return any(k not in self._warm for k in primary)
+
+    def claim_cold(self, spec) -> bool:
+        return self.group_cold(
+            spec.n_oracles, spec.dimension, spec.consensus_config()
+        )
+
+    # -- one key -------------------------------------------------------------
+
+    def step(self, key: CompileKey) -> str:
+        """Warm ONE key; returns the recorded outcome.  Deliberately a
+        jit-compile in a caller's loop (SVOC003's hazard is recompiles
+        on the DISPATCH path; compiling ahead of it is this module's
+        whole purpose) and deliberately construction-time work even
+        when driven from a background thread mid-serving."""
+        if key.donate:
+            # The donated twin warns once per compiled shape on
+            # backends whose output layouts can't alias the cube (CPU)
+            # — expected noise here exactly as on the device-resident
+            # router; install the shared filter BEFORE the AOT compile
+            # (the warning fires at compile time, not dispatch).
+            from svoc_tpu.fabric.router import _filter_donation_warning_once
+
+            _filter_donation_warning_once()
+        try:
+            outcome = self._warm_one(key)
+        except Exception as e:  # noqa: BLE001 — a broken shape must not kill the walk
+            outcome = "error"
+            _log.warning(
+                "prewarm failed for %s (%s: %s); the first real "
+                "dispatch of this shape will compile inline instead",
+                key.label(),
+                type(e).__name__,
+                e,
+            )
+        self._metrics.counter(
+            PREWARM_COUNTER, labels={"outcome": outcome}
+        ).add(1)
+        if outcome in ("compiled", "primed"):
+            with self._lock:
+                self._warm.add(key)
+        return outcome
+
+    def _warm_one(self, key: CompileKey) -> str:
+        import jax
+        import jax.numpy as jnp
+
+        from svoc_tpu.consensus.batch import _PAD_VALUE, jit_dispatcher
+
+        sanitized = key.kind.endswith("sanitized")
+        sharded = key.kind.startswith("sharded_")
+        lo, hi = self._bounds(key) if sanitized else (None, None)
+        compiled_aot = False
+        if not sharded and key.impl == "xla":
+            # AOT through the very jit objects the router calls; the
+            # wall time (a fresh XLA compile OR a persistent-cache
+            # retrieval — the histogram tells them apart by magnitude)
+            # is the per-shape compile latency the bench reports.
+            fn = jit_dispatcher(sanitized, key.donate)
+            sds = jax.ShapeDtypeStruct
+            values = sds(
+                (key.bucket, key.n_oracles, key.dimension), jnp.float32
+            )
+            mask = sds((key.bucket,), jnp.bool_)
+            t0 = self._clock()
+            if sanitized:
+                lowered = fn.lower(values, mask, key.cfg, lo, hi)
+            else:
+                ok = sds((key.bucket, key.n_oracles), jnp.bool_)
+                lowered = fn.lower(values, ok, mask, key.cfg)
+            lowered.compile()
+            self._metrics.histogram(PREWARM_HISTOGRAM).observe(
+                max(0.0, self._clock() - t0)
+            )
+            compiled_aot = True
+        if not self.config.prime:
+            # Without priming, only the AOT branch did real work: a
+            # sharded or pallas-routed key compiled NOTHING and must
+            # not be marked warm (the defer gate and warmth counters
+            # would lie about it) — counted ``skipped`` instead.
+            return "compiled" if compiled_aot else "skipped"
+        self._prime(key, sanitized, sharded, lo, hi, _PAD_VALUE)
+        return "compiled" if compiled_aot else "primed"
+
+    def _prime(self, key, sanitized, sharded, lo, hi, pad_value) -> None:
+        """One dummy dispatch through the PUBLIC wrappers — the exact
+        call the router makes, on an all-padding cube whose outputs the
+        kernel forces invalid.  Discarded after the device sync; no
+        journal, no state."""
+        import jax
+        import jax.numpy as jnp
+
+        from svoc_tpu.consensus.batch import (
+            claims_consensus_gated,
+            claims_consensus_sanitized,
+        )
+
+        values = jnp.full(
+            (key.bucket, key.n_oracles, key.dimension),
+            pad_value,
+            dtype=jnp.float32,
+        )
+        mask = jnp.zeros((key.bucket,), dtype=bool)
+        if sharded:
+            shard = self.router._shard
+            if shard is None:
+                raise RuntimeError(
+                    f"{key.label()} is a sharded key but the router "
+                    "has no mesh — stale universe"
+                )
+            ok = jnp.ones((key.bucket, key.n_oracles), dtype=bool)
+            if sanitized:
+                out = shard.dispatch_sanitized(
+                    values, mask, key.cfg, lo, hi
+                )
+            else:
+                out = shard.dispatch_gated(values, ok, mask, key.cfg)
+        elif sanitized:
+            out = claims_consensus_sanitized(
+                values,
+                mask,
+                key.cfg,
+                lo,
+                hi,
+                consensus_impl=key.impl,
+                metrics=self._metrics,
+                donate=key.donate,
+            )
+        else:
+            ok = jnp.ones((key.bucket, key.n_oracles), dtype=bool)
+            out = claims_consensus_gated(
+                values,
+                ok,
+                mask,
+                key.cfg,
+                consensus_impl=key.impl,
+                metrics=self._metrics,
+                donate=key.donate,
+            )
+        jax.block_until_ready(out)
+
+    @staticmethod
+    def _bounds(key: CompileKey):
+        from svoc_tpu.robustness.sanitize import SanitizeConfig
+
+        bounds = SanitizeConfig.for_consensus(key.cfg.constrained)
+        return bounds.lo, bounds.hi
+
+    # -- the walk ------------------------------------------------------------
+
+    def warm_all(
+        self,
+        budget_s: Optional[float] = None,
+        include_twins: Optional[bool] = None,
+    ) -> Dict[str, Any]:
+        """Walk the (refreshed) universe in priority order under the
+        time budget; returns the JSON-safe report.  Reentrant-safe for
+        a second call after new claims register or with a different
+        ``include_twins`` — warmed keys are skipped, not recompiled."""
+        self._started = True
+        self._done.clear()
+        keys: List[CompileKey] = []
+        # The enumeration sits inside the finally too: an enumeration
+        # error on the background thread must still set _done, or
+        # ``active`` stays True forever and every cold group's requests
+        # are deferred eternally (the gate would truthfully report a
+        # walk that will never finish — worse than any compile).
+        try:
+            keys = self.universe(refresh=True, include_twins=include_twins)
+        except BaseException:
+            self._done.set()
+            raise
+        return self._walk(keys, budget_s)
+
+    def _walk(
+        self, keys: List[CompileKey], budget_s: Optional[float]
+    ) -> Dict[str, Any]:
+        """The walk proper, over ALREADY-ENUMERATED keys — shared by
+        :meth:`warm_all` and the background thread :meth:`start`
+        spawns (which enumerated before going live for the defer gate,
+        and must not pay the registry scan twice)."""
+        budget = budget_s if budget_s is not None else self.config.budget_s
+        started_at = self._clock()
+        outcomes: Dict[str, int] = {}
+        try:
+            for i, key in enumerate(keys):
+                if self.is_warm(key):
+                    continue
+                if budget is not None and (
+                    self._clock() - started_at
+                ) > budget:
+                    remaining = sum(
+                        1 for k in keys[i:] if not self.is_warm(k)
+                    )
+                    self._metrics.counter(
+                        PREWARM_COUNTER,
+                        labels={"outcome": "budget_exhausted"},
+                    ).add(remaining)
+                    outcomes["budget_exhausted"] = (
+                        outcomes.get("budget_exhausted", 0) + remaining
+                    )
+                    break
+                outcome = self.step(key)
+                outcomes[outcome] = outcomes.get(outcome, 0) + 1
+        finally:
+            self._done.set()
+        report = {
+            "universe": universe_summary(keys),
+            "outcomes": outcomes,
+            "warmed": len(self._warm),
+            "elapsed_s": round(self._clock() - started_at, 4),
+            "budget_s": budget,
+        }
+        with self._lock:
+            self._report = report
+        return report
+
+    def start(
+        self,
+        budget_s: Optional[float] = None,
+        include_twins: Optional[bool] = None,
+    ) -> threading.Thread:
+        """Run :meth:`warm_all` on a background daemon thread (the
+        serving deployment's mode: the tier serves — and defers cold
+        shapes — while the universe compiles).  Idempotent while a
+        walk is live."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self._thread
+        # Enumerate BEFORE going live: the defer gate reads the
+        # universe, and a gate that opens before the walk knows its
+        # keys would let a cold shape slip into the first micro-batch.
+        # The thread walks THESE keys (claims registered in the
+        # microseconds between here and the walk join the next one) —
+        # no second enumeration on the background path.
+        keys = self.universe(refresh=True, include_twins=include_twins)
+        self._started = True
+        self._done.clear()
+        thread = threading.Thread(
+            target=self._walk,
+            args=(keys, budget_s),
+            name="svoc-prewarm",
+            daemon=True,
+        )
+        with self._lock:
+            self._thread = thread
+        thread.start()
+        return thread
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the walk finishes; True iff it did."""
+        return self._done.wait(timeout)
+
+    def stats(self) -> Dict[str, Any]:
+        """The snapshot/`/api/state` view: warmed count, universe size,
+        liveness, the last report."""
+        with self._lock:
+            universe = self._universe
+            report = self._report
+            warmed = len(self._warm)
+        return {
+            "active": self.active,
+            "warmed": warmed,
+            "universe": len(universe) if universe is not None else None,
+            "report": report,
+        }

@@ -1,0 +1,248 @@
+"""Shape-universe enumeration: the reachable compile keys, derived.
+
+A compiled claim-cube program is keyed on (pow2 claim bucket ×
+(n_oracles, dimension, consensus config) group × dispatch kind ×
+donate twin × impl × mesh) — everything else the router varies per
+cycle is DYNAMIC data by construction (docs/FABRIC.md §replay,
+SVOC003).  PRs 6–13 bounded that universe; this module makes it
+ENUMERABLE from live config so the prewarm worker
+(:mod:`svoc_tpu.compile.prewarm`) can walk it ahead of traffic instead
+of guessing:
+
+- the (N, M, cfg) groups come from the :class:`ClaimRegistry`'s live
+  claims (the same grouping ``ClaimRouter._step_inner`` computes),
+- the bucket set is every power of two up to the router's
+  ``max_claims_per_batch`` (mesh-rounded exactly like
+  :func:`~svoc_tpu.consensus.batch.pow2_bucket` at dispatch),
+- the dispatch kind / donate flag / impl / mesh are the ROUTER'S
+  resolved, construction-pinned values — never re-resolved here.
+
+Order IS priority: serving-critical shapes first (the bucket the
+CURRENT claim count actually dispatches, per group), then the remaining
+buckets ascending (cold-start traffic grows through small buckets
+first), then the twin variants an operator could flip to
+(``device_resident`` donate twins, the other gate fusion mode) — a
+bounded prewarm budget cuts from the tail, never the head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from svoc_tpu.consensus.batch import pow2_bucket
+from svoc_tpu.consensus.kernel import ConsensusConfig
+
+#: Dispatch kinds the fabric/serving hot path can compile.  ``gated``
+#: is the pull-mode router's dispatch (host gate verdicts re-used on
+#: device), ``sanitized`` the serving tier's fused gate+consensus
+#: program; the ``sharded_*`` twins are the same programs inside the
+#: pinned claim mesh's ``shard_map``.
+KINDS = ("gated", "sanitized", "sharded_gated", "sharded_sanitized")
+
+
+@dataclasses.dataclass(frozen=True)
+class CompileKey:
+    """One compiled program's identity, as the router dispatches it.
+
+    ``cfg`` is the kernel's static configuration (already hashable —
+    the jit static arg); the sanitize bounds of a ``sanitized`` key are
+    NOT part of the identity because they are a pure function of
+    ``cfg.constrained`` (``SanitizeConfig.for_consensus``) — one gate
+    per constrained mode per process, never per-request data."""
+
+    kind: str
+    bucket: int
+    n_oracles: int
+    dimension: int
+    cfg: ConsensusConfig
+    donate: bool = False
+    impl: str = "xla"
+    mesh: Optional[str] = None
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"kind {self.kind!r} is not one of {KINDS}"
+            )
+        if self.bucket < 1:
+            raise ValueError("bucket must be >= 1")
+
+    def group(self) -> Tuple[int, int, ConsensusConfig]:
+        """The router's (N, M, cfg) dispatch-group key."""
+        return (self.n_oracles, self.dimension, self.cfg)
+
+    def label(self) -> str:
+        """Compact metrics/log label: ``gated:c8n7m6[+donate]``."""
+        suffix = "+donate" if self.donate else ""
+        mesh = f"@{self.mesh}" if self.mesh else ""
+        return (
+            f"{self.kind}:c{self.bucket}n{self.n_oracles}"
+            f"m{self.dimension}{suffix}{mesh}"
+        )
+
+
+def dispatch_key(
+    *,
+    sanitized: bool,
+    sharded: bool,
+    bucket: int,
+    n_oracles: int,
+    dimension: int,
+    cfg: ConsensusConfig,
+    donate: bool,
+    impl: str,
+    mesh: Optional[str],
+) -> CompileKey:
+    """The key for ONE router dispatch, from the router's own flags —
+    the single constructor both the router's warmth accounting and the
+    prewarm universe share, so they can never disagree on identity."""
+    kind = ("sharded_" if sharded else "") + (
+        "sanitized" if sanitized else "gated"
+    )
+    return CompileKey(
+        kind=kind,
+        bucket=bucket,
+        n_oracles=n_oracles,
+        dimension=dimension,
+        cfg=cfg,
+        donate=donate,
+        impl=impl,
+        mesh=mesh if sharded else None,
+    )
+
+
+def registry_groups(registry) -> Dict[Tuple[int, int, ConsensusConfig], int]:
+    """Live (N, M, cfg) dispatch groups → unpaused claim count, exactly
+    the grouping ``ClaimRouter._step_inner`` builds per cycle (paused
+    claims keep their registration but draw no dispatches)."""
+    groups: Dict[Tuple[int, int, ConsensusConfig], int] = {}
+    for state in registry.states():
+        if state.paused:
+            continue
+        spec = state.spec
+        key = (spec.n_oracles, spec.dimension, spec.consensus_config())
+        groups[key] = groups.get(key, 0) + 1
+    return groups
+
+
+def bucket_ladder(
+    cap: int, *, floor: int = 1, multiple_of: int = 1
+) -> List[int]:
+    """Every bucket the router can dispatch for up to ``cap`` claims:
+    pow2 (mesh-rounded) buckets ascending, deduplicated."""
+    if cap < 1:
+        raise ValueError("cap must be >= 1")
+    out: List[int] = []
+    n = 1
+    while True:
+        bucket = pow2_bucket(n, floor=floor, multiple_of=multiple_of)
+        if bucket not in out:
+            out.append(bucket)
+        if n >= cap:
+            break
+        n *= 2
+    return out
+
+
+def enumerate_universe(
+    groups: Dict[Tuple[int, int, ConsensusConfig], int],
+    *,
+    max_claims_per_batch: int,
+    sanitized_dispatch: bool,
+    donate: bool,
+    impl: str,
+    mesh: Optional[str] = None,
+    mesh_claim_size: int = 1,
+    include_twins: bool = True,
+) -> List[CompileKey]:
+    """The priority-ordered compile universe for one router's live
+    config.  ``groups`` is :func:`registry_groups`' output; the flag
+    arguments are the router's construction-pinned resolution (impl,
+    mesh, donate, gate fusion) — the universe DERIVES from config, it
+    never resolves anything itself.
+
+    Phases (order is priority; a budgeted walk cuts from the tail):
+
+    1. per group, the bucket the CURRENT claim count dispatches, in the
+       router's own kind/donate variant — the serving-critical head;
+    2. the remaining bucket ladder ascending, same variant;
+    3. twin variants (the other gate fusion, the donate flip) for every
+       bucket — an operator flipping ``device_resident`` or
+       ``sanitized_dispatch`` on the next restart still restarts warm.
+
+    Twins are enumerated for the UNSHARDED path only: the sharded
+    programs neither donate (the dispatcher manages its buffers) nor
+    pre-build gate variants the mesh wasn't constructed for.
+    """
+    sharded = mesh is not None
+    ordered_groups = sorted(
+        groups.items(), key=lambda kv: (kv[0][0], kv[0][1], repr(kv[0][2]))
+    )
+    ladder = bucket_ladder(
+        max_claims_per_batch,
+        multiple_of=mesh_claim_size if sharded else 1,
+    )
+
+    def key(group, bucket, *, sanitized, donate_flag) -> CompileKey:
+        n, m, cfg = group
+        return dispatch_key(
+            sanitized=sanitized,
+            sharded=sharded,
+            bucket=bucket,
+            n_oracles=n,
+            dimension=m,
+            cfg=cfg,
+            donate=donate_flag and not sharded,
+            impl=impl,
+            mesh=mesh,
+        )
+
+    out: List[CompileKey] = []
+    seen = set()
+
+    def push(k: CompileKey) -> None:
+        if k not in seen:
+            seen.add(k)
+            out.append(k)
+
+    # Phase 1 — serving-critical: what the next cycle will dispatch.
+    for group, count in ordered_groups:
+        live = max(1, min(count, max_claims_per_batch))
+        bucket = pow2_bucket(
+            live, multiple_of=mesh_claim_size if sharded else 1
+        )
+        push(key(group, bucket, sanitized=sanitized_dispatch,
+                 donate_flag=donate))
+    # Phase 2 — the rest of the ladder, primary variant.
+    for group, _count in ordered_groups:
+        for bucket in ladder:
+            push(key(group, bucket, sanitized=sanitized_dispatch,
+                     donate_flag=donate))
+    # Phase 3 — twins (unsharded only; see docstring).
+    if include_twins and not sharded:
+        for group, _count in ordered_groups:
+            for bucket in ladder:
+                push(key(group, bucket, sanitized=not sanitized_dispatch,
+                         donate_flag=donate))
+                push(key(group, bucket, sanitized=sanitized_dispatch,
+                         donate_flag=not donate))
+                push(key(group, bucket, sanitized=not sanitized_dispatch,
+                         donate_flag=not donate))
+    return out
+
+
+def universe_summary(keys: Iterable[CompileKey]) -> Dict[str, object]:
+    """JSON-safe digest of an enumerated universe (bench artifacts,
+    the ``/api/state`` compile section): size, per-kind counts, bucket
+    span."""
+    keys = list(keys)
+    kinds: Dict[str, int] = {}
+    for k in keys:
+        kinds[k.kind] = kinds.get(k.kind, 0) + 1
+    return {
+        "keys": len(keys),
+        "kinds": kinds,
+        "buckets": sorted({k.bucket for k in keys}),
+        "groups": len({k.group() for k in keys}),
+    }

@@ -374,6 +374,27 @@ def _quarantine_claims_jit(values, lo, hi):
     return quarantine_mask_claims(values, lo, hi)
 
 
+def jit_dispatcher(sanitized: bool, donate: bool):
+    """The module-level jitted dispatcher a (kind, donate) route runs —
+    the SAME function objects :func:`claims_consensus_gated` /
+    :func:`claims_consensus_sanitized` call, exposed so the compile
+    plane's AOT prewarmer (:mod:`svoc_tpu.compile.prewarm`) lowers and
+    compiles through them: a parallel re-jit of the same body would
+    populate a DIFFERENT jit cache and the first real dispatch would
+    recompile anyway (the whole point of prewarming lost, silently)."""
+    if sanitized:
+        return (
+            _claims_consensus_sanitized_xla_donated
+            if donate
+            else _claims_consensus_sanitized_xla
+        )
+    return (
+        _claims_consensus_gated_xla_donated
+        if donate
+        else _claims_consensus_gated_xla
+    )
+
+
 #: (n_oracles, dim, cfg) triples whose pallas dispatch raised — a
 #: Mosaic lowering failure is deterministic per shape/config, so one
 #: failure routes that group to XLA for the process lifetime instead of

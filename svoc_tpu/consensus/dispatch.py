@@ -203,6 +203,70 @@ def resolve_commit_mode(path: Optional[str] = None) -> str:
     return validate_commit_mode(mode, source)
 
 
+ALLOWED_WARMUP_MODES = ("none", "prewarm")
+WARMUP_MODE_ENV = "SVOC_WARMUP"
+
+ALLOWED_COMPILATION_CACHES = ("off", "persistent")
+COMPILATION_CACHE_ENV = "SVOC_COMPILATION_CACHE"
+
+
+class CompilePlaneError(ValueError):
+    """An unknown warmup mode / compilation-cache mode was requested
+    (env override or a corrupt committed record)."""
+
+
+def resolve_warmup_mode(path: Optional[str] = None) -> str:
+    """The compile-plane warmup routing twin of
+    :func:`resolve_consensus_impl` (docs/PARALLELISM.md §compile-plane):
+    ``SVOC_WARMUP`` env > the committed ``PERF_DECISIONS.json``
+    ``warmup_mode`` record (written by ``tools/decide_perf.py`` from
+    the measured ``BENCH_COLDSTART`` A/B — host-side evidence, so the
+    CPU container qualifies like ``commit_mode``) > ``"none"``.
+
+    ``"prewarm"`` walks the enumerated shape universe through AOT
+    ``lower().compile()`` + dispatch priming at startup/recovery
+    (:mod:`svoc_tpu.compile.prewarm`); ``"none"`` keeps the historical
+    compile-on-first-request behavior.  Warmup NEVER changes numerics
+    or journal events (``make coldstart-smoke`` pins fingerprint
+    identity), so unlike impl/mesh it is not a fingerprint family —
+    but it is still resolved ONCE per router construction (SVOC011):
+    a mid-run flip would make cold/warm accounting uninterpretable."""
+    mode, source = perf_decision(
+        "warmup_mode", "none", WARMUP_MODE_ENV, path=path
+    )
+    if mode not in ALLOWED_WARMUP_MODES:
+        allowed = ", ".join(repr(v) for v in ALLOWED_WARMUP_MODES)
+        raise CompilePlaneError(
+            f"warmup_mode {mode!r} (from {source}) is not a known "
+            f"warmup mode: allowed values are {allowed}; set "
+            f"{WARMUP_MODE_ENV} to override the committed record"
+        )
+    return mode
+
+
+def resolve_compilation_cache(path: Optional[str] = None) -> str:
+    """Persistent-compilation-cache routing
+    (docs/RESILIENCE.md §compile-cache): ``SVOC_COMPILATION_CACHE`` env
+    > the committed ``PERF_DECISIONS.json`` ``compilation_cache``
+    record > ``"off"``.  ``"persistent"`` points
+    ``jax_compilation_cache_dir`` under the durability base dir at
+    :class:`~svoc_tpu.durability.recovery.RecoveryManager` construction
+    (the only place that knows the base dir), so compiled programs
+    survive the PR 8 kill/restart cycle.  Purely an execution-cost
+    knob — cached and fresh compiles produce identical programs."""
+    mode, source = perf_decision(
+        "compilation_cache", "off", COMPILATION_CACHE_ENV, path=path
+    )
+    if mode not in ALLOWED_COMPILATION_CACHES:
+        allowed = ", ".join(repr(v) for v in ALLOWED_COMPILATION_CACHES)
+        raise CompilePlaneError(
+            f"compilation_cache {mode!r} (from {source}) is not a known "
+            f"mode: allowed values are {allowed}; set "
+            f"{COMPILATION_CACHE_ENV} to override the committed record"
+        )
+    return mode
+
+
 #: ``SVOC_MESH=<claims>x<oracles>`` — operator override for the claim
 #: mesh (kept in sync with ``svoc_tpu.parallel.mesh.CLAIM_MESH_ENV``;
 #: duplicated literal so this resolver keeps importing no jax).

@@ -123,6 +123,8 @@ class RecoveryManager:
         wal=None,
         tier=None,
         clock: Optional[Callable[[], float]] = None,
+        compilation_cache: Optional[str] = None,
+        compile_cache_max_bytes: Optional[int] = None,
     ):
         self.multi = multi
         self.out_dir = out_dir
@@ -132,6 +134,39 @@ class RecoveryManager:
         self._metrics = multi.metrics
         self._lock = threading.Lock()
         self.snapshots = 0
+        #: Persistent XLA compilation cache under the durability base
+        #: dir (docs/RESILIENCE.md §compile-cache): resolved ONCE here
+        #: (``SVOC_COMPILATION_CACHE`` env > PERF_DECISIONS.json >
+        #: off — the SVOC011 construction-pinning discipline; this is
+        #: the one constructor that knows the durable base dir).  When
+        #: ``"persistent"``, compiled programs survive the same
+        #: kill/restart cycle the WAL and snapshots do, so a recovered
+        #: process's prewarm walk is cache retrievals, not compiles.
+        #: The cache dir is durable state but NOT journal state: WAL
+        #: rotation and trace rotation never touch it; the size cap is
+        #: enforced on the snapshot cadence instead.
+        from svoc_tpu.compile.cache import DEFAULT_MAX_BYTES
+        from svoc_tpu.consensus.dispatch import resolve_compilation_cache
+
+        self.compilation_cache = (
+            compilation_cache
+            if compilation_cache is not None
+            else resolve_compilation_cache()
+        )
+        self._compile_cache_max_bytes = (
+            compile_cache_max_bytes
+            if compile_cache_max_bytes is not None
+            else DEFAULT_MAX_BYTES
+        )
+        self.compile_cache_dir: Optional[str] = None
+        if self.compilation_cache == "persistent":
+            from svoc_tpu.compile.cache import enable_persistent_cache
+
+            self.compile_cache_dir = enable_persistent_cache(
+                out_dir,
+                max_bytes=self._compile_cache_max_bytes,
+                metrics=self._metrics,
+            )
         #: Orphan claim state quarantined by a restore (membership
         #: changed between snapshot and recovery).  Carried forward
         #: into every subsequent snapshot — the "never silently
@@ -180,6 +215,18 @@ class RecoveryManager:
                 # pre-restart cycle awaits reconciliation): keep the
                 # log, rotate on a later snapshot.
                 self._metrics.counter("wal_rotate_deferred").add(1)
+        if self.compile_cache_dir is not None:
+            # Size-cap enforcement rides the snapshot cadence — the
+            # cache never grows unbounded under the durability dir,
+            # and eviction happens at a quiesced point, never inside a
+            # dispatch.
+            from svoc_tpu.compile.cache import evict_cache
+
+            evict_cache(
+                self.compile_cache_dir,
+                self._compile_cache_max_bytes,
+                metrics=self._metrics,
+            )
         self._metrics.counter("durability_snapshots").add(1)
         journal.emit(
             "durability.snapshot",
@@ -220,6 +267,7 @@ class RecoveryManager:
         adapters: Optional[Dict[str, Any]] = None,
         trace_path: Optional[str] = None,
         resend: bool = True,
+        prewarm: bool = False,
     ) -> Dict[str, Any]:
         """Bring a freshly-constructed fabric back to the pre-crash
         state: snapshot restore → fingerprint-checked journal ring →
@@ -275,6 +323,34 @@ class RecoveryManager:
                 registry=self._metrics,
             )
             report["reconcile"] = rec.as_dict()
+        if prewarm:
+            # Recovery restarts WARM (docs/PARALLELISM.md
+            # §compile-plane): with the persistent cache enabled at
+            # construction, the synchronous walk is cache retrievals,
+            # not compiles — the first post-recovery request dispatches
+            # at steady-state latency.  Opt-in (``prewarm=True``: the
+            # serving deployment and ``make coldstart-smoke``; the
+            # crash/fuzz kill-matrix harnesses keep their recoveries
+            # lean) and honoring the pinned warmup_mode
+            # (``start_prewarm`` is a no-op returning None under
+            # ``"none"``); never fatal — a prewarm defect must not
+            # block a recovery that is otherwise complete.
+            try:
+                # Primary variants only: a BLOCKING recovery walk must
+                # reach serving-ready fast; the restart-insurance twin
+                # variants (which this pinned process can never
+                # dispatch) compile on the next background walk.
+                worker = self.multi.start_prewarm(
+                    background=False, include_twins=False
+                )
+                report["prewarm"] = (
+                    worker.stats() if worker is not None else None
+                )
+            except Exception:  # noqa: BLE001 — counted, recovery proceeds
+                self._metrics.counter(
+                    "compile_cache_errors", labels={"op": "prewarm"}
+                ).add(1)
+                report["prewarm"] = {"error": True}
         return report
 
     # -- views ---------------------------------------------------------------
@@ -295,6 +371,17 @@ class RecoveryManager:
                 for lin, c in wal_cycles(records).items()
                 if not c["done"]
             ]
+        if self.compile_cache_dir is not None:
+            from svoc_tpu.compile.cache import cache_stats
+
+            # Stats only for the dir THIS manager owns: cache_stats'
+            # no-arg fallback reads the process-global enabled dir,
+            # which another enabler (a bench, a tool) may have pointed
+            # elsewhere — an "off" manager must report zeros, not a
+            # stranger's cache.
+            compile_cache = cache_stats(self.compile_cache_dir)
+        else:
+            compile_cache = {"entries": 0.0, "bytes": 0.0}
         return {
             "snapshot_path": self.snapshot_path,
             "snapshot_exists": snap_exists,
@@ -302,6 +389,9 @@ class RecoveryManager:
             "wal_path": getattr(self.wal, "path", None),
             "wal_records": wal_records,
             "wal_open_cycles": open_cycles,
+            "compilation_cache": self.compilation_cache,
+            "compile_cache_dir": self.compile_cache_dir,
+            "compile_cache": compile_cache,
         }
 
     def attach(self, console) -> None:

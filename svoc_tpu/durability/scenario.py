@@ -255,8 +255,18 @@ def run_durable_scenario(
             slow_window_s=50 * step_period_s,
         ),
     )
+    # compilation_cache pinned OFF like commit_mode above: the seeded
+    # kill matrix must not change behavior with the committed record
+    # (or a stray SVOC_COMPILATION_CACHE) — an enabled cache re-points
+    # jax's process-global cache into the workdir and deletes sibling
+    # salt dirs, none of which belongs in a pinned crash replay.
     manager = RecoveryManager(
-        multi, out_dir=workdir, wal=wal, tier=tier, clock=clock
+        multi,
+        out_dir=workdir,
+        wal=wal,
+        tier=tier,
+        clock=clock,
+        compilation_cache="off",
     )
 
     # ---- arm the named fault point (BEFORE recovery: recovery_storm

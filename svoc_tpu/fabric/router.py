@@ -48,9 +48,11 @@ from svoc_tpu.consensus.batch import (
     pad_claim_cube,
     pow2_bucket,
 )
+from svoc_tpu.compile.universe import dispatch_key
 from svoc_tpu.consensus.dispatch import (
     resolve_claim_mesh,
     resolve_consensus_impl,
+    resolve_warmup_mode,
 )
 from svoc_tpu.fabric.registry import ClaimRegistry, ClaimState
 from svoc_tpu.io.chain import ChainCommitError
@@ -169,6 +171,7 @@ class ClaimRouter:
         mesh=None,
         pipelined: bool = False,
         device_resident: bool = False,
+        warmup_mode: Optional[str] = None,
     ):
         if max_claims_per_batch < 1:
             raise ValueError("max_claims_per_batch must be >= 1")
@@ -243,6 +246,32 @@ class ClaimRouter:
         #: between them.  Off by default: the pull-mode fabric keeps its
         #: PR 6 behavior (and its seeded smoke fingerprints) unchanged.
         self.sanitized_dispatch = sanitized_dispatch
+        #: Compile-plane warmup routing, resolved ONCE at construction
+        #: like impl/mesh above (``SVOC_WARMUP`` env > the committed
+        #: PERF_DECISIONS.json ``warmup_mode`` record > ``"none"``;
+        #: docs/PARALLELISM.md §compile-plane).  NOT a fingerprint
+        #: family — warmup never journals and never changes numerics
+        #: (``make coldstart-smoke``) — but still pinned: cold/warm
+        #: dispatch accounting must mean one thing per process.
+        self.warmup_mode = (
+            warmup_mode if warmup_mode is not None else resolve_warmup_mode()
+        )
+        #: The attached :class:`~svoc_tpu.compile.prewarm.PrewarmWorker`
+        #: (None until :meth:`attach_prewarmer` /
+        #: ``MultiSession.start_prewarm``) — lets the warmth accounting
+        #: below distinguish a first dispatch the prewarmer already
+        #: compiled (``prewarmed``) from a genuinely cold one.
+        self.prewarmer = None
+        #: Compile keys this router has dispatched at least once — the
+        #: cold/warm boundary of ``consensus_dispatch{warmth=}``.
+        #: Router-thread-only (the scheduling loop is single-threaded).
+        self._warmth_seen: set = set()
+        #: (bucket, N, M, cfg) -> CompileKey: for a construction-pinned
+        #: router the key is a pure function of the dispatched shape,
+        #: so the steady state reuses one frozen dataclass per group
+        #: instead of re-validating/re-hashing it every cycle (the
+        #: §host-overhead discipline).
+        self._warmth_keys: Dict[Any, Any] = {}
         self._lock = threading.Lock()
         #: weighted rotation: claim ids, each appearing ``weight``
         #: times.  Rebuilt lazily when the registry's membership
@@ -547,6 +576,7 @@ class ClaimRouter:
         # not depend on where the cube computed — the meshed==unmeshed
         # fingerprint identity (make shard-smoke) is a contract.
         journal_bucket = pow2_bucket(len(members))
+        warmth_key = self._account_warmth(values, cfg)
         if self.sanitized_dispatch:
             # Gate + consensus in ONE traced program: the in-graph
             # quarantine twin recomputes the admission masks (identical
@@ -603,9 +633,64 @@ class ClaimRouter:
                     metrics=self._metrics,
                     donate=self._donate,
                 )
+        # Seen only after the dispatch call returned: a raising
+        # dispatch compiled nothing, and its retry must count cold.
+        self._warmth_seen.add(warmth_key)
         return _PendingGroup(
             members, cfg, out, oks, journal_bucket, lineages
         )
+
+    def attach_prewarmer(self, worker) -> None:
+        """Wire a :class:`~svoc_tpu.compile.prewarm.PrewarmWorker` into
+        the warmth accounting (and the serving tier's cold-shape defer
+        gate, which reads ``router.prewarmer``)."""
+        self.prewarmer = worker
+
+    def _account_warmth(self, values, cfg):
+        """Count this dispatch cold / prewarmed / warm
+        (``consensus_dispatch{warmth=}``, docs/PARALLELISM.md
+        §compile-plane).  ``cold`` = the first time THIS process
+        dispatches the compile key and no prewarmer compiled it ahead —
+        the dispatch below pays trace+compile inline (or a
+        persistent-cache retrieval, still the slow lane);
+        ``prewarmed`` = first dispatch of a key the attached worker
+        already warmed; ``warm`` = every repeat.  Metrics only — the
+        journal never sees warmth, so seeded replay fingerprints are
+        independent of compile state (the coldstart-smoke gate).
+
+        Returns the key; the CALLER marks it seen after the dispatch
+        call succeeds (a raising dispatch compiled nothing — the retry
+        must count cold again, not read as warm)."""
+        shape_key = (
+            int(values.shape[0]),
+            int(values.shape[1]),
+            int(values.shape[2]),
+            cfg,
+        )
+        key = self._warmth_keys.get(shape_key)
+        if key is None:
+            key = dispatch_key(
+                sanitized=self.sanitized_dispatch,
+                sharded=self._shard is not None,
+                bucket=shape_key[0],
+                n_oracles=shape_key[1],
+                dimension=shape_key[2],
+                cfg=cfg,
+                donate=self._donate,
+                impl=self.consensus_impl,
+                mesh=self.mesh_spec,
+            )
+            self._warmth_keys[shape_key] = key
+        if key in self._warmth_seen:
+            warmth = "warm"
+        elif self.prewarmer is not None and self.prewarmer.is_warm(key):
+            warmth = "prewarmed"
+        else:
+            warmth = "cold"
+        self._metrics.counter(
+            "consensus_dispatch", labels={"warmth": warmth}
+        ).add(1)
+        return key
 
     def _group_staging(self, blocks, cfg, multiple: int) -> _GroupStaging:
         """The (shape, config) group's reusable staging buffers, sized

@@ -93,11 +93,20 @@ def run_fabric_scenario(
     pipelined: bool = False,
     device_resident: bool = False,
     commit_mode: Optional[str] = None,
+    warmup: bool = False,
 ) -> Dict[str, Any]:
     """One seeded fabric run; returns per-claim fingerprints, isolation
     accounting, and the injection log.  Pure function of ``seed`` (plus
     the shape arguments) — ``tools/fabric_smoke.py`` runs it twice and
     asserts the fingerprints match byte-for-byte.
+
+    ``warmup=True`` runs a SYNCHRONOUS AOT prewarm of the claim-cube
+    shape universe before the first cycle (docs/PARALLELISM.md
+    §compile-plane).  Warmup never journals and never changes numerics,
+    so it is NOT a fingerprint family — ``make coldstart-smoke`` runs
+    this scenario warmed (with a persistent compilation cache, across a
+    kill/restart) and unwarmed and asserts byte-identical per-claim
+    fingerprints.
 
     ``mesh`` pins the 2-D claim-cube dispatch mesh
     (``"<claims>x<oracles>"``, docs/FABRIC.md §mesh — the shard-smoke
@@ -171,6 +180,8 @@ def run_fabric_scenario(
                 tamper=tamper if name == offender_claim else None,
             )
         )
+    if warmup:
+        multi.start_prewarm(background=False, force=True)
     reports = multi.run(cycles)
 
     claims: Dict[str, Any] = {}

@@ -58,6 +58,7 @@ class MultiSession:
         pipelined: bool = False,
         device_resident: bool = False,
         commit_mode: Optional[str] = None,
+        warmup_mode: Optional[str] = None,
         clock: Optional[Callable[[], float]] = None,
         adapter_factory=None,
     ):
@@ -106,6 +107,11 @@ class MultiSession:
         #: ``device_resident`` turns on the zero-allocation staging +
         #: donated dispatch (docs/PARALLELISM.md §host-overhead) —
         #: bit-identical outputs, so NOT a fingerprint family.
+        #: ``warmup_mode`` pins the compile-plane routing the same way
+        #: (``"none"`` | ``"prewarm"``; None = ``SVOC_WARMUP`` env >
+        #: PERF_DECISIONS.json > none, resolved once by the router —
+        #: docs/PARALLELISM.md §compile-plane).  :meth:`start_prewarm`
+        #: honors it.
         self.router = ClaimRouter(
             self.registry,
             max_claims_per_batch=max_claims_per_batch,
@@ -116,6 +122,7 @@ class MultiSession:
             mesh=mesh,
             pipelined=pipelined,
             device_resident=device_resident,
+            warmup_mode=warmup_mode,
         )
         for spec in specs:
             self.add_claim(spec)
@@ -260,6 +267,60 @@ class MultiSession:
         (:meth:`ClaimRouter.flush`); no-op when unpipelined."""
         return self.router.flush()
 
+    # -- the compile plane (docs/PARALLELISM.md §compile-plane) --------------
+
+    def start_prewarm(
+        self,
+        *,
+        budget_s: Optional[float] = None,
+        background: bool = True,
+        force: bool = False,
+        include_twins: bool = True,
+    ):
+        """Build (once) and run the AOT prewarm worker over this
+        fabric's live shape universe
+        (:class:`~svoc_tpu.compile.prewarm.PrewarmWorker`).
+
+        Honors the router's pinned ``warmup_mode`` — a ``"none"``
+        routing returns None unless ``force=True`` (tools/benches force
+        their legs explicitly; the serving deployment follows the
+        committed decision).  ``background=True`` (the serving default)
+        compiles on a daemon thread while the tier serves — and defers
+        cold shapes (docs/SERVING.md §cold-start); ``background=False``
+        blocks until the universe is warm (recovery restarts, smokes,
+        benches — with a persistent cache the walk is retrievals, not
+        compiles).  Returns the worker, reused on repeat calls (a
+        second call after new claims registered re-walks the refreshed
+        universe).
+
+        ``include_twins=False`` restricts THIS walk to the PRIMARY
+        variants this construction-pinned process can actually dispatch
+        — the synchronous recovery path uses it (a blocking restart
+        should reach serving-ready in the primary walk's time, ~1/4 of
+        the full universe).  It is a per-walk override, not worker
+        state: a later call with the default re-enumerates the twins
+        and compiles only what is still missing (warmed keys are
+        skipped), which is exactly how the restart-insurance twins
+        land on the background walk after a primary-only recovery."""
+        if self.router.warmup_mode == "none" and not force:
+            return None
+        worker = self.router.prewarmer
+        if worker is None:
+            from svoc_tpu.compile.prewarm import PrewarmConfig, PrewarmWorker
+
+            worker = PrewarmWorker(
+                self.router,
+                self.registry,
+                metrics=self._metrics,
+                config=PrewarmConfig(budget_s=budget_s),
+            )
+            self.router.attach_prewarmer(worker)
+        if background:
+            worker.start(budget_s=budget_s, include_twins=include_twins)
+        else:
+            worker.warm_all(budget_s=budget_s, include_twins=include_twins)
+        return worker
+
     # -- views ---------------------------------------------------------------
 
     def claims_state(self) -> Dict[str, Dict]:
@@ -281,6 +342,12 @@ class MultiSession:
             "mesh": self.router.mesh_spec,
             "pipelined": self.router.pipelined,
             "device_resident": self.router.device_resident,
+            "warmup_mode": self.router.warmup_mode,
+            "prewarm": (
+                self.router.prewarmer.stats()
+                if self.router.prewarmer is not None
+                else None
+            ),
             "claims": self.claims_state(),
         }
 

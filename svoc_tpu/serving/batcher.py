@@ -58,12 +58,18 @@ class MicroBatcher:
 
     def assemble(self) -> List[ServingRequest]:
         """Drain up to ``max_requests`` pending requests, round-robin
-        one-per-claim over the registry's registration order."""
+        one-per-claim over the registry's registration order.  Claims
+        whose consensus shape is still compiling are SKIPPED — their
+        deferred requests stay queued (docs/SERVING.md §cold-start)
+        rather than dragging a whole cross-claim micro-batch into an
+        inline compile; the next assemble after the prewarmer reaches
+        their shape drains them normally."""
         picked: List[ServingRequest] = []
         order = [
             cid
             for cid in self.frontend.multi.claim_ids()
             if self.frontend.depth(cid) > 0
+            and not self.frontend.is_cold(cid)
         ]
         while order and len(picked) < self.max_requests:
             still_pending: List[str] = []

@@ -190,6 +190,7 @@ class ServingFrontend:
         metrics: Optional[MetricsRegistry] = None,
         journal=None,
         clock=None,
+        cold_gate=None,
     ):
         import time
 
@@ -213,6 +214,20 @@ class ServingFrontend:
         #: occupy no slot — the same degraded-mode contract as SLO-burn
         #: shedding).
         self._draining = False
+        #: Cold-shape gate (docs/SERVING.md §cold-start):
+        #: ``cold_gate(claim_id) -> bool`` says whether the claim's
+        #: consensus program is STILL COMPILING (an AOT prewarm in
+        #: flight that hasn't reached its shape yet).  A cold claim's
+        #: cache-miss submissions are DEFERRED, not shed: admitted to
+        #: the bounded queue (the queue-full bound still applies — a
+        #: full queue sheds regardless) with a typed
+        #: ``serving.deferred{reason="cold_shape"}`` event, and the
+        #: batcher skips the claim until the gate opens — the request
+        #: waits out the compile instead of either being dropped or
+        #: blocking a whole serving step on an inline compile.  None
+        #: (the default, and always once warmup finishes) defers
+        #: nothing — the PR 7 admission path byte-for-byte.
+        self._cold_gate = cold_gate
 
     # -- the submit path ----------------------------------------------------
 
@@ -225,7 +240,12 @@ class ServingFrontend:
         - ``status="cached"`` — answered now, with the vector and the
           claim's latest consensus slice;
         - ``status="admitted"`` — queued for the next micro-batch;
-        - ``status="shed"`` — rejected, with the reason.
+        - ``status="deferred"`` — queued like an admission, but the
+          claim's consensus program is still compiling
+          (``reason="cold_shape"``, docs/SERVING.md §cold-start): the
+          batcher will drain it once the shape is warm — NOT a
+          rejection, HTTP 200;
+        - ``status="shed"`` — rejected, with the reason (HTTP 429).
 
         Raises ``KeyError`` for an unknown claim (the HTTP layer maps
         it to 404 — an unknown market is a client error, not load).
@@ -271,6 +291,7 @@ class ServingFrontend:
             claim_id, text, seq, lineage, self._clock(), key=key,
             digest=digest,
         )
+        deferred = self.is_cold(claim_id)
         with self._lock:
             q = self._queues.setdefault(claim_id, deque())
             if self._draining:
@@ -296,6 +317,36 @@ class ServingFrontend:
                 seq=seq,
                 source="queue",
             )
+            if deferred:
+                # Cold shape (docs/SERVING.md §cold-start): queued, but
+                # the batcher will not drain this claim until its
+                # program is compiled.  The ``serving.admitted`` event
+                # above still fires (crash recovery accounts admitted
+                # queue requests by it); the deferral is its own typed
+                # event so the flight recorder shows WHY the request
+                # waited.  Both are deterministic given a deterministic
+                # warmup schedule (seeded smokes warm synchronously
+                # first, so replays never see a deferral they can't
+                # reproduce).
+                self._metrics.counter(
+                    "serving_deferred",
+                    labels={"claim": claim_id, "reason": "cold_shape"},
+                ).add(1)
+                self._journal.emit(
+                    "serving.deferred",
+                    lineage=lineage,
+                    claim=claim_id,
+                    seq=seq,
+                    reason="cold_shape",
+                )
+                return {
+                    "status": "deferred",
+                    "claim": claim_id,
+                    "request_id": request.request_id,
+                    "lineage": lineage,
+                    "queue_depth": depth,
+                    "reason": "cold_shape",
+                }
             return {
                 "status": "admitted",
                 "claim": claim_id,
@@ -321,6 +372,20 @@ class ServingFrontend:
             "lineage": lineage,
             "reason": decision.reason,
         }
+
+    def is_cold(self, claim_id: str) -> bool:
+        """Whether the claim's consensus shape is still compiling (the
+        cold-shape defer window).  False without a gate, and false for
+        ANY gate failure — a broken warmth probe must degrade to the
+        historical serve-now behavior, never to an eternal deferral."""
+        gate = self._cold_gate
+        if gate is None:
+            return False
+        try:
+            return bool(gate(claim_id))
+        except Exception:  # noqa: BLE001 — degrade open, count it
+            self._metrics.counter("serving_cold_gate_errors").add(1)
+            return False
 
     def set_draining(self, draining: bool = True) -> None:
         """Flip the drain latch (the SIGTERM handler's first act)."""

@@ -58,6 +58,7 @@ class ServingTier:
         clock=None,
         slos: Optional[Sequence] = None,
         slo_clock=None,
+        prewarmer=None,
     ):
         from svoc_tpu.fabric.router import resolve_journal
         from svoc_tpu.utils.slo import SLOEvaluator
@@ -68,6 +69,12 @@ class ServingTier:
         self._clock = clock if clock is not None else time.monotonic
         if cache is None:
             cache = ResultCache(cache_capacity, metrics=self._metrics)
+        #: The compile-plane worker gating cold-shape deferrals
+        #: (docs/SERVING.md §cold-start).  None (or an attached router
+        #: prewarmer) falls back to ``multi.router.prewarmer`` so one
+        #: ``MultiSession.start_prewarm()`` wires both the warmth
+        #: accounting and the defer gate.
+        self._prewarmer = prewarmer
         self.frontend = ServingFrontend(
             multi,
             admission=admission,
@@ -75,6 +82,7 @@ class ServingTier:
             metrics=self._metrics,
             journal=self._journal,
             clock=self._clock,
+            cold_gate=self._claim_cold,
         )
         #: The cross-claim vectorizer.  None = each micro-batch builds
         #: on demand from the FIRST claim session's vectorizer (the
@@ -112,6 +120,25 @@ class ServingTier:
     @property
     def cache(self) -> ResultCache:
         return self.frontend.cache
+
+    @property
+    def prewarmer(self):
+        """The active prewarm worker: the injected one, else whatever
+        ``MultiSession.start_prewarm`` attached to the router."""
+        return (
+            self._prewarmer
+            if self._prewarmer is not None
+            else self.multi.router.prewarmer
+        )
+
+    def _claim_cold(self, claim_id: str) -> bool:
+        """The frontend's cold-shape gate: True while an in-flight
+        prewarm has not yet compiled this claim's dispatch group.  No
+        worker (or a finished one) defers nothing."""
+        worker = self.prewarmer
+        if worker is None or not worker.active:
+            return False
+        return worker.claim_cold(self.multi.get(claim_id).spec)
 
     def _resolve_vectorizer(self):
         if self._vectorizer is None:
@@ -361,9 +388,26 @@ class ServingTier:
 
     def run_loop(self, period_s: float = 0.05) -> threading.Event:
         """Drive ``step()`` on a daemon thread every ``period_s``;
-        returns the stop event.  Idempotent: a live loop is reused."""
+        returns the stop event.  Idempotent: a live loop is reused.
+
+        This is the live deployment's entry point, so it ACTIVATES the
+        committed compile-plane routing (docs/PARALLELISM.md
+        §compile-plane): under ``warmup_mode="prewarm"`` the AOT walk
+        starts in the background before the first tick — cold shapes
+        defer instead of compiling inline — exactly like
+        ``commit_mode`` activates at Session construction (the PR 13
+        precedent).  A scenario/test driving ``step()`` directly stays
+        warmup-free, as before."""
         if self._loop_thread is not None and self._loop_thread.is_alive():
             return self._loop_stop
+        if self.multi.router.warmup_mode == "prewarm":
+            # Unconditional (not gated on an existing worker): after a
+            # primary-only recovery walk the SAME worker must run a
+            # background pass that picks up the restart-insurance twin
+            # variants — warmed keys are skipped, so a fully-warm
+            # universe makes this a fast no-op walk.  start() is
+            # idempotent while a walk is live.
+            self.multi.start_prewarm(background=True)
         stop = threading.Event()
 
         def loop():
@@ -407,6 +451,17 @@ class ServingTier:
             # the single-device path (docs/FABRIC.md §mesh) — same
             # replay-pinning contract as the impl above.
             "mesh": self.multi.router.mesh_spec,
+            # Compile plane (docs/PARALLELISM.md §compile-plane): the
+            # pinned warmup routing, the live prewarm walk, and the
+            # cold-shape deferral count — an operator can tell a tier
+            # still compiling its universe from a saturated one.
+            "warmup_mode": self.multi.router.warmup_mode,
+            "prewarm": (
+                self.prewarmer.stats()
+                if self.prewarmer is not None
+                else None
+            ),
+            "deferred": reg.family_total("serving_deferred"),
             "queues": self.frontend.depths(),
             "submitted": reg.family_total("serving_submitted"),
             "admitted": reg.family_total("serving_admitted"),

@@ -754,16 +754,30 @@ _monitoring_lock = threading.Lock()
 
 
 def install_compile_listener() -> bool:
-    """Count XLA compilations via ``jax.monitoring`` when available.
+    """Observe XLA compilation via ``jax.monitoring`` when available.
 
-    Installs (once per process) a duration-event listener bumping
-    ``jit_compiles`` / ``jit_compile_seconds`` for every ``*compile*``
-    monitoring event.  Counts always land in the process-wide default
-    :data:`registry` — compiles are process-global events, and a
-    listener bound to whichever registry happened to call first would
-    silently starve every other scrape.  Returns True iff the listener
-    is installed; any API drift in this private-ish surface degrades to
-    a benign False — compile counts simply stay absent.
+    Installs (once per process) two listeners feeding the process-wide
+    default :data:`registry` — compiles are process-global events, and
+    a listener bound to whichever registry happened to call first would
+    silently starve every other scrape:
+
+    - a duration listener: every ``*compile*`` monitoring event keeps
+      bumping the legacy ``jit_compiles`` / ``jit_compile_seconds``
+      counters (PR 1's coarse series — it counts trace and MLIR stages
+      too), and the **backend** compile events additionally land in a
+      real ``xla_compile_seconds`` histogram (p50/p95/p99 of actual
+      XLA compile wall time) plus the ``xla_compiles_total`` counter —
+      the compile plane's primary series (docs/PARALLELISM.md
+      §compile-plane);
+    - a plain-event listener: the persistent compilation cache's
+      ``cache_hits`` / ``cache_misses`` events count into
+      ``xla_cache_events{event=hit|miss}`` — a MISS is a fresh compile
+      paid this process, which is exactly what ``make coldstart-smoke``
+      asserts to be zero after a warm restart.
+
+    Returns True iff the listeners are installed; any API drift in this
+    private-ish surface degrades to a benign False — compile series
+    simply stay absent.
     """
     with _monitoring_lock:
         if _monitoring_listener_state["installed"]:
@@ -771,16 +785,91 @@ def install_compile_listener() -> bool:
         try:
             from jax import monitoring as _monitoring
 
+            # Resolve BOTH registration surfaces before calling either:
+            # a partial registration (duration listener in, event
+            # listener AttributeError) would return False without
+            # marking installed, and the next call would stack a second
+            # duration listener — every compile double-counted, worse
+            # each scrape.
+            register_duration = (
+                _monitoring.register_event_duration_secs_listener
+            )
+            register_event = _monitoring.register_event_listener
+
             def _on_duration(event: str, duration: float, **kwargs) -> None:
                 if "compile" in event:
                     registry.counter("jit_compiles").add(1)
                     registry.counter("jit_compile_seconds").add(duration)
+                if "backend_compile" in event:
+                    registry.counter("xla_compiles_total").add(1)
+                    registry.histogram("xla_compile_seconds").observe(
+                        max(0.0, duration)
+                    )
 
-            _monitoring.register_event_duration_secs_listener(_on_duration)
+            def _on_event(event: str, **kwargs) -> None:
+                if event.endswith("compilation_cache/cache_hits"):
+                    registry.counter(
+                        "xla_cache_events", labels={"event": "hit"}
+                    ).add(1)
+                elif event.endswith("compilation_cache/cache_misses"):
+                    registry.counter(
+                        "xla_cache_events", labels={"event": "miss"}
+                    ).add(1)
+
+            register_duration(_on_duration)
+            # The duration listener is LIVE from here: mark installed
+            # immediately so no failure below can ever stack a second
+            # one, and swallow ANY register_event failure — whatever a
+            # drifted jax.monitoring raises, the degradation is absent
+            # cache-event series, never a crashed caller or a False
+            # that contradicts the live duration listener.
+            _monitoring_listener_state["installed"] = True
+            try:
+                register_event(_on_event)
+            except Exception:  # noqa: BLE001 — see above
+                pass
         except (ImportError, AttributeError, TypeError):
             return False
-        _monitoring_listener_state["installed"] = True
         return True
+
+
+def compile_snapshot(reg: Optional["MetricsRegistry"] = None) -> Dict[str, float]:
+    """JSON-safe digest of the compile-plane series (soak snapshots,
+    bench ``detail``, the durability status panel).  Reads the DEFAULT
+    registry by default — that is where :func:`install_compile_listener`
+    lands process-global events regardless of which registry a seeded
+    run injected."""
+    reg = reg or registry
+    h = reg.histogram("xla_compile_seconds")
+    return {
+        "xla_compiles_total": reg.counter("xla_compiles_total").count,
+        "xla_compile_seconds_sum": round(h.sum, 6),
+        "xla_compile_p50_ms": round(h.percentile(50) * 1e3, 3),
+        "xla_compile_p99_ms": round(h.percentile(99) * 1e3, 3),
+        "cache_hits": reg.counter(
+            "xla_cache_events", labels={"event": "hit"}
+        ).count,
+        "cache_misses": reg.counter(
+            "xla_cache_events", labels={"event": "miss"}
+        ).count,
+        "prewarm_outcomes": {
+            "compiled": reg.counter(
+                "compile_prewarm", labels={"outcome": "compiled"}
+            ).count,
+            "primed": reg.counter(
+                "compile_prewarm", labels={"outcome": "primed"}
+            ).count,
+            "skipped": reg.counter(
+                "compile_prewarm", labels={"outcome": "skipped"}
+            ).count,
+            "error": reg.counter(
+                "compile_prewarm", labels={"outcome": "error"}
+            ).count,
+            "budget_exhausted": reg.counter(
+                "compile_prewarm", labels={"outcome": "budget_exhausted"}
+            ).count,
+        },
+    }
 
 
 def _backend_initialized() -> bool:

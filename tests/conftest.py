@@ -17,6 +17,20 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Compile-plane hermeticity (docs/PARALLELISM.md §compile-plane): the
+# committed PERF_DECISIONS.json routes compilation_cache="persistent",
+# which would make every default-constructed RecoveryManager re-point
+# jax's PROCESS-GLOBAL compilation cache at a pytest tmp dir (deleted
+# later while still configured) and delete sibling salt dirs —
+# cross-test state leakage.  Pin both compile-plane knobs off; tests
+# that exercise the plane pass explicit kwargs/env (monkeypatch.setenv
+# overrides these) or record paths with monkeypatch-cleared env.
+# Unconditional (not setdefault): an ambient export from a local bench
+# run would silently defeat the pin; per-test monkeypatch.setenv still
+# overrides these.
+os.environ["SVOC_COMPILATION_CACHE"] = "off"
+os.environ["SVOC_WARMUP"] = "none"
+
 # The axon sitecustomize pins jax at the TPU platform regardless of the
 # env var — override through jax.config as well (must happen before any
 # backend is initialized).

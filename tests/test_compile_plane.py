@@ -1,0 +1,925 @@
+"""Compile plane (docs/PARALLELISM.md §compile-plane): shape-universe
+enumeration, AOT prewarm, the persistent compilation cache, warmth
+accounting, and the serving tier's cold-shape deferral."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from svoc_tpu.compile.cache import (
+    DEFAULT_MAX_BYTES,
+    cache_salt,
+    cache_stats,
+    enable_persistent_cache,
+    evict_cache,
+    kernel_revision,
+    persistent_cache_dir,
+)
+from svoc_tpu.compile.prewarm import PrewarmConfig, PrewarmWorker
+from svoc_tpu.compile.universe import (
+    CompileKey,
+    bucket_ladder,
+    dispatch_key,
+    enumerate_universe,
+    registry_groups,
+    universe_summary,
+)
+from svoc_tpu.consensus.dispatch import (
+    CompilePlaneError,
+    resolve_compilation_cache,
+    resolve_warmup_mode,
+)
+from svoc_tpu.consensus.kernel import ConsensusConfig
+from svoc_tpu.fabric.registry import ClaimRegistry, ClaimSpec
+from svoc_tpu.fabric.router import ClaimRouter
+from svoc_tpu.utils.metrics import MetricsRegistry
+
+CFG = ConsensusConfig(n_failing=2, constrained=True)
+
+
+def bare_registry(n_claims=3, n_oracles=7, dimension=6) -> ClaimRegistry:
+    reg = ClaimRegistry()
+    for i in range(n_claims):
+        reg.add(
+            ClaimSpec(
+                claim_id=f"c{i}", n_oracles=n_oracles, dimension=dimension
+            ),
+            None,
+            None,
+        )
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# Universe enumeration
+# ---------------------------------------------------------------------------
+
+
+class TestUniverse:
+    def test_registry_groups_counts_unpaused_claims_per_group(self):
+        reg = bare_registry(3)
+        reg.add(ClaimSpec(claim_id="big", n_oracles=16, dimension=6), None, None)
+        groups = registry_groups(reg)
+        assert groups[(7, 6, CFG)] == 3
+        assert groups[(16, 6, CFG)] == 1
+        reg.get("c0").paused = True
+        assert registry_groups(reg)[(7, 6, CFG)] == 2
+
+    def test_serving_critical_bucket_first_then_ladder_then_twins(self):
+        keys = enumerate_universe(
+            {(7, 6, CFG): 3},
+            max_claims_per_batch=8,
+            sanitized_dispatch=True,
+            donate=True,
+            impl="xla",
+        )
+        # Head: the bucket 3 live claims dispatch (pow2 -> 4), in the
+        # router's own variant (sanitized + donate).
+        assert keys[0] == CompileKey(
+            kind="sanitized", bucket=4, n_oracles=7, dimension=6,
+            cfg=CFG, donate=True,
+        )
+        # The primary-variant ladder comes before any twin.
+        first_twin = next(
+            i for i, k in enumerate(keys)
+            if k.kind == "gated" or not k.donate
+        )
+        primaries = keys[:first_twin]
+        assert {k.bucket for k in primaries} == {1, 2, 4, 8}
+        assert all(k.kind == "sanitized" and k.donate for k in primaries)
+        # Twins cover the other gate fusion and the donate flip.
+        kinds = {(k.kind, k.donate) for k in keys}
+        assert kinds == {
+            ("sanitized", True), ("sanitized", False),
+            ("gated", True), ("gated", False),
+        }
+        # No duplicates; order deterministic.
+        assert len(keys) == len(set(keys))
+        assert keys == enumerate_universe(
+            {(7, 6, CFG): 3},
+            max_claims_per_batch=8,
+            sanitized_dispatch=True,
+            donate=True,
+            impl="xla",
+        )
+
+    def test_mesh_universe_is_sharded_without_twins(self):
+        keys = enumerate_universe(
+            {(8, 6, CFG): 2},
+            max_claims_per_batch=4,
+            sanitized_dispatch=False,
+            donate=True,  # sharded programs never donate
+            impl="xla",
+            mesh="2x4",
+            mesh_claim_size=2,
+        )
+        assert all(k.kind == "sharded_gated" for k in keys)
+        assert all(not k.donate for k in keys)
+        assert all(k.mesh == "2x4" for k in keys)
+        assert all(k.bucket % 2 == 0 for k in keys)
+
+    def test_bucket_ladder_mesh_rounding(self):
+        assert bucket_ladder(8) == [1, 2, 4, 8]
+        # pow2 buckets rounded UP to the mesh claim-axis multiple,
+        # deduplicated: 1,2 -> 3; 4 -> 6; 8 -> 9.
+        assert bucket_ladder(8, multiple_of=3) == [3, 6, 9]
+
+    def test_dispatch_key_matches_enumerated_identity(self):
+        key = dispatch_key(
+            sanitized=True, sharded=False, bucket=4, n_oracles=7,
+            dimension=6, cfg=CFG, donate=False, impl="xla", mesh=None,
+        )
+        keys = enumerate_universe(
+            {(7, 6, CFG): 4},
+            max_claims_per_batch=4,
+            sanitized_dispatch=True,
+            donate=False,
+            impl="xla",
+        )
+        assert key in keys
+
+    def test_compile_key_validation_and_summary(self):
+        with pytest.raises(ValueError):
+            CompileKey(kind="nope", bucket=1, n_oracles=7, dimension=6, cfg=CFG)
+        with pytest.raises(ValueError):
+            CompileKey(kind="gated", bucket=0, n_oracles=7, dimension=6, cfg=CFG)
+        keys = enumerate_universe(
+            {(7, 6, CFG): 1},
+            max_claims_per_batch=2,
+            sanitized_dispatch=False,
+            donate=False,
+            impl="xla",
+        )
+        summary = universe_summary(keys)
+        assert summary["keys"] == len(keys)
+        assert summary["groups"] == 1
+        assert set(summary["kinds"]) == {"gated", "sanitized"}
+
+
+# ---------------------------------------------------------------------------
+# Persistent cache: salt versioning + eviction
+# ---------------------------------------------------------------------------
+
+
+class TestPersistentCache:
+    def test_salt_covers_jax_version_and_kernel_revision(self):
+        import jax
+
+        salt = cache_salt()
+        assert jax.__version__ in salt
+        assert kernel_revision()[:12] in salt
+
+    def test_salt_change_invalidates_old_entries(self, tmp_path, monkeypatch):
+        base = str(tmp_path)
+        monkeypatch.setattr(
+            "svoc_tpu.compile.cache.cache_salt", lambda: "saltA"
+        )
+        dir_a = enable_persistent_cache(base, metrics=MetricsRegistry())
+        assert dir_a and dir_a.endswith("saltA")
+        stale = os.path.join(dir_a, "old-cache")
+        with open(stale, "w") as f:
+            f.write("x" * 100)
+        # A new salt (jax upgrade / kernel edit) gets a DIFFERENT dir
+        # and deletes the stale one — old entries can never be read.
+        monkeypatch.setattr(
+            "svoc_tpu.compile.cache.cache_salt", lambda: "saltB"
+        )
+        reg = MetricsRegistry()
+        dir_b = enable_persistent_cache(base, metrics=reg)
+        assert dir_b != dir_a
+        assert not os.path.exists(dir_a)
+        assert (
+            reg.counter(
+                "compile_cache_invalidated", labels={"salt": "saltA"}
+            ).count
+            == 1
+        )
+
+    def test_eviction_drops_least_recently_used_until_under_cap(
+        self, tmp_path
+    ):
+        cache_dir = str(tmp_path)
+        for i, age in [(0, 100), (1, 50), (2, 10)]:
+            payload = os.path.join(cache_dir, f"k{i}-cache")
+            atime = os.path.join(cache_dir, f"k{i}-atime")
+            with open(payload, "w") as f:
+                f.write("x" * 1000)
+            with open(atime, "w") as f:
+                f.write("")
+            now = os.path.getmtime(payload)
+            os.utime(atime, (now - age, now - age))
+        reg = MetricsRegistry()
+        stats = evict_cache(cache_dir, 2500, metrics=reg)
+        assert stats["evicted"] == 1
+        # Oldest-used (k0) evicted, payload AND atime twin.
+        assert not os.path.exists(os.path.join(cache_dir, "k0-cache"))
+        assert not os.path.exists(os.path.join(cache_dir, "k0-atime"))
+        assert os.path.exists(os.path.join(cache_dir, "k2-cache"))
+        assert reg.counter("compile_cache_evictions").count == 1
+        assert reg.gauge("compile_cache_bytes").get() == 2000.0
+        assert cache_stats(cache_dir) == {"entries": 2.0, "bytes": 2000.0}
+
+    def test_cache_module_imports_jax_free(self):
+        # The RecoveryManager constructor path (reachable from jax-free
+        # durable-plane consumers — the PR 14 fuzz-child discipline)
+        # imports compile.cache; the package __init__ re-exports are
+        # PEP 562 lazy so this import must never pull jax.
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import sys; "
+                "from svoc_tpu.compile.cache import enable_persistent_cache; "
+                "assert 'jax' not in sys.modules, 'jax leaked'",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr[-1000:]
+
+    def test_persistent_cache_dir_is_salted_subdir(self, tmp_path):
+        d = persistent_cache_dir(str(tmp_path))
+        assert d.startswith(os.path.join(str(tmp_path), "xla_cache"))
+
+    def test_enable_is_idempotent_and_capped(self, tmp_path):
+        reg = MetricsRegistry()
+        d1 = enable_persistent_cache(
+            str(tmp_path), max_bytes=DEFAULT_MAX_BYTES, metrics=reg
+        )
+        d2 = enable_persistent_cache(
+            str(tmp_path), max_bytes=DEFAULT_MAX_BYTES, metrics=reg
+        )
+        assert d1 == d2 and os.path.isdir(d1)
+
+
+# ---------------------------------------------------------------------------
+# Resolution (env > PERF_DECISIONS.json > default, SVOC011 pinning)
+# ---------------------------------------------------------------------------
+
+
+class TestResolution:
+    def test_warmup_mode_env_beats_record_beats_default(
+        self, tmp_path, monkeypatch
+    ):
+        record = tmp_path / "decisions.json"
+        record.write_text('{"warmup_mode": "prewarm"}')
+        monkeypatch.delenv("SVOC_WARMUP", raising=False)
+        assert resolve_warmup_mode(str(record)) == "prewarm"
+        monkeypatch.setenv("SVOC_WARMUP", "none")
+        assert resolve_warmup_mode(str(record)) == "none"
+        monkeypatch.delenv("SVOC_WARMUP", raising=False)
+        assert resolve_warmup_mode(str(tmp_path / "absent.json")) == "none"
+
+    def test_compilation_cache_resolution_and_typed_errors(
+        self, tmp_path, monkeypatch
+    ):
+        record = tmp_path / "decisions.json"
+        record.write_text('{"compilation_cache": "persistent"}')
+        monkeypatch.delenv("SVOC_COMPILATION_CACHE", raising=False)
+        assert resolve_compilation_cache(str(record)) == "persistent"
+        assert (
+            resolve_compilation_cache(str(tmp_path / "absent.json")) == "off"
+        )
+        monkeypatch.setenv("SVOC_COMPILATION_CACHE", "bogus")
+        with pytest.raises(CompilePlaneError) as e:
+            resolve_compilation_cache(str(record))
+        assert "SVOC_COMPILATION_CACHE" in str(e.value)
+        monkeypatch.setenv("SVOC_WARMUP", "bogus")
+        with pytest.raises(CompilePlaneError):
+            resolve_warmup_mode(str(record))
+
+    def test_router_pins_warmup_mode_at_construction(self, monkeypatch):
+        monkeypatch.setenv("SVOC_WARMUP", "prewarm")
+        router = ClaimRouter(bare_registry(), metrics=MetricsRegistry())
+        monkeypatch.setenv("SVOC_WARMUP", "none")
+        assert router.warmup_mode == "prewarm"  # pinned, no re-read
+        explicit = ClaimRouter(
+            bare_registry(), metrics=MetricsRegistry(), warmup_mode="none"
+        )
+        assert explicit.warmup_mode == "none"
+
+
+# ---------------------------------------------------------------------------
+# Prewarm worker + warmth accounting
+# ---------------------------------------------------------------------------
+
+
+class TestPrewarm:
+    def test_warm_all_compiles_universe_and_marks_warm(self):
+        reg = MetricsRegistry()
+        registry = bare_registry(2)
+        router = ClaimRouter(
+            registry,
+            max_claims_per_batch=2,
+            metrics=reg,
+            warmup_mode="prewarm",
+        )
+        worker = PrewarmWorker(
+            router, registry, metrics=reg,
+            config=PrewarmConfig(include_twins=False),
+        )
+        report = worker.warm_all()
+        assert report["outcomes"].get("compiled", 0) > 0
+        assert not report["outcomes"].get("error")
+        assert worker.stats()["warmed"] == report["warmed"]
+        for key in worker.universe():
+            assert worker.is_warm(key)
+        # Compile latency histogram observed per AOT key.
+        assert (
+            reg.histogram("prewarm_compile_seconds").count
+            >= report["outcomes"]["compiled"]
+        )
+        # Finished walk: nothing is cold.
+        assert not worker.group_cold(7, 6, CFG)
+
+    def test_budget_exhaustion_is_counted_and_cuts_the_tail(self):
+        reg = MetricsRegistry()
+        registry = bare_registry(2)
+        router = ClaimRouter(
+            registry, max_claims_per_batch=4, metrics=reg,
+            warmup_mode="prewarm",
+        )
+        clock = {"t": 0.0}
+
+        def fake_clock():
+            clock["t"] += 10.0  # every step "costs" 10s
+            return clock["t"]
+
+        worker = PrewarmWorker(
+            router, registry, metrics=reg, clock=fake_clock,
+            config=PrewarmConfig(budget_s=15.0, include_twins=False),
+        )
+        report = worker.warm_all()
+        assert report["outcomes"].get("budget_exhausted", 0) > 0
+        assert (
+            reg.counter(
+                "compile_prewarm", labels={"outcome": "budget_exhausted"}
+            ).count
+            == report["outcomes"]["budget_exhausted"]
+        )
+        # The cut universe still warmed its head (priority order).
+        assert report["warmed"] >= 1
+
+    def test_prewarmed_numerics_match_fresh_jit_bitwise(self):
+        import jax
+        from functools import partial
+
+        from svoc_tpu.consensus.batch import claims_consensus_gated
+        from svoc_tpu.consensus.kernel import consensus_step_gated_claims
+        import jax.numpy as jnp
+
+        reg = MetricsRegistry()
+        registry = bare_registry(2)
+        router = ClaimRouter(
+            registry, max_claims_per_batch=2, metrics=reg,
+            warmup_mode="prewarm",
+        )
+        worker = PrewarmWorker(
+            router, registry, metrics=reg,
+            config=PrewarmConfig(include_twins=False),
+        )
+        worker.warm_all()
+        rng = np.random.default_rng(3)
+        values = rng.uniform(0.05, 0.95, size=(2, 7, 6)).astype(np.float32)
+        ok = np.ones((2, 7), dtype=bool)
+        mask = np.ones(2, dtype=bool)
+        warm = claims_consensus_gated(
+            jnp.asarray(values), jnp.asarray(ok), jnp.asarray(mask), CFG,
+            consensus_impl="xla", metrics=reg,
+        )
+        # The reference is a FRESH jit of the same body: the eager
+        # trace differs by one ulp in rel₂ (the XLA CPU fusion finding
+        # of docs/PARALLELISM.md §sharded-claims), so bitwise identity
+        # is only owed between identically-compiled programs.
+        fresh = partial(
+            jax.jit(consensus_step_gated_claims, static_argnames=("cfg",))
+        )
+        ref = fresh(
+            jnp.asarray(values), jnp.asarray(ok), jnp.asarray(mask), CFG
+        )
+        # Prewarming (AOT compile + dummy priming) must never change
+        # results: the warmed dispatch is bitwise the fresh program.
+        np.testing.assert_array_equal(
+            np.asarray(warm.essence), np.asarray(ref.essence)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(warm.reliability_second_pass),
+            np.asarray(ref.reliability_second_pass),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(warm.reliable), np.asarray(ref.reliable)
+        )
+
+    def test_defer_gate_closes_on_primary_keys_not_twins(self):
+        # The serving-critical head of the walk (the pinned variant's
+        # bucket ladder) is what the router can dispatch; the twin
+        # variants at the tail are restart insurance.  The defer gate
+        # must open as soon as the PRIMARY keys are warm — a gate held
+        # by twins would defer for the whole walk, worse than the
+        # inline compile it exists to avoid (review finding).
+        reg = MetricsRegistry()
+        registry = bare_registry(2)
+        router = ClaimRouter(
+            registry, max_claims_per_batch=2, metrics=reg,
+            warmup_mode="prewarm",
+        )
+        worker = PrewarmWorker(
+            router, registry, metrics=reg,
+            config=PrewarmConfig(include_twins=True),
+        )
+        worker.universe(refresh=True)
+        worker._started = True  # mid-walk: active, nothing warm yet
+        assert worker.group_cold(7, 6, CFG)
+        for key in worker._primary_keys(7, 6, CFG):
+            assert worker.step(key) in ("compiled", "primed")
+        # Primary surface warm -> the gate opens, twins still pending.
+        assert not worker.group_cold(7, 6, CFG)
+        pending_twins = [
+            k for k in worker.universe() if not worker.is_warm(k)
+        ]
+        assert pending_twins, "twins should still be unwarmed here"
+        worker._done.set()
+
+    def test_prime_less_walk_never_fakes_warmth_for_unaot_keys(self):
+        # prime=False only does AOT work, which covers the unsharded
+        # XLA twins — a pallas-routed key gets NO work and must be
+        # counted skipped, not marked warm (review finding).
+        reg = MetricsRegistry()
+        registry = bare_registry(1)
+        router = ClaimRouter(
+            registry, max_claims_per_batch=1, metrics=reg,
+            warmup_mode="prewarm", consensus_impl="pallas",
+        )
+        worker = PrewarmWorker(
+            router, registry, metrics=reg,
+            config=PrewarmConfig(prime=False, include_twins=False),
+        )
+        key = worker.universe(refresh=True)[0]
+        assert key.impl == "pallas"
+        assert worker.step(key) == "skipped"
+        assert not worker.is_warm(key)
+        assert (
+            reg.counter(
+                "compile_prewarm", labels={"outcome": "skipped"}
+            ).count
+            == 1
+        )
+
+    def test_prime_less_walk_still_aot_compiles_xla_keys(self):
+        reg = MetricsRegistry()
+        registry = bare_registry(1)
+        router = ClaimRouter(
+            registry, max_claims_per_batch=1, metrics=reg,
+            warmup_mode="prewarm",
+        )
+        worker = PrewarmWorker(
+            router, registry, metrics=reg,
+            config=PrewarmConfig(prime=False, include_twins=False),
+        )
+        key = worker.universe(refresh=True)[0]
+        assert worker.step(key) == "compiled"
+        assert worker.is_warm(key)
+
+    def test_worker_never_touches_a_journal(self):
+        import svoc_tpu.compile.prewarm as prewarm_mod
+        import inspect
+
+        # The worker must be invisible to replay fingerprints: no
+        # journal resolution, no event emission, no events import —
+        # its only traces are metrics and compiled code.  (The word
+        # "journal" may appear in prose; the APIs may not.)
+        source = inspect.getsource(prewarm_mod)
+        for forbidden in (
+            "resolve_journal",
+            ".emit(",
+            "svoc_tpu.utils.events",
+            "EventJournal",
+        ):
+            assert forbidden not in source, forbidden
+
+    def test_router_warmth_accounting_cold_then_warm(self):
+        reg = MetricsRegistry()
+
+        def count(warmth):
+            return reg.counter(
+                "consensus_dispatch", labels={"warmth": warmth}
+            ).count
+
+        registry = bare_registry(2)
+        router = ClaimRouter(
+            registry, max_claims_per_batch=2, metrics=reg,
+            warmup_mode="none",
+        )
+        values = np.full((2, 7, 6), 0.5, dtype=np.float32)
+        # Drive the accounting contract _dispatch_group implements:
+        # count, dispatch, THEN mark seen — so first sight is cold,
+        # a retry after a raising dispatch is cold AGAIN, and only a
+        # successful dispatch flips the key to warm.
+        key = router._account_warmth(values, CFG)
+        assert (count("cold"), count("warm")) == (1.0, 0.0)
+        router._account_warmth(values, CFG)  # dispatch raised: still cold
+        assert (count("cold"), count("warm")) == (2.0, 0.0)
+        router._warmth_seen.add(key)  # the post-dispatch commit
+        router._account_warmth(values, CFG)
+        assert (count("cold"), count("warm")) == (2.0, 1.0)
+
+    def test_router_counts_prewarmed_first_dispatch(self):
+        reg = MetricsRegistry()
+        registry = bare_registry(2)
+        router = ClaimRouter(
+            registry, max_claims_per_batch=2, metrics=reg,
+            warmup_mode="prewarm",
+        )
+        worker = PrewarmWorker(
+            router, registry, metrics=reg,
+            config=PrewarmConfig(include_twins=False),
+        )
+        router.attach_prewarmer(worker)
+        worker.warm_all()
+        values = np.full((2, 7, 6), 0.5, dtype=np.float32)
+        router._account_warmth(values, CFG)
+        assert (
+            reg.counter(
+                "consensus_dispatch", labels={"warmth": "prewarmed"}
+            ).count
+            == 1.0
+        )
+
+
+# ---------------------------------------------------------------------------
+# Serving: defer-then-serve (cold shapes wait, nothing is lost)
+# ---------------------------------------------------------------------------
+
+
+def _tier(vectorizer=None, **kwargs):
+    from svoc_tpu.fabric.session import MultiSession
+    from svoc_tpu.io.comment_store import CommentStore
+    from svoc_tpu.serving.tier import ServingTier
+    from svoc_tpu.utils.events import EventJournal
+
+    def vec(texts):
+        rng = np.random.default_rng(
+            [abs(hash(t)) % 2**31 for t in texts] or [0]
+        )
+        v = rng.uniform(0.05, 0.95, size=(len(texts), 6))
+        return v / v.sum(axis=1, keepdims=True)
+
+    def store_factory(cid):
+        return CommentStore()
+
+    multi = MultiSession(
+        base_seed=0,
+        vectorizer=vec,
+        store_factory=store_factory,
+        journal=EventJournal(),
+        metrics=MetricsRegistry(),
+        lineage_scope="cp",
+        sanitized_dispatch=True,
+        warmup_mode="none",
+    )
+    for name in ("alpha", "beta"):
+        multi.add_claim(ClaimSpec(claim_id=name, n_oracles=7, dimension=6))
+    tier = ServingTier(multi, vectorizer=vectorizer or vec, **kwargs)
+    return multi, tier
+
+
+class _FakeWorker:
+    """A controllable prewarmer double: active + per-group coldness."""
+
+    def __init__(self):
+        self.active = True
+        self.cold_groups = set()
+
+    def claim_cold(self, spec):
+        return (
+            spec.n_oracles, spec.dimension, spec.consensus_config()
+        ) in self.cold_groups
+
+    def is_warm(self, key):
+        return False
+
+    def stats(self):
+        return {"active": self.active, "warmed": 0, "universe": 0,
+                "report": None}
+
+
+class TestColdShapeDeferral:
+    def test_defer_then_serve_accounting(self):
+        multi, tier = _tier()
+        worker = _FakeWorker()
+        worker.cold_groups = {(7, 6, CFG)}
+        tier._prewarmer = worker
+        reg = multi.metrics
+        out = tier.submit("alpha", "first comment while cold")
+        assert out["status"] == "deferred"
+        assert out["reason"] == "cold_shape"
+        # Deferred ≠ shed: the request is queued, counted admitted AND
+        # deferred, and journaled serving.deferred{cold_shape}.
+        assert tier.frontend.depth("alpha") == 1
+        assert reg.family_total("serving_admitted") == 1
+        assert reg.family_total("serving_shed") == 0
+        assert (
+            reg.counter(
+                "serving_deferred",
+                labels={"claim": "alpha", "reason": "cold_shape"},
+            ).count
+            == 1
+        )
+        events = multi._resolve_journal().recent(type="serving.deferred")
+        assert events and events[-1].data["reason"] == "cold_shape"
+        # A cold claim's queue is not drained: the step serves nothing.
+        report = tier.step()
+        assert report["requests"] == 0
+        assert tier.frontend.depth("alpha") == 1
+        # Warmup reaches the shape -> the deferred request serves.
+        worker.cold_groups = set()
+        report = tier.step()
+        assert report["requests"] == 1
+        assert "alpha" in report["served"]
+        assert tier.frontend.depth("alpha") == 0
+        # End-state accounting: every submission is served or queued —
+        # deferral lost nothing and shed nothing.
+        assert reg.family_total("serving_completed") == 1
+        assert reg.family_total("serving_dropped") == 0
+
+    def test_warm_claims_serve_while_sibling_defers(self):
+        multi, tier = _tier()
+        worker = _FakeWorker()
+        worker.cold_groups = {(7, 6, CFG)}
+        tier._prewarmer = worker
+        # beta's group differs -> not cold.
+        multi.add_claim(
+            ClaimSpec(claim_id="gamma", n_oracles=9, dimension=6)
+        )
+        cold = tier.submit("alpha", "cold-path text")
+        warm = tier.submit("gamma", "warm-path text")
+        assert cold["status"] == "deferred"
+        assert warm["status"] == "admitted"
+        report = tier.step()
+        assert report["served"] == ["gamma"]
+        assert tier.frontend.depth("alpha") == 1
+
+    def test_finished_worker_defers_nothing(self):
+        multi, tier = _tier()
+        worker = _FakeWorker()
+        worker.cold_groups = {(7, 6, CFG)}
+        worker.active = False  # walk done (or budget spent)
+        tier._prewarmer = worker
+        out = tier.submit("alpha", "text after warmup finished")
+        assert out["status"] == "admitted"
+
+    def test_cold_gate_errors_degrade_open(self):
+        multi, tier = _tier()
+
+        class Broken:
+            active = True
+
+            def claim_cold(self, spec):
+                raise RuntimeError("warmth probe broke")
+
+            def stats(self):
+                return {}
+
+        tier._prewarmer = Broken()
+        out = tier.submit("alpha", "gate failure must still serve")
+        assert out["status"] == "admitted"
+        assert multi.metrics.counter("serving_cold_gate_errors").count == 1
+
+    def test_run_loop_activates_the_committed_prewarm_routing(self):
+        # The live deployment's entry point (run_loop) must activate
+        # warmup_mode="prewarm" — the PR 13 precedent: a committed
+        # decision that nothing in the serving path consumes is dead
+        # routing (review finding).
+        from svoc_tpu.fabric.session import MultiSession
+        from svoc_tpu.io.comment_store import CommentStore
+        from svoc_tpu.serving.tier import ServingTier
+        from svoc_tpu.utils.events import EventJournal
+
+        def vec(texts):
+            return np.full((len(texts), 6), 1 / 6)
+
+        multi = MultiSession(
+            base_seed=0,
+            vectorizer=vec,
+            store_factory=lambda cid: CommentStore(),
+            journal=EventJournal(),
+            metrics=MetricsRegistry(),
+            lineage_scope="rl",
+            warmup_mode="prewarm",
+        )
+        multi.add_claim(ClaimSpec(claim_id="alpha", n_oracles=7))
+        tier = ServingTier(multi, vectorizer=vec)
+        assert tier.prewarmer is None
+        stop = tier.run_loop(period_s=10.0)
+        try:
+            assert tier.prewarmer is not None
+            assert multi.router.prewarmer is tier.prewarmer
+            assert tier.prewarmer.wait(120)
+        finally:
+            stop.set()
+            tier.stop_loop()
+
+    def test_queue_full_still_sheds_even_when_cold(self):
+        from svoc_tpu.serving.frontend import AdmissionConfig
+
+        multi, tier = _tier(admission=AdmissionConfig(queue_capacity=1))
+        worker = _FakeWorker()
+        worker.cold_groups = {(7, 6, CFG)}
+        tier._prewarmer = worker
+        assert tier.submit("alpha", "one")["status"] == "deferred"
+        out = tier.submit("alpha", "two")
+        assert out["status"] == "shed"
+        assert out["reason"] == "queue_full"
+
+
+# ---------------------------------------------------------------------------
+# Recovery integration: the cache is durable state, restarts are warm
+# ---------------------------------------------------------------------------
+
+
+class TestRecoveryIntegration:
+    def _multi(self, n_oracles: int = 7):
+        from svoc_tpu.fabric.session import MultiSession
+        from svoc_tpu.io.comment_store import CommentStore
+        from svoc_tpu.utils.events import EventJournal
+
+        multi = MultiSession(
+            base_seed=0,
+            vectorizer=lambda texts: np.full((len(texts), 6), 1 / 6),
+            store_factory=lambda cid: CommentStore(),
+            journal=EventJournal(),
+            metrics=MetricsRegistry(),
+            lineage_scope="rw",
+            warmup_mode="prewarm",
+        )
+        multi.add_claim(ClaimSpec(claim_id="alpha", n_oracles=n_oracles))
+        return multi
+
+    def test_manager_enables_salted_cache_under_out_dir(self, tmp_path):
+        from svoc_tpu.durability.recovery import RecoveryManager
+
+        manager = RecoveryManager(
+            self._multi(),
+            out_dir=str(tmp_path),
+            compilation_cache="persistent",
+        )
+        assert manager.compile_cache_dir is not None
+        assert manager.compile_cache_dir.startswith(
+            os.path.join(str(tmp_path), "xla_cache")
+        )
+        status = manager.status()
+        assert status["compilation_cache"] == "persistent"
+        assert status["compile_cache_dir"] == manager.compile_cache_dir
+
+    def test_manager_off_mode_leaves_cache_disabled(self, tmp_path):
+        from svoc_tpu.durability.recovery import RecoveryManager
+
+        manager = RecoveryManager(
+            self._multi(), out_dir=str(tmp_path), compilation_cache="off"
+        )
+        assert manager.compile_cache_dir is None
+        assert not os.path.exists(os.path.join(str(tmp_path), "xla_cache"))
+
+    def test_recover_prewarm_restarts_warm(self, tmp_path):
+        from svoc_tpu.durability.recovery import RecoveryManager
+
+        # A fleet shape no other test compiles: an in-process jit reuse
+        # of an already-compiled program skips the backend compile and
+        # would write nothing into THIS manager's cache dir.
+        multi = self._multi(n_oracles=11)
+        manager = RecoveryManager(
+            multi, out_dir=str(tmp_path), compilation_cache="persistent"
+        )
+        report = manager.recover(prewarm=True)
+        assert report["prewarm"] is not None
+        assert report["prewarm"]["warmed"] > 0
+        assert multi.router.prewarmer is not None
+        # The blocking recovery walk is PRIMARY-only: every key is the
+        # router's pinned variant (twins are background work) — here an
+        # unsanitized, undonated router, so gated/no-donate throughout.
+        assert all(
+            k.kind == "gated" and not k.donate
+            for k in multi.router.prewarmer.universe()
+        )
+        # The cache dir survived and holds the compiled programs — the
+        # restart-warm witness at the unit level (the full
+        # kill/restart matrix is make coldstart-smoke).
+        assert cache_stats(manager.compile_cache_dir)["entries"] > 0
+
+    def test_recover_honors_warmup_mode_none(self, tmp_path):
+        from svoc_tpu.durability.recovery import RecoveryManager
+        from svoc_tpu.fabric.session import MultiSession
+        from svoc_tpu.io.comment_store import CommentStore
+        from svoc_tpu.utils.events import EventJournal
+
+        multi = MultiSession(
+            base_seed=0,
+            vectorizer=lambda texts: np.full((len(texts), 6), 1 / 6),
+            store_factory=lambda cid: CommentStore(),
+            journal=EventJournal(),
+            metrics=MetricsRegistry(),
+            lineage_scope="rn",
+            warmup_mode="none",
+        )
+        multi.add_claim(ClaimSpec(claim_id="alpha", n_oracles=7))
+        manager = RecoveryManager(
+            multi, out_dir=str(tmp_path), compilation_cache="off"
+        )
+        report = manager.recover(prewarm=True)
+        assert report["prewarm"] is None
+        assert multi.router.prewarmer is None
+
+    def test_snapshot_runs_cache_eviction(self, tmp_path):
+        from svoc_tpu.durability.recovery import RecoveryManager
+
+        manager = RecoveryManager(
+            self._multi(),
+            out_dir=str(tmp_path),
+            compilation_cache="persistent",
+            compile_cache_max_bytes=1500,
+        )
+        for i in range(3):
+            with open(
+                os.path.join(manager.compile_cache_dir, f"k{i}-cache"), "w"
+            ) as f:
+                f.write("x" * 1000)
+        manager.snapshot()
+        assert cache_stats(manager.compile_cache_dir)["bytes"] <= 1500
+
+
+# ---------------------------------------------------------------------------
+# Monitoring satellite: real histogram + cache events
+# ---------------------------------------------------------------------------
+
+
+class TestCompileMonitoring:
+    def test_backend_compiles_land_in_histogram_and_counter(self):
+        import jax
+        import jax.numpy as jnp
+
+        from svoc_tpu.utils.metrics import (
+            compile_snapshot,
+            install_compile_listener,
+            registry as process_registry,
+        )
+
+        assert install_compile_listener()
+        before = process_registry.counter("xla_compiles_total").count
+        hist_before = process_registry.histogram("xla_compile_seconds").count
+
+        @jax.jit
+        def fresh(x):
+            return x * 3.25 + 1.5
+
+        fresh(jnp.arange(13, dtype=jnp.float32)).block_until_ready()
+        assert process_registry.counter("xla_compiles_total").count > before
+        assert (
+            process_registry.histogram("xla_compile_seconds").count
+            > hist_before
+        )
+        snap = compile_snapshot()
+        assert snap["xla_compiles_total"] >= 1
+        assert snap["xla_compile_seconds_sum"] > 0
+        assert "prewarm_outcomes" in snap
+
+    def test_cache_events_counted_hit_and_miss(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from svoc_tpu.utils.metrics import (
+            install_compile_listener,
+            registry as process_registry,
+        )
+
+        install_compile_listener()
+        enable_persistent_cache(str(tmp_path), metrics=MetricsRegistry())
+
+        def miss_count():
+            return process_registry.counter(
+                "xla_cache_events", labels={"event": "miss"}
+            ).count
+
+        def hit_count():
+            return process_registry.counter(
+                "xla_cache_events", labels={"event": "hit"}
+            ).count
+
+        # Two separately-jitted but IDENTICAL lambdas (the cache key
+        # covers the computation name, so the twins must share it).
+        program = jax.jit(lambda x: (x + 7.125) * 0.375)
+        program2 = jax.jit(lambda x: (x + 7.125) * 0.375)
+        misses0 = miss_count()
+        program(jnp.arange(11, dtype=jnp.float32)).block_until_ready()
+        assert miss_count() > misses0  # fresh compile = a counted miss
+        hits0 = hit_count()
+        # Second wrapper: traces again, but the backend compile is a
+        # persistent-cache HIT.
+        program2(jnp.arange(11, dtype=jnp.float32)).block_until_ready()
+        assert hit_count() > hits0

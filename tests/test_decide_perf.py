@@ -553,3 +553,97 @@ def test_claims_grid_walkover_never_demotes_prior_measured_pallas(
     record = json.loads(out.read_text())
     assert record["consensus_impl"] == "pallas"  # the measurement stands
     assert record["claim_mesh"] == "none"
+
+
+# ---------------------------------------------------------------------------
+# Compile plane: warmup_mode / compilation_cache from the cold-start A/B
+# (ISSUE 15 satellite — host-side evidence, like commit_mode)
+# ---------------------------------------------------------------------------
+
+
+def _coldstart_grid(checks_override=None):
+    checks = {
+        "numerics_identical_across_legs": True,
+        "prewarmed_speedup_ge_5": True,
+        "restart_speedup_ge_5": True,
+        "zero_fresh_compiles_after_restart": True,
+        "cache_only_faster_than_cold": True,
+    }
+    checks.update(checks_override or {})
+    return {
+        "artifact": "BENCH_COLDSTART",
+        "checks": checks,
+        "speedups_vs_cold": {
+            "prewarm": 63.7,
+            "restart": 65.3,
+            "restart_nowarm": 2.6,
+        },
+        "legs": {"restart": {"fresh_compiles_during_dispatch": 0}},
+    }
+
+
+def test_coldstart_clean_ab_routes_prewarm_and_persistent():
+    decisions, evidence = decide_perf.coldstart_decisions(_coldstart_grid())
+    assert decisions == {
+        "warmup_mode": "prewarm",
+        "compilation_cache": "persistent",
+    }
+    assert evidence["warmup_mode"]["host_measured"]
+    assert evidence["compilation_cache"]["restart_speedup"] == 65.3
+    assert "blocker" not in evidence["warmup_mode"]
+
+
+def test_coldstart_fresh_compiles_block_the_cache_not_the_warmup():
+    decisions, evidence = decide_perf.coldstart_decisions(
+        _coldstart_grid({"zero_fresh_compiles_after_restart": False})
+    )
+    # The restart leg leaked compiles: the CACHE decision records the
+    # honest null, but in-process prewarming still measured its win.
+    assert decisions["warmup_mode"] == "prewarm"
+    assert decisions["compilation_cache"] == "off"
+    assert "zero_fresh_compiles_after_restart" in evidence[
+        "compilation_cache"
+    ]["blocker"]
+
+
+def test_coldstart_numerics_break_blocks_everything():
+    decisions, _evidence = decide_perf.coldstart_decisions(
+        _coldstart_grid({"numerics_identical_across_legs": False})
+    )
+    assert decisions == {
+        "warmup_mode": "none",
+        "compilation_cache": "off",
+    }
+
+
+def test_coldstart_absent_or_malformed_grid_decides_nothing(tmp_path):
+    assert decide_perf.coldstart_decisions(None) == ({}, {})
+    bad = tmp_path / "bad.json"
+    bad.write_text("[1, 2, 3]")
+    assert decide_perf.load_coldstart_grid(str(bad)) is None
+    assert (
+        decide_perf.load_coldstart_grid(str(tmp_path / "absent.json"))
+        is None
+    )
+
+
+def test_resolvers_consume_the_committed_compile_plane_record(
+    tmp_path, monkeypatch
+):
+    from svoc_tpu.consensus.dispatch import (
+        resolve_compilation_cache,
+        resolve_warmup_mode,
+    )
+
+    # conftest pins both knobs off via env for suite hermeticity — the
+    # env outranks the record, so clear it to exercise record routing.
+    monkeypatch.delenv("SVOC_WARMUP", raising=False)
+    monkeypatch.delenv("SVOC_COMPILATION_CACHE", raising=False)
+    record = tmp_path / "PERF_DECISIONS.json"
+    record.write_text(
+        json.dumps(
+            {"warmup_mode": "prewarm", "compilation_cache": "persistent"}
+        )
+    )
+    assert resolve_warmup_mode(str(record)) == "prewarm"
+    assert resolve_compilation_cache(str(record)) == "persistent"

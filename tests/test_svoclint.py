@@ -1582,6 +1582,33 @@ def test_svoc011_flags_knob_resolution_through_helpers():
     assert "dispatch_gated" in trace and "resolve_consensus_impl" in trace
 
 
+def test_svoc011_prewarm_and_warmup_bodies_are_construction_time():
+    # ISSUE 15 satellite: the compile plane's warmup worker names its
+    # unit-of-work ``step()`` and deliberately walks knob-resolving jit
+    # paths AHEAD of traffic — the entry heuristic must read any
+    # prewarm/warmup-qualified body as construction-time, while the
+    # same body under a non-warmup name keeps flagging.
+    warm = """
+    import os
+
+    class PrewarmWorker:
+        def step(self, key):
+            return os.environ.get("SVOC_CONSENSUS_IMPL")
+
+    def warmup_step():
+        return os.environ.get("SVOC_CONSENSUS_IMPL")
+    """
+    assert analyze_source(src(warm)) == []
+    hot = """
+    import os
+
+    class CubeWorker:
+        def step(self, key):
+            return os.environ.get("SVOC_CONSENSUS_IMPL")
+    """
+    assert rules_of(analyze_source(src(hot))) == ["SVOC011"]
+
+
 def test_svoc011_negative_non_svoc_env_and_non_entry_functions():
     findings = analyze_source(
         src(

@@ -1,0 +1,215 @@
+"""Cold-start smoke: prewarm → kill → restart → warm, fingerprint-gated
+(``make coldstart-smoke``; docs/PARALLELISM.md §compile-plane).
+
+Three child processes run the SAME seeded 4-claim fabric scenario:
+
+1. ``control``      — no compilation cache, no warmup: the historical
+                      compile-on-first-dispatch behavior.
+2. ``warm_first``   — a persistent compilation cache under a durable
+                      dir + a synchronous AOT prewarm before the first
+                      cycle.  This child POPULATES the cache and is
+                      then SIGKILLed (it parks after reporting) — the
+                      PR 8 kill, applied to the compile plane.
+3. ``warm_restart`` — a fresh process on the SAME cache dir, prewarm
+                      again, run the scenario.
+
+The gate asserts:
+
+- **Warmup is invisible to replays** — per-claim and whole-journal
+  fingerprints of all three runs are byte-identical (warmup never
+  journals, never changes numerics; the fingerprint-compatibility
+  discipline of PR 13 applied to the compile plane).
+- **0 fresh compiles after the restart** — the ``warm_restart`` child
+  ends with ZERO persistent-cache misses: every program it ran (the
+  prewarmed claim cubes AND every auxiliary jit the scenario touches)
+  was served from the cache the killed process left behind.
+- **The witness is not vacuous** — the ``warm_first`` child recorded
+  nonzero cache misses (it really did populate the cache) and the
+  restart's prewarm walk visibly finished its universe.
+
+Usage::
+
+    python tools/coldstart_smoke.py [--seed 0] [--out COLDSTART_SMOKE.json]
+"""
+
+from __future__ import annotations
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+import select  # noqa: E402
+import signal  # noqa: E402
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+import tempfile  # noqa: E402
+import time  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
+
+
+def child(leg: str, seed: int, cycles: int, cache_dir: str) -> None:
+    """One scenario leg; prints a single JSON line, then (warm_first)
+    parks for the parent's SIGKILL."""
+    from svoc_tpu.utils.metrics import install_compile_listener, registry
+
+    install_compile_listener()
+    if leg != "control":
+        from svoc_tpu.compile.cache import enable_persistent_cache
+
+        enabled = enable_persistent_cache(cache_dir)
+        assert enabled, "persistent cache must enable for warm legs"
+
+    from svoc_tpu.fabric.scenario import run_fabric_scenario
+
+    result = run_fabric_scenario(
+        seed, cycles=cycles, warmup=(leg != "control")
+    )
+
+    def cache_events(event: str) -> float:
+        return registry.counter(
+            "xla_cache_events", labels={"event": event}
+        ).count
+
+    print(
+        json.dumps(
+            {
+                "leg": leg,
+                "journal_fingerprint": result["journal_fingerprint"],
+                "claims": {
+                    c: result["claims"][c]["fingerprint"]
+                    for c in sorted(result["claims"])
+                },
+                "cache_misses": cache_events("miss"),
+                "cache_hits": cache_events("hit"),
+            }
+        ),
+        flush=True,
+    )
+    if leg == "warm_first":
+        # Park: the parent SIGKILLs this process — compiled programs
+        # must survive an unclean death (they are written at compile
+        # time, not at exit), exactly like WAL records survive one.
+        signal.pause()
+
+
+def run_leg(
+    leg: str, seed: int, cycles: int, cache_dir: str, kill: bool = False
+) -> dict:
+    cmd = [
+        sys.executable,
+        os.path.abspath(__file__),
+        "--child",
+        leg,
+        "--seed",
+        str(seed),
+        "--cycles",
+        str(cycles),
+        "--cache-dir",
+        cache_dir,
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # stderr goes to a FILE, not a pipe: a chatty child (per-shape jax
+    # warnings across the whole universe) filling a 64 KB stderr pipe
+    # would deadlock against our blocking stdout read — the
+    # crash_smoke.py lesson, solved here without communicate() because
+    # the warm_first child must stay ALIVE for the parent's SIGKILL.
+    with tempfile.TemporaryFile(mode="w+") as err:
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=err, text=True,
+            env=env, cwd=REPO,
+        )
+        try:
+            ready, _w, _x = select.select([proc.stdout], [], [], 600)
+            line = proc.stdout.readline() if ready else ""
+            if not line:
+                proc.kill()
+                proc.wait(timeout=10)
+                err.seek(0)
+                raise RuntimeError(
+                    f"leg {leg} died before reporting: "
+                    f"{err.read()[-2000:]}"
+                )
+            if kill:
+                proc.kill()  # SIGKILL mid-life: the compile plane's crash
+            proc.wait(timeout=600)
+            return json.loads(line)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--cycles", type=int, default=8)
+    p.add_argument("--out", default="COLDSTART_SMOKE.json")
+    p.add_argument("--child", default=None)
+    p.add_argument("--cache-dir", default=None)
+    args = p.parse_args(argv)
+
+    if args.child:
+        child(args.child, args.seed, args.cycles, args.cache_dir)
+        return 0
+
+    t0 = time.time()
+    with tempfile.TemporaryDirectory(prefix="svoc-coldstart-smoke-") as tmp:
+        cache_dir = os.path.join(tmp, "durable")
+        control = run_leg("control", args.seed, args.cycles, cache_dir)
+        warm_first = run_leg(
+            "warm_first", args.seed, args.cycles, cache_dir, kill=True
+        )
+        warm_restart = run_leg(
+            "warm_restart", args.seed, args.cycles, cache_dir
+        )
+
+    claim_ids = sorted(control["claims"])
+    checks = {
+        "warmed_equals_control": (
+            warm_first["claims"] == control["claims"]
+            and warm_first["journal_fingerprint"]
+            == control["journal_fingerprint"]
+        ),
+        "restart_equals_control": (
+            warm_restart["claims"] == control["claims"]
+            and warm_restart["journal_fingerprint"]
+            == control["journal_fingerprint"]
+        ),
+        "first_run_populated_cache": warm_first["cache_misses"] > 0,
+        "zero_fresh_compiles_after_restart": (
+            warm_restart["cache_misses"] == 0
+        ),
+        "restart_really_hit_cache": warm_restart["cache_hits"] > 0,
+    }
+    ok = all(checks.values())
+    artifact = {
+        "seed": args.seed,
+        "cycles": args.cycles,
+        "elapsed_s": round(time.time() - t0, 1),
+        "checks": checks,
+        "ok": ok,
+        "legs": {
+            "control": control,
+            "warm_first": warm_first,
+            "warm_restart": warm_restart,
+        },
+    }
+    atomic_write_json(args.out, artifact)
+    for name, passed in checks.items():
+        print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+    print(
+        f"coldstart-smoke {'OK' if ok else 'FAILED'}: {len(claim_ids)} "
+        f"claims × {args.cycles} cycles — prewarmed + SIGKILLed + "
+        f"restarted warm ({int(warm_restart['cache_hits'])} cache hits, "
+        f"{int(warm_restart['cache_misses'])} misses), fingerprints "
+        f"identical to the unwarmed control -> {args.out}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,6 +37,15 @@ committed measurements — not an editorial choice:
   otherwise — including the honest-null CPU sweep (1-core container:
   simulated devices cannot add compute) and any parity breakage, with
   the blocker recorded as evidence.
+- ``warmup_mode`` / ``compilation_cache`` — the compile plane
+  (docs/PARALLELISM.md §compile-plane), from the committed
+  ``BENCH_COLDSTART_r09.json`` A/B: ``"prewarm"`` iff the in-process
+  prewarmed first dispatch beat cold by ≥5× with byte-identical
+  numerics; ``"persistent"`` iff the ACROSS-RESTART leg also beat cold
+  by ≥5× with zero fresh compiles after the restart.  Host-side
+  evidence like ``commit_mode`` — compile latency is paid by the host
+  XLA pipeline, so the CPU container qualifies; ``"none"``/``"off"``
+  otherwise with the failed checks as the blocker.
 
 A decision is only derived from results whose ``detail.backend`` is
 ``"tpu"`` with no fallback/small-mode label; with no qualifying
@@ -339,6 +348,82 @@ def hotpath_commit_decision(grid):
     return "per_tx", evidence
 
 
+def load_coldstart_grid(path):
+    """Load the cold-start A/B artifact (``BENCH_COLDSTART_r09.json``:
+    a flat ``{"checks", "legs", "speedups_vs_cold", ...}`` record) or
+    None when absent/malformed — the same shape-tolerant contract as
+    :func:`load_hotpath_grid`."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or not isinstance(data.get("checks"), dict):
+        return None
+    return data
+
+
+def coldstart_decisions(grid):
+    """``({decision_key: value}, {decision_key: evidence})`` for the
+    compile-plane routing from the cold-start A/B
+    (``bench_coldstart.py``).  Host-side measurement like
+    ``commit_mode`` — no TPU gate: compile latency is host work, and
+    the on-chip (Mosaic) compile cost is strictly larger, so a
+    CPU-measured win is a lower bound (the artifact's
+    ``tpu_compile_cost: null`` honest-null stands until the campaign
+    measures the chip)."""
+    if grid is None:
+        return {}, {}
+    checks = grid.get("checks")
+    if not isinstance(checks, dict):
+        return {}, {}
+    speedups = (
+        grid.get("speedups_vs_cold")
+        if isinstance(grid.get("speedups_vs_cold"), dict)
+        else {}
+    )
+    source = grid.get("artifact", "BENCH_COLDSTART")
+    decisions, evidence = {}, {}
+
+    warm_required = ("numerics_identical_across_legs", "prewarmed_speedup_ge_5")
+    warm_failed = [k for k in warm_required if not checks.get(k)]
+    warm_evidence = {
+        "source": source,
+        "prewarm_speedup": speedups.get("prewarm"),
+        "host_measured": True,
+    }
+    if not warm_failed:
+        decisions["warmup_mode"] = "prewarm"
+    else:
+        decisions["warmup_mode"] = "none"
+        warm_evidence["blocker"] = f"failed checks: {warm_failed}"
+    evidence["warmup_mode"] = warm_evidence
+
+    cache_required = warm_required + (
+        "restart_speedup_ge_5",
+        "zero_fresh_compiles_after_restart",
+    )
+    cache_failed = [k for k in cache_required if not checks.get(k)]
+    cache_evidence = {
+        "source": source,
+        "restart_speedup": speedups.get("restart"),
+        "restart_nowarm_speedup": speedups.get("restart_nowarm"),
+        "fresh_compiles_after_restart": (
+            grid.get("legs", {})
+            .get("restart", {})
+            .get("fresh_compiles_during_dispatch")
+        ),
+        "host_measured": True,
+    }
+    if not cache_failed:
+        decisions["compilation_cache"] = "persistent"
+    else:
+        decisions["compilation_cache"] = "off"
+        cache_evidence["blocker"] = f"failed checks: {cache_failed}"
+    evidence["compilation_cache"] = cache_evidence
+    return decisions, evidence
+
+
 def load_flash_verdict(repo: str):
     """The on-TPU flash numerics verdict from FLASH_PARITY.json
     (``tools/flash_probe.py --parity-only``), or None when unmeasured.
@@ -361,6 +446,7 @@ def decide(
     claims_grid=None,
     shard_grid=None,
     hotpath_grid=None,
+    coldstart_grid=None,
 ) -> tuple:
     """``(decisions, evidence)`` from qualifying TPU results (plus the
     grid walkover rules — module docstring)."""
@@ -460,6 +546,10 @@ def decide(
         decisions["commit_mode"] = commit_decision
         evidence["commit_mode"] = commit_evidence
 
+    cold_decisions, cold_evidence = coldstart_decisions(coldstart_grid)
+    decisions.update(cold_decisions)
+    evidence.update(cold_evidence)
+
     return decisions, evidence
 
 
@@ -498,6 +588,8 @@ def main(argv=None) -> int:
                     "flash_numerics",
                     "claim_mesh",
                     "commit_mode",
+                    "warmup_mode",
+                    "compilation_cache",
                 )
             }
     except (OSError, ValueError):
@@ -520,6 +612,9 @@ def main(argv=None) -> int:
         shard_grid=load_grid(os.path.join(REPO, "BENCH_SHARD_r07.json")),
         hotpath_grid=load_hotpath_grid(
             os.path.join(REPO, "BENCH_HOTPATH_r08.json")
+        ),
+        coldstart_grid=load_coldstart_grid(
+            os.path.join(REPO, "BENCH_COLDSTART_r09.json")
         ),
     )
     if (

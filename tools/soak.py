@@ -123,7 +123,15 @@ def main(argv=None) -> int:
     from svoc_tpu.apps.commands import CommandConsole
     from svoc_tpu.apps.session import Session, SessionConfig
     from svoc_tpu.io.comment_store import CommentStore
-    from svoc_tpu.utils.metrics import registry
+    from svoc_tpu.utils.metrics import (
+        compile_snapshot,
+        install_compile_listener,
+        registry,
+    )
+
+    # Compile-plane series must start counting BEFORE the first jit —
+    # the listener is process-global and on-demand elsewhere.
+    install_compile_listener()
 
     # The real packed transformer pipeline, with workload conditioning:
     # random weights (no HF cache in the image) map every text to a
@@ -294,6 +302,15 @@ def main(argv=None) -> int:
                 "trace_write_errors": registry.counter(
                     "trace_write_errors"
                 ).count,
+                # Compile plane (docs/PARALLELISM.md §compile-plane):
+                # fresh XLA compiles + persistent-cache hit/miss over
+                # the run — a soak that keeps compiling is a shape leak.
+                "xla_compiles": registry.counter(
+                    "xla_compiles_total"
+                ).count,
+                "xla_cache_misses": registry.counter(
+                    "xla_cache_events", labels={"event": "miss"}
+                ).count,
             }
             artifact["snapshots"].append(snap)
             flush()
@@ -378,6 +395,10 @@ def main(argv=None) -> int:
             "journal": journal.summary(),
             "slo": session.slo_step(),
             "postmortem_bundles": list(monitor.bundles),
+            # End-of-run compile-plane digest (ISSUE 15 satellite): the
+            # xla_compile_seconds histogram + cache hit/miss totals the
+            # jax.monitoring listener fed over the whole soak.
+            "compile": compile_snapshot(),
             "chaos_seed": args.chaos_seed,
             "rss_mb_first_quarter_median": rss_first,
             "rss_mb_last_quarter_median": rss_last,
