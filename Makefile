@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving bench-shard bench-hotpath bench-coldstart campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke fabric-smoke serving-smoke crash-smoke chaos-fuzz-smoke shard-smoke hotpath-smoke coldstart-smoke pallas-parity clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving bench-shard bench-hotpath bench-coldstart campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke obs-cost-smoke fabric-smoke serving-smoke crash-smoke chaos-fuzz-smoke shard-smoke hotpath-smoke coldstart-smoke pallas-parity clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -86,6 +86,17 @@ robustness-cert:
 # CPU, no transformer builds.
 obs-smoke:
 	$(PY) tools/obs_smoke.py
+
+# Cost-attribution gate (docs/OBSERVABILITY.md §cost-attribution): the
+# seeded serving scenario four ways (plane on twice, off twice) —
+# byte-identical journal fingerprints across ALL FOUR (timelines,
+# ledger samples, and obs records never touch the replay-pinned
+# journal), gapless per-request stage decomposition, a cost estimate
+# for EVERY key the router's compile universe enumerates, and the
+# ledger rebuilt bit-identically from the streamed JSONL via
+# tools/obs_query.py.  Seconds on CPU, no transformer builds.
+obs-cost-smoke:
+	$(PY) tools/obs_cost_smoke.py
 
 # Multi-claim fabric gate (docs/FABRIC.md): the seeded 4-claim ×
 # 7-oracle scenario twice — byte-identical PER-CLAIM journal
@@ -177,7 +188,7 @@ chaos-fuzz-smoke:
 # convergence gates (I/O-plane, then data-plane), then the flight
 # recorder, then the fabric and serving tiers, then crash consistency
 # and the fault-space fuzzer, then the suite.
-verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke fabric-smoke shard-smoke serving-smoke hotpath-smoke coldstart-smoke chaos-fuzz-smoke crash-smoke test
+verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke obs-cost-smoke fabric-smoke shard-smoke serving-smoke hotpath-smoke coldstart-smoke chaos-fuzz-smoke crash-smoke test
 
 # End-of-round gate: lint + the driver-contract guards FIRST (fast,
 # loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
@@ -189,6 +200,7 @@ presnapshot:
 	$(MAKE) chaos-smoke
 	$(MAKE) robustness-smoke
 	$(MAKE) obs-smoke
+	$(MAKE) obs-cost-smoke
 	$(MAKE) fabric-smoke
 	$(MAKE) shard-smoke
 	$(MAKE) serving-smoke

@@ -1,0 +1,209 @@
+"""Cost-attribution plane overhead A/B (docs/OBSERVABILITY.md
+§cost-attribution).
+
+The question the artifact answers: does leaving the plane ON for every
+request cost anything the serving tier can feel?  The seeded
+``bench_serving.run_level`` scenario runs both arms — plane ``off``
+vs plane ``on`` — at a fixed below-knee offered load, ``--repeats``
+times each (interleaved off/on/off/on so drift hits both arms alike on
+this 1-core container).  Latency percentiles are VIRTUAL time and
+fingerprint-invariant, so the overhead metric is HOST time: every
+measured ``tier.step()`` ``perf_counter`` duration, pooled across
+repeats per arm, compared at p50/p99.  A mini plane-on knee sweep then
+re-derives the saturation knee to show the serving shape is untouched.
+
+Checks (gate): all fingerprints across BOTH arms and every repeat are
+byte-identical (the plane is replay-invisible under load, not just in
+the smoke), both arms measured real steps, and the knee survives.  The
+p99 overhead itself is REPORTED, not gated — ``tools/decide_perf.py``
+turns it into the ``cost_plane`` routing decision (on iff ≤ 5%).
+
+Usage::
+
+    python bench_obs.py [--seed 0] [--qps 120] [--repeats 3]
+                        [--out BENCH_OBS_r10.json]
+"""
+
+from __future__ import annotations
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import argparse  # noqa: E402
+import sys  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from bench_serving import DEFAULT_QPS, find_knee, run_level  # noqa: E402
+
+#: The ``cost_plane`` decision threshold (tools/decide_perf.py): the
+#: plane defaults ON only when its measured p99 host step overhead is
+#: within this fraction of the off arm.
+OVERHEAD_BUDGET = 0.05
+
+
+def run_arm(arm, qps, seed, repeats):
+    """Pooled host-step samples + per-repeat fingerprints for one arm."""
+    samples, fingerprints, records = [], [], []
+    for rep in range(repeats):
+        rec = run_level(qps, seed=seed, cost_plane=arm)
+        host = rec.pop("host_step_ms")
+        rec.pop("step_detail")
+        samples.extend(host["samples_s"])
+        fingerprints.append(rec["journal_fingerprint"])
+        records.append(
+            {
+                "repeat": rep,
+                "p50_host_ms": host["p50"],
+                "p99_host_ms": host["p99"],
+                "total_host_s": host["total_s"],
+                "completed": rec["completed"],
+                "journal_fingerprint": rec["journal_fingerprint"],
+            }
+        )
+    return samples, fingerprints, records
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--qps",
+        type=float,
+        default=120.0,
+        help="fixed below-knee offered load for the A/B",
+    )
+    p.add_argument("--repeats", type=int, default=5)
+    p.add_argument(
+        "--knee-qps",
+        default=",".join(str(q) for q in DEFAULT_QPS),
+        help="plane-on knee sweep levels",
+    )
+    p.add_argument("--out", default="BENCH_OBS_r10.json")
+    args = p.parse_args(argv)
+
+    from svoc_tpu.utils.artifacts import atomic_write_json
+
+    # One discarded run first: the process-level compile cost (jit
+    # tracing on the first dispatches) would otherwise land entirely on
+    # whichever arm runs first and swamp the A/B.
+    run_level(args.qps, seed=args.seed, cost_plane="off")
+    print("  warmup run discarded (process-level compiles paid)")
+
+    # Interleave arms per repeat so slow host drift (thermal, page
+    # cache) lands on both sides symmetrically.
+    pooled = {"off": [], "on": []}
+    prints = {"off": [], "on": []}
+    repeats = {"off": [], "on": []}
+    for rep in range(args.repeats):
+        for arm in ("off", "on"):
+            s, f, r = run_arm(arm, args.qps, args.seed, 1)
+            pooled[arm].extend(s)
+            prints[arm].extend(f)
+            repeats[arm].extend(
+                {**rec, "repeat": rep} for rec in r
+            )
+            print(
+                f"  rep {rep} {arm:>3}: p99 host "
+                f"{r[0]['p99_host_ms']:7.3f} ms, total "
+                f"{r[0]['total_host_s']:6.3f} s, fingerprint "
+                f"{f[0][:16]}"
+            )
+
+    arm_stats = {}
+    for arm in ("off", "on"):
+        vals = np.asarray(pooled[arm]) * 1e3  # samples are seconds
+        # Per-arm p99 = MEDIAN of the per-repeat p99s: the pooled p99
+        # is the top 1-2 samples of the pool — pure GC/scheduler noise
+        # on this 1-core container — while the median-of-p99s tracks
+        # the repeatable tail.
+        rep_p99s = [r["p99_host_ms"] for r in repeats[arm]]
+        arm_stats[arm] = {
+            "steps": int(vals.size),
+            "p50_host_ms": round(float(np.percentile(vals, 50)), 4),
+            "p99_host_ms": round(float(np.median(rep_p99s)), 4),
+            "p99_per_repeat_ms": rep_p99s,
+            "mean_host_ms": round(float(np.mean(vals)), 4),
+        }
+    p99_off = arm_stats["off"]["p99_host_ms"]
+    p99_on = arm_stats["on"]["p99_host_ms"]
+    p50_off = arm_stats["off"]["p50_host_ms"]
+    p50_on = arm_stats["on"]["p50_host_ms"]
+    p99_overhead = (p99_on - p99_off) / p99_off if p99_off > 0 else None
+    p50_overhead = (p50_on - p50_off) / p50_off if p50_off > 0 else None
+
+    print("  knee sweep (plane on):")
+    knee_levels = sorted(
+        float(tok) for tok in args.knee_qps.split(",") if tok
+    )
+    knee_sweep = []
+    for qps in knee_levels:
+        rec = run_level(qps, seed=args.seed, cost_plane="on")
+        rec.pop("step_detail")
+        rec.pop("host_step_ms")
+        knee_sweep.append(rec)
+        print(
+            f"    qps {qps:7.1f}: goodput {rec['goodput_qps']:7.1f}, "
+            f"shed {rec['shed_rate']:6.1%}"
+        )
+    knee = find_knee(knee_sweep)
+
+    checks = {
+        # One fingerprint across BOTH arms and all repeats: replay
+        # invisibility under open-loop load, per repeat, per arm.
+        "fingerprints_identical_across_arms": (
+            len(set(prints["off"]) | set(prints["on"])) == 1
+        ),
+        "both_arms_measured": all(
+            s["steps"] > 0 and s["p99_host_ms"] > 0
+            for s in arm_stats.values()
+        ),
+        "overhead_finite": p99_overhead is not None,
+        "knee_inside_sweep": bool(
+            knee and any(r["offered_qps"] > knee for r in knee_sweep)
+        ),
+    }
+    ok = all(checks.values())
+    from bench import device_topology
+
+    artifact = {
+        "seed": args.seed,
+        "qps": args.qps,
+        "repeats": args.repeats,
+        "device_topology": device_topology(),
+        "overhead_budget": OVERHEAD_BUDGET,
+        "p99_overhead": (
+            round(p99_overhead, 4) if p99_overhead is not None else None
+        ),
+        "p50_overhead": (
+            round(p50_overhead, 4) if p50_overhead is not None else None
+        ),
+        "within_budget": (
+            p99_overhead is not None and p99_overhead <= OVERHEAD_BUDGET
+        ),
+        "arms": arm_stats,
+        "arm_repeats": repeats,
+        "journal_fingerprint": prints["off"][0],
+        "knee_qps_plane_on": knee,
+        "knee_sweep": knee_sweep,
+        "checks": checks,
+        "ok": ok,
+    }
+    atomic_write_json(args.out, artifact)
+    for name, passed in checks.items():
+        print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+    print(
+        f"bench-obs {'OK' if ok else 'FAILED'}: p99 host step "
+        f"{p99_off:.3f} -> {p99_on:.3f} ms "
+        f"({p99_overhead:+.1%} overhead, budget {OVERHEAD_BUDGET:.0%}), "
+        f"p50 {p50_overhead:+.1%}, knee (plane on) ~{knee:g} QPS "
+        f"-> {args.out}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,6 +41,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import argparse  # noqa: E402
 import json  # noqa: E402
 import sys  # noqa: E402
+import time  # noqa: E402
 from typing import Any, Dict, List, Optional  # noqa: E402
 
 import numpy as np  # noqa: E402
@@ -88,9 +89,17 @@ def run_level(
     hot_pool: int = 12,
     hot_fraction: float = 0.3,
     vectorizer=None,
+    cost_plane: Optional[str] = None,
 ) -> Dict[str, Any]:
     """One offered-QPS level: a fresh seeded tier under ``steps`` of
-    open-loop arrivals; returns the level's metrics record."""
+    open-loop arrivals; returns the level's metrics record.
+
+    ``cost_plane`` pins the cost-attribution plane ``"on"`` / ``"off"``
+    for this level (None inherits the tier default); ``bench_obs.py``
+    A/Bs the two arms.  Latency percentiles are VIRTUAL time (identical
+    across arms by fingerprint invariance), so the record also carries
+    ``host_step_ms`` — real ``perf_counter`` per ``tier.step()`` over
+    the measured window — which is where plane overhead would show."""
     from svoc_tpu.fabric.registry import ClaimSpec
     from svoc_tpu.fabric.scenario import _claim_names, deterministic_vectorizer
     from svoc_tpu.fabric.session import MultiSession
@@ -126,6 +135,13 @@ def run_level(
         multi.add_claim(
             ClaimSpec(claim_id=name, n_oracles=n_oracles, dimension=dimension)
         )
+    plane = None
+    if cost_plane is not None:
+        from svoc_tpu.obsplane.plane import CostPlane
+
+        plane = CostPlane(
+            enabled=(cost_plane == "on"), clock=clock, metrics=metrics
+        )
     tier = ServingTier(
         multi,
         vectorizer=vec,
@@ -134,6 +150,7 @@ def run_level(
         ),
         max_requests_per_step=max_requests_per_step,
         clock=clock,
+        cost_plane=plane,
         slos=serving_slos(
             metrics,
             latency_target_s=2.5 * step_period_s,
@@ -146,6 +163,7 @@ def run_level(
     pool = [f"hot take {i} shared across markets" for i in range(hot_pool)]
     carry = 0.0  # fractional-arrival accumulator: offered rate is exact
     step_detail: List[Dict[str, Any]] = []
+    host_step_s: List[float] = []
     measured_submitted = 0
     shed_at_warmup = 0.0
     completed_at_warmup = 0.0
@@ -163,7 +181,10 @@ def run_level(
                 lambda c: f"unique {c} q{qps:g} s{step_no} #{i}",
             )
             tier.submit(claim, text)
+        t_host = time.perf_counter()
         report = tier.step()
+        if step_no >= warmup_steps:
+            host_step_s.append(time.perf_counter() - t_host)
         if step_no == warmup_steps - 1:
             shed_at_warmup = metrics.family_total("serving_shed")
             completed_at_warmup = metrics.family_total("serving_completed")
@@ -222,6 +243,15 @@ def run_level(
         "cache": tier.cache.stats(),
         "shed_by_reason": dict(sorted(reason_totals.items())),
         "journal_fingerprint": journal.fingerprint(),
+        "host_step_ms": {
+            "p50": round(float(np.percentile(host_step_s, 50)) * 1e3, 4),
+            "p99": round(float(np.percentile(host_step_s, 99)) * 1e3, 4),
+            "total_s": round(float(np.sum(host_step_s)), 4),
+            "samples_s": host_step_s,
+        },
+        **(
+            {"cost_plane": cost_plane} if cost_plane is not None else {}
+        ),
         **(
             {"packing_fill_ratio": fill_final}
             if any(fill_final.values())

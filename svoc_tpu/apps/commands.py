@@ -59,6 +59,11 @@ HELP = """Commands:
     - durability [snapshot] (crash-consistency status: snapshot
       freshness, commit-intent WAL health, open cycles; 'snapshot'
       forces one — docs/RESILIENCE.md)
+    - costs (shape-keyed dispatch-cost ledger: warm/cold EMA seconds
+      per compile key + per-stage request latency decomposition —
+      docs/OBSERVABILITY.md §cost-attribution)
+    - profile [start [seconds]|stop|status] (on-demand jax.profiler
+      capture, bounded duration; default: status)
     - drain (graceful teardown: stop admission, flush queues,
       snapshot, postmortem bundle — what SIGTERM does)
     - multimodal [K|auto] (mixture analysis of the last fetch;
@@ -123,6 +128,10 @@ class CommandConsole:
         #: durability section read them.  None = in-memory-only.
         self.durability = None
         self.drainer = None
+        #: On-demand profiler (docs/OBSERVABILITY.md
+        #: §cost-attribution): set by ``ProfileCapture.attach`` — the
+        #: ``profile`` command and ``GET /api/profile`` read it.
+        self.profiler = None
         self._auto_fetch_thread: Optional[threading.Thread] = None
         self._scraper_stop: Optional[threading.Event] = None
         self._scraper_thread: Optional[threading.Thread] = None
@@ -692,6 +701,58 @@ class CommandConsole:
                 for lin in status["wal_open_cycles"]:
                     emit(f"  OPEN {lin} — a commit is in flight (or a "
                          "crash awaits reconciliation)")
+            elif cmd == "costs":
+                # Shape-keyed dispatch-cost ledger
+                # (docs/OBSERVABILITY.md §cost-attribution).
+                plane = getattr(self.serving, "cost_plane", None) \
+                    if self.serving is not None else None
+                if plane is None:
+                    emit(
+                        "no cost plane attached — wire a ServingTier "
+                        "(docs/OBSERVABILITY.md §cost-attribution)"
+                    )
+                    return out
+                snap = plane.snapshot()
+                ledger = snap["ledger"]
+                emit(
+                    f"cost plane: "
+                    f"{'enabled' if snap['enabled'] else 'DISABLED'} — "
+                    f"{ledger['keys']} keys, {ledger['samples']} samples "
+                    f"(alpha={ledger['alpha']}), "
+                    f"{snap['observations']} observation records"
+                )
+                for key_str, entry in sorted(snap["entries"].items()):
+                    cells = entry["warmth"]
+                    rendered = "  ".join(
+                        f"{w}: {cells[w]['ema_s'] * 1e3:.2f} ms "
+                        f"({cells[w]['samples']}x)"
+                        for w in ("cold", "prewarmed", "warm")
+                        if w in cells
+                    )
+                    emit(f"  {key_str} [{entry['group']}]  {rendered}")
+            elif cmd == "profile":
+                # On-demand jax.profiler capture (bounded duration,
+                # docs/OBSERVABILITY.md §cost-attribution).
+                if self.profiler is None:
+                    emit(
+                        "no profiler attached — construct a "
+                        "ProfileCapture and attach(console) "
+                        "(docs/OBSERVABILITY.md §cost-attribution)"
+                    )
+                    return out
+                sub = args[0] if args else "status"
+                if sub == "start":
+                    duration = float(args[1]) if len(args) > 1 else None
+                    result = self.profiler.start(duration_s=duration)
+                elif sub == "stop":
+                    result = self.profiler.stop()
+                elif sub == "status":
+                    result = self.profiler.status()
+                else:
+                    emit("usage: profile [start [seconds]|stop|status]")
+                    return out
+                for k, v in sorted(result.items()):
+                    emit(f"{k}: {v}")
             elif cmd == "drain":
                 # The SIGTERM path, manually (docs/RESILIENCE.md
                 # §drain): stop admission, flush, snapshot, bundle.

@@ -435,6 +435,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, json.dumps(payload).encode(), "application/json")
         elif self.path == "/api/events" or self.path.startswith("/api/events?"):
             self._serve_events()
+        elif self.path == "/api/profile" or self.path.startswith("/api/profile?"):
+            self._serve_profile()
         elif self.path.startswith("/api/audit/"):
             # Per-block audit record (docs/OBSERVABILITY.md §lineage):
             # events + spans + summary joined on one lineage id.
@@ -539,14 +541,36 @@ class _Handler(BaseHTTPRequestHandler):
                     # ≤ 50 typed frames per tick: a journal burst drains
                     # over a few ticks instead of wedging this write
                     # loop (the busy-loop guard the cap test pins).
-                    wrote = False
-                    for rec in _journal.since(last_seq, limit=50):
-                        self.wfile.write(
-                            f"event: journal\ndata: {rec.to_json()}\n\n".encode()
-                        )
-                        last_seq = rec.seq
-                        wrote = True
-                    if wrote:
+                    # Truncation is VISIBLE, not silent
+                    # (docs/OBSERVABILITY.md §events): a capped tick
+                    # marks its LAST frame ``truncated: true`` and
+                    # counts the backlog it deferred in
+                    # ``sse_frames_dropped{stream="journal"}`` — a
+                    # consumer can tell "caught up" from "drinking from
+                    # a burst through a straw".
+                    batch = _journal.since(last_seq, limit=50)
+                    if batch:
+                        backlog = _journal.last_seq() - batch[-1].seq
+                        for i, rec in enumerate(batch):
+                            if backlog > 0 and i == len(batch) - 1:
+                                payload = rec.as_dict()
+                                payload["truncated"] = True
+                                data = json.dumps(payload, sort_keys=True)
+                            else:
+                                data = rec.to_json()
+                            self.wfile.write(
+                                f"event: journal\ndata: {data}\n\n".encode()
+                            )
+                            last_seq = rec.seq
+                        if backlog > 0:
+                            from svoc_tpu.utils.metrics import (
+                                registry as _metrics,
+                            )
+
+                            _metrics.counter(
+                                "sse_frames_dropped",
+                                labels={"stream": "journal"},
+                            ).add(backlog)
                         self.wfile.flush()
                         last_write = now
                 _time.sleep(0.25)
@@ -559,6 +583,55 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             with self.server.svoc_sse_lock:
                 self.server.svoc_sse_streams -= 1
+
+    def _serve_profile(self) -> None:
+        """``GET /api/profile`` — on-demand profiler control
+        (docs/OBSERVABILITY.md §cost-attribution).  ``?action=start``
+        (optional ``&duration_s=``), ``?action=stop``, or
+        ``?action=status`` (default).  503 when no profiler is
+        attached; the profiler itself never raises — a capture error
+        comes back as its status dict (500)."""
+        profiler = getattr(self.console, "profiler", None)
+        if profiler is None:
+            self._send(
+                503,
+                json.dumps({"error": "no profiler attached"}).encode(),
+                "application/json",
+            )
+            return
+        query = self.path.split("?", 1)[1] if "?" in self.path else ""
+        params = dict(
+            p.split("=", 1) for p in query.split("&") if "=" in p
+        )
+        action = params.get("action", "status")
+        if action == "start":
+            try:
+                duration_s = (
+                    float(params["duration_s"])
+                    if "duration_s" in params
+                    else None
+                )
+            except ValueError:
+                self._send(
+                    400,
+                    json.dumps({"error": "duration_s must be a number"}).encode(),
+                    "application/json",
+                )
+                return
+            result = profiler.start(duration_s=duration_s)
+        elif action == "stop":
+            result = profiler.stop()
+        elif action == "status":
+            result = profiler.status()
+        else:
+            self._send(
+                400,
+                json.dumps({"error": f"unknown action {action!r}"}).encode(),
+                "application/json",
+            )
+            return
+        code = 500 if result.get("status") == "error" else 200
+        self._send(code, json.dumps(result).encode(), "application/json")
 
     def do_POST(self):  # noqa: N802
         if self.path not in ("/api/query", "/api/submit"):

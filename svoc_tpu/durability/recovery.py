@@ -42,6 +42,7 @@ from svoc_tpu.utils.checkpoint import (
 )
 
 SNAPSHOT_NAME = "snapshot.json"
+COST_LEDGER_NAME = "cost_ledger.json"
 
 #: The recovery path's own kill window (the restart-storm class): the
 #: journal ring is restored and fingerprint-checked, but counters are
@@ -178,6 +179,20 @@ class RecoveryManager:
     def snapshot_path(self) -> str:
         return os.path.join(self.out_dir, SNAPSHOT_NAME)
 
+    @property
+    def cost_ledger_path(self) -> str:
+        return os.path.join(self.out_dir, COST_LEDGER_NAME)
+
+    def _cost_plane(self):
+        """The stack's cost-attribution plane, if one is wired: the
+        tier owns it; the router carries the tier's reference for the
+        dispatch hooks (docs/OBSERVABILITY.md §cost-attribution)."""
+        if self.tier is not None:
+            plane = getattr(self.tier, "cost_plane", None)
+            if plane is not None:
+                return plane
+        return getattr(self.multi.router, "cost_plane", None)
+
     def _journal(self):
         from svoc_tpu.utils.events import resolve_journal
 
@@ -227,6 +242,18 @@ class RecoveryManager:
                 self._compile_cache_max_bytes,
                 metrics=self._metrics,
             )
+        plane = self._cost_plane()
+        if plane is not None and plane.enabled:
+            # The cost ledger rides the snapshot cadence as its own
+            # sidecar artifact (atomic, like the snapshot): derived
+            # telemetry, so it never bloats snapshot.json and a torn
+            # ledger never fails a recovery.
+            try:
+                plane.save_ledger(self.cost_ledger_path)
+            except OSError:
+                self._metrics.counter(
+                    "cost_ledger_errors", labels={"op": "save"}
+                ).add(1)
         self._metrics.counter("durability_snapshots").add(1)
         journal.emit(
             "durability.snapshot",
@@ -313,6 +340,14 @@ class RecoveryManager:
                 report["requeued"] = self.tier.restore_serving_state(
                     payload["serving"]
                 )
+        plane = self._cost_plane()
+        if plane is not None and plane.enabled:
+            # Warm/cold cost estimates survive the restart with the
+            # process: a recovered scheduler plans with measured
+            # numbers, not a fresh empty ledger.
+            report["cost_ledger_keys"] = plane.restore_ledger(
+                self.cost_ledger_path
+            )
         report["lost_requests"] = self._account_lost_requests(journal, tail)
         if self.wal is not None:
             rec: ReconcileReport = reconcile_wal(

@@ -34,6 +34,7 @@ certifies).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -102,15 +103,47 @@ class _PendingGroup:
     time, so the pipelined write-back one cycle later journals against
     the RIGHT blocks even after the sessions fetched new ones."""
 
-    __slots__ = ("members", "cfg", "out", "oks", "bucket", "lineages")
+    __slots__ = (
+        "members",
+        "cfg",
+        "out",
+        "oks",
+        "bucket",
+        "lineages",
+        "warmth_key",
+        "warmth",
+        "h2d_s",
+        "dispatch_s",
+    )
 
-    def __init__(self, members, cfg, out, oks, bucket, lineages):
+    def __init__(
+        self,
+        members,
+        cfg,
+        out,
+        oks,
+        bucket,
+        lineages,
+        warmth_key=None,
+        warmth=None,
+        h2d_s=0.0,
+        dispatch_s=0.0,
+    ):
         self.members = members
         self.cfg = cfg
         self.out = out
         self.oks = oks
         self.bucket = bucket
         self.lineages = lineages
+        # Cost-plane context captured at dispatch time (the write-back
+        # may land a pipelined cycle later): the CompileKey + warmth
+        # this dispatch was accounted under, and its measured
+        # perf_counter windows (real host seconds — never the tier's
+        # virtual clock, never a fingerprint).
+        self.warmth_key = warmth_key
+        self.warmth = warmth
+        self.h2d_s = h2d_s
+        self.dispatch_s = dispatch_s
 
 
 class _GroupStaging:
@@ -262,6 +295,12 @@ class ClaimRouter:
         #: below distinguish a first dispatch the prewarmer already
         #: compiled (``prewarmed``) from a genuinely cold one.
         self.prewarmer = None
+        #: The serving tier's cost-attribution plane
+        #: (docs/OBSERVABILITY.md §cost-attribution), attached by
+        #: ``ServingTier.__init__``; None (or disabled) keeps every
+        #: dispatch-cost hook a no-op — the pull-mode fabric and its
+        #: seeded smoke fingerprints never see it.
+        self.cost_plane = None
         #: Compile keys this router has dispatched at least once — the
         #: cold/warm boundary of ``consensus_dispatch{warmth=}``.
         #: Router-thread-only (the scheduling loop is single-threaded).
@@ -511,8 +550,12 @@ class ClaimRouter:
                     self._finish_group(self._dispatch_group(members, cfg))
 
         # ---- commit + supervise + SLO, claim by claim ----
+        plane = self.cost_plane
+        track = plane is not None and plane.enabled
         for state in fetched:
             self._commit_claim(state)
+            if track:
+                plane.claim_mark([state.spec.claim_id], "committed")
             state.cycles += 1
             report["served"].append(state.spec.claim_id)
             report["claims"][state.spec.claim_id] = {
@@ -576,7 +619,17 @@ class ClaimRouter:
         # not depend on where the cube computed — the meshed==unmeshed
         # fingerprint identity (make shard-smoke) is a contract.
         journal_bucket = pow2_bucket(len(members))
-        warmth_key = self._account_warmth(values, cfg)
+        warmth_key, warmth = self._account_warmth(values, cfg)
+        # Cost plane (docs/OBSERVABILITY.md §cost-attribution): real
+        # perf_counter windows around the H2D + dispatch sections feed
+        # the shape-keyed ledger; per-claim timeline marks ride the
+        # plane's own (tier) clock.  `track` false keeps the hot path
+        # byte-identical to the plane-less router.
+        plane = self.cost_plane
+        track = plane is not None and plane.enabled
+        claim_ids = [s.spec.claim_id for s in members] if track else None
+        t_start = time.perf_counter() if track else 0.0
+        h2d_s = 0.0
         if self.sanitized_dispatch:
             # Gate + consensus in ONE traced program: the in-graph
             # quarantine twin recomputes the admission masks (identical
@@ -599,6 +652,9 @@ class ClaimRouter:
                 with stage_span("fabric_h2d"):
                     values_dev = self._h2d(values)
                     mask_dev = self._h2d(claim_mask)
+                if track:
+                    h2d_s = time.perf_counter() - t_start
+                    plane.claim_mark(claim_ids, "h2d")
                 with stage_span("fabric_dispatch"):
                     out, ok_traced = claims_consensus_sanitized(
                         values_dev,
@@ -623,6 +679,9 @@ class ClaimRouter:
                 values_dev = self._h2d(values)
                 ok_dev = self._h2d(ok)
                 mask_dev = self._h2d(claim_mask)
+            if track:
+                h2d_s = time.perf_counter() - t_start
+                plane.claim_mark(claim_ids, "h2d")
             with stage_span("fabric_dispatch"):
                 out = claims_consensus_gated(
                     values_dev,
@@ -633,11 +692,24 @@ class ClaimRouter:
                     metrics=self._metrics,
                     donate=self._donate,
                 )
+        dispatch_s = 0.0
+        if track:
+            dispatch_s = max(0.0, time.perf_counter() - t_start - h2d_s)
+            plane.claim_mark(claim_ids, "dispatched")
         # Seen only after the dispatch call returned: a raising
         # dispatch compiled nothing, and its retry must count cold.
         self._warmth_seen.add(warmth_key)
         return _PendingGroup(
-            members, cfg, out, oks, journal_bucket, lineages
+            members,
+            cfg,
+            out,
+            oks,
+            journal_bucket,
+            lineages,
+            warmth_key=warmth_key if track else None,
+            warmth=warmth,
+            h2d_s=h2d_s,
+            dispatch_s=dispatch_s,
         )
 
     def attach_prewarmer(self, worker) -> None:
@@ -658,9 +730,13 @@ class ClaimRouter:
         journal never sees warmth, so seeded replay fingerprints are
         independent of compile state (the coldstart-smoke gate).
 
-        Returns the key; the CALLER marks it seen after the dispatch
-        call succeeds (a raising dispatch compiled nothing — the retry
-        must count cold again, not read as warm)."""
+        Returns ``(key, warmth)``; the CALLER marks the key seen after
+        the dispatch call succeeds (a raising dispatch compiled nothing
+        — the retry must count cold again, not read as warm).  The
+        warmth string travels with the dispatch so the cost plane's
+        ledger folds the measured seconds into the regime this counter
+        accounted, even when the write-back lands a pipelined cycle
+        later (by which time the key reads warm)."""
         shape_key = (
             int(values.shape[0]),
             int(values.shape[1]),
@@ -690,7 +766,7 @@ class ClaimRouter:
         self._metrics.counter(
             "consensus_dispatch", labels={"warmth": warmth}
         ).add(1)
-        return key
+        return key, warmth
 
     def _group_staging(self, blocks, cfg, multiple: int) -> _GroupStaging:
         """The (shape, config) group's reusable staging buffers, sized
@@ -736,6 +812,13 @@ class ClaimRouter:
         out = pending.out
         oks = pending.oks
         c = len(members)
+        plane = self.cost_plane
+        track = (
+            plane is not None
+            and plane.enabled
+            and pending.warmth_key is not None
+        )
+        t_sync = time.perf_counter() if track else 0.0
         with stage_span("fabric_sync"):
             if not isinstance(oks, list):
                 # Sanitized dispatch: the traced in-graph masks (still
@@ -751,6 +834,25 @@ class ClaimRouter:
             rel2 = np.asarray(out.reliability_second_pass)
             reliable = np.asarray(out.reliable)
             valid = np.asarray(out.interval_valid)
+        if track:
+            # The dispatch's full host cost lands in the shape-keyed
+            # ledger here (one fold per GROUP, not per claim — the
+            # amortization is the point), under the warmth the dispatch
+            # was accounted at.
+            sync_s = time.perf_counter() - t_sync
+            plane.claim_mark(
+                [s.spec.claim_id for s in members], "synced"
+            )
+            plane.observe_dispatch(
+                pending.warmth_key,
+                pending.warmth,
+                pending.h2d_s + pending.dispatch_s + sync_s,
+                breakdown={
+                    "h2d": pending.h2d_s,
+                    "dispatch": pending.dispatch_s,
+                    "sync": sync_s,
+                },
+            )
         journal = self._resolve_journal()
         bucket = pending.bucket
         with stage_span("fabric_journal"):

@@ -1,0 +1,42 @@
+"""Cost-attribution plane (docs/OBSERVABILITY.md §cost-attribution):
+per-request latency decomposition, the shape-keyed dispatch-cost
+ledger, and on-demand profiling — the telemetry substrate ROADMAP
+items 1 (fleet placement) and 2 (cost-model scheduling) consume."""
+
+from svoc_tpu.obsplane.ledger import (
+    CostLedger,
+    CostModel,
+    group_key,
+    ledger_key,
+)
+from svoc_tpu.obsplane.plane import (
+    REQUEST_STAGE_HISTOGRAM,
+    CostPlane,
+    resolve_cost_plane,
+    resolve_cost_plane_enabled,
+)
+from svoc_tpu.obsplane.profiler import ProfileCapture
+from svoc_tpu.obsplane.timeline import (
+    MARKS,
+    STAGE_OF_MARK,
+    ObservationLog,
+    RequestTimeline,
+    read_observations,
+)
+
+__all__ = [
+    "CostLedger",
+    "CostModel",
+    "CostPlane",
+    "MARKS",
+    "ObservationLog",
+    "ProfileCapture",
+    "REQUEST_STAGE_HISTOGRAM",
+    "RequestTimeline",
+    "STAGE_OF_MARK",
+    "group_key",
+    "ledger_key",
+    "read_observations",
+    "resolve_cost_plane",
+    "resolve_cost_plane_enabled",
+]

@@ -1,0 +1,238 @@
+"""Shape-keyed dispatch-cost ledger (docs/OBSERVABILITY.md
+§cost-attribution).
+
+The router already keys every claim-cube dispatch by a
+:class:`~svoc_tpu.compile.universe.CompileKey` (its warmth accounting)
+— the ledger folds the measured host cost of each dispatch into an EMA
+per ``(key, warmth)`` cell, so ROADMAP item 2's scheduler can ask
+"what does a warm c8n7m6 sanitized dispatch cost HERE?" and get a
+number measured on this box instead of a guess.
+
+Key schema: ``CompileKey.label()`` deliberately omits ``cfg`` and
+``impl`` (metrics-label compactness), so the ledger string appends
+both deterministically::
+
+    sanitized:c4n7m6|xla|cfg#9d3a
+
+(`cfg#xxxx` is crc32-of-``repr(cfg)`` — stable across processes for
+equal configs, and two claims with different consensus configs never
+share a cost cell).  Samples are ``time.perf_counter`` windows from
+the router — REAL host seconds, independent of the scenario's virtual
+clock — and they reach fingerprints nowhere: the ledger lives outside
+the journal, and its ``cost.sample`` records ride the observation
+channel (:mod:`svoc_tpu.obsplane.timeline`).
+
+:class:`CostModel` is the read API: ``estimate(key)`` answers for
+EVERY key the compile plane's universe enumerates, falling back from
+the exact cell to the ``(n_oracles, dimension)`` group average to the
+global average (source-labeled, so a scheduler can discount borrowed
+estimates).  The EMA fold is order-deterministic: replaying the same
+``cost.sample`` stream through :meth:`CostLedger.observe` reproduces
+the persisted cell values exactly (``tools/obs_query.py``'s offline
+reconstruction).
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Dict, Optional
+
+#: EMA smoothing for cost folds — matches LatencyTimer's cadence-free
+#: convention; small enough to damp one slow outlier dispatch, large
+#: enough that a recompile-induced regime change shows within ~10
+#: dispatches.
+DEFAULT_ALPHA = 0.2
+
+WARMTHS = ("cold", "prewarmed", "warm")
+
+
+def ledger_key(key) -> str:
+    """Deterministic ledger cell id for a CompileKey — ``label()``
+    plus the impl and a crc32 cfg signature it omits."""
+    cfg_sig = zlib.crc32(repr(key.cfg).encode()) & 0xFFFF
+    return f"{key.label()}|{key.impl}|cfg#{cfg_sig:04x}"
+
+
+def group_key(key) -> str:
+    """The fallback-pool id: keys sharing (N, M) have comparable
+    per-dispatch cost regardless of bucket/kind/donate."""
+    return f"n{key.n_oracles}m{key.dimension}"
+
+
+class CostLedger:
+    """EMA cost cells keyed ``ledger_key × warmth``; thread-safe
+    (router dispatch threads fold, snapshot/console read)."""
+
+    def __init__(self, *, alpha: float = DEFAULT_ALPHA):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError("alpha must be in (0, 1]")
+        self.alpha = alpha
+        self._lock = threading.Lock()
+        #: {key_str: {"group": str, "warmth": {w: {"ema_s", "samples"}}}}
+        self._entries: Dict[str, dict] = {}
+
+    def observe_key_str(
+        self, key_str: str, group: str, warmth: str, seconds: float
+    ) -> None:
+        """Fold one measured dispatch into its cell (string-keyed twin
+        of :meth:`observe` — the offline reconstruction path, which has
+        JSONL records instead of CompileKeys)."""
+        seconds = float(seconds)
+        with self._lock:
+            entry = self._entries.setdefault(
+                key_str, {"group": group, "warmth": {}}
+            )
+            cell = entry["warmth"].get(warmth)
+            if cell is None:
+                entry["warmth"][warmth] = {"ema_s": seconds, "samples": 1}
+            else:
+                cell["ema_s"] += self.alpha * (seconds - cell["ema_s"])
+                cell["samples"] += 1
+
+    def observe(self, key, warmth: str, seconds: float) -> str:
+        """Fold one dispatch measured against its CompileKey; returns
+        the cell id (the router's ``cost.sample`` record carries it)."""
+        key_str = ledger_key(key)
+        self.observe_key_str(key_str, group_key(key), warmth, seconds)
+        return key_str
+
+    def to_dict(self) -> dict:
+        """JSON-safe state (the ``cost_ledger.json`` snapshot payload
+        and the ``/api/state`` costs section)."""
+        with self._lock:
+            return {
+                "version": 1,
+                "alpha": self.alpha,
+                "entries": {
+                    k: {
+                        "group": e["group"],
+                        "warmth": {
+                            w: dict(c) for w, c in e["warmth"].items()
+                        },
+                    }
+                    for k, e in self._entries.items()
+                },
+            }
+
+    def restore(self, payload: dict) -> int:
+        """Load persisted cells (snapshot recovery); returns the count.
+        Tolerates absent/foreign payloads — a ledger is derived
+        telemetry, never worth failing a recovery over."""
+        entries = (payload or {}).get("entries")
+        if not isinstance(entries, dict):
+            return 0
+        cleaned: Dict[str, dict] = {}
+        for key_str, entry in entries.items():
+            warmth = entry.get("warmth") if isinstance(entry, dict) else None
+            if not isinstance(warmth, dict):
+                continue
+            cells = {
+                w: {"ema_s": float(c["ema_s"]), "samples": int(c["samples"])}
+                for w, c in warmth.items()
+                if isinstance(c, dict) and "ema_s" in c and "samples" in c
+            }
+            if cells:
+                cleaned[key_str] = {
+                    "group": str(entry.get("group", "")),
+                    "warmth": cells,
+                }
+        with self._lock:
+            self._entries.update(cleaned)
+            return len(self._entries)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def summary(self) -> dict:
+        with self._lock:
+            samples = sum(
+                c["samples"]
+                for e in self._entries.values()
+                for c in e["warmth"].values()
+            )
+            return {
+                "keys": len(self._entries),
+                "samples": samples,
+                "alpha": self.alpha,
+            }
+
+
+class CostModel:
+    """The scheduler-facing read API over a :class:`CostLedger`
+    (ROADMAP item 2).  Estimates are dicts, not bare floats, because
+    the SOURCE matters to a placement decision: an ``exact`` warm
+    number is load-bearing, a ``group`` borrow is a same-shape-family
+    guess, a ``global`` borrow is barely better than nothing — and
+    ``None`` means the fleet has measured nothing at all yet."""
+
+    def __init__(self, ledger: CostLedger):
+        self.ledger = ledger
+
+    @staticmethod
+    def _warm_cold(cells: Dict[str, dict]) -> Dict[str, Optional[dict]]:
+        """Collapse warmth cells to the scheduler's two regimes: warm
+        (steady-state; ``prewarmed`` counts — an AOT-compiled first
+        dispatch pays no compile) and cold (first-touch)."""
+        warm = cells.get("warm") or cells.get("prewarmed")
+        cold = cells.get("cold")
+        return {"warm": warm, "cold": cold}
+
+    def estimate(self, key) -> dict:
+        """Warm/cold cost estimates for one CompileKey, with fallback:
+        exact cell → (N, M) group average → global average.  Each
+        regime falls back independently (a key dispatched only warm
+        borrows its cold estimate from the group)."""
+        key_str = ledger_key(key)
+        group = group_key(key)
+        with self.ledger._lock:
+            entries = {
+                k: {
+                    "group": e["group"],
+                    "warmth": {w: dict(c) for w, c in e["warmth"].items()},
+                }
+                for k, e in self.ledger._entries.items()
+            }
+
+        exact = self._warm_cold(entries[key_str]["warmth"]) if key_str in entries else {"warm": None, "cold": None}
+
+        def pool_average(pool) -> Dict[str, Optional[dict]]:
+            sums = {"warm": [0.0, 0], "cold": [0.0, 0]}
+            for entry in pool:
+                regimes = self._warm_cold(entry["warmth"])
+                for regime, cell in regimes.items():
+                    if cell is not None:
+                        sums[regime][0] += cell["ema_s"]
+                        sums[regime][1] += 1
+            return {
+                regime: (
+                    {"ema_s": total / n, "samples": n}
+                    if n
+                    else None
+                )
+                for regime, (total, n) in sums.items()
+            }
+
+        group_avg = pool_average(
+            e for e in entries.values() if e["group"] == group
+        )
+        global_avg = pool_average(entries.values())
+
+        out = {"key": key_str, "group": group}
+        for regime in ("warm", "cold"):
+            for source, cell in (
+                ("exact", exact[regime]),
+                ("group", group_avg[regime]),
+                ("global", global_avg[regime]),
+            ):
+                if cell is not None:
+                    out[regime] = {
+                        "seconds": cell["ema_s"],
+                        "source": source,
+                        "samples": cell["samples"],
+                    }
+                    break
+            else:
+                out[regime] = None
+        return out

@@ -1,0 +1,238 @@
+"""The cost-attribution plane: one object wiring timelines, the cost
+ledger, and the observation channel through the serving tier and the
+claim router (docs/OBSERVABILITY.md §cost-attribution).
+
+The plane's ``enabled`` flag is resolved ONCE at construction
+(``SVOC_COST_PLANE`` env > the committed ``PERF_DECISIONS.json``
+``cost_plane`` routing > off — the same pinning discipline as
+``consensus_impl``/``commit_mode``, SVOC011): a half-run flag flip
+would split a request's marks across regimes.  Disabled, every hook is
+a cheap attribute check and the serving hot path is byte-for-byte the
+same stream of journal events — ``make obs-cost-smoke`` certifies the
+fingerprints ON vs OFF.
+
+Two clocks, deliberately:
+
+- **timeline marks** use the TIER's clock (virtual in seeded
+  scenarios) — stage sums must telescope to the same end-to-end
+  latency the ``request_latency_seconds`` histogram sees;
+- **ledger samples** use ``time.perf_counter`` — the scheduler needs
+  the real host cost of a dispatch, which a virtual clock cannot see.
+
+Neither reaches a fingerprint: marks aggregate into the
+``request_stage_seconds{stage=,claim=}`` histogram and the observation
+channel; ledger samples live in the ledger and ``cost.sample``
+observation records.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from svoc_tpu.obsplane.ledger import CostLedger, CostModel, group_key
+from svoc_tpu.obsplane.timeline import ObservationLog, RequestTimeline
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_registry
+
+#: The per-stage, per-claim latency decomposition histogram — the
+#: request_latency_seconds twin with a stage axis.
+REQUEST_STAGE_HISTOGRAM = "request_stage_seconds"
+
+
+def _decisions_cost_plane() -> Optional[str]:
+    """The committed ``cost_plane`` routing, or None."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "PERF_DECISIONS.json",
+    )
+    try:
+        with open(path) as f:
+            decisions = json.load(f)
+        value = decisions.get("cost_plane")
+        return value if isinstance(value, str) else None
+    except (OSError, ValueError, AttributeError):
+        return None
+
+
+def resolve_cost_plane_enabled(enabled: Optional[bool] = None) -> bool:
+    """Construction-time resolution: explicit arg > ``SVOC_COST_PLANE``
+    env (`1/on/true` vs `0/off/false`) > PERF_DECISIONS.json
+    ``cost_plane`` > off."""
+    if enabled is not None:
+        return bool(enabled)
+    env = os.environ.get("SVOC_COST_PLANE", "").strip().lower()
+    if env in ("1", "on", "true", "yes"):
+        return True
+    if env in ("0", "off", "false", "no"):
+        return False
+    return _decisions_cost_plane() == "on"
+
+
+class CostPlane:
+    """Timeline recorder + cost ledger + observation log behind one
+    enabled flag.  Thread-safety: timeline marks for one request happen
+    on the tier's step thread; the router's per-claim marks are stored
+    per step and folded on the same thread; the ledger and log lock
+    internally."""
+
+    def __init__(
+        self,
+        *,
+        enabled: Optional[bool] = None,
+        clock: Optional[Callable[[], float]] = None,
+        metrics: Optional[MetricsRegistry] = None,
+        trace_path: Optional[str] = None,
+        alpha: Optional[float] = None,
+    ):
+        self.enabled = resolve_cost_plane_enabled(enabled)
+        self._clock = clock if clock is not None else time.monotonic
+        self._metrics = metrics or _default_registry
+        self.obslog = ObservationLog(trace_path=trace_path)
+        self.ledger = CostLedger(**({"alpha": alpha} if alpha else {}))
+        self.model = CostModel(self.ledger)
+        #: Per-claim dispatch marks for the CURRENT serving step
+        #: ({claim_id: [(mark, t)]}); the router writes, the tier folds
+        #: into each completed request's timeline and clears per step.
+        self._claim_marks: Dict[str, List[Tuple[str, float]]] = {}
+
+    # -- timeline hooks (serving tier clock) ---------------------------------
+
+    def timeline_for(
+        self, lineage: str, claim: str, t_submit: float
+    ) -> Optional[RequestTimeline]:
+        if not self.enabled:
+            return None
+        return RequestTimeline(lineage, claim, t_submit)
+
+    def mark_requests(self, requests: Sequence, name: str) -> None:
+        """Mark every request that carries a timeline, NOW on the tier
+        clock (one clock read per call, not per request)."""
+        if not self.enabled:
+            return
+        now = self._clock()
+        for request in requests:
+            timeline = getattr(request, "timeline", None)
+            if timeline is not None:
+                timeline.mark(name, now)
+
+    def claim_mark(self, claim_ids: Sequence[str], name: str) -> None:
+        """Router-side per-claim marks (h2d/dispatched/synced/
+        committed): the router knows claims, not requests — the tier
+        folds these into each request's timeline at completion."""
+        if not self.enabled:
+            return
+        now = self._clock()
+        for cid in claim_ids:
+            self._claim_marks.setdefault(cid, []).append((name, now))
+
+    def complete(self, request, now: float, outcome: str = "completed") -> None:
+        """Finalize one request's timeline: fold the claim marks in,
+        observe per-stage histograms, append the ``timeline.request``
+        observation record."""
+        timeline = getattr(request, "timeline", None)
+        if not self.enabled or timeline is None:
+            return
+        timeline.extend(self._claim_marks.get(request.claim, ()))
+        timeline.mark("completed", now)
+        stages = timeline.stages()
+        if outcome == "completed":
+            for stage, seconds in stages.items():
+                self._metrics.histogram(
+                    REQUEST_STAGE_HISTOGRAM,
+                    labels={"stage": stage, "claim": request.claim},
+                ).observe(seconds)
+        self.obslog.record(
+            "timeline.request",
+            lineage=timeline.lineage,
+            claim=request.claim,
+            outcome=outcome,
+            e2e_s=timeline.e2e_s(),
+            stages=stages,
+        )
+
+    def shed(self, lineage: str, claim: str, reason: str) -> None:
+        """Admission-only timeline for a shed request: the verdict is
+        in the journal (``serving.shed``); the observation record makes
+        the lineage joinable in the same timeline tooling."""
+        if not self.enabled:
+            return
+        self.obslog.record(
+            "timeline.request",
+            lineage=lineage,
+            claim=claim,
+            outcome="shed",
+            reason=reason,
+            e2e_s=0.0,
+            stages={},
+        )
+
+    def end_step(self) -> None:
+        """Clear the per-step claim marks (tier calls once per step,
+        after completions are folded)."""
+        if self._claim_marks:
+            self._claim_marks.clear()
+
+    # -- ledger hooks (real host clock) --------------------------------------
+
+    def observe_dispatch(
+        self, key, warmth: str, seconds: float, breakdown: Optional[dict] = None
+    ) -> None:
+        """Fold one measured dispatch into the ledger and append its
+        ``cost.sample`` observation record (the offline-reconstruction
+        source: same samples, same order, same alpha ⇒ same EMAs)."""
+        if not self.enabled:
+            return
+        key_str = self.ledger.observe(key, warmth, seconds)
+        self._metrics.counter(
+            "cost_samples", labels={"warmth": warmth}
+        ).add(1)
+        self.obslog.record(
+            "cost.sample",
+            key=key_str,
+            group=group_key(key),
+            warmth=warmth,
+            seconds=seconds,
+            **({"breakdown": breakdown} if breakdown else {}),
+        )
+
+    # -- persistence + views -------------------------------------------------
+
+    def save_ledger(self, path: str) -> None:
+        from svoc_tpu.utils.artifacts import atomic_write_json
+
+        atomic_write_json(path, self.ledger.to_dict())
+
+    def restore_ledger(self, path: str) -> int:
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return 0
+        return self.ledger.restore(payload)
+
+    def snapshot(self) -> dict:
+        """The ``costs`` section for ``ServingTier.snapshot()`` /
+        ``/api/state`` / the console's ``costs`` command."""
+        return {
+            "enabled": self.enabled,
+            "ledger": self.ledger.summary(),
+            "entries": self.ledger.to_dict()["entries"],
+            "observations": len(self.obslog),
+        }
+
+
+def resolve_cost_plane(
+    *,
+    enabled: Optional[bool] = None,
+    clock: Optional[Callable[[], float]] = None,
+    metrics: Optional[MetricsRegistry] = None,
+    trace_path: Optional[str] = None,
+) -> CostPlane:
+    """Build the tier's cost plane with the routing resolved once
+    (docstring above) — the ServingTier default."""
+    return CostPlane(
+        enabled=enabled, clock=clock, metrics=metrics, trace_path=trace_path
+    )

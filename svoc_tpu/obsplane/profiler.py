@@ -1,0 +1,203 @@
+"""On-demand ``jax.profiler`` capture sessions (docs/OBSERVABILITY.md
+§cost-attribution).
+
+The span histograms say WHICH stage is slow; when the answer is "the
+device" you need the XLA view, and by the time a human starts XProf the
+incident is over.  :class:`ProfileCapture` makes the capture a
+first-class, bounded operation:
+
+- **manual** — console ``profile start/stop``, ``GET /api/profile`` —
+  starts a capture into ``<out_dir>/profile-<n>`` (a monotone index,
+  NOT a timestamp: the capture path is journaled and wall clock never
+  enters journal data — SVOC008);
+- **automatic** — the :class:`~svoc_tpu.utils.postmortem.
+  PostmortemMonitor` calls :meth:`maybe_capture` on SLO burn /
+  breaker-open, rate-limited (default 120 s between auto captures) so
+  a flapping breaker cannot fill the disk with traces;
+- **bounded** — every capture arms a daemon timer that force-stops it
+  after ``max_duration_s`` (default 30 s): an operator who starts a
+  capture and gets paged away must not leave the profiler running for
+  a week.
+
+Completion journals one ``profile.captured`` event (trigger + path —
+an incident-path event like ``postmortem.bundle``; it never fires in
+seeded replays).  When ``jax.profiler`` is unavailable or a capture
+fails, the plane degrades LOUDLY-BUT-OPEN: the error lands in
+``profile_errors_total{stage=}`` and the returned status, and serving
+is never taken down over telemetry.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from svoc_tpu.utils.metrics import MetricsRegistry
+from svoc_tpu.utils.metrics import registry as _default_registry
+
+
+class ProfileCapture:
+    """One process-wide profiler session manager (jax.profiler allows
+    a single active trace, so concurrency is a feature, not a limit)."""
+
+    def __init__(
+        self,
+        out_dir: str = "profiles",
+        *,
+        journal=None,
+        metrics: Optional[MetricsRegistry] = None,
+        max_duration_s: float = 30.0,
+        auto_min_interval_s: float = 120.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.out_dir = out_dir
+        self._journal = journal
+        self._metrics = metrics or _default_registry
+        self.max_duration_s = max_duration_s
+        self.auto_min_interval_s = auto_min_interval_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._active: Optional[dict] = None
+        self._timer: Optional[threading.Timer] = None
+        self._captures = 0
+        self._last_auto: Optional[float] = None
+
+    # -- availability --------------------------------------------------------
+
+    @staticmethod
+    def available() -> bool:
+        try:
+            import jax.profiler  # noqa: F401
+
+            return True
+        except Exception:
+            return False
+
+    def _emit(self, event_type: str, **data) -> None:
+        j = self._journal
+        if j is None:
+            from svoc_tpu.utils.events import journal as j
+        j.emit(event_type, **data)
+
+    def _error(self, stage: str, exc: Exception) -> dict:
+        self._metrics.counter(
+            "profile_errors", labels={"stage": stage}
+        ).add(1)
+        return {
+            "status": "error",
+            "stage": stage,
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+
+    # -- capture lifecycle ---------------------------------------------------
+
+    def start(
+        self,
+        trigger: str = "manual",
+        duration_s: Optional[float] = None,
+    ) -> dict:
+        """Start a capture.  Returns a status dict, never raises:
+        ``started`` / ``already_running`` / ``unavailable`` /
+        ``error``."""
+        duration = min(
+            self.max_duration_s,
+            duration_s if duration_s is not None else self.max_duration_s,
+        )
+        with self._lock:
+            if self._active is not None:
+                return {"status": "already_running", **self._active}
+            self._captures += 1
+            index = self._captures
+        log_dir = os.path.join(self.out_dir, f"profile-{index:04d}")
+        try:
+            import jax.profiler
+        except Exception as e:
+            return self._error("import", e)
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            jax.profiler.start_trace(log_dir)
+        except Exception as e:
+            return self._error("start", e)
+        info = {"path": log_dir, "trigger": trigger, "index": index}
+        timer = threading.Timer(duration, self._auto_stop, args=(index,))
+        timer.daemon = True
+        with self._lock:
+            self._active = info
+            self._timer = timer
+        timer.start()
+        self._metrics.counter(
+            "profile_captures", labels={"trigger": trigger}
+        ).add(1)
+        return {"status": "started", "duration_s": duration, **info}
+
+    def stop(self) -> dict:
+        """Stop the active capture and journal ``profile.captured``."""
+        with self._lock:
+            info = self._active
+            timer = self._timer
+            self._active = None
+            self._timer = None
+        if info is None:
+            return {"status": "idle"}
+        if timer is not None:
+            timer.cancel()
+        try:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+        except Exception as e:
+            return self._error("stop", e)
+        # Outside the lock (the journal lock is a leaf — SVOC010), and
+        # the data carries no clock readings (SVOC008): the capture's
+        # own timing lives in the profile artifact, not the journal.
+        self._emit(
+            "profile.captured",
+            trigger=info["trigger"],
+            path=info["path"],
+        )
+        return {"status": "captured", **info}
+
+    def _auto_stop(self, index: int) -> None:
+        """Duration-bound force stop; a no-op when the operator
+        already stopped (or a newer capture started)."""
+        with self._lock:
+            if self._active is None or self._active["index"] != index:
+                return
+        self.stop()
+
+    def maybe_capture(self, trigger: str) -> Optional[dict]:
+        """The automatic path (postmortem monitor): start a capture
+        unless one is running or the auto rate limit holds.  Suppressed
+        calls are counted, not raised."""
+        now = self._clock()
+        with self._lock:
+            if self._active is not None:
+                return None
+            if (
+                self._last_auto is not None
+                and now - self._last_auto < self.auto_min_interval_s
+            ):
+                self._metrics.counter(
+                    "profile_suppressed", labels={"reason": "rate_limit"}
+                ).add(1)
+                return None
+            self._last_auto = now
+        return self.start(trigger=trigger)
+
+    def status(self) -> dict:
+        with self._lock:
+            active = dict(self._active) if self._active else None
+            captures = self._captures
+        return {
+            "available": self.available(),
+            "active": active,
+            "captures": captures,
+            "max_duration_s": self.max_duration_s,
+        }
+
+    def attach(self, console) -> None:
+        """Expose through a CommandConsole: the ``profile`` command and
+        ``GET /api/profile`` read ``console.profiler``."""
+        console.profiler = self

@@ -1,0 +1,201 @@
+"""Per-request latency decomposition (docs/OBSERVABILITY.md
+§cost-attribution).
+
+Two pieces:
+
+- :class:`ObservationLog` — the **observation channel**: a sidecar
+  stream of ``"obs"``-keyed JSONL lines sharing the flight-recorder
+  file with spans (keyed ``"name"``) and journal events (keyed
+  ``"event"``), plus its own bounded in-memory ring.  Observation
+  records NEVER enter the :class:`~svoc_tpu.utils.events.EventJournal`:
+  the replay fingerprint digests every journal record *including its
+  seq*, so a timeline record in the ring would shift sibling seqs and
+  break the ON-vs-OFF byte-identity `make obs-cost-smoke` certifies.
+  ``read_trace_events`` keeps only ``"event"``-keyed lines, so recovery
+  roll-forward is equally blind to this channel — observations are
+  derived telemetry, not replayable history.
+- :class:`RequestTimeline` — ordered marks on ONE clock (the serving
+  tier's: virtual in seeded scenarios, monotonic live) along a
+  request's path: admitted → assembled → vectorized → h2d → dispatched
+  → synced → committed → completed.  Stage durations are differences of
+  CONSECUTIVE marks, so their sum telescopes exactly to the end-to-end
+  latency — gapless by construction, which the smoke asserts.  Under a
+  virtual clock every intra-step stage is 0 and ``queue_wait`` carries
+  the steps a request waited; live, each stage carries real host time.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from svoc_tpu.utils.events import release_writer, shared_writer
+
+#: Canonical mark order.  A timeline may skip marks (a deferred claim
+#: has no commit cycle that step); the stage between two PRESENT
+#: neighbors is named after the later mark, so sums stay telescoping.
+MARKS = (
+    "admitted",
+    "assembled",
+    "vectorized",
+    "h2d",
+    "dispatched",
+    "synced",
+    "committed",
+    "completed",
+)
+
+#: mark → the stage name that ENDS at it (docs/OBSERVABILITY.md's
+#: stage table).  ``admitted`` starts the clock and ends nothing.
+STAGE_OF_MARK = {
+    "assembled": "queue_wait",
+    "vectorized": "vectorize",
+    "h2d": "h2d",
+    "dispatched": "dispatch",
+    "synced": "sync",
+    "committed": "commit",
+    "completed": "respond",
+}
+
+_MARK_ORDER = {name: i for i, name in enumerate(MARKS)}
+
+
+class RequestTimeline:
+    """Marks along one serving request's path, all on one clock."""
+
+    __slots__ = ("lineage", "claim", "marks")
+
+    def __init__(self, lineage: str, claim: str, t_submit: float):
+        self.lineage = lineage
+        self.claim = claim
+        self.marks: List[Tuple[str, float]] = [("admitted", t_submit)]
+
+    def mark(self, name: str, t: float) -> None:
+        """Record one mark; re-marks of the same name are ignored (the
+        first crossing wins — a request served from a claim that
+        dispatched twice in one step keeps its first completion path)."""
+        if name not in _MARK_ORDER:
+            raise ValueError(f"unknown timeline mark {name!r}")
+        if any(existing == name for existing, _ in self.marks):
+            return
+        self.marks.append((name, t))
+
+    def extend(self, marks) -> None:
+        """Merge externally-collected ``(name, t)`` marks (the router's
+        per-claim dispatch marks)."""
+        for name, t in marks:
+            self.mark(name, t)
+
+    def stages(self) -> Dict[str, float]:
+        """Stage durations between consecutive PRESENT marks, in mark
+        order.  Never negative (a claim mark taken before this
+        request's own vectorize mark under a live clock clamps to 0 —
+        the sum check tolerance covers the clamp)."""
+        ordered = sorted(self.marks, key=lambda m: _MARK_ORDER[m[0]])
+        out: Dict[str, float] = {}
+        for (_prev, t_prev), (name, t) in zip(ordered, ordered[1:]):
+            out[STAGE_OF_MARK[name]] = max(0.0, t - t_prev)
+        return out
+
+    def e2e_s(self) -> float:
+        ordered = sorted(self.marks, key=lambda m: _MARK_ORDER[m[0]])
+        return max(0.0, ordered[-1][1] - ordered[0][1])
+
+
+class ObservationLog:
+    """Bounded ring + ``"obs"``-keyed JSONL sidecar for derived
+    telemetry (timelines, cost samples).  Same writer pool and
+    rotation/error-latch discipline as the tracer; its seq counter is
+    its OWN — observation seqs never interleave with journal seqs."""
+
+    def __init__(self, *, max_records: int = 4096, trace_path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max_records)
+        self._seq = 0
+        self._writer = None
+        self._trace_path: Optional[str] = None
+        self._write_error_latched = False
+        if trace_path:
+            self.set_trace_file(trace_path)
+
+    def set_trace_file(self, path: Optional[str]) -> None:
+        with self._lock:
+            old = self._trace_path
+            self._trace_path = path
+            self._writer = shared_writer(path) if path else None
+            self._write_error_latched = False
+        if old and old != path:
+            release_writer(old)
+
+    def record(self, kind: str, *, lineage: Optional[str] = None, **data) -> dict:
+        """Append one observation; JSONL write happens outside the
+        lock (leaf-lock discipline, same as the journal's)."""
+        import json
+
+        with self._lock:
+            self._seq += 1
+            rec = {
+                "obs": kind,
+                "seq": self._seq,
+                "lineage": lineage,
+                "data": data,
+            }
+            self._ring.append(rec)
+            writer = self._writer
+            latched = self._write_error_latched
+        if writer is not None and not latched:
+            try:
+                writer.write_line(json.dumps(rec, sort_keys=True))
+            except OSError:
+                # Loud-but-open: the plane keeps its in-memory ring and
+                # the latch stops per-record error spam.
+                with self._lock:
+                    self._write_error_latched = True
+        return rec
+
+    def recent(
+        self,
+        n: int = 50,
+        *,
+        kind: Optional[str] = None,
+        lineage: Optional[str] = None,
+    ) -> List[dict]:
+        with self._lock:
+            records = list(self._ring)
+        if kind is not None:
+            records = [r for r in records if r["obs"] == kind]
+        if lineage is not None:
+            records = [r for r in records if r["lineage"] == lineage]
+        return records[-n:]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+def read_observations(path: str, keep: Optional[int] = None) -> List[dict]:
+    """Offline twin of ``read_trace_events`` for the observation
+    channel: every ``"obs"``-keyed line across the rotated segment
+    chain, oldest first, tolerating a torn final line."""
+    import json
+    import os
+
+    keep = keep if keep is not None else 8
+    out: List[dict] = []
+    segments = [f"{path}.{i}" for i in range(keep, 0, -1)] + [path]
+    for segment in segments:
+        if not os.path.exists(segment):
+            continue
+        with open(segment, "r") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail of a crashed writer
+                if isinstance(rec, dict) and "obs" in rec:
+                    out.append(rec)
+    return out

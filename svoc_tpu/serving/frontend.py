@@ -146,6 +146,7 @@ class ServingRequest:
         "key",
         "t_submit",
         "vector",
+        "timeline",
     )
 
     def __init__(
@@ -175,6 +176,12 @@ class ServingRequest:
         )
         self.t_submit = t_submit
         self.vector: Optional[np.ndarray] = None
+        #: Cost-attribution timeline (docs/OBSERVABILITY.md
+        #: §cost-attribution) — attached at admission when the tier's
+        #: cost plane is enabled, None otherwise (and always None for
+        #: snapshot-restored requests: a timeline spanning a restart
+        #: would mix two clocks).
+        self.timeline = None
 
 
 class ServingFrontend:
@@ -191,6 +198,7 @@ class ServingFrontend:
         journal=None,
         clock=None,
         cold_gate=None,
+        cost_plane=None,
     ):
         import time
 
@@ -228,6 +236,10 @@ class ServingFrontend:
         #: (the default, and always once warmup finishes) defers
         #: nothing — the PR 7 admission path byte-for-byte.
         self._cold_gate = cold_gate
+        #: Cost-attribution plane (docs/OBSERVABILITY.md
+        #: §cost-attribution); None/disabled leaves the submit path —
+        #: and its journal event stream — byte-identical.
+        self._cost_plane = cost_plane
 
     # -- the submit path ----------------------------------------------------
 
@@ -291,6 +303,12 @@ class ServingFrontend:
             claim_id, text, seq, lineage, self._clock(), key=key,
             digest=digest,
         )
+        plane = self._cost_plane
+        if plane is not None and plane.enabled:
+            # The admission mark IS t_submit — queue wait starts here.
+            request.timeline = plane.timeline_for(
+                lineage, claim_id, request.t_submit
+            )
         deferred = self.is_cold(claim_id)
         with self._lock:
             q = self._queues.setdefault(claim_id, deque())
@@ -365,6 +383,12 @@ class ServingFrontend:
             seq=seq,
             reason=decision.reason,
         )
+        if plane is not None and plane.enabled:
+            # Admission-only timeline: the shed verdict is journaled
+            # above; this observation-channel record keeps the lineage
+            # joinable in the same timeline tooling (and fingerprints
+            # never see it).
+            plane.shed(lineage, claim_id, decision.reason)
         return {
             "status": "shed",
             "claim": claim_id,

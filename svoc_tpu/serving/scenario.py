@@ -89,10 +89,21 @@ def run_serving_scenario(
     hot_fraction: float = 0.35,
     journal=None,
     metrics=None,
+    cost_plane: Optional[str] = None,
+    cost_trace_path: Optional[str] = None,
 ) -> Dict[str, Any]:
     """One seeded serving run; returns the journal fingerprint,
-    per-phase shed accounting, cache stats, and latency percentiles."""
+    per-phase shed accounting, cache stats, and latency percentiles.
+
+    ``cost_plane`` pins the cost-attribution plane explicitly for this
+    run: ``"on"`` / ``"off"`` build a plane on the scenario's virtual
+    clock + fresh metrics (``make obs-cost-smoke`` runs both and
+    asserts fingerprint identity); None inherits the tier's default
+    resolution (env > PERF_DECISIONS.json).  ``cost_trace_path``
+    optionally streams the plane's observation records to JSONL for
+    ``tools/obs_query.py``."""
     from svoc_tpu.fabric.session import MultiSession
+    from svoc_tpu.obsplane.plane import CostPlane
     from svoc_tpu.serving.frontend import AdmissionConfig
     from svoc_tpu.serving.tier import ServingTier
     from svoc_tpu.utils.events import EventJournal
@@ -125,9 +136,20 @@ def run_serving_scenario(
                 claim_id=name, n_oracles=n_oracles, dimension=dimension
             )
         )
+    plane = (
+        CostPlane(
+            enabled=(cost_plane == "on"),
+            clock=clock,
+            metrics=metrics,
+            trace_path=cost_trace_path,
+        )
+        if cost_plane is not None
+        else None
+    )
     tier = ServingTier(
         multi,
         vectorizer=deterministic_vectorizer,
+        cost_plane=plane,
         admission=AdmissionConfig(
             queue_capacity=queue_capacity, burn_threshold=4.0, seed=seed
         ),
@@ -204,4 +226,10 @@ def run_serving_scenario(
         "per_claim_fingerprints": {
             name: multi.claim_fingerprint(name) for name in names
         },
+        # The live plane object (not just its snapshot): the obs smoke
+        # inspects timelines/ledger/model directly after the run.
+        "cost_plane": tier.cost_plane,
+        # The live session: the obs smoke enumerates the router's
+        # compile universe to assert ledger estimate coverage.
+        "multi": multi,
     }

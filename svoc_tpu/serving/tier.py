@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from svoc_tpu.obsplane.plane import resolve_cost_plane
 from svoc_tpu.serving.batcher import MicroBatcher
 from svoc_tpu.serving.cache import ResultCache
 from svoc_tpu.serving.frontend import AdmissionConfig, ServingFrontend
@@ -59,6 +60,7 @@ class ServingTier:
         slos: Optional[Sequence] = None,
         slo_clock=None,
         prewarmer=None,
+        cost_plane=None,
     ):
         from svoc_tpu.fabric.router import resolve_journal
         from svoc_tpu.utils.slo import SLOEvaluator
@@ -75,6 +77,19 @@ class ServingTier:
         #: ``MultiSession.start_prewarm()`` wires both the warmth
         #: accounting and the defer gate.
         self._prewarmer = prewarmer
+        #: The cost-attribution plane (docs/OBSERVABILITY.md
+        #: §cost-attribution).  Routing resolves ONCE here — explicit
+        #: arg > SVOC_COST_PLANE env > PERF_DECISIONS.json — the same
+        #: construction-time pinning as consensus_impl/commit_mode.
+        #: Marks use the tier clock so virtual-time scenarios stay
+        #: deterministic; the router shares this plane for its
+        #: dispatch-cost windows.
+        self.cost_plane = (
+            cost_plane
+            if cost_plane is not None
+            else resolve_cost_plane(clock=self._clock, metrics=self._metrics)
+        )
+        self.multi.router.cost_plane = self.cost_plane
         self.frontend = ServingFrontend(
             multi,
             admission=admission,
@@ -83,6 +98,7 @@ class ServingTier:
             journal=self._journal,
             clock=self._clock,
             cold_gate=self._claim_cold,
+            cost_plane=self.cost_plane,
         )
         #: The cross-claim vectorizer.  None = each micro-batch builds
         #: on demand from the FIRST claim session's vectorizer (the
@@ -180,6 +196,7 @@ class ServingTier:
 
     def _step_inner(self) -> Dict[str, Any]:
         self.steps += 1
+        plane = self.cost_plane
         report: Dict[str, Any] = {
             "step": self.steps,
             "requests": 0,
@@ -198,6 +215,9 @@ class ServingTier:
                 # after an overload is observed even with no traffic.
                 self._evaluator.evaluate()
                 return report
+            # Batch assembly done: queue_wait ends here for every
+            # drained request (cost plane; no-op when disabled).
+            plane.mark_requests(requests, "assembled")
             self._resolve_vectorizer()
             drained = len(requests)
             # Every drained request must end this step accounted —
@@ -214,6 +234,10 @@ class ServingTier:
                 self._metrics.counter(
                     "serving_dropped", labels={"claim": request.claim}
                 ).add(1)
+                # Dropped requests still close their timeline (outcome
+                # keeps the per-stage histograms clean of partial
+                # flows, but the lineage stays joinable offline).
+                plane.complete(request, self._clock(), outcome="dropped")
                 pending.discard(request)
                 dropped += 1
 
@@ -244,6 +268,7 @@ class ServingTier:
                         except Exception:
                             drop(request)
                     requests, vectors = survivors, vecs
+                plane.mark_requests(requests, "vectorized")
                 for request, vector in zip(requests, vectors):
                     # The serving step's documented host fetch: the
                     # packed forward's vectors must land on host to
@@ -280,11 +305,21 @@ class ServingTier:
                     self._metrics.counter(
                         "serving_completed", labels={"claim": request.claim}
                     ).add(1)
+                    # Fold the router's per-claim dispatch marks into
+                    # this request's timeline and close it at the SAME
+                    # `now` the latency histogram used — stage sums
+                    # telescope to the observed end-to-end latency.
+                    plane.complete(request, now)
                     pending.discard(request)
             except BaseException:
                 for request in list(pending):
                     drop(request)
                 raise
+            finally:
+                # The router's claim marks are per-step state; clear
+                # them even when the step dies mid-way so a failed
+                # step's marks never leak into the next one.
+                plane.end_step()
             report.update(
                 requests=drained,
                 claims=len(feeds),
@@ -472,6 +507,10 @@ class ServingTier:
             "cache": self.cache.stats(),
             "burn_rate": self.frontend.controller.burn_rate(),
             "latency": reg.histogram(REQUEST_LATENCY_HISTOGRAM).snapshot(),
+            # Cost-attribution plane (docs/OBSERVABILITY.md
+            # §cost-attribution): the shape-keyed dispatch-cost ledger
+            # summary + cells the console `costs` command renders.
+            "costs": self.cost_plane.snapshot(),
         }
 
     def attach(self, console) -> None:

@@ -85,6 +85,8 @@ EVENT_TYPES: Tuple[str, ...] = (
     "trace.write_error",
     "slo.alert",
     "postmortem.bundle",
+    "postmortem.suppressed",
+    "profile.captured",
     "serving.admitted",
     "serving.shed",
     "serving.step",

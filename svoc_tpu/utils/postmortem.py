@@ -258,6 +258,7 @@ class PostmortemMonitor:
         min_interval_s: float = 60.0,
         max_bundles: int = 8,
         clock: Callable[[], float] = time.monotonic,
+        profiler=None,
     ):
         self.out_dir = out_dir
         self._session = session
@@ -269,8 +270,19 @@ class PostmortemMonitor:
         self.min_interval_s = min_interval_s
         self.max_bundles = max_bundles
         self._clock = clock
+        #: An optional :class:`~svoc_tpu.obsplane.profiler.
+        #: ProfileCapture`: incident-class events (breaker-open, SLO
+        #: burn) trigger a bounded, rate-limited automatic capture —
+        #: the device-side view a bundle's host rings cannot carry.
+        self._profiler = profiler
         self._lock = threading.Lock()
         self._last_built: Optional[float] = None
+        #: Suppression latch per reason: the counter bumps on EVERY
+        #: suppressed incident, the ``postmortem.suppressed`` journal
+        #: event fires ONCE per latch (cleared by the next bundle that
+        #: does build) — visible without being an event storm of its
+        #: own.
+        self._suppressed_latched: set = set()
         #: Paths of every bundle this monitor built (soak artifacts).
         self.bundles: List[str] = []
         self._shutdown_done = False
@@ -369,18 +381,51 @@ class PostmortemMonitor:
 
     def _on_event(self, record: EventRecord) -> None:
         trigger = self.classify(record)
+        if self._profiler is not None and (
+            trigger == "breaker_open" or record.type == "slo.alert"
+        ):
+            # Incident-triggered device capture (docs/OBSERVABILITY.md
+            # §cost-attribution): bounded duration + its own rate limit
+            # live in the profiler; a capture failure lands in
+            # profile_errors and never blocks the bundle below.
+            self._profiler.maybe_capture(
+                "slo_burn" if record.type == "slo.alert" else trigger
+            )
         if trigger is None:
             return
         now = self._clock()
+        suppressed: Optional[str] = None
+        first_latch = False
         with self._lock:
             if len(self.bundles) >= self.max_bundles:
-                return
-            if (
+                suppressed = "cap"
+            elif (
                 self._last_built is not None
                 and now - self._last_built < self.min_interval_s
             ):
-                return
-            self._last_built = now
+                suppressed = "rate_limit"
+            else:
+                self._last_built = now
+            if suppressed is not None:
+                first_latch = suppressed not in self._suppressed_latched
+                self._suppressed_latched.add(suppressed)
+        if suppressed is not None:
+            # Visible suppression (the satellite contract): every
+            # suppressed incident counts; the journal sees ONE latch
+            # event per reason, emitted outside the monitor lock
+            # (journal lock is a leaf — SVOC010).  classify() has no
+            # rule for postmortem.suppressed, so no recursion.
+            (self._registry or _default_registry).counter(
+                "postmortem_suppressed", labels={"reason": suppressed}
+            ).add(1)
+            if first_latch:
+                self._journal.emit(
+                    "postmortem.suppressed",
+                    lineage=record.lineage,
+                    reason=suppressed,
+                    trigger=trigger,
+                )
+            return
         path = build_bundle(
             out_dir=self.out_dir,
             trigger=trigger,
@@ -393,6 +438,9 @@ class PostmortemMonitor:
         )
         with self._lock:
             self.bundles.append(path)
+            # A successful bundle re-arms the suppression latches: the
+            # NEXT suppression window journals again.
+            self._suppressed_latched.clear()
         (self._registry or _default_registry).counter(
             "postmortem_bundles", labels={"trigger": trigger}
         ).add(1)

@@ -522,12 +522,14 @@ class TestPrewarm:
         # count, dispatch, THEN mark seen — so first sight is cold,
         # a retry after a raising dispatch is cold AGAIN, and only a
         # successful dispatch flips the key to warm.
-        key = router._account_warmth(values, CFG)
+        key, warmth = router._account_warmth(values, CFG)
+        assert warmth == "cold"
         assert (count("cold"), count("warm")) == (1.0, 0.0)
         router._account_warmth(values, CFG)  # dispatch raised: still cold
         assert (count("cold"), count("warm")) == (2.0, 0.0)
         router._warmth_seen.add(key)  # the post-dispatch commit
-        router._account_warmth(values, CFG)
+        _key, warmth = router._account_warmth(values, CFG)
+        assert warmth == "warm"
         assert (count("cold"), count("warm")) == (2.0, 1.0)
 
     def test_router_counts_prewarmed_first_dispatch(self):

@@ -1,0 +1,644 @@
+"""Cost-attribution plane (docs/OBSERVABILITY.md §cost-attribution):
+timelines, the shape-keyed cost ledger, profiling, fingerprint
+invisibility, persistence, and the serving-lineage audit trail."""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from svoc_tpu.compile.universe import dispatch_key
+from svoc_tpu.consensus.kernel import ConsensusConfig
+from svoc_tpu.obsplane.ledger import (
+    DEFAULT_ALPHA,
+    CostLedger,
+    CostModel,
+    group_key,
+    ledger_key,
+)
+from svoc_tpu.obsplane.plane import (
+    REQUEST_STAGE_HISTOGRAM,
+    CostPlane,
+    resolve_cost_plane_enabled,
+)
+from svoc_tpu.obsplane.profiler import ProfileCapture
+from svoc_tpu.obsplane.timeline import (
+    MARKS,
+    STAGE_OF_MARK,
+    ObservationLog,
+    RequestTimeline,
+    read_observations,
+)
+from svoc_tpu.utils.events import EventJournal, read_trace_events
+from svoc_tpu.utils.metrics import MetricsRegistry
+
+CFG = ConsensusConfig(n_failing=2, constrained=True)
+
+
+def make_key(bucket=4, n_oracles=7, dimension=6, **overrides):
+    kwargs = dict(
+        sanitized=True,
+        sharded=False,
+        bucket=bucket,
+        n_oracles=n_oracles,
+        dimension=dimension,
+        cfg=CFG,
+        donate=False,
+        impl="xla",
+        mesh=None,
+    )
+    kwargs.update(overrides)
+    return dispatch_key(**kwargs)
+
+
+@pytest.fixture(scope="module")
+def scenario_on():
+    from svoc_tpu.serving.scenario import run_serving_scenario
+
+    return run_serving_scenario(0, cost_plane="on")
+
+
+@pytest.fixture(scope="module")
+def scenario_off():
+    from svoc_tpu.serving.scenario import run_serving_scenario
+
+    return run_serving_scenario(0, cost_plane="off")
+
+
+# ---------------------------------------------------------------------------
+# Request timelines
+# ---------------------------------------------------------------------------
+
+
+class TestRequestTimeline:
+    def test_stages_telescope_to_e2e(self):
+        tl = RequestTimeline("blkt-c0-rq1", "c0", 10.0)
+        for i, mark in enumerate(MARKS):
+            tl.mark(mark, 10.0 + (i + 1) * 0.5)
+        stages = tl.stages()
+        assert set(stages) == set(STAGE_OF_MARK.values())
+        assert sum(stages.values()) == pytest.approx(tl.e2e_s())
+        assert tl.e2e_s() == pytest.approx(len(MARKS) * 0.5)
+
+    def test_first_crossing_wins(self):
+        tl = RequestTimeline("blkt-c0-rq2", "c0", 0.0)
+        tl.mark("assembled", 1.0)
+        tl.mark("assembled", 5.0)  # retry/duplicate mark: ignored
+        tl.mark("completed", 2.0)
+        assert tl.stages()["queue_wait"] == pytest.approx(1.0)
+
+    def test_skipped_marks_still_telescope(self):
+        # A cache-served request never crosses h2d/dispatch/sync; the
+        # decomposition must stay gapless regardless.
+        tl = RequestTimeline("blkt-c0-rq3", "c0", 0.0)
+        tl.mark("assembled", 0.4)
+        tl.mark("completed", 1.0)
+        stages = tl.stages()
+        assert sum(stages.values()) == pytest.approx(tl.e2e_s())
+        assert all(v >= 0.0 for v in stages.values())
+
+    def test_out_of_order_marks_clamp_nonnegative(self):
+        # A claim mark can land "early" relative to this request's own
+        # marks under a live clock; the negative segment clamps to 0
+        # (so no stage reads as negative time) at the cost of the sum
+        # overshooting e2e by the clamped amount.
+        tl = RequestTimeline("blkt-c0-rq4", "c0", 0.0)
+        tl.mark("vectorized", 2.0)
+        tl.mark("h2d", 1.0)
+        tl.mark("completed", 3.0)
+        stages = tl.stages()
+        assert all(v >= 0.0 for v in stages.values())
+        assert stages["h2d"] == 0.0
+        assert sum(stages.values()) >= tl.e2e_s()
+
+
+# ---------------------------------------------------------------------------
+# Observation channel
+# ---------------------------------------------------------------------------
+
+
+class TestObservationLog:
+    def test_ring_and_filters(self):
+        log = ObservationLog()
+        log.record("timeline.request", lineage="blkt-c0-rq1", outcome="shed")
+        log.record("cost.sample", lineage=None, key="k", seconds=0.1)
+        assert len(log) == 2
+        assert [r["obs"] for r in log.recent(10)] == [
+            "timeline.request",
+            "cost.sample",
+        ]
+        only = log.recent(10, kind="timeline.request")
+        assert len(only) == 1 and only[0]["lineage"] == "blkt-c0-rq1"
+
+    def test_obs_lines_invisible_to_journal_recovery(self, tmp_path):
+        """The fingerprint-invisibility mechanism: obs records share
+        the trace FILE with journal events but ``read_trace_events``
+        (the recovery reader) must never see them, while
+        ``read_observations`` sees only them."""
+        path = str(tmp_path / "trace.jsonl")
+        journal = EventJournal(registry=MetricsRegistry())
+        journal.set_trace_file(path)
+        journal.emit("serving.step", requests=1)
+        log = ObservationLog(trace_path=path)
+        log.record("cost.sample", lineage=None, key="k", seconds=0.5)
+        events = read_trace_events(path)
+        assert [e["event"] for e in events] == ["serving.step"]
+        obs = read_observations(path)
+        assert [r["obs"] for r in obs] == ["cost.sample"]
+
+
+# ---------------------------------------------------------------------------
+# Cost ledger + model
+# ---------------------------------------------------------------------------
+
+
+class TestCostLedger:
+    def test_ema_fold_is_deterministic(self):
+        ledger = CostLedger(alpha=0.5)
+        key = make_key()
+        ledger.observe(key, "cold", 1.0)
+        ledger.observe(key, "cold", 2.0)  # 1.0 + 0.5*(2.0-1.0)
+        ledger.observe(key, "warm", 0.25)
+        cell = ledger.to_dict()["entries"][ledger_key(key)]["warmth"]
+        assert cell["cold"]["ema_s"] == pytest.approx(1.5)
+        assert cell["cold"]["samples"] == 2
+        assert cell["warm"]["ema_s"] == pytest.approx(0.25)
+
+    def test_observe_key_str_replays_observe(self):
+        """The obs_query reconstruction contract: replaying the
+        ``cost.sample`` stream through ``observe_key_str`` in order
+        reproduces the live ledger exactly."""
+        live = CostLedger()
+        rebuilt = CostLedger()
+        key = make_key()
+        for warmth, s in (("cold", 0.8), ("warm", 0.1), ("warm", 0.3)):
+            live.observe(key, warmth, s)
+            rebuilt.observe_key_str(
+                ledger_key(key), group_key(key), warmth, s
+            )
+        assert live.to_dict() == rebuilt.to_dict()
+
+    def test_restore_round_trip(self, tmp_path):
+        ledger = CostLedger()
+        ledger.observe(make_key(), "cold", 1.2)
+        ledger.observe(make_key(bucket=8), "warm", 0.4)
+        payload = ledger.to_dict()
+        fresh = CostLedger()
+        assert fresh.restore(payload) == 2
+        assert fresh.to_dict() == payload
+
+    def test_estimate_fallback_ladder(self):
+        ledger = CostLedger()
+        model = CostModel(ledger)
+        observed = make_key(bucket=4)
+        twin = make_key(bucket=16)  # same (N, M) family, never seen
+        foreign = make_key(n_oracles=9, dimension=4)  # other family
+        # Empty ledger: nothing to price.
+        est = model.estimate(observed)
+        assert est["warm"] is None and est["cold"] is None
+        ledger.observe(observed, "cold", 1.0)
+        ledger.observe(observed, "prewarmed", 0.1)
+        assert model.estimate(observed)["cold"]["source"] == "exact"
+        # "prewarmed" counts as the warm regime.
+        warm = model.estimate(observed)["warm"]
+        assert warm["source"] == "exact"
+        assert warm["seconds"] == pytest.approx(0.1)
+        assert model.estimate(twin)["cold"]["source"] == "group"
+        assert model.estimate(foreign)["cold"]["source"] == "global"
+
+    def test_restore_tolerates_garbage(self):
+        fresh = CostLedger()
+        assert fresh.restore({"entries": None}) == 0
+        assert fresh.restore({"version": 1, "entries": {"x": "bad"}}) == 0
+        assert len(fresh) == 0
+
+
+# ---------------------------------------------------------------------------
+# CostPlane unit behavior
+# ---------------------------------------------------------------------------
+
+
+class TestCostPlane:
+    def test_disabled_plane_is_inert(self):
+        metrics = MetricsRegistry()
+        plane = CostPlane(enabled=False, metrics=metrics)
+        assert plane.timeline_for("l", "c0", 0.0) is None
+        plane.claim_mark(["c0"], "h2d")
+        plane.observe_dispatch(make_key(), "cold", 0.5)
+        plane.shed("l", "c0", "queue_full")
+        assert plane._claim_marks == {}
+        assert len(plane.obslog) == 0
+        assert len(plane.ledger) == 0
+        assert plane.snapshot()["enabled"] is False
+
+    def test_complete_folds_claim_marks_and_histograms(self):
+        metrics = MetricsRegistry()
+        t = {"now": 0.0}
+        plane = CostPlane(
+            enabled=True, clock=lambda: t["now"], metrics=metrics
+        )
+
+        class Req:
+            claim = "c0"
+            timeline = None
+
+        req = Req()
+        req.timeline = plane.timeline_for("blkt-c0-rq1", "c0", 0.0)
+        t["now"] = 0.2
+        plane.mark_requests([req], "assembled")
+        t["now"] = 0.3
+        plane.claim_mark(["c0"], "h2d")
+        plane.claim_mark(["c0"], "dispatched")
+        t["now"] = 0.5
+        plane.complete(req, 0.5)
+        plane.end_step()
+        assert plane._claim_marks == {}
+        rec = plane.obslog.recent(1, kind="timeline.request")[0]
+        assert rec["data"]["outcome"] == "completed"
+        assert rec["data"]["e2e_s"] == pytest.approx(0.5)
+        assert sum(rec["data"]["stages"].values()) == pytest.approx(0.5)
+        hist = metrics.histogram(
+            REQUEST_STAGE_HISTOGRAM,
+            labels={"stage": "queue_wait", "claim": "c0"},
+        ).snapshot()
+        assert hist["count"] == 1
+
+    def test_shed_records_timeline_without_stages(self):
+        plane = CostPlane(enabled=True, metrics=MetricsRegistry())
+        plane.shed("blkt-c0-rq9", "c0", "queue_full")
+        rec = plane.obslog.recent(1, kind="timeline.request")[0]
+        assert rec["data"]["outcome"] == "shed"
+        assert rec["data"]["reason"] == "queue_full"
+        assert rec["data"]["stages"] == {}
+
+    def test_resolution_pin_order(self, monkeypatch):
+        # Explicit arg beats the env; env beats the committed routing.
+        monkeypatch.setenv("SVOC_COST_PLANE", "on")
+        assert resolve_cost_plane_enabled(False) is False
+        assert resolve_cost_plane_enabled(None) is True
+        monkeypatch.setenv("SVOC_COST_PLANE", "off")
+        assert resolve_cost_plane_enabled(None) is False
+        assert resolve_cost_plane_enabled(True) is True
+
+
+# ---------------------------------------------------------------------------
+# Serving integration: invisibility, decomposition, persistence, audit
+# ---------------------------------------------------------------------------
+
+
+class TestServingIntegration:
+    def test_fingerprint_invariant_on_vs_off(self, scenario_on, scenario_off):
+        """The tentpole acceptance: enabling the plane changes NOTHING
+        a seeded replay reproduces."""
+        assert (
+            scenario_on["journal_fingerprint"]
+            == scenario_off["journal_fingerprint"]
+        )
+        assert (
+            scenario_on["per_claim_fingerprints"]
+            == scenario_off["per_claim_fingerprints"]
+        )
+
+    def test_snapshot_carries_costs_section(self, scenario_on):
+        costs = scenario_on["snapshot"]["costs"]
+        assert costs["enabled"] is True
+        assert costs["ledger"]["samples"] > 0
+        assert costs["observations"] > 0
+
+    def test_completed_timelines_gapless(self, scenario_on):
+        plane = scenario_on["cost_plane"]
+        records = [
+            r
+            for r in plane.obslog.recent(10_000, kind="timeline.request")
+            if r["data"]["outcome"] == "completed"
+        ]
+        assert records
+        for rec in records:
+            assert sum(rec["data"]["stages"].values()) == pytest.approx(
+                rec["data"]["e2e_s"], abs=1e-9
+            )
+
+    def test_shed_requests_observed(self, scenario_on):
+        plane = scenario_on["cost_plane"]
+        shed = [
+            r
+            for r in plane.obslog.recent(10_000, kind="timeline.request")
+            if r["data"]["outcome"] == "shed"
+        ]
+        assert shed  # the overload phase sheds
+        assert all(r["data"]["reason"] for r in shed)
+
+    def test_universe_estimates_cover_every_key(self, scenario_on):
+        from svoc_tpu.compile.universe import (
+            enumerate_universe,
+            registry_groups,
+        )
+
+        multi = scenario_on["multi"]
+        router = multi.router
+        keys = enumerate_universe(
+            registry_groups(multi.registry),
+            max_claims_per_batch=router.max_claims_per_batch,
+            sanitized_dispatch=router.sanitized_dispatch,
+            donate=router._donate,
+            impl=router.consensus_impl,
+            mesh=router.mesh_spec,
+            mesh_claim_size=(
+                router._shard.claim_size if router._shard else 1
+            ),
+        )
+        assert keys
+        model = scenario_on["cost_plane"].model
+        for key in keys:
+            est = model.estimate(key)
+            assert est["warm"] is not None, est["key"]
+            assert est["cold"] is not None, est["key"]
+            assert est["warm"]["seconds"] > 0
+
+    def test_ledger_persists_on_snapshot_cadence(
+        self, scenario_on, tmp_path
+    ):
+        """Kill/restart continuity: the RecoveryManager's snapshot
+        writes the sidecar ledger; a fresh plane restores it and prices
+        identically."""
+        from svoc_tpu.durability.recovery import RecoveryManager
+
+        plane = scenario_on["cost_plane"]
+        manager = RecoveryManager(
+            scenario_on["multi"], out_dir=str(tmp_path)
+        )
+        assert manager._cost_plane() is plane  # resolved via the router
+        manager.snapshot()
+        assert os.path.exists(manager.cost_ledger_path)
+        fresh = CostPlane(enabled=True, metrics=MetricsRegistry())
+        restored = fresh.restore_ledger(manager.cost_ledger_path)
+        assert restored == len(plane.ledger)
+        assert fresh.ledger.to_dict() == plane.ledger.to_dict()
+
+    def test_audit_trail_for_completed_lineage(self, scenario_on):
+        """Satellite: every serving request's rq lineage joins the
+        flight recorder — admission through commit for a completed
+        request."""
+        plane = scenario_on["cost_plane"]
+        completed = [
+            r
+            for r in plane.obslog.recent(10_000, kind="timeline.request")
+            if r["data"]["outcome"] == "completed"
+        ][-1]
+        record = scenario_on["multi"].audit(completed["lineage"])
+        assert record["found"] is True
+        types = [e["event"] for e in record["events"]]
+        assert "serving.admitted" in types
+        assert record["summary"]
+
+    def test_audit_trail_for_shed_lineage(self, scenario_on):
+        """The shed request is auditable too: its lineage carries the
+        ``serving.shed`` verdict in the journal AND the plane's
+        timeline record, joinable on the same id."""
+        plane = scenario_on["cost_plane"]
+        shed = [
+            r
+            for r in plane.obslog.recent(10_000, kind="timeline.request")
+            if r["data"]["outcome"] == "shed"
+        ][-1]
+        record = scenario_on["multi"].audit(shed["lineage"])
+        assert record["found"] is True
+        shed_events = [
+            e for e in record["events"] if e["event"] == "serving.shed"
+        ]
+        assert shed_events
+        assert (
+            shed_events[0]["data"]["reason"] == shed["data"]["reason"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Profiler
+# ---------------------------------------------------------------------------
+
+
+class TestProfileCapture:
+    def test_start_stop_cycle(self, tmp_path):
+        journal = EventJournal(registry=MetricsRegistry())
+        metrics = MetricsRegistry()
+        cap = ProfileCapture(
+            out_dir=str(tmp_path), journal=journal, metrics=metrics
+        )
+        assert cap.status()["active"] is None
+        started = cap.start(duration_s=30.0)
+        assert started["status"] == "started"
+        # Monotone index, never a wall-clock timestamp (SVOC008).
+        assert started["path"].endswith("profile-0001")
+        assert cap.start()["status"] == "already_running"
+        stopped = cap.stop()
+        assert stopped["status"] == "captured"
+        assert cap.stop()["status"] == "idle"
+        events = [e for e in journal.recent() if e.type == "profile.captured"]
+        assert len(events) == 1
+        assert events[0].data["path"].endswith("profile-0001")
+        assert (
+            metrics.counter(
+                "profile_captures", labels={"trigger": "manual"}
+            ).count
+            == 1
+        )
+
+    def test_auto_capture_rate_limited(self, tmp_path):
+        metrics = MetricsRegistry()
+        t = {"now": 0.0}
+        cap = ProfileCapture(
+            out_dir=str(tmp_path),
+            journal=EventJournal(registry=MetricsRegistry()),
+            metrics=metrics,
+            auto_min_interval_s=120.0,
+            clock=lambda: t["now"],
+        )
+        first = cap.maybe_capture("slo_burn")
+        assert first is not None and first["status"] == "started"
+        cap.stop()
+        t["now"] = 60.0  # inside the window: suppressed + counted
+        assert cap.maybe_capture("slo_burn") is None
+        assert (
+            metrics.counter(
+                "profile_suppressed", labels={"reason": "rate_limit"}
+            ).count
+            == 1
+        )
+        t["now"] = 200.0  # window elapsed: captures again
+        again = cap.maybe_capture("breaker_open")
+        assert again is not None and again["status"] == "started"
+        cap.stop()
+
+    def test_degrades_loudly_but_open(self, tmp_path, monkeypatch):
+        metrics = MetricsRegistry()
+        cap = ProfileCapture(out_dir=str(tmp_path), metrics=metrics)
+
+        def boom(_dir):
+            raise RuntimeError("no profiler backend")
+
+        import jax.profiler
+
+        monkeypatch.setattr(jax.profiler, "start_trace", boom)
+        result = cap.start()
+        assert result["status"] == "error"
+        assert "no profiler backend" in result["error"]
+        assert (
+            metrics.counter(
+                "profile_errors", labels={"stage": "start"}
+            ).count
+            == 1
+        )
+        # Serving keeps going: the capture object stays usable.
+        assert cap.status()["active"] is None
+
+
+# ---------------------------------------------------------------------------
+# Postmortem: auto-profile hook + visible suppression
+# ---------------------------------------------------------------------------
+
+
+class TestPostmortemIntegration:
+    def _monitor(self, tmp_path, **kwargs):
+        from svoc_tpu.utils.postmortem import PostmortemMonitor
+
+        journal = EventJournal(registry=MetricsRegistry())
+        metrics = MetricsRegistry()
+        monitor = PostmortemMonitor(
+            out_dir=str(tmp_path),
+            journal=journal,
+            registry=metrics,
+            **kwargs,
+        ).install()
+        return journal, metrics, monitor
+
+    def test_breaker_open_triggers_auto_capture(self, tmp_path):
+        captured = []
+
+        class FakeProfiler:
+            def maybe_capture(self, trigger):
+                captured.append(trigger)
+
+        journal, _metrics, monitor = self._monitor(
+            tmp_path, profiler=FakeProfiler(), min_interval_s=0.0
+        )
+        try:
+            journal.emit("breaker.transition", to="open")
+            journal.emit("slo.alert", slo="request_latency")
+            journal.emit("serving.step", requests=0)  # not incident-class
+        finally:
+            monitor.uninstall()
+        assert captured == ["breaker_open", "slo_burn"]
+
+    def test_suppression_counted_and_latched_once(self, tmp_path):
+        t = {"now": 0.0}
+        journal, metrics, monitor = self._monitor(
+            tmp_path, min_interval_s=60.0, clock=lambda: t["now"]
+        )
+        try:
+            journal.emit("breaker.transition", to="open")  # bundles
+            t["now"] = 1.0
+            journal.emit("breaker.transition", to="open")  # suppressed
+            t["now"] = 2.0
+            journal.emit("breaker.transition", to="open")  # suppressed
+        finally:
+            monitor.uninstall()
+        assert len(monitor.bundles) == 1
+        # EVERY suppression counts; the journal latches ONE event.
+        assert (
+            metrics.counter(
+                "postmortem_suppressed", labels={"reason": "rate_limit"}
+            ).count
+            == 2
+        )
+        latched = [
+            e for e in journal.recent() if e.type == "postmortem.suppressed"
+        ]
+        assert len(latched) == 1
+        assert latched[0].data["reason"] == "rate_limit"
+        assert latched[0].data["trigger"] == "breaker_open"
+
+    def test_latch_rearms_after_next_bundle(self, tmp_path):
+        t = {"now": 0.0}
+        journal, _metrics, monitor = self._monitor(
+            tmp_path, min_interval_s=60.0, clock=lambda: t["now"]
+        )
+        try:
+            journal.emit("breaker.transition", to="open")  # bundle 1
+            t["now"] = 1.0
+            journal.emit("breaker.transition", to="open")  # latch fires
+            t["now"] = 120.0
+            journal.emit("breaker.transition", to="open")  # bundle 2
+            t["now"] = 121.0
+            journal.emit("breaker.transition", to="open")  # re-latched
+        finally:
+            monitor.uninstall()
+        assert len(monitor.bundles) == 2
+        latched = [
+            e for e in journal.recent() if e.type == "postmortem.suppressed"
+        ]
+        assert len(latched) == 2
+
+
+# ---------------------------------------------------------------------------
+# Console + web surface
+# ---------------------------------------------------------------------------
+
+
+class TestConsoleAndWeb:
+    def test_console_commands_degrade_without_plane(self):
+        from tests.conftest import make_fake_console
+
+        console = make_fake_console()
+        assert any("cost" in line for line in console.query("costs"))
+        assert any(
+            "profiler" in line.lower()
+            for line in console.query("profile status")
+        )
+
+    def test_profile_endpoint(self, tmp_path):
+        from svoc_tpu.apps.commands import CommandConsole
+        from svoc_tpu.apps.web import serve
+        from tests.test_apps import make_session
+
+        console = CommandConsole(make_session())
+        srv, _thread = serve(console, port=0, block=False)
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            # No profiler attached: 503, serving untouched.
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(f"{base}/api/profile", timeout=10)
+            assert exc_info.value.code == 503
+            ProfileCapture(out_dir=str(tmp_path)).attach(console)
+            with urllib.request.urlopen(
+                f"{base}/api/profile", timeout=10
+            ) as r:
+                status = json.loads(r.read())
+            assert status["available"] is True
+            assert status["active"] is None
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(
+                    f"{base}/api/profile?action=bogus", timeout=10
+                )
+            assert exc_info.value.code == 400
+        finally:
+            srv.shutdown()
+
+    def test_costs_command_renders_live_ledger(self, scenario_on):
+        """The console ``costs`` view over a real post-scenario plane:
+        summary line + per-key warmth cells."""
+        from tests.conftest import make_fake_console
+
+        console = make_fake_console()
+        console.serving = scenario_on  # duck-typed: .cost_plane lookup
+
+        class Holder:
+            cost_plane = scenario_on["cost_plane"]
+
+        console.serving = Holder()
+        out = console.query("costs")
+        joined = "\n".join(out)
+        assert "enabled" in joined
+        assert "ms" in joined

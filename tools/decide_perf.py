@@ -37,6 +37,15 @@ committed measurements — not an editorial choice:
   otherwise — including the honest-null CPU sweep (1-core container:
   simulated devices cannot add compute) and any parity breakage, with
   the blocker recorded as evidence.
+- ``cost_plane`` — the cost-attribution plane's default
+  (docs/OBSERVABILITY.md §cost-attribution), from the committed
+  ``BENCH_OBS_r10.json`` A/B: ``"on"`` iff the plane's runs stayed
+  fingerprint-identical to the off arm under open-loop load AND its
+  measured p99 host step overhead is within the artifact's budget
+  (≤ 5%) — host-side evidence like ``commit_mode``, so the CPU
+  container qualifies; ``"off"`` otherwise with the blocker recorded.
+  (Explicit ``SVOC_COST_PLANE`` / constructor pins always override the
+  routed default.)
 - ``warmup_mode`` / ``compilation_cache`` — the compile plane
   (docs/PARALLELISM.md §compile-plane), from the committed
   ``BENCH_COLDSTART_r09.json`` A/B: ``"prewarm"`` iff the in-process
@@ -348,6 +357,63 @@ def hotpath_commit_decision(grid):
     return "per_tx", evidence
 
 
+def load_obs_grid(path):
+    """Load the cost-plane overhead A/B artifact
+    (``BENCH_OBS_r10.json``: a flat ``{"checks", "arms",
+    "p99_overhead", ...}`` record) or None when absent/malformed — the
+    same shape-tolerant contract as :func:`load_hotpath_grid`."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or not isinstance(data.get("checks"), dict):
+        return None
+    return data
+
+
+def obs_cost_decision(grid):
+    """``(decision_or_None, evidence)`` for the ``cost_plane`` routing
+    from the overhead A/B (``bench_obs.py``).  Host-side measurement
+    like ``commit_mode`` — the plane's cost IS host work (perf_counter
+    reads, ring appends), so the CPU container qualifies.  ``"on"``
+    needs fingerprint identity across both arms (replay invisibility
+    under load) and the measured p99 overhead within the artifact's
+    budget; anything else routes ``"off"`` with the blocker named."""
+    if grid is None:
+        return None, None
+    checks = grid.get("checks")
+    if not isinstance(checks, dict):
+        return None, None
+    evidence = {
+        "source": grid.get("artifact", "BENCH_OBS"),
+        "p99_overhead": grid.get("p99_overhead"),
+        "p50_overhead": grid.get("p50_overhead"),
+        "overhead_budget": grid.get("overhead_budget"),
+        "fingerprints_identical": checks.get(
+            "fingerprints_identical_across_arms"
+        ),
+        "host_measured": True,
+    }
+    required = (
+        "fingerprints_identical_across_arms",
+        "both_arms_measured",
+        "overhead_finite",
+    )
+    failed = [k for k in required if not checks.get(k)]
+    if not failed and grid.get("within_budget"):
+        return "on", evidence
+    evidence["blocker"] = (
+        f"failed checks: {failed}"
+        if failed
+        else (
+            f"p99 overhead {grid.get('p99_overhead')} exceeds budget "
+            f"{grid.get('overhead_budget')}"
+        )
+    )
+    return "off", evidence
+
+
 def load_coldstart_grid(path):
     """Load the cold-start A/B artifact (``BENCH_COLDSTART_r09.json``:
     a flat ``{"checks", "legs", "speedups_vs_cold", ...}`` record) or
@@ -447,6 +513,7 @@ def decide(
     shard_grid=None,
     hotpath_grid=None,
     coldstart_grid=None,
+    obs_grid=None,
 ) -> tuple:
     """``(decisions, evidence)`` from qualifying TPU results (plus the
     grid walkover rules — module docstring)."""
@@ -550,6 +617,11 @@ def decide(
     decisions.update(cold_decisions)
     evidence.update(cold_evidence)
 
+    obs_decision, obs_evidence = obs_cost_decision(obs_grid)
+    if obs_decision is not None:
+        decisions["cost_plane"] = obs_decision
+        evidence["cost_plane"] = obs_evidence
+
     return decisions, evidence
 
 
@@ -590,6 +662,7 @@ def main(argv=None) -> int:
                     "commit_mode",
                     "warmup_mode",
                     "compilation_cache",
+                    "cost_plane",
                 )
             }
     except (OSError, ValueError):
@@ -616,6 +689,7 @@ def main(argv=None) -> int:
         coldstart_grid=load_coldstart_grid(
             os.path.join(REPO, "BENCH_COLDSTART_r09.json")
         ),
+        obs_grid=load_obs_grid(os.path.join(REPO, "BENCH_OBS_r10.json")),
     )
     if (
         "consensus_impl" in prior_decisions

@@ -1,0 +1,222 @@
+"""Cost-attribution plane smoke: fingerprint invisibility, gapless
+timelines, universe-wide cost estimates, and JSONL reconstruction as a
+CI gate (``make obs-cost-smoke``; docs/OBSERVABILITY.md
+§cost-attribution).
+
+The seeded serving scenario runs FOUR times — plane ON twice, plane
+OFF twice (fresh journals, fresh metrics, a virtual clock) — and the
+gate asserts:
+
+1. **Fingerprint invisibility** — all four journal fingerprints are
+   byte-identical: the plane's timelines, ledger samples, and obs
+   records never touch the replay-pinned journal, so enabling cost
+   attribution cannot change what a seeded replay reproduces.
+2. **Gapless decomposition** — every completed request's stage
+   durations telescope to its end-to-end latency (no unattributed
+   time), and every stage the taxonomy names appears.
+3. **Universe coverage** — ``CostModel.estimate`` returns a non-None
+   warm AND cold figure for EVERY key the router's compile universe
+   enumerates (exact cell, (N, M)-group fallback, or global pool), so
+   the scheduler can price shapes it has never dispatched.
+4. **Ledger reconstruction** — ``tools/obs_query.py --json`` refolds
+   the streamed ``cost.sample`` records into EMAs identical to the
+   live ledger's cells: the persisted ledger is recoverable from JSONL
+   alone.
+5. **Samples flowed** — the ON runs actually measured dispatches
+   (nonzero ledger samples and observation records).
+
+Usage::
+
+    python tools/obs_cost_smoke.py [--seed 0] [--out OBS_COST_SMOKE.json]
+"""
+
+from __future__ import annotations
+
+import os
+
+# Off-TPU by construction (the axon sitecustomize pins the platform, so
+# go through jax.config too — tools/soak.py measurement postmortem).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import argparse  # noqa: E402
+import json  # noqa: E402
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+import tempfile  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
+
+STAGES = (
+    "queue_wait",
+    "vectorize",
+    "h2d",
+    "dispatch",
+    "sync",
+    "commit",
+    "respond",
+)
+
+
+def gapless(plane, tol=1e-6):
+    """(checked, worst_gap, stage key set, positive-duration set) over
+    completed timeline records: stage sums must telescope to e2e within
+    ``tol``.  On the scenario's VIRTUAL clock intra-step stages are
+    zero-width — only ``queue_wait`` (carried across steps) accrues
+    time — so positivity is asserted for queue_wait alone while the
+    full taxonomy is asserted by key presence."""
+    checked, worst = 0, 0.0
+    seen, positive = set(), set()
+    for rec in plane.obslog.recent(10_000, kind="timeline.request"):
+        data = rec.get("data") or {}
+        if data.get("outcome") != "completed":
+            continue
+        stages = data.get("stages") or {}
+        seen.update(stages)
+        positive.update(k for k, v in stages.items() if v > 0.0)
+        gap = abs(sum(stages.values()) - float(data.get("e2e_s", 0.0)))
+        worst = max(worst, gap)
+        checked += 1
+    return checked, worst, seen, positive
+
+
+def universe_coverage(result):
+    """Every enumerated compile key must price (satellite of the
+    scheduler handoff: estimates cover shapes never yet dispatched via
+    the group/global fallbacks)."""
+    from svoc_tpu.compile.universe import (
+        enumerate_universe,
+        registry_groups,
+        universe_summary,
+    )
+
+    multi = result["multi"]
+    router = multi.router
+    keys = enumerate_universe(
+        registry_groups(multi.registry),
+        max_claims_per_batch=router.max_claims_per_batch,
+        sanitized_dispatch=router.sanitized_dispatch,
+        donate=router._donate,
+        impl=router.consensus_impl,
+        mesh=router.mesh_spec,
+        mesh_claim_size=router._shard.claim_size if router._shard else 1,
+    )
+    model = result["cost_plane"].model
+    uncovered = []
+    sources = {}
+    for key in keys:
+        est = model.estimate(key)
+        if est["warm"] is None or est["cold"] is None:
+            uncovered.append(est["key"])
+            continue
+        for regime in ("warm", "cold"):
+            src = est[regime]["source"]
+            sources[src] = sources.get(src, 0) + 1
+    return {
+        "universe": universe_summary(keys),
+        "estimated": len(keys) - len(uncovered),
+        "uncovered": uncovered,
+        "sources": sources,
+    }
+
+
+def reconstruction_identical(trace_path, plane):
+    """Shell through ``obs_query --json`` and compare its refolded
+    ledger against the live one, cell for cell (EMA determinism: same
+    samples, same order, same alpha → identical floats)."""
+    query = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "obs_query.py")
+    proc = subprocess.run(
+        [sys.executable, query, trace_path, "--tag",
+         f"{trace_path}=smoke", "--json"],
+        capture_output=True,
+        text=True,
+        check=False,
+    )
+    if proc.returncode != 0:
+        return False, {"error": proc.stderr[-500:]}
+    doc = json.loads(proc.stdout)
+    rebuilt = doc["ledgers"]["smoke"]["ledger"]["entries"]
+    live = plane.ledger.to_dict()["entries"]
+    return rebuilt == live, {
+        "rebuilt_keys": len(rebuilt),
+        "live_keys": len(live),
+        "samples": doc["ledgers"]["smoke"]["samples"],
+        "timelines": len(doc["timelines"]),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="OBS_COST_SMOKE.json")
+    args = p.parse_args(argv)
+
+    from svoc_tpu.serving.scenario import run_serving_scenario
+
+    with tempfile.TemporaryDirectory(prefix="obs_cost_smoke_") as tmp:
+        trace_path = os.path.join(tmp, "obs_trace.jsonl")
+        on_a = run_serving_scenario(
+            args.seed, cost_plane="on", cost_trace_path=trace_path
+        )
+        on_b = run_serving_scenario(args.seed, cost_plane="on")
+        off_a = run_serving_scenario(args.seed, cost_plane="off")
+        off_b = run_serving_scenario(args.seed, cost_plane="off")
+
+        fingerprints = [
+            r["journal_fingerprint"] for r in (on_a, on_b, off_a, off_b)
+        ]
+        plane = on_a["cost_plane"]
+        checked, worst_gap, stages_seen, stages_positive = gapless(plane)
+        coverage = universe_coverage(on_a)
+        ledger = plane.ledger.summary()
+        rebuilt_ok, rebuild_info = reconstruction_identical(
+            trace_path, plane
+        )
+
+    checks = {
+        "fingerprints_identical": len(set(fingerprints)) == 1,
+        "off_plane_inert": off_a["cost_plane"].snapshot()["ledger"][
+            "samples"
+        ] == 0,
+        "timelines_gapless": checked > 0 and worst_gap <= 1e-6,
+        "stages_observed": set(STAGES) <= stages_seen,
+        "queue_wait_accrues": "queue_wait" in stages_positive,
+        "universe_fully_estimated": not coverage["uncovered"],
+        "ledger_samples_nonzero": ledger["samples"] > 0,
+        "ledger_rebuilt_from_jsonl": rebuilt_ok,
+    }
+    ok = all(checks.values())
+    artifact = {
+        "seed": args.seed,
+        "checks": checks,
+        "ok": ok,
+        "journal_fingerprint": fingerprints[0],
+        "fingerprints": fingerprints,
+        "timelines_checked": checked,
+        "worst_gap_s": worst_gap,
+        "stages_seen": sorted(stages_seen),
+        "stages_positive": sorted(stages_positive),
+        "coverage": coverage,
+        "ledger": ledger,
+        "reconstruction": rebuild_info,
+        "completed": on_a["completed"],
+        "shed": on_a["shed"],
+    }
+    atomic_write_json(args.out, artifact)
+    for name, passed in checks.items():
+        print(f"  {'PASS' if passed else 'FAIL'}  {name}")
+    print(
+        f"obs-cost-smoke {'OK' if ok else 'FAILED'}: 4x fingerprint "
+        f"{fingerprints[0][:16]}, {checked} timelines gapless "
+        f"(worst {worst_gap:.2e}s), {coverage['estimated']}/"
+        f"{coverage['universe']['keys']} universe keys priced, "
+        f"{ledger['samples']} samples over {ledger['keys']} keys, "
+        f"JSONL rebuild {'identical' if rebuilt_ok else 'DIVERGED'} "
+        f"-> {args.out}"
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
