@@ -49,6 +49,27 @@ naming the call chain that justifies them):
 - **SVOC012 durability-ordering** — rename without directory fsync;
   durability-path writes without fsync.
 
+Contract-plane rules (``statecov.py``, ``emissions.py``, ``taint.py``,
+``shardspec.py``, plus SVOC014 in ``interrules.py``; each joins the
+code against an operator-facing promise, in both directions where one
+exists):
+
+- **SVOC013 snapshot-coverage** — mutable replay-class ``self.*``
+  state the durable serializers never read; deliberate transients
+  carry audited ``# svoc: volatile(<reason>)`` annotations, and a
+  stale annotation is itself a finding.
+- **SVOC014 silent-fallback** — except/degrade handlers reachable
+  from step/commit/serving entries that neither re-raise, read the
+  exception, bump a metric, nor emit an event.
+- **SVOC015 emission-taxonomy-sync** — two-way join of emitted event
+  types / metric families against docs/OBSERVABILITY.md's tables.
+- **SVOC016 fingerprint-taint** — intraprocedural dataflow from
+  nondeterminism sources into journal-emit data or fingerprint
+  returns (the two-line form SVOC008's reachability misses).
+- **SVOC017 shard-spec-consistency** — PartitionSpec / collective
+  axis names no ``*_AXIS`` constant defines, and any collective
+  inside the exact-parity claim-cube bodies.
+
 Entry points: :func:`svoc_tpu.analysis.engine.analyze_paths` (the CLI
 ``tools/svoclint.py`` wraps it, with a ``.svoclint_cache.json``
 content-hash cache so warm runs never re-parse unchanged files) and

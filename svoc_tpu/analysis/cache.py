@@ -8,7 +8,7 @@ and the interprocedural :class:`ModuleSummary` — keyed by
 ``(RULESET_VERSION, sha256(source))``.  A warm run therefore:
 
 - skips ``ast.parse`` and the per-module rules for unchanged files,
-- still runs the package rules (SVOC008–012) fresh every time — they
+- still runs the package rules (SVOC008–017) fresh every time — they
   are cross-file by definition and consume only the cached summaries,
   which is exactly why summaries are JSON-serializable.
 
@@ -30,7 +30,10 @@ from svoc_tpu.analysis.callgraph import ModuleSummary
 from svoc_tpu.analysis.findings import Finding
 
 #: Bump on ANY change to rules, summaries, or suppression handling.
-RULESET_VERSION = "svoclint-2-interproc-1"
+#: (``-3-contract-1``: the SVOC013–017 contract plane widened the
+#: summary shape — attrs/self_sets/excepts/specs/collectives/consts —
+#: so every ``-2-`` entry must re-extract.)
+RULESET_VERSION = "svoclint-3-contract-1"
 
 CACHE_BASENAME = ".svoclint_cache.json"
 

@@ -4,7 +4,7 @@ The per-file rules (SVOC001–007) are deliberately module-local; the
 hazards that actually bit PRs 5–11 were *interprocedural*: wall-clock
 reaching a fingerprinted journal path three calls down, an env knob
 read per dispatch through two module boundaries, a lock held across a
-helper that eventually emits.  This module gives the SVOC008–012 rules
+helper that eventually emits.  This module gives the SVOC008–017 rules
 the missing whole-package view while keeping every discipline of the
 analysis package: pure ``ast``, no JAX, no imports of analyzed code,
 and a summary representation cheap enough that the whole repo
@@ -47,6 +47,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import re
+import tokenize
 from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
 from svoc_tpu.analysis.concurrency import lock_identity
@@ -77,6 +78,26 @@ _EVENT_TYPE_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
 #: the resilience helpers locally bind ``j = self._journal or journal``).
 EVENT_ROOTS = {"journal", "event_journal", "events", "_journal", "_events", "j"}
 
+#: The ``svoc: volatile(<reason>)`` comment annotation (SVOC013),
+#: marking a replay-class field as deliberately transient
+#: (recomputable, or meaningless across a restart).  Parsed from
+#: comment tokens at summary time so the annotation set rides the
+#: findings cache like everything else.
+_VOLATILE_RE = re.compile(r"#\s*svoc:\s*volatile\(([^)]*)\)")
+
+#: PartitionSpec constructors, as written (``P`` is the conventional
+#: alias; the import map disambiguates at rule time).
+_SPEC_LEAVES = {"P", "PartitionSpec"}
+
+#: ``jax.lax`` collective leaves and the position of their axis-name
+#: argument (keyword ``axis_name`` always wins).
+_COLLECTIVE_LEAVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "psum_scatter", "axis_index", "axis_size",
+    "pbroadcast",
+}
+_COLLECTIVE_AXIS_ARG0 = {"axis_index", "axis_size"}
+
 
 @dataclasses.dataclass(frozen=True)
 class CallSite:
@@ -91,6 +112,9 @@ class CallSite:
     locks: Tuple[str, ...]  # lock ids held at this callsite (lexical)
     emit_arg_of: int  # line of the enclosing emit call when this call
     #                   sits in its ARGUMENTS; 0 otherwise
+    arg0_name: Optional[str] = None  # first positional arg when a bare
+    #                                  Name (resolved against module
+    #                                  constants by SVOC015/017)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -102,6 +126,7 @@ class CallSite:
             line=int(d["line"]), col=int(d.get("col", 0)),
             arg0=d.get("arg0"), locks=tuple(d.get("locks", ())),
             emit_arg_of=int(d.get("emit_arg_of", 0)),
+            arg0_name=d.get("arg0_name"),
         )
 
 
@@ -135,6 +160,20 @@ class FuncSummary:
     calls: List[CallSite]
     locks: List[LockAcq]
     set_iters: List[int]  # lines iterating a set-typed expression
+    #: every attribute NAME this function touches, any context —
+    #: SVOC013's serializer-coverage universe (``session._fetch_claim``
+    #: read in a to_dict counts the field as snapshotted)
+    attrs: List[str] = dataclasses.field(default_factory=list)
+    #: ``self.<attr> = ...`` assignment sites: ``[attr, line]`` pairs
+    self_sets: List[List[Any]] = dataclasses.field(default_factory=list)
+    #: except-handler facts for SVOC014: {"line", "end", "raises"}
+    excepts: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    #: does the body (nested defs excluded) contain a ``raise``?
+    raises: bool = False
+    #: PartitionSpec constructions: {"line", "func", "axes": [[kind, val]]}
+    specs: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    #: lax collectives: {"line", "leaf", "name", "axes": [[kind, val]]}
+    collectives: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -143,6 +182,12 @@ class FuncSummary:
             "calls": [c.to_dict() for c in self.calls],
             "locks": [a.to_dict() for a in self.locks],
             "set_iters": list(self.set_iters),
+            "attrs": list(self.attrs),
+            "self_sets": [list(s) for s in self.self_sets],
+            "excepts": [dict(e) for e in self.excepts],
+            "raises": self.raises,
+            "specs": [dict(s) for s in self.specs],
+            "collectives": [dict(c) for c in self.collectives],
         }
 
     @classmethod
@@ -153,6 +198,12 @@ class FuncSummary:
             calls=[CallSite.from_dict(c) for c in d.get("calls", ())],
             locks=[LockAcq.from_dict(a) for a in d.get("locks", ())],
             set_iters=[int(x) for x in d.get("set_iters", ())],
+            attrs=[str(a) for a in d.get("attrs", ())],
+            self_sets=[[str(s[0]), int(s[1])] for s in d.get("self_sets", ())],
+            excepts=[dict(e) for e in d.get("excepts", ())],
+            raises=bool(d.get("raises", False)),
+            specs=[dict(s) for s in d.get("specs", ())],
+            collectives=[dict(c) for c in d.get("collectives", ())],
         )
 
 
@@ -165,6 +216,12 @@ class ModuleSummary:
     classes: Dict[str, List[str]]  # class name -> method names
     functions: List[FuncSummary]
     tags: List[str]
+    #: module-level ``NAME = "literal"`` string constants — SVOC015
+    #: resolves event types / metric families passed by constant, and
+    #: SVOC017 resolves ``*_AXIS`` names through them
+    consts: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: ``svoc: volatile(<reason>)`` comment annotations: line -> reason
+    volatile: Dict[int, str] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -172,6 +229,8 @@ class ModuleSummary:
             "classes": {k: list(v) for k, v in self.classes.items()},
             "functions": [f.to_dict() for f in self.functions],
             "tags": sorted(self.tags),
+            "consts": dict(self.consts),
+            "volatile": {str(k): v for k, v in self.volatile.items()},
         }
 
     @classmethod
@@ -181,6 +240,10 @@ class ModuleSummary:
             classes={k: list(v) for k, v in d.get("classes", {}).items()},
             functions=[FuncSummary.from_dict(f) for f in d.get("functions", ())],
             tags=list(d.get("tags", ())),
+            consts={str(k): str(v) for k, v in d.get("consts", {}).items()},
+            volatile={
+                int(k): str(v) for k, v in d.get("volatile", {}).items()
+            },
         )
 
 
@@ -255,6 +318,48 @@ def _iter_is_setish(expr: ast.AST) -> bool:
     return False
 
 
+def _axis_tokens(nodes: Iterable[ast.AST]) -> List[List[str]]:
+    """Axis-name tokens of a PartitionSpec/collective argument list:
+    ``[kind, value]`` with kind ``lit`` (string literal), ``name``
+    (bare Name, resolved at rule time), or ``expr`` (opaque — skipped
+    by the rules, the under-approximation polarity)."""
+    out: List[List[str]] = []
+    for arg in nodes:
+        elts = list(arg.elts) if isinstance(arg, (ast.Tuple, ast.List)) else [arg]
+        for node in elts:
+            if isinstance(node, ast.Constant):
+                if isinstance(node.value, str):
+                    out.append(["lit", node.value])
+                # None (replicated dim) and other constants: no axis
+            elif isinstance(node, ast.Name):
+                out.append(["name", node.id])
+            else:
+                out.append(["expr", ""])
+    return out
+
+
+def _collective_axis_args(node: ast.Call, leaf: str) -> List[ast.AST]:
+    for kw in node.keywords:
+        if kw.arg == "axis_name":
+            return [kw.value]
+    pos = 0 if leaf in _COLLECTIVE_AXIS_ARG0 else 1
+    if len(node.args) > pos:
+        return [node.args[pos]]
+    return []
+
+
+def _walk_executed_nodes(root: ast.AST) -> Iterable[ast.AST]:
+    """Walk ``root`` skipping nested def/lambda bodies (their code does
+    not execute where it is defined)."""
+    stack: List[ast.AST] = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
 class _FuncScan:
     """One function body's walk: calls, lock regions, emit-arg context,
     set iterations.  Nested def/lambda bodies are skipped — they get
@@ -267,6 +372,12 @@ class _FuncScan:
         self.calls: List[CallSite] = []
         self.locks: List[LockAcq] = []
         self.set_iters: List[int] = []
+        self.attrs: Set[str] = set()
+        self.self_sets: List[List[Any]] = []
+        self.excepts: List[Dict[str, Any]] = []
+        self.raises = False
+        self.specs: List[Dict[str, Any]] = []
+        self.collectives: List[Dict[str, Any]] = []
 
     def scan(self, fn: ast.AST) -> None:
         for stmt in fn.body:
@@ -289,18 +400,74 @@ class _FuncScan:
             for stmt in node.body:
                 self._visit(stmt, inner, emit_line)
             return
+        if isinstance(node, ast.Raise):
+            self.raises = True
+        elif isinstance(node, ast.Try):
+            for handler in node.handlers:
+                end = getattr(handler, "end_lineno", None) or handler.lineno
+                self.excepts.append(
+                    {
+                        "line": handler.lineno,
+                        "end": int(end),
+                        "raises": any(
+                            isinstance(n, ast.Raise)
+                            for n in _walk_executed_nodes(handler)
+                        ),
+                        # `except X as e` with `e` read in the body: the
+                        # error is CAPTURED (into a log, a verdict field,
+                        # a bundle payload) rather than dropped — not a
+                        # silent degrade under SVOC014
+                        "uses_exc": bool(handler.name)
+                        and any(
+                            isinstance(n, ast.Name)
+                            and n.id == handler.name
+                            and isinstance(n.ctx, ast.Load)
+                            for n in _walk_executed_nodes(handler)
+                        ),
+                    }
+                )
+        elif isinstance(node, ast.Attribute):
+            self.attrs.add(node.attr)
+            if (
+                isinstance(node.ctx, ast.Store)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                self.self_sets.append([node.attr, node.lineno])
         if isinstance(node, ast.Call):
             name = _dotted(node.func) or ""
             leaf, root = _call_leaf_root(node.func)
             arg0 = None
-            if node.args and isinstance(node.args[0], ast.Constant):
-                if isinstance(node.args[0].value, str):
-                    arg0 = node.args[0].value
+            arg0_name = None
+            if node.args:
+                if isinstance(node.args[0], ast.Constant):
+                    if isinstance(node.args[0].value, str):
+                        arg0 = node.args[0].value
+                elif isinstance(node.args[0], ast.Name):
+                    arg0_name = node.args[0].id
+            if leaf in _SPEC_LEAVES:
+                self.specs.append(
+                    {
+                        "line": node.lineno,
+                        "func": name or leaf,
+                        "axes": _axis_tokens(node.args),
+                    }
+                )
+            elif leaf in _COLLECTIVE_LEAVES:
+                self.collectives.append(
+                    {
+                        "line": node.lineno,
+                        "leaf": leaf,
+                        "name": name,
+                        "axes": _axis_tokens(_collective_axis_args(node, leaf)),
+                    }
+                )
             self.calls.append(
                 CallSite(
                     name=name, leaf=leaf, root=root,
                     line=node.lineno, col=node.col_offset,
                     arg0=arg0, locks=held, emit_arg_of=emit_line,
+                    arg0_name=arg0_name,
                 )
             )
             child_emit = (
@@ -350,12 +517,45 @@ def _import_map(tree: ast.Module, mod_dotted: str) -> Dict[str, str]:
 
 
 def summarize_module(
-    path: str, tree: ast.Module, tags: Iterable[str] = ()
+    path: str,
+    tree: ast.Module,
+    tags: Iterable[str] = (),
+    source_lines: Optional[List[str]] = None,
 ) -> ModuleSummary:
-    """Reduce one parsed module to its interprocedural summary."""
+    """Reduce one parsed module to its interprocedural summary.
+
+    ``source_lines`` (when the caller has them) feeds the
+    ``# svoc: volatile(...)`` annotation scan — comments are invisible
+    to the AST."""
     imports = _import_map(tree, module_dotted(path))
     classes: Dict[str, List[str]] = {}
     functions: List[FuncSummary] = []
+    consts: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if (
+                isinstance(target, ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+            ):
+                consts[target.id] = node.value.value
+    volatile: Dict[int, str] = {}
+    if source_lines:
+        # tokenize, not a per-line regex: a docstring or a hint string
+        # DESCRIBING the annotation grammar must not register as one
+        # (the analysis package documents it, and would otherwise flag
+        # itself stale).
+        reader = iter([line + "\n" for line in source_lines]).__next__
+        try:
+            for tok in tokenize.generate_tokens(reader):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _VOLATILE_RE.search(tok.string)
+                if m:
+                    volatile[tok.start[0]] = m.group(1).strip()
+        except (tokenize.TokenizeError, IndentationError, SyntaxError):
+            pass  # the file parsed via ast, so this is belt-and-braces
 
     def walk_defs(node: ast.AST, cls: Optional[str], prefix: str) -> None:
         for child in ast.iter_child_nodes(node):
@@ -377,6 +577,12 @@ def summarize_module(
                         qual=qual, name=child.name, cls=cls, line=child.lineno,
                         calls=scan.calls, locks=scan.locks,
                         set_iters=scan.set_iters,
+                        attrs=sorted(scan.attrs),
+                        self_sets=scan.self_sets,
+                        excepts=scan.excepts,
+                        raises=scan.raises,
+                        specs=scan.specs,
+                        collectives=scan.collectives,
                     )
                 )
                 # nested defs: scanned separately (locks don't leak in)
@@ -386,6 +592,7 @@ def summarize_module(
     return ModuleSummary(
         path=path, imports=imports, classes=classes,
         functions=functions, tags=list(tags),
+        consts=consts, volatile=volatile,
     )
 
 

@@ -6,8 +6,11 @@ content-hash cache), build its :class:`~svoc_tpu.analysis.jitmap.JitMap`,
 run every per-module rule, then fold the per-module
 :class:`~svoc_tpu.analysis.callgraph.ModuleSummary` extracts into one
 :class:`~svoc_tpu.analysis.callgraph.Program` and run the
-interprocedural rules (SVOC008–012) over it, drop suppressed findings,
-and return an :class:`AnalysisReport`.
+interprocedural rules (SVOC008–015, SVOC017) over it, drop suppressed
+findings, and return an :class:`AnalysisReport`.  SVOC015 additionally
+reads ``docs/OBSERVABILITY.md`` (resolved against the analysis root)
+— the one non-Python input the engine threads through as
+``PackageContext.docs_path``.
 
 Two-phase shape: phase 1 is embarrassingly per-file (and therefore
 cacheable — ``.svoclint_cache.json`` keys on content hash, so a warm
@@ -37,6 +40,21 @@ from svoc_tpu.analysis.jitmap import JitMap
 from svoc_tpu.analysis.rules import ALL_RULES
 
 SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "_build"}
+
+#: SVOC015's docs-side input, relative to the analysis root.
+OBSERVABILITY_DOC = "docs/OBSERVABILITY.md"
+
+
+def _load_docs(root: str) -> Tuple[Optional[str], List[str]]:
+    """``(root-relative docs path, lines)`` for the observability
+    taxonomy, or ``(None, [])`` when the root has no docs tree (fixture
+    dirs, vendored subsets) — SVOC015 skips in that case."""
+    full = os.path.join(root, *OBSERVABILITY_DOC.split("/"))
+    try:
+        with open(full, "r", encoding="utf-8") as fh:
+            return OBSERVABILITY_DOC, fh.read().splitlines()
+    except OSError:
+        return None, []
 
 
 @dataclasses.dataclass
@@ -168,10 +186,11 @@ def _run_package_rules(
     summaries: List[ModuleSummary],
     lines_by_path: Dict[str, List[str]],
     suppressions: Dict[str, SuppressionIndex],
+    docs_path: Optional[str] = None,
 ) -> Tuple[List[Finding], int]:
     """The interprocedural phase: one Program over every summary."""
     program = Program(summaries)
-    ctx = PackageContext(lines_by_path)
+    ctx = PackageContext(lines_by_path, docs_path=docs_path)
     raw: List[Finding] = []
     for rule in PACKAGE_RULES:
         raw.extend(rule(program, ctx))
@@ -201,9 +220,14 @@ def analyze_module(path: str, source: str) -> List[Finding]:
     if isinstance(unit, Finding):
         return [unit]
     findings, _suppressed = _run_rules(unit)
-    summary = summarize_module(path, unit.tree, unit.tags)
+    summary = summarize_module(path, unit.tree, unit.tags, source_lines=unit.lines)
+    # No docs here: a single source string is not the package, and
+    # loading docs/OBSERVABILITY.md from the CWD would make
+    # analyze_source results depend on where the test runner sits.
+    # SVOC015 needs a real root — analyze_paths threads it through.
     pkg, _pkg_suppressed = _run_package_rules(
-        [summary], {path: unit.lines}, {path: unit.suppressions}
+        [summary], {path: unit.lines}, {path: unit.suppressions},
+        docs_path=None,
     )
     return sorted(
         findings + pkg, key=lambda f: (f.line, f.col, f.rule, f.message)
@@ -292,7 +316,7 @@ def analyze_paths(
         kept, n_suppressed = _run_rules(unit)
         findings.extend(kept)
         suppressed += n_suppressed
-        summary = summarize_module(rel, unit.tree, unit.tags)
+        summary = summarize_module(rel, unit.tree, unit.tags, source_lines=unit.lines)
         summaries.append(summary)
         suppressions[rel] = unit.suppressions
         if cache is not None:
@@ -307,8 +331,11 @@ def analyze_paths(
                     suppressions=unit.suppressions.to_dict(),
                 ),
             )
+    docs_path, docs_lines = _load_docs(root)
+    if docs_path is not None:
+        lines_by_path[docs_path] = docs_lines
     pkg_findings, pkg_suppressed = _run_package_rules(
-        summaries, lines_by_path, suppressions
+        summaries, lines_by_path, suppressions, docs_path=docs_path
     )
     findings.extend(pkg_findings)
     suppressed += pkg_suppressed

@@ -1,4 +1,4 @@
-"""SVOC008–SVOC012: the interprocedural determinism & concurrency rules.
+"""SVOC008–SVOC015 + SVOC017: the interprocedural contract rules.
 
 Package rules run AFTER the per-module pass, over the whole-program
 view (:class:`svoc_tpu.analysis.callgraph.Program`).  Each one encodes
@@ -30,6 +30,19 @@ review:
   until the directory entry is durable a crash resurrects the
   pre-rename layout), and durability-path file writes with no fsync
   (a WAL record is NO record until its bytes are on the platter).
+- **SVOC014 silent-fallback** — defined here; an ``except``/degrade
+  branch reachable from a dispatch/commit/serving/recovery entry that
+  neither re-raises, increments a counter, nor emits a typed event.
+  The fleet's fallback contract (``consensus_pallas_fallback``,
+  ``claim_shard_fallback``, ``commit_batch_fallback``) is "counted,
+  never silent": a degrade nobody can see on a dashboard is an outage
+  with extra steps.
+
+The rest of the contract plane lives in sibling modules and registers
+here: **SVOC013** snapshot-coverage (``statecov.py``), **SVOC015**
+emission-taxonomy sync (``emissions.py``), **SVOC017** shard-spec
+consistency (``shardspec.py``).  SVOC016 fingerprint-taint is
+intraprocedural and rides ``ALL_RULES`` (``taint.py``).
 
 Every interprocedural finding carries a ``path_trace`` naming the call
 chain that justifies it — a finding nobody can replay from the source
@@ -45,6 +58,7 @@ import re
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from svoc_tpu.analysis.callgraph import (
+    _EVENT_TYPE_RE,
     CallSite,
     FuncSummary,
     ModuleSummary,
@@ -53,7 +67,10 @@ from svoc_tpu.analysis.callgraph import (
     is_emit_callsite,
 )
 from svoc_tpu.analysis.concurrency import LockModel, is_journal_lock
+from svoc_tpu.analysis.emissions import METRIC_LEAVES, rule_svoc015
 from svoc_tpu.analysis.findings import Finding
+from svoc_tpu.analysis.shardspec import rule_svoc017
+from svoc_tpu.analysis.statecov import rule_svoc013
 
 # RULE_DOCS for 008–012 live in rules.py next to 001–007 (one table,
 # one --list-rules); imported lazily to avoid a cycle.
@@ -69,8 +86,18 @@ class PackageContext:
     """What package rules need beyond the Program: source lines for
     snippet/context (the baseline key parts) and a Finding factory."""
 
-    def __init__(self, lines_by_path: Dict[str, List[str]]):
+    def __init__(
+        self,
+        lines_by_path: Dict[str, List[str]],
+        docs_path: Optional[str] = None,
+    ):
         self._lines = lines_by_path
+        #: Root-relative path of docs/OBSERVABILITY.md when the engine
+        #: found it (None in doc-less runs — SVOC015 then skips).
+        self.docs_path = docs_path
+
+    def lines(self, path: str) -> List[str]:
+        return self._lines.get(path, [])
 
     def _line(self, path: str, line: int) -> str:
         lines = self._lines.get(path, [])
@@ -682,10 +709,133 @@ def rule_svoc012(program: Program, ctx: PackageContext) -> List[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# SVOC014 — silent-fallback
+# ---------------------------------------------------------------------------
+
+#: Entry bodies whose reachable except-handlers must be accounted:
+#: the per-step dispatch/serving surfaces of SVOC011 plus the commit
+#: and recovery planes (a silent degrade during recovery is the worst
+#: one — it "succeeds" into a wrong state).
+_FALLBACK_ENTRY_RE = re.compile(
+    r"^_?(step|serving_step|submit|fetch|drain|tick|recover|commit)$"
+    r"|^_?(dispatch|commit_)"
+)
+
+
+def _accounts_call(call: CallSite, module: ModuleSummary) -> Optional[str]:
+    """Does this callsite make a degrade VISIBLE — a metric-family
+    registration/increment or a typed-event emission?"""
+    arg0 = call.arg0
+    if arg0 is None and call.arg0_name:
+        arg0 = module.consts.get(call.arg0_name) or call.arg0_name
+    if call.leaf in METRIC_LEAVES:
+        # any metric touch counts, even with a computed family name —
+        # visibility is the contract, not which family
+        return f"metric family `{arg0 or call.name}`"
+    if is_emit_callsite(call.leaf, call.root, call.name, call.arg0):
+        return f"typed event emit `{call.name or call.leaf}()`"
+    if "emit" in call.leaf and arg0 and _EVENT_TYPE_RE.match(arg0):
+        return f"typed event `{arg0}`"
+    return None
+
+
+def _handler_accounted(
+    program: Program, module: ModuleSummary, fs: FuncSummary, handler: Dict
+) -> bool:
+    if handler.get("raises"):
+        return True
+    if handler.get("uses_exc"):
+        # the bound exception is read inside the handler — captured into
+        # a log line, a verdict/bundle field, or a helper's argument, so
+        # the degrade leaves a trace; "silent" means dropped on the floor
+        return True
+    lo, hi = int(handler["line"]), int(handler["end"])
+    in_range = [c for c in fs.calls if lo <= c.line <= hi]
+    if any(_accounts_call(c, module) is not None for c in in_range):
+        return True
+    # a helper called from the handler may do the accounting (or
+    # re-raise) on the handler's behalf — shallow walk, both count
+    return (
+        find_hazard(
+            program,
+            module,
+            in_range,
+            _accounts_call,
+            func_pred=lambda f, m: ("re-raises", f.line) if f.raises else None,
+            root_func=fs,
+            max_depth=4,
+        )
+        is not None
+    )
+
+
+def rule_svoc014(program: Program, ctx: PackageContext) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    memo: Dict[Tuple[str, int], bool] = {}
+
+    def check_func(fid: str, entry: str, trace_prefix: Tuple[str, ...]):
+        fs = program.funcs[fid]
+        module = program.modules[program.module_of(fid)]
+        for handler in fs.excepts:
+            hkey = (fid, int(handler["line"]))
+            if hkey not in memo:
+                memo[hkey] = _handler_accounted(program, module, fs, handler)
+            if memo[hkey]:
+                continue
+            key = (module.path, int(handler["line"]))
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(
+                ctx.finding(
+                    "SVOC014",
+                    module.path,
+                    int(handler["line"]),
+                    f"silent fallback: except handler in `{fs.qual}` "
+                    f"(reachable from entry `{entry}`) neither re-raises, "
+                    "increments a counter, nor emits a typed event — a "
+                    "degrade nobody can see on a dashboard is an outage "
+                    "with extra steps",
+                    "count it (the consensus_pallas_fallback contract: "
+                    "fallbacks are counted, never silent) or emit a typed "
+                    "event; re-raise if the degrade is not deliberate; "
+                    "suppress with a reason only for handlers whose "
+                    "outcome is already accounted upstream",
+                    trace_prefix
+                    + (
+                        f"{module.path}::{fs.qual}:{handler['line']} "
+                        "silent handler",
+                    ),
+                )
+            )
+
+    for module in program.modules.values():
+        for fs in module.functions:
+            if not _FALLBACK_ENTRY_RE.match(fs.name):
+                continue
+            if _CONSTRUCTION_RE.search(fs.qual):
+                continue
+            entry = f"{module.path}::{fs.qual}"
+            fid = f"{module.path}::{fs.qual}"
+            check_func(fid, entry, (entry,))
+            for call in fs.calls:
+                for reached, trace in _reachable_funcs(
+                    program, module, call, fs, max_depth=6
+                ):
+                    check_func(reached, entry, (entry,) + trace)
+    return out
+
+
 PACKAGE_RULES: Sequence[Callable[[Program, PackageContext], List[Finding]]] = (
     rule_svoc008,
     rule_svoc009,
     rule_svoc010,
     rule_svoc011,
     rule_svoc012,
+    rule_svoc013,
+    rule_svoc014,
+    rule_svoc015,
+    rule_svoc017,
 )

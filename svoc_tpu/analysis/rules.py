@@ -171,6 +171,56 @@ RULE_DOCS: Dict[str, Dict[str, str]] = {
             "durability-path file write with no fsync before returning"
         ),
     },
+    "SVOC013": {
+        "name": "snapshot-coverage",
+        "severity": "error",
+        "summary": (
+            "mutable self.* state on a replay-relevant class that the "
+            "durable serializers (utils/checkpoint.py, "
+            "durability/recovery.py) never read — a crash + recover "
+            "silently resets it; `# svoc: volatile(<reason>)` marks "
+            "deliberately transient fields and is audited for staleness"
+        ),
+    },
+    "SVOC014": {
+        "name": "silent-fallback",
+        "severity": "warning",
+        "summary": (
+            "an except/degrade branch reachable from a dispatch/commit/"
+            "serving/recovery entry that neither re-raises, increments "
+            "a counter, nor emits a typed event — fallbacks are "
+            "counted, never silent"
+        ),
+    },
+    "SVOC015": {
+        "name": "emission-taxonomy-sync",
+        "severity": "error",
+        "summary": (
+            "two-way join of emitted event types + registered metric "
+            "families against docs/OBSERVABILITY.md's taxonomy tables: "
+            "emitted-but-undocumented AND documented-but-never-emitted "
+            "both fail"
+        ),
+    },
+    "SVOC016": {
+        "name": "fingerprint-taint",
+        "severity": "error",
+        "summary": (
+            "intraprocedural taint flow (assignments, f-strings, "
+            "containers) from nondeterministic sources (wall clocks, "
+            "id(), hash(), os.urandom, set iteration) into journal-emit "
+            "data or fingerprint* return values"
+        ),
+    },
+    "SVOC017": {
+        "name": "shard-spec-consistency",
+        "severity": "error",
+        "summary": (
+            "PartitionSpec / collective axis names must exist among the "
+            "parallel/mesh.py *_AXIS constants; any collective inside "
+            "the exact-parity claim-cube bodies is an error"
+        ),
+    },
 }
 
 
@@ -971,6 +1021,8 @@ def rule_svoc007(unit) -> List[Finding]:
     return out
 
 
+from svoc_tpu.analysis.taint import rule_svoc016  # noqa: E402  (needs RULE_DOCS above)
+
 ALL_RULES: Sequence[Callable] = (
     rule_svoc001,
     rule_svoc002,
@@ -979,4 +1031,5 @@ ALL_RULES: Sequence[Callable] = (
     rule_svoc005,
     rule_svoc006,
     rule_svoc007,
+    rule_svoc016,
 )

@@ -680,7 +680,7 @@ class Session:
                     self._block_source = source
                     self.last_quarantine = quarantine
                     self.last_lineage = lineage
-                    self.last_preview = preview
+                    self.last_preview = preview  # svoc: volatile(render cache derived from predictions; the UI rebuilds it on the next fetch/poll)
                     self.bump_state()
         return preview
 
@@ -729,7 +729,7 @@ class Session:
                 "revert the final oracle's tx (math.cairo:320-338) — "
                 "defer until the block regains oracle diversity"
             ) from None
-        except Exception:
+        except Exception:  # svoclint: disable=SVOC014 -- deliberate: every OTHER engine panic keeps its existing commit-path semantics — the txs go out and fail per-oracle with full breaker/supervisor accounting, so the degrade is counted downstream, not here
             # Every OTHER engine panic (interval error, codec range, …)
             # keeps its existing commit-path semantics: the txs are sent
             # and fail per-oracle with full breaker/supervisor

@@ -126,7 +126,7 @@ def perf_decision(
             # one — this resolver never raises on a bad record.
             value = data.get(key) if isinstance(data, dict) else None
             source = "PERF_DECISIONS.json"
-        except (OSError, ValueError):
+        except (OSError, ValueError):  # svoclint: disable=SVOC014 -- deliberate: "this resolver never raises on a bad record" is its documented contract; a missing/corrupt PERF_DECISIONS.json resolves to the default, and every consumer logs the resolved (value, source) pair at construction
             value = None
     if not value:
         value, source = default, "default"

@@ -683,6 +683,18 @@ class ChainAdapter:
 
         _metrics.counter("chain_commit_rpcs", labels={"mode": mode}).add(n)
 
+    @staticmethod
+    def _count_fallback(reason: str) -> None:
+        """The batched commit plane degrading is counted under the same
+        family as retry.py's resume machinery
+        (``commit_batch_fallback{reason=}``, docs/RESILIENCE.md
+        §batched-commits) — fallbacks are counted, never silent."""
+        from svoc_tpu.utils.metrics import registry as _metrics
+
+        _metrics.counter(
+            "commit_batch_fallback", labels={"reason": reason}
+        ).add(1)
+
     @_atomic
     def invoke_update_prediction(self, oracle_address, prediction) -> None:
         _fire_fault_point(
@@ -1004,6 +1016,10 @@ class ChainAdapter:
                         sent_count=e.index,
                     ) from e
                 except BatchNotCertified:
+                    # counted, never silent: the throughput batch path
+                    # degrading to the exact per-tx loop is the same
+                    # contract surface as retry.py's resume machinery
+                    self._count_fallback("uncertified")
                     fell_through = True  # exact per-tx loop below
             if not fell_through:
                 if codec_failure is not None:

@@ -212,6 +212,12 @@ def pack_tokens_auto(
             token_lists, seq_len, max_segments, pad_id, rows
         )
     except ImportError:  # pragma: no cover — runtime package stripped
+        # counted, never silent: a stripped/broken native packer degrades
+        # to the Python packer per BATCH, so the rate of degraded packs
+        # is visible on the dashboard rather than only as a latency blur
+        from svoc_tpu.utils.metrics import registry as _metrics
+
+        _metrics.counter("pack_native_fallback").add(1)
         raw = None
     if raw is None:
         return pack_tokens(token_lists, seq_len, max_segments, pad_id, rows)

@@ -209,7 +209,14 @@ class CostPlane:
         try:
             with open(path) as f:
                 payload = json.load(f)
+        except FileNotFoundError:  # svoclint: disable=SVOC014 — no sidecar on a fresh boot: the routine cold-start path, not a degrade
+            return 0
         except (OSError, ValueError):
+            # an unreadable/corrupt sidecar degrades to a cold ledger —
+            # counted under the RecoveryManager's sidecar family
+            self._metrics.counter(
+                "cost_ledger_errors", labels={"op": "restore"}
+            ).add(1)
             return 0
         return self.ledger.restore(payload)
 

@@ -148,8 +148,14 @@ class ObservationLog:
             try:
                 writer.write_line(json.dumps(rec, sort_keys=True))
             except OSError:
-                # Loud-but-open: the plane keeps its in-memory ring and
-                # the latch stops per-record error spam.
+                # Loud-but-open: the plane keeps its in-memory ring, the
+                # latch stops per-record error spam, and the lost export
+                # is COUNTED under the tracer's write-error family so a
+                # full disk shows up on the dashboard, not in a diff of
+                # missing obs lines.
+                from svoc_tpu.utils.metrics import registry as _metrics
+
+                _metrics.counter("trace_write_errors").add(1)
                 with self._lock:
                     self._write_error_latched = True
         return rec

@@ -294,7 +294,7 @@ def encode_matrix(matrix, on_error: str | None = None) -> list:
         if on_error == "none":
             try:
                 out[i] = encode_vector(arr[i])
-            except Exception:
+            except Exception:  # svoclint: disable=SVOC014 -- deliberate: on_error="none" is the per-element error CHANNEL — the None sentinel is this lane's documented output and callers (the WAL cycle-open) keep exact per-slot failure semantics
                 out[i] = None
         else:
             out[i] = encode_vector(arr[i])

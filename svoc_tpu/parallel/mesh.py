@@ -32,6 +32,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 CLAIM_AXIS = "claim"
 ORACLE_AXIS = "oracle"
 
+#: Inference/fine-tune axes (module docstring above) and the multi-
+#: slice DCN axis of :func:`hybrid_mesh`.  Every ``PartitionSpec`` and
+#: collective in the tree must name one of these ``*_AXIS`` constants —
+#: the shard-spec lint (SVOC017) joins spec/collective axis names
+#: against exactly this set, so a literal that drifts from the mesh is
+#: a build failure, not a dispatch-time surprise.
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+REPLICA_AXIS = "replica"
+
 #: ``SVOC_MESH=<claims>x<oracles>`` — the operator override for
 #: :func:`claim_mesh` (resolution order lives in
 #: :func:`svoc_tpu.consensus.dispatch.resolve_claim_mesh`).
@@ -152,7 +162,7 @@ def claim_mesh(
 
 
 def best_mesh(
-    axis_name: str = "oracle", devices: Optional[Sequence[jax.Device]] = None
+    axis_name: str = ORACLE_AXIS, devices: Optional[Sequence[jax.Device]] = None
 ) -> Mesh:
     """A 1-D mesh over every available device — the default fleet layout."""
     devs = list(devices if devices is not None else jax.devices())
@@ -161,7 +171,7 @@ def best_mesh(
 
 def hybrid_mesh(
     ici_spec: MeshSpec,
-    dcn_axis: str = "replica",
+    dcn_axis: str = REPLICA_AXIS,
     n_slices: Optional[int] = None,
 ) -> Mesh:
     """Multi-host/multi-slice mesh: ``dcn_axis`` ranges over slices

@@ -338,6 +338,23 @@ def session_durable_dict(session) -> Dict[str, Any]:
             "last_lineage": session.last_lineage,
             "fetch_claim": session._fetch_claim,
             "fetch_published": session._fetch_published,
+            # The published predictions themselves: fetch_published is
+            # a cursor — restoring the cursor without the payload would
+            # leave the commit path with "window N published" and
+            # nothing to commit (the publish guard refuses to re-fetch
+            # an already-published claim).
+            "predictions": (
+                None
+                if session.predictions is None
+                else np.asarray(session.predictions).tolist()
+            ),
+            "state_version": session.state_version,
+            # Operator toggles: a crash must not silently flip the
+            # fleet back to manual (or worse, re-enable auto_commit
+            # the operator turned off mid-incident).
+            "auto_fetch": session.auto_fetch,
+            "auto_commit": session.auto_commit,
+            "auto_resume": session.auto_resume,
             # The PRNG key as raw uint32 words: post-restore fleet
             # draws CONTINUE the stream instead of replaying it from
             # the seed (two restarts must not publish the same
@@ -424,6 +441,25 @@ def restore_durable_session(
             None
             if key is None
             else jnp.asarray(np.asarray(key, dtype=np.uint32))
+        )
+        preds = payload.get("predictions")
+        session.predictions = (
+            None if preds is None else np.asarray(preds, dtype=np.float64)
+        )
+        # state_version stays monotonic across the restore: a web
+        # client polling with a pre-crash version must still see the
+        # next redraw.
+        session.state_version = max(
+            session.state_version, int(payload.get("state_version", 0))
+        )
+        session.auto_fetch = bool(
+            payload.get("auto_fetch", session.auto_fetch)
+        )
+        session.auto_commit = bool(
+            payload.get("auto_commit", session.auto_commit)
+        )
+        session.auto_resume = bool(
+            payload.get("auto_resume", session.auto_resume)
         )
 
 

@@ -568,6 +568,48 @@ class TestSnapshotRestore:
         rs.fetch()
         assert rs.last_lineage == f"blk{'t'}-alpha-{4:06x}"
 
+    def test_round_trip_preserves_predictions_and_operator_toggles(
+        self, tmp_path
+    ):
+        """Regression for the SVOC013-confirmed snapshot gaps: the
+        published predictions payload, the web-plane state_version, and
+        the operator's auto_fetch/auto_commit/auto_resume toggles were
+        mutable session state the durable serializers never read — a
+        crash + recover silently reset them (the cursor said "window N
+        published" with nothing left to commit, and an incident-time
+        auto_commit OFF flipped back on)."""
+        import numpy as np
+
+        from svoc_tpu.utils.checkpoint import (
+            multi_session_to_dict,
+            restore_multi_session,
+        )
+
+        multi = make_multi(["alpha"])
+        multi.run(2)
+        session = multi.get("alpha").session
+        assert session.predictions is not None  # run() published
+        before_preds = np.asarray(session.predictions).copy()
+        session.auto_fetch = True
+        session.auto_commit = False
+        session.auto_resume = True
+        session.state_version += 3
+        before_version = session.state_version
+
+        fresh = make_multi(["alpha"])
+        report = restore_multi_session(multi_session_to_dict(multi), fresh)
+        assert report["restored"] == ["alpha"]
+        rs = fresh.get("alpha").session
+        np.testing.assert_array_equal(
+            np.asarray(rs.predictions), before_preds
+        )
+        assert rs.auto_fetch is True
+        assert rs.auto_commit is False
+        assert rs.auto_resume is True
+        # monotonic across the restore: a web client polling with a
+        # pre-crash version still sees the next redraw
+        assert rs.state_version >= before_version
+
     def test_changed_membership_quarantines_orphans(self, tmp_path):
         from svoc_tpu.utils.checkpoint import (
             multi_session_to_dict,

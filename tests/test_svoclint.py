@@ -975,7 +975,8 @@ def test_whole_package_run_is_clean_and_fast():
 
 def test_every_documented_rule_has_a_registered_doc():
     # SVOC001–007 per-module + SVOC008–012 interprocedural
-    assert sorted(RULE_DOCS) == [f"SVOC{i:03d}" for i in range(1, 13)]
+    # + SVOC013–017 contract plane
+    assert sorted(RULE_DOCS) == [f"SVOC{i:03d}" for i in range(1, 18)]
     for doc in RULE_DOCS.values():
         assert doc["severity"] in ("error", "warning")
 
@@ -1051,12 +1052,51 @@ _INJECTED = {
         "        json.dump(payload, f)\n"
         "    os.replace(path + '.tmp', path)\n"
     ),
+    # a stale volatile annotation in a serializer module is SVOC013's
+    # single-file form (uncovered-field findings need a two-module tree)
+    "SVOC013": (
+        "def save_state(session):\n"
+        "    return {'cursor': session.cursor}\n"
+        "\n"
+        "SCRATCH = 1  # svoc: volatile(scratch buffer)\n"
+    ),
+    "SVOC014": (
+        "def step(store):\n"
+        "    try:\n"
+        "        return store.fetch()\n"
+        "    except Exception:\n"
+        "        return None\n"
+    ),
+    "SVOC015": (
+        "from svoc_tpu.utils.events import emit_event\n\n"
+        "def notify(n):\n"
+        "    emit_event('bogus.event_xyz', n=n)\n"
+    ),
+    "SVOC016": (
+        "import time\n"
+        "from svoc_tpu.utils.events import emit_event\n\n"
+        "def report(n):\n"
+        "    started = time.perf_counter()\n"
+        "    took = 1.0 - started\n"
+        "    emit_event('consensus.result', took=took)\n"
+    ),
+    "SVOC017": (
+        "from jax.sharding import PartitionSpec\n\n"
+        "CLAIM_AXIS = 'claims'\n\n"
+        "def spec():\n"
+        "    return PartitionSpec('oraclez')\n"
+    ),
 }
+
+#: Rules whose single-file fixture only fires at a specific path (the
+#: SVOC013 coverage walk roots on serializer-module suffixes).
+_INJECTED_PATHS = {"SVOC013": os.path.join("utils", "checkpoint.py")}
 
 
 @pytest.mark.parametrize("rule", sorted(_INJECTED))
 def test_cli_exits_nonzero_on_injected_violation(rule, tmp_path):
-    bad = tmp_path / f"bad_{rule.lower()}.py"
+    bad = tmp_path / _INJECTED_PATHS.get(rule, f"bad_{rule.lower()}.py")
+    bad.parent.mkdir(parents=True, exist_ok=True)
     bad.write_text(_INJECTED[rule])
     proc = _run_cli([str(bad), "--no-baseline"])
     assert proc.returncode == 1, proc.stdout + proc.stderr
@@ -1184,9 +1224,12 @@ def test_linting_never_imports_jax():
             "-c",
             (
                 "import sys; sys.path.insert(0, '.');"
-                "from svoc_tpu.analysis import analyze_paths;"
+                "from svoc_tpu.analysis import analyze_paths, RULE_DOCS;"
+                "from svoc_tpu.analysis.sarif import to_sarif;"
                 "r = analyze_paths(['svoc_tpu', 'tools']);"
                 "assert r.files > 50;"
+                "doc = to_sarif(r.all_findings, RULE_DOCS, root='.');"
+                "assert doc['version'] == '2.1.0';"
                 "assert 'jax' not in sys.modules, 'lint imported jax';"
                 "assert 'numpy' not in sys.modules, 'lint imported numpy'"
             ),
@@ -2047,3 +2090,719 @@ def test_json_findings_carry_path_trace_for_interprocedural_rules(tmp_path):
                      "--format", "json"])
     payload = json.loads(proc.stdout)
     assert payload["findings"][0]["path_trace"] == []
+
+# ---------------------------------------------------------------------------
+# SVOC013 — snapshot-coverage (contract plane)
+# ---------------------------------------------------------------------------
+
+
+def _write(tree, rel, text):
+    path = tree / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(src(text))
+    return path
+
+
+_SVOC013_SERIALIZER = """
+    from app import read_fields
+
+    def save(session):
+        return read_fields(session)
+    """
+
+_SVOC013_APP = """
+    class Session:
+        def step(self):
+            self.cursor = 1
+            self.backlog = []
+
+    def read_fields(session):
+        return {"cursor": session.cursor}
+    """
+
+
+def _svoc013(report):
+    return [f for f in report.all_findings if f.rule == "SVOC013"]
+
+
+def test_svoc013_flags_uncovered_replay_field_with_trace(tmp_path):
+    tree = tmp_path / "tree"
+    _write(tree, "utils/checkpoint.py", _SVOC013_SERIALIZER)
+    _write(tree, "app.py", _SVOC013_APP)
+    findings = _svoc013(analyze_paths([str(tree)], root=str(tree)))
+    assert len(findings) == 1
+    (f,) = findings
+    # `cursor` is covered through the serializer's helper call;
+    # `backlog` is the gap
+    assert "self.backlog" in f.message and "Session" in f.message
+    assert f.path == "app.py"
+    trace = " | ".join(f.path_trace)
+    assert "utils/checkpoint.py" in trace  # names the coverage roots
+
+
+def test_svoc013_negative_serializer_coverage_through_helper(tmp_path):
+    tree = tmp_path / "tree"
+    _write(tree, "utils/checkpoint.py", _SVOC013_SERIALIZER)
+    _write(
+        tree,
+        "app.py",
+        """
+        class Session:
+            def step(self):
+                self.cursor = 1
+
+        def read_fields(session):
+            return {"cursor": session.cursor}
+        """,
+    )
+    assert _svoc013(analyze_paths([str(tree)], root=str(tree))) == []
+
+
+def test_svoc013_volatile_annotation_suppresses_with_reason(tmp_path):
+    tree = tmp_path / "tree"
+    _write(tree, "utils/checkpoint.py", _SVOC013_SERIALIZER)
+    _write(
+        tree,
+        "app.py",
+        """
+        class Session:
+            def step(self):
+                self.cursor = 1
+                self.backlog = []  # svoc: volatile(rebuilt per step)
+
+        def read_fields(session):
+            return {"cursor": session.cursor}
+        """,
+    )
+    assert _svoc013(analyze_paths([str(tree)], root=str(tree))) == []
+
+
+def test_svoc013_stale_volatile_annotation_is_its_own_finding(tmp_path):
+    # The annotated field got covered (or renamed): the claim is stale
+    # and must fail exactly like a stale baseline entry.
+    tree = tmp_path / "tree"
+    _write(tree, "utils/checkpoint.py", _SVOC013_SERIALIZER)
+    _write(
+        tree,
+        "app.py",
+        """
+        class Session:
+            def step(self):
+                self.cursor = 1  # svoc: volatile(obsolete claim)
+
+        def read_fields(session):
+            return {"cursor": session.cursor}
+        """,
+    )
+    findings = _svoc013(analyze_paths([str(tree)], root=str(tree)))
+    assert len(findings) == 1
+    assert "stale" in findings[0].message
+    assert "obsolete claim" in findings[0].message
+
+
+def test_svoc013_skips_subset_runs_without_serializer_modules(tmp_path):
+    # a --changed slice with no serializer module has no coverage
+    # roots: flagging every field would be pure noise
+    tree = tmp_path / "tree"
+    _write(tree, "app.py", _SVOC013_APP)
+    assert _svoc013(analyze_paths([str(tree)], root=str(tree))) == []
+
+
+def test_svoc013_non_replay_classes_are_out_of_scope(tmp_path):
+    tree = tmp_path / "tree"
+    _write(tree, "utils/checkpoint.py", _SVOC013_SERIALIZER)
+    _write(
+        tree,
+        "app.py",
+        """
+        class ScratchPad:
+            def step(self):
+                self.doodle = 1
+
+        def read_fields(session):
+            return {"cursor": session.cursor}
+        """,
+    )
+    assert _svoc013(analyze_paths([str(tree)], root=str(tree))) == []
+
+
+def test_svoc013_catches_seeded_regression_in_real_tier(tmp_path):
+    """Acceptance: adding a mutable field to the REAL ServingTier that
+    the durable serializers never read must produce a SVOC013 finding
+    with a path_trace — the exact regression class PR 8 closed by hand."""
+    tree = tmp_path / "tree"
+    for rel in ("utils/checkpoint.py", "serving/tier.py"):
+        with open(os.path.join(REPO_ROOT, "svoc_tpu", rel)) as fh:
+            _write(tree, rel, fh.read())
+    before = {
+        (f.path, f.message)
+        for f in _svoc013(analyze_paths([str(tree)], root=str(tree)))
+    }
+    with open(tree / "serving" / "tier.py", "a") as fh:
+        fh.write(
+            "\n\nclass ServingTier:\n"
+            "    def _seeded_tick(self):\n"
+            "        self._seeded_drift_window = {}\n"
+        )
+    after = _svoc013(analyze_paths([str(tree)], root=str(tree)))
+    fresh = [f for f in after if (f.path, f.message) not in before]
+    seeded = [f for f in fresh if "_seeded_drift_window" in f.message]
+    assert seeded, "seeded uncovered field not caught:\n" + "\n".join(
+        f.render() for f in after
+    )
+    assert seeded[0].path_trace
+
+
+# ---------------------------------------------------------------------------
+# SVOC014 — silent-fallback (contract plane)
+# ---------------------------------------------------------------------------
+
+
+def test_svoc014_flags_silent_handler_in_step_entry():
+    findings = analyze_source(
+        src(
+            """
+            def step(store):
+                try:
+                    return store.fetch()
+                except Exception:
+                    return None
+            """
+        )
+    )
+    assert "SVOC014" in rules_of(findings)
+    f = next(f for f in findings if f.rule == "SVOC014")
+    assert "silent fallback" in f.message
+    assert f.path_trace
+
+
+def test_svoc014_flags_silent_handler_reached_through_helper():
+    findings = analyze_source(
+        src(
+            """
+            def _quiet(store):
+                try:
+                    return store.fetch()
+                except Exception:
+                    return None
+
+            def step(store):
+                return _quiet(store)
+            """
+        )
+    )
+    hits = [f for f in findings if f.rule == "SVOC014"]
+    assert hits
+    trace = " | ".join(hits[0].path_trace)
+    assert "step" in trace and "_quiet" in trace
+
+
+def test_svoc014_negative_reraise_counter_and_exception_capture():
+    findings = analyze_source(
+        src(
+            """
+            from svoc_tpu.utils.metrics import registry
+
+            def step(store):
+                try:
+                    return store.fetch()
+                except Exception:
+                    raise
+
+            def submit(store):
+                try:
+                    return store.fetch()
+                except Exception:
+                    registry.counter("submit_fallback").add(1)
+                    return None
+
+            def drain(store, log):
+                try:
+                    return store.fetch()
+                except Exception as e:
+                    log.append(str(e))
+                    return None
+            """
+        )
+    )
+    assert "SVOC014" not in rules_of(findings)
+
+
+def test_svoc014_negative_handler_outside_entry_reachability():
+    # not an entry name and never called from one: out of scope
+    findings = analyze_source(
+        src(
+            """
+            def helper(store):
+                try:
+                    return store.fetch()
+                except Exception:
+                    return None
+            """
+        )
+    )
+    assert "SVOC014" not in rules_of(findings)
+
+
+def test_svoc014_inline_suppression_with_reason():
+    findings = analyze_source(
+        src(
+            """
+            def step(store):
+                try:
+                    return store.fetch()
+                except Exception:  # svoclint: disable=SVOC014 -- counted upstream
+                    return None
+            """
+        )
+    )
+    assert "SVOC014" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# SVOC015 — emission-taxonomy sync (contract plane)
+# ---------------------------------------------------------------------------
+
+
+def test_svoc015_docs_parser_round_trip():
+    from svoc_tpu.analysis.emissions import parse_observability_tables
+
+    lines = [
+        "Prose mentioning `not.documented` and `svoc_not_a_row` does",
+        "not count as documentation.",
+        "",
+        "| type | emitted by | data |",
+        "|------|------------|------|",
+        "| `a.b` | `app.py: run` | `n` |",
+        "",
+        "| series | type | meaning |",
+        "|--------|------|---------|",
+        "| `svoc_foo_total` | counter | things (`svoc_red_herring`) |",
+        "| `svoc_cache_events_total{event=hit\\|miss}` | counter | raw |",
+        "| `svoc_bar_seconds` | timer | wall time |",
+        "",
+        "| SLO | target | window |",
+        "|-----|--------|--------|",
+        "| `availability` | 99.9 | 30d |",
+    ]
+    doc_events, doc_series = parse_observability_tables(lines)
+    assert doc_events == {"a.b": 6}
+    # svoc_ prefix and {label=...} suffix stripped; the escaped pipe
+    # inside the label set must not break the cell split; backticks in
+    # NON-FIRST cells never count
+    assert set(doc_series) == {"foo_total", "cache_events_total", "bar_seconds"}
+    # a non-series, non-event table (the SLO table) parses as neither
+    assert "availability" not in doc_series and "availability" not in doc_events
+
+
+def test_svoc015_two_way_join_over_a_tree(tmp_path):
+    tree = tmp_path / "tree"
+    _write(
+        tree,
+        "docs/OBSERVABILITY.md",
+        """
+        | type | emitted by | data |
+        |------|------------|------|
+        | `a.b` | `app.py: run` | `n` |
+        | `never.sent` | nobody | |
+
+        | series | type | meaning |
+        |--------|------|---------|
+        | `svoc_foo_total` | counter | counted |
+        | `svoc_ghost_total` | counter | never registered |
+        """,
+    )
+    # completeness markers: the doc-side direction only runs when the
+    # journal and metrics modules are in the analyzed set
+    _write(tree, "utils/events.py", "def emit_event(t, **d):\n    return None")
+    _write(tree, "utils/metrics.py", "class Registry:\n    pass")
+    _write(
+        tree,
+        "app.py",
+        """
+        from utils.events import emit_event
+
+        def run(reg, n):
+            emit_event("a.b", n=n)
+            emit_event("c.d", n=n)
+            reg.counter("foo").add(1)
+            reg.counter("undocumented_fam").add(1)
+        """,
+    )
+    report = analyze_paths([str(tree)], root=str(tree))
+    msgs = [f.message for f in report.all_findings if f.rule == "SVOC015"]
+    assert any("`c.d`" in m and "absent" in m for m in msgs)
+    assert any("`undocumented_fam`" in m for m in msgs)
+    assert any("`never.sent`" in m and "never emitted" in m for m in msgs)
+    assert any("`svoc_ghost_total`" in m for m in msgs)
+    # the documented-and-emitted pairs are clean
+    assert not any("`a.b`" in m for m in msgs)
+    assert not any("`foo`" in m or "`svoc_foo_total`" in m for m in msgs)
+    assert len(msgs) == 4
+
+
+def test_svoc015_doc_side_requires_whole_package(tmp_path):
+    # without utils/events.py + utils/metrics.py in the analyzed set, a
+    # subset run cannot prove a documented name is NEVER emitted
+    tree = tmp_path / "tree"
+    _write(
+        tree,
+        "docs/OBSERVABILITY.md",
+        """
+        | type | emitted by | data |
+        |------|------------|------|
+        | `never.sent` | nobody | |
+        """,
+    )
+    _write(
+        tree,
+        "app.py",
+        """
+        from utils.events import emit_event
+
+        def run(n):
+            emit_event("c.d", n=n)
+        """,
+    )
+    report = analyze_paths([str(tree)], root=str(tree))
+    msgs = [f.message for f in report.all_findings if f.rule == "SVOC015"]
+    assert any("`c.d`" in m for m in msgs)  # code->docs still runs
+    assert not any("never.sent" in m for m in msgs)
+
+
+def test_svoc015_counter_render_matches_total_suffix(tmp_path):
+    # family `f` may be documented under any metrics.py render:
+    # svoc_f, svoc_f_total, svoc_f_seconds, svoc_f_seconds_max
+    tree = tmp_path / "tree"
+    _write(
+        tree,
+        "docs/OBSERVABILITY.md",
+        """
+        | series | type | meaning |
+        |--------|------|---------|
+        | `svoc_fetch_latency_seconds` | timer | wall time |
+        """,
+    )
+    _write(
+        tree,
+        "app.py",
+        """
+        def run(reg):
+            reg.timer("fetch_latency").time()
+        """,
+    )
+    report = analyze_paths([str(tree)], root=str(tree))
+    assert not [f for f in report.all_findings if f.rule == "SVOC015"]
+
+
+# ---------------------------------------------------------------------------
+# SVOC016 — fingerprint-taint (contract plane)
+# ---------------------------------------------------------------------------
+
+
+def test_svoc016_flags_clock_taint_through_variable_into_emit():
+    findings = analyze_source(
+        src(
+            """
+            import time
+            from svoc_tpu.utils.events import emit_event
+
+            def report(n):
+                started = time.perf_counter()
+                took = 1.0 - started
+                emit_event("consensus.result", n=n, took=took)
+            """
+        )
+    )
+    hits = [f for f in findings if f.rule == "SVOC016"]
+    assert len(hits) == 1
+    assert "`took`" in hits[0].message
+    trace = " | ".join(hits[0].path_trace)
+    assert "source" in trace and "sink" in trace
+
+
+def test_svoc016_taint_propagates_through_containers_and_fstrings():
+    findings = analyze_source(
+        src(
+            """
+            import time
+            from svoc_tpu.utils.events import emit_event
+
+            def report_list(n):
+                t0 = time.monotonic()
+                parts = [t0, n]
+                emit_event("consensus.result", parts=parts)
+
+            def report_fstring(n):
+                t0 = time.monotonic()
+                label = f"run-{t0}"
+                emit_event("consensus.result", label=label)
+            """
+        )
+    )
+    hits = [f for f in findings if f.rule == "SVOC016"]
+    assert len(hits) == 2
+    assert any("`parts`" in f.message for f in hits)
+    assert any("`label`" in f.message for f in hits)
+
+
+def test_svoc016_flags_set_iteration_taint_in_fingerprint_return():
+    findings = analyze_source(
+        src(
+            """
+            def fingerprint_keys(d):
+                acc = ""
+                for k in set(d):
+                    acc = acc + k
+                return acc
+            """
+        )
+    )
+    hits = [f for f in findings if f.rule == "SVOC016"]
+    assert len(hits) == 1
+    assert "fingerprint_keys" in hits[0].message
+    assert "set" in hits[0].message
+
+
+def test_svoc016_negative_sorted_sanitizes_and_reassignment_clears():
+    findings = analyze_source(
+        src(
+            """
+            import time
+            from svoc_tpu.utils.events import emit_event
+
+            def fingerprint_keys(d):
+                acc = ""
+                for k in sorted(set(d)):
+                    acc = acc + k
+                return acc
+
+            def report(n):
+                t0 = time.monotonic()
+                t0 = 0.0
+                emit_event("consensus.result", t0=t0)
+            """
+        )
+    )
+    assert "SVOC016" not in rules_of(findings)
+
+
+def test_svoc016_direct_source_at_sink_is_svoc008_not_svoc016():
+    # one hazard, one rule id: the direct form belongs to SVOC008
+    findings = analyze_source(
+        src(
+            """
+            import time
+            from svoc_tpu.utils.events import emit_event
+
+            def report(n):
+                emit_event("consensus.result", at=time.time())
+            """
+        )
+    )
+    assert "SVOC008" in rules_of(findings)
+    assert "SVOC016" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# SVOC017 — shard-spec consistency (contract plane)
+# ---------------------------------------------------------------------------
+
+
+def test_svoc017_flags_unknown_axis_in_partition_spec():
+    findings = analyze_source(
+        src(
+            """
+            from jax.sharding import PartitionSpec
+
+            CLAIM_AXIS = "claims"
+
+            def claims_spec():
+                return PartitionSpec("oraclez", None)
+            """
+        )
+    )
+    hits = [f for f in findings if f.rule == "SVOC017"]
+    assert len(hits) == 1
+    assert "`oraclez`" in hits[0].message
+    assert "claims" in hits[0].message  # names the known universe
+
+
+def test_svoc017_negative_axes_resolved_through_constants():
+    findings = analyze_source(
+        src(
+            """
+            from jax.sharding import PartitionSpec
+
+            CLAIM_AXIS = "claims"
+            ORACLE_AXIS = "oracles"
+
+            def claims_spec():
+                return PartitionSpec(CLAIM_AXIS, ORACLE_AXIS)
+
+            def literal_but_known():
+                return PartitionSpec("claims")
+            """
+        )
+    )
+    assert "SVOC017" not in rules_of(findings)
+
+
+def test_svoc017_flags_collective_over_unknown_axis():
+    findings = analyze_source(
+        src(
+            """
+            import jax
+
+            CLAIM_AXIS = "claims"
+
+            def reduce_scores(x):
+                return jax.lax.psum(x, "oraclez")
+            """
+        )
+    )
+    hits = [f for f in findings if f.rule == "SVOC017"]
+    assert len(hits) == 1
+    assert "psum" in hits[0].message and "`oraclez`" in hits[0].message
+
+
+def test_svoc017_any_collective_in_parity_body_is_an_error(tmp_path):
+    # even over a KNOWN axis: the claim-cube bodies are the bit-exact
+    # parity surface — cross-shard communication there is the bug class
+    tree = tmp_path / "tree"
+    _write(
+        tree,
+        "parallel/claim_shard.py",
+        """
+        import jax
+
+        CLAIM_AXIS = "claims"
+
+        def _host_cube_body(x):
+            return jax.lax.psum(x, CLAIM_AXIS)
+
+        def _fleet_cube_body(x):
+            return jax.lax.psum(x, CLAIM_AXIS)
+        """,
+    )
+    report = analyze_paths([str(tree)], root=str(tree))
+    hits = [f for f in report.all_findings if f.rule == "SVOC017"]
+    assert len(hits) == 1
+    assert "_host_cube_body" in hits[0].message
+    assert "parity" in hits[0].message
+
+
+def test_svoc017_empty_axis_universe_skips():
+    # a subset run without parallel/mesh.py (no *_AXIS constants in
+    # sight) proves nothing — must not flag every axis
+    findings = analyze_source(
+        src(
+            """
+            from jax.sharding import PartitionSpec
+
+            def claims_spec():
+                return PartitionSpec("anything_goes")
+            """
+        )
+    )
+    assert "SVOC017" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# SARIF export
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_document_shape_and_path_trace_related_locations(tmp_path):
+    from svoc_tpu.analysis.sarif import to_sarif
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(_INJECTED["SVOC010"])
+    report = analyze_paths([str(bad)], root=str(tmp_path))
+    doc = to_sarif(report.all_findings, RULE_DOCS, root=str(tmp_path))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == set(RULE_DOCS)
+    res = next(r for r in run["results"] if r["ruleId"] == "SVOC010")
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "bad.py"
+    assert loc["region"]["startLine"] >= 1
+    # the interprocedural path_trace rides as relatedLocations, in
+    # order; anchored hops get physical locations
+    assert res["relatedLocations"]
+    assert any("physicalLocation" in rl for rl in res["relatedLocations"])
+    for rl in res["relatedLocations"]:
+        if "physicalLocation" in rl:
+            assert rl["physicalLocation"]["artifactLocation"]["uri"] == "bad.py"
+
+
+def test_sarif_levels_follow_rule_severity(tmp_path):
+    from svoc_tpu.analysis.sarif import to_sarif
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(_INJECTED["SVOC001"])
+    report = analyze_paths([str(bad)], root=str(tmp_path))
+    doc = to_sarif(report.all_findings, RULE_DOCS, root=str(tmp_path))
+    res = next(
+        r for r in doc["runs"][0]["results"] if r["ruleId"] == "SVOC001"
+    )
+    assert res["level"] == RULE_DOCS["SVOC001"]["severity"]
+
+
+def test_cli_sarif_flag_writes_document(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_INJECTED["SVOC001"])
+    out = tmp_path / "findings.sarif"
+    proc = _run_cli([str(bad), "--no-baseline", "--sarif", str(out)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"][0]["ruleId"] == "SVOC001"
+
+
+def test_cli_sarif_clean_repo_run_exports_empty_results(tmp_path):
+    # baselined findings are accepted debt — they must NOT surface as
+    # annotations on every PR
+    out = tmp_path / "clean.sarif"
+    proc = _run_cli(["--sarif", str(out)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(out.read_text())
+    assert doc["runs"][0]["results"] == []
+
+
+# ---------------------------------------------------------------------------
+# contract-plane cache + timing acceptance
+# ---------------------------------------------------------------------------
+
+
+def test_cache_rejects_pre_contract_ruleset_version(tmp_path):
+    # the PR that added SVOC013-017 bumped RULESET_VERSION: a cache
+    # written by the previous rule set must load as empty, or warm runs
+    # would silently skip the new rules on unchanged files
+    from svoc_tpu.analysis.cache import RULESET_VERSION
+
+    assert RULESET_VERSION != "svoclint-2-interproc-1"
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    _make_tree(tree, n=3)
+    cache = str(tmp_path / "cache.json")
+    analyze_paths([str(tree)], root=str(tmp_path), cache_path=cache)
+    data = json.load(open(cache))
+    data["ruleset"] = "svoclint-2-interproc-1"
+    json.dump(data, open(cache, "w"))
+    r = analyze_paths([str(tree)], root=str(tmp_path), cache_path=cache)
+    assert r.parsed == 3 and r.cache_hits == 0
+
+
+def test_whole_repo_warm_cache_run_is_fast(tmp_path):
+    # acceptance: whole-repo lint < 5 s warm (< 10 s cold is pinned by
+    # test_whole_package_run_is_clean_and_fast)
+    cache = str(tmp_path / "cache.json")
+    paths = [
+        os.path.join(REPO_ROOT, "svoc_tpu"),
+        os.path.join(REPO_ROOT, "tools"),
+    ]
+    analyze_paths(paths, root=REPO_ROOT, cache_path=cache)
+    warm = analyze_paths(paths, root=REPO_ROOT, cache_path=cache)
+    assert warm.parsed == 0, "warm run re-parsed files"
+    assert warm.duration_s < 5.0

@@ -19,10 +19,13 @@ Two speed paths keep iteration sub-second as the repo grows:
 main`` (falling back to the full tree when git is unavailable), and the
 content-hash findings cache (``.svoclint_cache.json``, gitignored; keyed
 by rule-set version + file sha256) lets warm full runs skip parsing
-unchanged files entirely.  The interprocedural rules (SVOC008–012) run
-fresh every time over the cached per-module summaries — their findings
-carry a ``path_trace`` (the call chain that justifies the finding) in
-both text (``via:`` lines) and JSON output.
+unchanged files entirely.  The interprocedural and contract-plane
+rules (SVOC008–017) run fresh every time over the cached per-module
+summaries — their findings carry a ``path_trace`` (the call chain that
+justifies the finding) in both text (``via:`` lines) and JSON output,
+and ``--sarif <path>`` additionally writes the NEW findings as a SARIF
+2.1.0 document (trace hops become ``relatedLocations``) for GitHub
+code scanning / editor ingestion.
 
 No JAX import anywhere on this path (enforced by
 tests/test_svoclint.py): linting must cost sub-seconds on a CPU-only
@@ -111,6 +114,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-cache",
         action="store_true",
         help="disable the findings cache for this run",
+    )
+    p.add_argument(
+        "--sarif",
+        default=None,
+        metavar="PATH",
+        help="also write the NEW findings as a SARIF 2.1.0 document to "
+        "PATH (path_trace hops become relatedLocations); baselined and "
+        "suppressed findings are not exported",
     )
     p.add_argument(
         "--list-rules", action="store_true", help="print the rule table and exit"
@@ -298,6 +309,11 @@ def main(argv=None) -> int:
     suggestions = {
         id(e): suggest_rebase(e, all_current) for e in stale
     }
+
+    if args.sarif:
+        from svoc_tpu.analysis.sarif import write_sarif  # noqa: E402
+
+        write_sarif(args.sarif, findings, RULE_DOCS, root=args.root)
 
     if args.format == "json":
         payload = {
