@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving bench-shard bench-hotpath bench-coldstart campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke obs-cost-smoke fabric-smoke serving-smoke crash-smoke chaos-fuzz-smoke shard-smoke hotpath-smoke coldstart-smoke pallas-parity clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving bench-shard bench-hotpath bench-coldstart bench-cluster campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke obs-cost-smoke fabric-smoke serving-smoke crash-smoke chaos-fuzz-smoke shard-smoke hotpath-smoke coldstart-smoke cluster-smoke pallas-parity clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -170,6 +170,16 @@ coldstart-smoke:
 crash-smoke:
 	$(PY) tools/crash_smoke.py
 
+# Multi-replica fleet chaos gate (docs/CLUSTER.md): seeded 3-replica ×
+# 6-claim scenario with a mid-run replica kill, failover two steps
+# later, an injected forwarding fault, and stale-epoch/down-replica
+# probes, run twice — asserts replay identity (per-claim + fleet
+# fingerprints), zero duplicate txs across the cluster-shared chain
+# logs, lineage continuity through every migration, zero unaccounted
+# requests, and full cluster fault-point coverage → CLUSTER_SMOKE.json.
+cluster-smoke:
+	$(PY) tools/cluster_smoke.py
+
 # Deterministic fault-space fuzzer gate (docs/RESILIENCE.md
 # §fault-surface): 32 seed-drawn kill/restart schedules over the named
 # fault-point registry — SIGKILL at the Nth firing, torn writes,
@@ -188,7 +198,7 @@ chaos-fuzz-smoke:
 # convergence gates (I/O-plane, then data-plane), then the flight
 # recorder, then the fabric and serving tiers, then crash consistency
 # and the fault-space fuzzer, then the suite.
-verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke obs-cost-smoke fabric-smoke shard-smoke serving-smoke hotpath-smoke coldstart-smoke chaos-fuzz-smoke crash-smoke test
+verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke obs-cost-smoke fabric-smoke shard-smoke serving-smoke hotpath-smoke coldstart-smoke chaos-fuzz-smoke crash-smoke cluster-smoke test
 
 # End-of-round gate: lint + the driver-contract guards FIRST (fast,
 # loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
@@ -208,6 +218,7 @@ presnapshot:
 	$(MAKE) coldstart-smoke
 	$(MAKE) chaos-fuzz-smoke
 	$(MAKE) crash-smoke
+	$(MAKE) cluster-smoke
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_graft_entry.py tests/test_bench.py -q
 	$(MAKE) test
@@ -247,6 +258,14 @@ bench-hotpath:
 # compilation_cache routing decisions).
 bench-coldstart:
 	$(PY) bench_coldstart.py
+
+# Cluster scaling bench (docs/CLUSTER.md §bench): aggregate QPS at
+# fixed total work for 1/2/4 replicas, fleet invariants asserted per
+# point → BENCH_CLUSTER_r11.json (CPU-honest — verdict is a recorded
+# null on 1-core hosts, the BENCH_SHARD_r07 precedent; parsed by
+# tools/decide_perf.py into the cluster_replicas routing decision).
+bench-cluster:
+	$(PY) tools/bench_cluster.py
 
 # Round-long liveness-gated hardware measurement campaign (resumes its
 # HW_CAMPAIGN.json journal; run in the background for the whole round).

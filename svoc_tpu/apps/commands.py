@@ -64,6 +64,9 @@ HELP = """Commands:
       docs/OBSERVABILITY.md §cost-attribution)
     - profile [start [seconds]|stop|status] (on-demand jax.profiler
       capture, bounded duration; default: status)
+    - cluster [status | migrate <claim> <replica>] (multi-replica
+      fleet: placement map + epoch, per-replica health/breakers, or
+      one operator migration — docs/CLUSTER.md)
     - drain (graceful teardown: stop admission, flush queues,
       snapshot, postmortem bundle — what SIGTERM does)
     - multimodal [K|auto] (mixture analysis of the last fetch;
@@ -132,6 +135,11 @@ class CommandConsole:
         #: §cost-attribution): set by ``ProfileCapture.attach`` — the
         #: ``profile`` command and ``GET /api/profile`` read it.
         self.profiler = None
+        #: Multi-replica fleet router (docs/CLUSTER.md): set by
+        #: ``ClusterRouter.attach`` — the ``cluster`` command and
+        #: ``/api/state``'s cluster section read it.  None = the
+        #: single-replica deployments of PRs 1–17, unchanged.
+        self.cluster = None
         self._auto_fetch_thread: Optional[threading.Thread] = None
         self._scraper_stop: Optional[threading.Event] = None
         self._scraper_thread: Optional[threading.Thread] = None
@@ -701,6 +709,59 @@ class CommandConsole:
                 for lin in status["wal_open_cycles"]:
                     emit(f"  OPEN {lin} — a commit is in flight (or a "
                          "crash awaits reconciliation)")
+            elif cmd == "cluster":
+                # Multi-replica fleet status / operator migration
+                # (docs/CLUSTER.md).
+                if self.cluster is None:
+                    emit(
+                        "no cluster attached — this is a single-replica "
+                        "deployment (wire a ClusterRouter and "
+                        "attach(console) — docs/CLUSTER.md)"
+                    )
+                    return out
+                sub = args[0] if args else "status"
+                if sub == "migrate":
+                    if len(args) != 3:
+                        emit("usage: cluster migrate <claim> <replica>")
+                        return out
+                    report = self.cluster.migrate(
+                        args[1], args[2], reason="operator"
+                    )
+                    emit(
+                        f"migrated {args[1]} -> {args[2]} "
+                        f"(epoch {report['epoch']}, cursor "
+                        f"{report['cursor']}, continuity "
+                        f"{'ok' if report['continuity'] else 'BROKEN'})"
+                    )
+                    return out
+                if sub != "status":
+                    emit("usage: cluster [status | migrate <claim> <replica>]")
+                    return out
+                snap = self.cluster.snapshot()
+                emit(
+                    f"cluster: epoch {snap['epoch']}, "
+                    f"{len(snap['replicas'])} replica(s), "
+                    f"{len(snap['claims'])} claim(s)"
+                    + (
+                        f", retired: {', '.join(snap['retired'])}"
+                        if snap["retired"]
+                        else ""
+                    )
+                )
+                for rid, rep in sorted(snap["replicas"].items()):
+                    requests = rep.get("requests", {})
+                    owned = sorted(
+                        cid
+                        for cid, owner in snap["claims"].items()
+                        if owner == rid
+                    )
+                    emit(
+                        f"  {rid}: "
+                        f"{'alive' if rep.get('alive') else 'DEAD'}, "
+                        f"breaker {rep.get('breaker', '?')}, "
+                        f"claims [{', '.join(owned)}], "
+                        f"completed {requests.get('completed', 0):.0f}"
+                    )
             elif cmd == "costs":
                 # Shape-keyed dispatch-cost ledger
                 # (docs/OBSERVABILITY.md §cost-attribution).

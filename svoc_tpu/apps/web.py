@@ -432,6 +432,12 @@ class _Handler(BaseHTTPRequestHandler):
             durability = getattr(self.console, "durability", None)
             if durability is not None:
                 payload["durability"] = durability.status()
+            # Cluster plane (docs/CLUSTER.md): placement map + epoch,
+            # per-replica liveness/accounting, and the migration/
+            # failover counters — the fleet operator's routing view.
+            cluster = getattr(self.console, "cluster", None)
+            if cluster is not None:
+                payload["cluster"] = cluster.snapshot()
             self._send(200, json.dumps(payload).encode(), "application/json")
         elif self.path == "/api/events" or self.path.startswith("/api/events?"):
             self._serve_events()

@@ -1,0 +1,22 @@
+"""Multi-replica serving fleet (PR 18, docs/CLUSTER.md).
+
+A thin placement/routing plane over N independent single-process
+serving stacks: :mod:`placement` maps claims to replicas
+deterministically, :mod:`replica` packages one MultiSession/ServingTier
+per durable base dir, :mod:`router` forwards, migrates, and fails over,
+and :mod:`scenario` is the seeded kill/failover workload behind
+``make cluster-smoke``.
+"""
+
+from svoc_tpu.cluster.placement import PlacementDirectory, PlacementError
+from svoc_tpu.cluster.replica import Replica, ReplicaDeadError
+from svoc_tpu.cluster.router import ClusterRouter, MigrationContinuityError
+
+__all__ = [
+    "PlacementDirectory",
+    "PlacementError",
+    "Replica",
+    "ReplicaDeadError",
+    "ClusterRouter",
+    "MigrationContinuityError",
+]

@@ -1,0 +1,595 @@
+"""Cluster router: forwards serving traffic to the owning replica.
+
+The thin plane over N :class:`~svoc_tpu.cluster.replica.Replica` stacks
+(G-Core's balanced multi-worker split, PAPERS.md): resolution is the
+:class:`~svoc_tpu.cluster.placement.PlacementDirectory`, transport
+faults ride a per-replica :class:`~svoc_tpu.resilience.breaker
+.CircuitBreaker` + :class:`~svoc_tpu.resilience.retry.RetryPolicy`, and
+every degraded outcome is TYPED — a stale-epoch caller gets a
+``redirect`` response, a dead/open-breaker owner gets a counted and
+journaled ``cluster.unavailable`` shed.  Nothing falls back silently
+(SVOC014).
+
+Migration (:meth:`migrate`) is drain → ship → adopt, each boundary a
+named fault point (docs/RESILIENCE.md §fault-surface):
+
+1. **drain** — per-claim :meth:`Replica.drain_claim`: the old owner
+   flushes the claim's admitted queue and journals the un-servable
+   remainder as ``serving.deferred`` (PR 8's never-silent accounting).
+2. **ship** — the claim's snapshot slice detaches
+   (:meth:`Replica.ship_claim`); a fault between ship and adopt
+   quarantines the slice through ``restore_multi_session``'s orphan
+   path — never dropped, never double-owned.
+3. **adopt** — the new owner replays the cluster-shared chain log
+   (digest dedup ⇒ zero duplicate txs) and restores the slice; the
+   lineage cursor must arrive exactly (``continuity`` check: the next
+   fetch mints claim N+1 on the new owner).
+4. the placement epoch bumps and the whole sequence is journaled as
+   lineage-carrying ``cluster.migrate`` events.
+
+Failover (:meth:`fail_over`) is recover-then-migrate: a fresh stack
+over the dead replica's durable dirs recovers exactly like the
+crash-smoke restart (its recovered counters become the accounting
+authority for the dead process — the PR 8 convention), then every
+owned claim migrates to the rendezvous-chosen survivor.
+
+SVOC011: the retry policy, breakers, placement, and journal are pinned
+at construction; :meth:`submit` resolves nothing from the environment.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from svoc_tpu.cluster.placement import (
+    PlacementDirectory,
+    PlacementError,
+    _hrw_score,
+)
+from svoc_tpu.cluster.replica import Replica, ReplicaDeadError, lineage_cursor
+from svoc_tpu.durability import faultspace
+from svoc_tpu.resilience.breaker import CircuitBreaker, CircuitOpenError
+from svoc_tpu.resilience.faults import InjectedFault
+from svoc_tpu.resilience.retry import RetryPolicy, call_with_retry
+from svoc_tpu.utils.checkpoint import restore_multi_session
+from svoc_tpu.utils.events import resolve_journal
+
+
+class MigrationContinuityError(RuntimeError):
+    """The adopted lineage cursor disagrees with the shipped one — the
+    new owner would re-mint or skip lineage ids.  Never expected; the
+    adopt event carries the evidence either way."""
+
+
+class ClusterRouter:
+    """Routes submits/cycles across replicas; owns migration/failover."""
+
+    def __init__(
+        self,
+        placement: PlacementDirectory,
+        *,
+        journal=None,
+        metrics=None,
+        clock: Optional[Callable[[], float]] = None,
+        retry: Optional[RetryPolicy] = None,
+        breaker_factory: Optional[Callable[[str], CircuitBreaker]] = None,
+        replica_factory: Optional[Callable[[str], Replica]] = None,
+        lineage_scope: str = "clu",
+        unclaimed_path: Optional[str] = None,
+    ):
+        from svoc_tpu.utils.metrics import registry as default_registry
+
+        self._placement = placement
+        self._journal = resolve_journal(journal)
+        self._metrics = metrics if metrics is not None else default_registry
+        self._clock = clock if clock is not None else time.monotonic
+        # Virtual clocks advance instead of blocking; a real clock
+        # sleeps for real (both pinned here — SVOC011).
+        advance = getattr(self._clock, "advance", None)
+        self._sleep: Callable[[float], None] = (
+            advance if callable(advance) else time.sleep
+        )
+        self._retry = (
+            retry
+            if retry is not None
+            else RetryPolicy(
+                max_attempts=2, base_s=0.0, cap_s=0.0, jitter_seed=0
+            )
+        )
+        self._breaker_factory = breaker_factory or (
+            lambda rid: CircuitBreaker(
+                f"cluster-{rid}",
+                failure_threshold=3,
+                reset_timeout_s=5.0,
+                clock=self._clock,
+                registry=self._metrics,
+                journal=self._journal,
+            )
+        )
+        #: Rebuilds a replica stack over its existing durable dirs —
+        #: the failover recovery path.  The scenario that constructed
+        #: the fleet pins it; without one, fail_over refuses.
+        self._replica_factory = replica_factory
+        self._lineage_scope = lineage_scope
+        self._unclaimed_path = unclaimed_path
+        self._replicas: Dict[str, Replica] = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._claims: Dict[str, Any] = {}
+        #: Accounting harvested from failed-over replicas: the
+        #: recovered durable counters are the authority for the dead
+        #: process (PR 8 convention) — fleet totals fold these in.
+        self._retired: Dict[str, Dict[str, Any]] = {}
+
+    # -- membership ----------------------------------------------------------
+
+    def add_replica(self, replica: Replica) -> None:
+        rid = replica.replica_id
+        self._replicas[rid] = replica
+        self._breakers[rid] = self._breaker_factory(rid)
+        self._placement.add_replica(rid)
+
+    def replica(self, replica_id: str) -> Replica:
+        return self._replicas[replica_id]
+
+    def replica_ids(self) -> List[str]:
+        return sorted(self._replicas)
+
+    def add_claim(self, spec) -> str:
+        """Register a claim fleet-wide: placement decides the owner."""
+        cid = spec.claim_id
+        self._claims[cid] = spec
+        owner = self._placement.owner(cid)
+        self._replicas[owner].add_claim(spec)
+        return owner
+
+    def claim_ids(self) -> List[str]:
+        return sorted(self._claims)
+
+    def _lineage_prefix(self, claim_id: str) -> str:
+        return f"blk{self._lineage_scope}-{claim_id}"
+
+    # -- the forwarding plane ------------------------------------------------
+
+    def submit(
+        self, claim_id: str, text: str, *, epoch: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """Forward one ``/api/submit`` to the owning replica.
+
+        ``epoch`` is the placement epoch the caller resolved under
+        (None = trust the router).  A stale epoch returns a typed
+        ``redirect`` carrying the current owner — the caller re-resolves
+        instead of the router silently re-routing a request the caller
+        addressed to somebody else."""
+        current = self._placement.epoch
+        if epoch is not None and int(epoch) != current:
+            owner = self._placement.owner(claim_id)
+            self._metrics.counter(
+                "cluster_redirects", labels={"claim": claim_id}
+            ).add(1)
+            self._journal.emit(
+                "cluster.redirect",
+                lineage=self._lineage_prefix(claim_id),
+                claim=claim_id,
+                presented_epoch=int(epoch),
+                epoch=current,
+                owner=owner,
+            )
+            return {
+                "status": "redirect",
+                "claim": claim_id,
+                "reason": "stale_epoch",
+                "epoch": current,
+                "owner": owner,
+            }
+        owner = self._placement.owner(claim_id)
+        replica = self._replicas.get(owner)
+        if replica is None or not replica.alive:
+            return self._shed(claim_id, owner, "replica_down")
+        if not replica.has_claim(claim_id):
+            # The HTTP 404 contract (unknown claim), kept OUTSIDE the
+            # breaker guard — a caller's typo is not replica failure.
+            raise KeyError(claim_id)
+        breaker = self._breakers[owner]
+
+        def send() -> Dict[str, Any]:
+            faultspace.fault_point(
+                faultspace.CLUSTER_FORWARD_PRE_SEND,
+                payload={"claim": claim_id, "replica": owner},
+            )
+            return replica.submit(claim_id, text)
+
+        try:
+            with breaker.guard():
+                response = call_with_retry(
+                    send,
+                    self._retry,
+                    op="cluster.forward",
+                    retry_on=(InjectedFault, ReplicaDeadError),
+                    sleep=self._sleep,
+                    clock=self._clock,
+                    registry=self._metrics,
+                )
+        except CircuitOpenError:
+            return self._shed(claim_id, owner, "breaker_open")
+        except Exception as err:
+            # Retry budget exhausted (injected fault, replica died
+            # mid-call): a counted, journaled shed — never silent.
+            return self._shed(
+                claim_id, owner, "forward_error", error=type(err).__name__
+            )
+        self._metrics.counter(
+            "cluster_forwarded", labels={"claim": claim_id, "replica": owner}
+        ).add(1)
+        response = dict(response)
+        response["replica"] = owner
+        response["epoch"] = current
+        return response
+
+    def _shed(
+        self,
+        claim_id: str,
+        replica_id: Optional[str],
+        reason: str,
+        error: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """The explicit degraded path: count + journal, then answer."""
+        self._metrics.counter(
+            "cluster_unavailable",
+            labels={"claim": claim_id, "replica": replica_id or "none"},
+        ).add(1)
+        data: Dict[str, Any] = {
+            "claim": claim_id,
+            "replica": replica_id,
+            "reason": reason,
+        }
+        if error is not None:
+            data["error"] = error
+        self._journal.emit(
+            "cluster.unavailable",
+            lineage=self._lineage_prefix(claim_id),
+            **data,
+        )
+        return {"status": "unavailable", "epoch": self._placement.epoch, **data}
+
+    def step_all(self) -> Dict[str, Any]:
+        """One pull-mode serving cycle on every live replica, roster
+        order — the cluster twin of ``ServingTier.step``."""
+        reports: Dict[str, Any] = {}
+        for rid in sorted(self._replicas):
+            replica = self._replicas[rid]
+            if not replica.alive:
+                continue
+            replica.step()
+            reports[rid] = {"steps": replica.tier.steps}
+        return reports
+
+    # -- migration -----------------------------------------------------------
+
+    def migrate(
+        self, claim_id: str, target_id: str, *, reason: str = "operator"
+    ) -> Dict[str, Any]:
+        """Move ``claim_id`` to ``target_id``: drain → ship → adopt →
+        epoch bump, journaled as a ``cluster.migrate`` sequence."""
+        if claim_id not in self._claims:
+            raise KeyError(claim_id)
+        source_id = self._placement.owner(claim_id)
+        source = self._replicas.get(source_id)
+        if source is None or not source.alive:
+            raise PlacementError(
+                f"claim {claim_id!r} owner {source_id!r} is down — "
+                "use fail_over, not migrate"
+            )
+        if source_id == target_id:
+            raise ValueError(f"claim {claim_id!r} already on {target_id!r}")
+        return self._migrate_from(source, claim_id, target_id, reason)
+
+    def _migrate_from(
+        self, source: Replica, claim_id: str, target_id: str, reason: str
+    ) -> Dict[str, Any]:
+        prefix = self._lineage_prefix(claim_id)
+        source_id = source.replica_id
+        payload = {"claim": claim_id, "source": source_id, "target": target_id}
+        self._journal.emit(
+            "cluster.migrate",
+            lineage=prefix,
+            phase="drain",
+            reason=reason,
+            epoch=self._placement.epoch,
+            **payload,
+        )
+        faultspace.fault_point(
+            faultspace.CLUSTER_MIGRATE_PRE_DRAIN, payload=payload
+        )
+        drain_report = source.drain_claim(claim_id)
+        entry = source.ship_claim(claim_id)
+        shipped_cursor = int(entry["session"]["fetch_claim"])
+        self._journal.emit(
+            "cluster.migrate",
+            lineage=prefix,
+            phase="ship",
+            cycles=entry["cycles"],
+            cursor=shipped_cursor,
+            deferred=drain_report["deferred"],
+            **payload,
+        )
+        target = self._replicas.get(target_id)
+        if (
+            target is None
+            or not target.alive
+            or target_id not in self._placement.replicas()
+        ):
+            return self._quarantine(
+                source, claim_id, entry, target_id, prefix, "missing_target"
+            )
+        try:
+            faultspace.fault_point(
+                faultspace.CLUSTER_MIGRATE_POST_SHIP, payload=payload
+            )
+            faultspace.fault_point(
+                faultspace.CLUSTER_MIGRATE_PRE_ADOPT, payload=payload
+            )
+            adopt_report = target.adopt_claim(claim_id, entry)
+        except InjectedFault as err:
+            # The slice is detached but not adopted — quarantine it
+            # (orphan path), never drop it or leave two live owners.
+            return self._quarantine(
+                source, claim_id, entry, target_id, prefix, type(err).__name__
+            )
+        continuity = (
+            claim_id in adopt_report["restored"]
+            and adopt_report["cursor"] == shipped_cursor
+        )
+        epoch = self._placement.assign(claim_id, target_id)
+        self._metrics.counter(
+            "cluster_migrations",
+            labels={"claim": claim_id, "replica": target_id},
+        ).add(1)
+        self._journal.emit(
+            "cluster.migrate",
+            lineage=prefix,
+            phase="adopt",
+            cursor=adopt_report["cursor"],
+            continuity=continuity,
+            epoch=epoch,
+            **payload,
+        )
+        if not continuity:
+            raise MigrationContinuityError(
+                f"claim {claim_id!r}: shipped cursor {shipped_cursor} != "
+                f"adopted {adopt_report['cursor']} "
+                f"(restored={adopt_report['restored']})"
+            )
+        return {
+            "status": "migrated",
+            "claim": claim_id,
+            "source": source_id,
+            "target": target_id,
+            "epoch": epoch,
+            "cursor": shipped_cursor,
+            "drain": drain_report,
+            "continuity": continuity,
+        }
+
+    def _quarantine(
+        self,
+        source: Replica,
+        claim_id: str,
+        entry: Dict[str, Any],
+        target_id: str,
+        prefix: str,
+        cause: str,
+    ) -> Dict[str, Any]:
+        """Route the detached slice through ``restore_multi_session``'s
+        orphan path (the claim is no longer live on the source, so the
+        restore quarantines it) and persist the quarantine durable."""
+        payload = {
+            "version": 1,
+            "router_steps": source.multi.router.steps,
+            "claims": {claim_id: dict(entry)},
+            "unclaimed": {},
+        }
+        membership = restore_multi_session(payload, source.multi)
+        merged: Dict[str, Any] = {}
+        if self._unclaimed_path is not None:
+            if os.path.exists(self._unclaimed_path):
+                with open(self._unclaimed_path) as f:
+                    merged = json.load(f)
+            merged.update(payload["unclaimed"])
+            tmp = self._unclaimed_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(merged, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._unclaimed_path)
+        else:
+            merged = payload["unclaimed"]
+        self._metrics.counter(
+            "cluster_quarantined", labels={"claim": claim_id}
+        ).add(1)
+        self._journal.emit(
+            "cluster.migrate",
+            lineage=prefix,
+            phase="quarantine",
+            claim=claim_id,
+            source=source.replica_id,
+            target=target_id,
+            reason=cause,
+            unclaimed=membership["unclaimed"],
+        )
+        return {
+            "status": "quarantined",
+            "claim": claim_id,
+            "target": target_id,
+            "reason": cause,
+            "unclaimed": sorted(merged),
+        }
+
+    # -- failover ------------------------------------------------------------
+
+    def fail_over(self, dead_id: str) -> Dict[str, Any]:
+        """Recover-then-migrate a dead replica's claims to survivors.
+
+        A fresh stack over the dead replica's durable dirs recovers the
+        pre-death state (snapshot + journal tail + WAL reconcile — the
+        crash-smoke restart), its recovered counters are harvested as
+        the dead process's accounting authority, and every owned claim
+        drains/ships/adopts onto its rendezvous-chosen survivor."""
+        dead = self._replicas.get(dead_id)
+        if dead is None:
+            raise PlacementError(f"unknown replica {dead_id!r}")
+        if dead.alive:
+            raise ValueError(
+                f"replica {dead_id!r} is alive — drain/migrate instead"
+            )
+        if self._replica_factory is None:
+            raise RuntimeError(
+                "fail_over needs the replica_factory pinned at construction"
+            )
+        survivors = [
+            rid
+            for rid in sorted(self._replicas)
+            if rid != dead_id and self._replicas[rid].alive
+        ]
+        if not survivors:
+            raise PlacementError("no surviving replica to fail over onto")
+        owned = sorted(
+            cid
+            for cid in self._claims
+            if self._placement.owner(cid) == dead_id
+        )
+        self._journal.emit(
+            "cluster.failover",
+            replica=dead_id,
+            phase="start",
+            claims=owned,
+            epoch=self._placement.epoch,
+        )
+        recovery = self._replica_factory(dead_id)
+        for cid in owned:
+            recovery.add_claim(self._claims[cid])
+        recovery_report = recovery.recover()
+        moved: Dict[str, Any] = {}
+        for cid in owned:
+            target_id = max(
+                survivors, key=lambda rid: (_hrw_score(cid, rid), rid)
+            )
+            moved[cid] = self._migrate_from(
+                recovery, cid, target_id, reason="failover"
+            )
+        # Harvest BEFORE discarding: the recovered durable counters and
+        # the recovered journal are the dead process's accounting and
+        # replay identity.
+        self._retired[dead_id] = {
+            "requests": recovery.request_accounting(),
+            "journal_fingerprint": recovery.journal.fingerprint(),
+            "journal_events": recovery.journal.last_seq(),
+            "claims": {
+                cid: recovery.claim_journal_fingerprint(
+                    self._lineage_prefix(cid) + "-"
+                )
+                for cid in sorted(self._claims)
+            },
+        }
+        del self._replicas[dead_id]
+        del self._breakers[dead_id]
+        epoch = self._placement.remove_replica(dead_id)
+        self._metrics.counter(
+            "cluster_failovers", labels={"replica": dead_id}
+        ).add(1)
+        self._journal.emit(
+            "cluster.failover",
+            replica=dead_id,
+            phase="done",
+            claims=owned,
+            targets={cid: moved[cid].get("target") for cid in owned},
+            epoch=epoch,
+        )
+        return {
+            "replica": dead_id,
+            "claims": moved,
+            "epoch": epoch,
+            "recovery": recovery_report,
+        }
+
+    # -- identity / operator plane -------------------------------------------
+
+    def claim_fingerprint(self, claim_id: str) -> str:
+        """Fold the claim's lineage-family journal slice across every
+        replica that ever served it (live + retired) — byte-identical
+        across same-seed replays iff every forwarding and failover
+        decision replayed identically."""
+        prefix = self._lineage_prefix(claim_id) + "-"
+        parts: Dict[str, str] = {
+            rid: self._replicas[rid].claim_journal_fingerprint(prefix)
+            for rid in sorted(self._replicas)
+        }
+        for rid in sorted(self._retired):
+            parts[f"retired:{rid}"] = self._retired[rid]["claims"].get(
+                claim_id, ""
+            )
+        return hashlib.sha256(
+            json.dumps(sorted(parts.items())).encode()
+        ).hexdigest()
+
+    def fleet_fingerprint(self) -> str:
+        """The whole-fleet replay digest: per-claim fingerprints, the
+        cluster journal (every redirect/shed/migrate/failover), the
+        placement content, and the epoch."""
+        payload = {
+            "claims": {
+                cid: self.claim_fingerprint(cid) for cid in sorted(self._claims)
+            },
+            "cluster_journal": self._journal.fingerprint(),
+            "placement": self._placement.fingerprint(),
+            "epoch": self._placement.epoch,
+            "retired": {
+                rid: self._retired[rid]["journal_fingerprint"]
+                for rid in sorted(self._retired)
+            },
+        }
+        return hashlib.sha256(
+            json.dumps(payload, sort_keys=True).encode()
+        ).hexdigest()
+
+    def fleet_accounting(self) -> Dict[str, float]:
+        """At-least-once accounting across live AND retired replicas
+        (recovered durable counts are the authority for the dead)."""
+        totals = {"admitted": 0.0, "completed": 0.0, "dropped": 0.0, "cached": 0.0}
+        for rid in sorted(self._replicas):
+            for key, value in self._replicas[rid].request_accounting().items():
+                totals[key] += value
+        for rid in sorted(self._retired):
+            for key, value in self._retired[rid]["requests"].items():
+                totals[key] += value
+        totals["unaccounted"] = max(
+            0.0, totals["admitted"] - totals["completed"] - totals["dropped"]
+        )
+        return totals
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The ``/api/state`` cluster section: roster, epoch, per-
+        replica health + breaker state."""
+        return {
+            "epoch": self._placement.epoch,
+            "placement": self._placement.snapshot(),
+            "claims": {
+                cid: self._placement.owner(cid) for cid in sorted(self._claims)
+            },
+            "replicas": {
+                rid: {
+                    **self._replicas[rid].snapshot(),
+                    "breaker": self._breakers[rid].state(),
+                }
+                for rid in sorted(self._replicas)
+            },
+            "retired": sorted(self._retired),
+        }
+
+    def attach(self, console) -> None:
+        """Wire into the operator console (``cluster`` command and the
+        ``/api/state`` cluster section)."""
+        console.cluster = self

@@ -67,9 +67,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from svoc_tpu.resilience.faults import InjectedFault
 
-#: The two harnesses a point may name as its witness (``smokes``).
+#: The harnesses a point may name as its witness (``smokes``).
 SMOKE_FUZZ = "fuzz"    # tools/chaos_fuzz.py — the light durable-plane harness
 SMOKE_CRASH = "crash"  # tools/crash_smoke.py — the full fabric/serving matrix
+SMOKE_CLUSTER = "cluster"  # tools/cluster_smoke.py — the multi-replica fleet
 
 ACTIONS = ("kill", "torn", "error")
 STAGES = ("run", "recovery")
@@ -93,7 +94,7 @@ class FaultPointSpec:
         if self.stage not in STAGES:
             raise ValueError(f"{self.name}: invalid stage {self.stage!r}")
         for s in self.smokes:
-            if s not in (SMOKE_FUZZ, SMOKE_CRASH):
+            if s not in (SMOKE_FUZZ, SMOKE_CRASH, SMOKE_CLUSTER):
                 raise ValueError(f"{self.name}: unknown smoke {s!r}")
 
 
@@ -221,6 +222,58 @@ SNAPSHOT_POST_RENAME = declare(
     "re-execute or double-dedup the cycles it covers",
     actions=("kill",),
     smokes=(SMOKE_FUZZ,),
+)
+
+# The cluster plane (PR 18, docs/CLUSTER.md).  ``cluster/router.py``
+# imports this module at call time only (``durability/__init__`` →
+# ``recovery`` → ``checkpoint`` ← ``cluster`` would otherwise cycle),
+# so the declarations live here like the serving/snapshot points above.
+# These name ONLY the cluster smoke: the crash harness's point set is
+# pinned exact, and the durable-plane fuzzer's coverage denominator
+# must not grow points its single-process scenario can never reach.
+CLUSTER_FORWARD_PRE_SEND = declare(
+    "cluster.forward.pre_send",
+    owner="svoc_tpu/cluster/router.py",
+    invariant="a forwarding fault surfaces as a retry, a breaker "
+    "transition, or a counted cluster.unavailable shed — never a "
+    "silently dropped admitted request",
+    actions=("error", "kill"),
+    smokes=(SMOKE_CLUSTER,),
+)
+CLUSTER_MIGRATE_PRE_DRAIN = declare(
+    "cluster.migrate.pre_drain",
+    owner="svoc_tpu/cluster/router.py",
+    invariant="a migration aborted before the drain leaves the claim "
+    "fully owned and serving on the source — no half-moved state",
+    actions=("error",),
+    smokes=(SMOKE_CLUSTER,),
+)
+CLUSTER_MIGRATE_POST_SHIP = declare(
+    "cluster.migrate.post_ship",
+    owner="svoc_tpu/cluster/router.py",
+    invariant="a fault after the slice is shipped but before adoption "
+    "must quarantine the slice (orphan path), never drop it or leave "
+    "two live owners",
+    actions=("error",),
+    smokes=(SMOKE_CLUSTER,),
+)
+CLUSTER_MIGRATE_PRE_ADOPT = declare(
+    "cluster.migrate.pre_adopt",
+    owner="svoc_tpu/cluster/router.py",
+    invariant="adoption replays the shared chain log before restoring "
+    "the slice — a fault here must not mint duplicate txs or rewind "
+    "the lineage cursor",
+    actions=("error",),
+    smokes=(SMOKE_CLUSTER,),
+)
+REPLICA_KILL = declare(
+    "replica.kill",
+    owner="svoc_tpu/cluster/scenario.py",
+    invariant="a replica death loses no admitted request: its durable "
+    "dirs recover on the failover path and its claims re-serve on the "
+    "survivors with exactly-once lineages and zero duplicate txs",
+    actions=("kill",),
+    smokes=(SMOKE_CLUSTER,),
 )
 
 

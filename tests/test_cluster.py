@@ -1,0 +1,344 @@
+"""Multi-replica serving fleet (ISSUE 18, docs/CLUSTER.md): placement
+determinism, typed degraded routing, drain→ship→adopt migration with
+lineage continuity, orphan quarantine, seeded failover replay identity,
+and the cluster chaos-corpus pinning entry."""
+
+import json
+import os
+
+import pytest
+
+from svoc_tpu.cluster import (
+    ClusterRouter,
+    PlacementDirectory,
+    PlacementError,
+    Replica,
+)
+from svoc_tpu.durability import faultspace
+from svoc_tpu.durability.faultspace import FaultEvent
+from svoc_tpu.fabric.registry import ClaimSpec
+from svoc_tpu.resilience.retry import RetryPolicy
+
+CORPUS_DIR = os.path.join(
+    os.path.dirname(__file__), "fixtures", "chaos_corpus", "cluster"
+)
+
+CLUSTER_POINTS = (
+    "cluster.forward.pre_send",
+    "cluster.migrate.pre_drain",
+    "cluster.migrate.post_ship",
+    "cluster.migrate.pre_adopt",
+    "replica.kill",
+)
+
+
+# ---------------------------------------------------------------------------
+# placement directory
+# ---------------------------------------------------------------------------
+
+
+def test_placement_deterministic_across_instances():
+    claims = [f"c{i}" for i in range(20)]
+    roster = ["r0", "r1", "r2"]
+    first = PlacementDirectory(roster)
+    second = PlacementDirectory(list(reversed(roster)))
+    owners = {c: first.owner(c) for c in claims}
+    assert owners == {c: second.owner(c) for c in claims}
+    # Every owner is on the roster and the map is non-degenerate for a
+    # 20-claim spread (HRW over crc32 — not all on one replica).
+    assert set(owners.values()) <= set(roster)
+    assert len(set(owners.values())) > 1
+
+
+def test_placement_epoch_monotone_and_explicit_wins(tmp_path):
+    directory = PlacementDirectory(
+        ["r0", "r1"], path=str(tmp_path / "placement.json")
+    )
+    epoch0 = directory.epoch
+    hashed = directory.owner("c0")
+    target = "r0" if hashed != "r0" else "r1"
+    epoch1 = directory.assign("c0", target)
+    assert epoch1 == epoch0 + 1
+    assert directory.owner("c0") == target
+    epoch2 = directory.add_replica("r2")
+    assert epoch2 == epoch1 + 1
+    # Removing the pinned replica drops the explicit entry: the claim
+    # falls back to the rendezvous hash over the survivors.
+    epoch3 = directory.remove_replica(target)
+    assert epoch3 == epoch2 + 1
+    assert directory.owner("c0") in directory.replicas()
+    assert "c0" not in directory.assignments()
+
+
+def test_placement_persist_roundtrip(tmp_path):
+    path = str(tmp_path / "placement.json")
+    directory = PlacementDirectory(["r0", "r1", "r2"], path=path)
+    directory.assign("c3", "r1")
+    loaded = PlacementDirectory.load(path)
+    assert loaded.epoch == directory.epoch
+    assert loaded.fingerprint() == directory.fingerprint()
+    assert loaded.owner("c3") == "r1"
+    assert all(
+        loaded.owner(f"c{i}") == directory.owner(f"c{i}") for i in range(8)
+    )
+
+
+def test_placement_error_paths():
+    with pytest.raises(PlacementError):
+        PlacementDirectory([]).owner("c0")
+    with pytest.raises(PlacementError):
+        PlacementDirectory(["r0"]).assign("c0", "rZ")
+    with pytest.raises(PlacementError):
+        PlacementDirectory(["r0"], explicit={"c0": "rZ"})
+
+
+def test_cluster_fault_points_declared_for_cluster_smoke():
+    surface = faultspace.surface()
+    for point in CLUSTER_POINTS:
+        assert point in surface, point
+        assert surface[point].smokes == (faultspace.SMOKE_CLUSTER,), point
+
+
+# ---------------------------------------------------------------------------
+# router: typed degraded paths (no serving cycles needed — cheap)
+# ---------------------------------------------------------------------------
+
+
+def build_fleet(tmp_path, *, n_replicas=2, claims=("c0",), seed=0):
+    from svoc_tpu.serving.scenario import VirtualClock
+    from svoc_tpu.utils.events import EventJournal
+    from svoc_tpu.utils.metrics import MetricsRegistry
+
+    metrics = MetricsRegistry()
+    journal = EventJournal(registry=metrics)
+    chain_dir = str(tmp_path / "chain")
+    placement = PlacementDirectory(
+        [], path=str(tmp_path / "placement.json")
+    )
+
+    def replica_factory(rid):
+        return Replica(
+            rid,
+            str(tmp_path / f"replica-{rid}"),
+            chain_dir=chain_dir,
+            seed=seed,
+            clock=VirtualClock(),
+            lineage_scope="clu",
+        )
+
+    router = ClusterRouter(
+        placement,
+        journal=journal,
+        metrics=metrics,
+        clock=VirtualClock(),
+        retry=RetryPolicy(max_attempts=2, base_s=0.0, cap_s=0.0, jitter_seed=0),
+        replica_factory=replica_factory,
+        lineage_scope="clu",
+        unclaimed_path=str(tmp_path / "unclaimed.json"),
+    )
+    for i in range(n_replicas):
+        router.add_replica(replica_factory(f"r{i}"))
+    for cid in claims:
+        router.add_claim(ClaimSpec(claim_id=cid, n_oracles=7, dimension=6))
+    return router, placement, metrics
+
+
+def test_stale_epoch_submit_redirects(tmp_path):
+    router, placement, metrics = build_fleet(tmp_path)
+    response = router.submit("c0", "text", epoch=placement.epoch - 1)
+    assert response["status"] == "redirect"
+    assert response["reason"] == "stale_epoch"
+    assert response["epoch"] == placement.epoch
+    assert response["owner"] == placement.owner("c0")
+    assert metrics.family_total("cluster_redirects") == 1.0
+    # A current-epoch caller is forwarded, not redirected.
+    assert router.submit("c0", "text", epoch=placement.epoch)["status"] != "redirect"
+
+
+def test_down_replica_submit_sheds_typed(tmp_path):
+    router, placement, metrics = build_fleet(tmp_path)
+    owner = placement.owner("c0")
+    router.replica(owner).kill()
+    response = router.submit("c0", "text")
+    assert response["status"] == "unavailable"
+    assert response["reason"] == "replica_down"
+    assert response["replica"] == owner
+    assert metrics.family_total("cluster_unavailable") == 1.0
+
+
+def test_unknown_claim_is_a_caller_error_not_a_shed(tmp_path):
+    router, _, metrics = build_fleet(tmp_path)
+    with pytest.raises(KeyError):
+        router.submit("nope", "text")
+    assert metrics.family_total("cluster_unavailable") == 0.0
+
+
+def test_forward_faults_open_the_breaker(tmp_path):
+    router, placement, metrics = build_fleet(tmp_path)
+    # Retry absorbs one fault per submit (max_attempts=2), so 6 error
+    # events = 3 submits that exhaust their budget; failure_threshold=3
+    # opens the breaker and the 4th submit sheds without forwarding.
+    controller = faultspace.arm(
+        faultspace.FaultController(
+            [
+                FaultEvent(
+                    point="cluster.forward.pre_send", nth=n, action="error"
+                )
+                for n in range(1, 7)
+            ]
+        )
+    )
+    try:
+        for _ in range(3):
+            response = router.submit("c0", "text")
+            assert response["status"] == "unavailable"
+            assert response["reason"] == "forward_error"
+        response = router.submit("c0", "text")
+        assert response["status"] == "unavailable"
+        assert response["reason"] == "breaker_open"
+    finally:
+        faultspace.disarm()
+    assert controller.counts()["cluster.forward.pre_send"] >= 6
+    assert metrics.family_total("cluster_unavailable") == 4.0
+
+
+def test_orphan_quarantine_on_missing_target(tmp_path):
+    router, placement, _ = build_fleet(tmp_path)
+    report = router.migrate("c0", "rZ", reason="test")
+    assert report["status"] == "quarantined"
+    assert report["reason"] == "missing_target"
+    assert "c0" in report["unclaimed"]
+    # The slice is durable in unclaimed.json, not dropped, and the
+    # claim is no longer live on any replica.
+    with open(str(tmp_path / "unclaimed.json")) as f:
+        unclaimed = json.load(f)
+    assert "c0" in unclaimed
+    assert not any(
+        router.replica(rid).has_claim("c0") for rid in router.replica_ids()
+    )
+
+
+def test_migrate_roundtrip_preserves_lineage_cursor(tmp_path):
+    from svoc_tpu.cluster.replica import lineage_cursor
+
+    router, placement, _ = build_fleet(tmp_path)
+    source = placement.owner("c0")
+    target = next(r for r in router.replica_ids() if r != source)
+    for i in range(3):
+        assert router.submit("c0", f"comment {i}")["status"] == "admitted"
+    router.step_all()
+    cursor_before = lineage_cursor(
+        router.replica(source).multi.get("c0").session
+    )
+    assert cursor_before >= 1
+    report = router.migrate("c0", target, reason="test")
+    assert report["status"] == "migrated"
+    assert report["continuity"] is True
+    assert report["cursor"] >= cursor_before
+    assert placement.owner("c0") == target
+    # The new owner serves the claim and the next mint continues the
+    # lineage family — no re-mint, no skip.
+    assert router.replica(target).has_claim("c0")
+    assert not router.replica(source).has_claim("c0")
+    assert router.submit("c0", "after migration")["status"] == "admitted"
+    router.step_all()
+    cursor_after = lineage_cursor(
+        router.replica(target).multi.get("c0").session
+    )
+    assert cursor_after > report["cursor"]
+
+
+def test_console_cluster_command(tmp_path):
+    from svoc_tpu.apps.commands import CommandConsole
+
+    router, placement, _ = build_fleet(tmp_path)
+    console = CommandConsole.__new__(CommandConsole)
+    console.cluster = None
+    router.attach(console)
+    assert console.cluster is router
+    snap = router.snapshot()
+    assert snap["epoch"] == placement.epoch
+    assert snap["claims"]["c0"] == placement.owner("c0")
+    assert set(snap["replicas"]) == set(router.replica_ids())
+
+
+# ---------------------------------------------------------------------------
+# seeded failover scenario (three small fleet runs, module-cached)
+# ---------------------------------------------------------------------------
+
+
+def load_corpus_entry():
+    with open(os.path.join(CORPUS_DIR, "kill-failover-fleet.json")) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def failover_runs(tmp_path_factory):
+    from svoc_tpu.cluster.scenario import run_cluster_scenario
+
+    plan = load_corpus_entry()["plan"]
+    runs = []
+    for tag in ("a", "b"):
+        workdir = str(tmp_path_factory.mktemp(f"fleet-{tag}"))
+        runs.append(
+            run_cluster_scenario(
+                workdir,
+                seed=load_corpus_entry()["seed"],
+                n_replicas=plan["n_replicas"],
+                n_claims=plan["n_claims"],
+                total_steps=plan["total_steps"],
+                arrivals_per_step=plan["arrivals_per_step"],
+                kill_replica=plan["kill"]["replica"],
+                kill_at_step=plan["kill"]["at_step"],
+                fail_over_at_step=plan["kill"]["fail_over_at"],
+            )
+        )
+    return runs
+
+
+def test_failover_replay_identity(failover_runs):
+    first, second = failover_runs
+    assert first["fleet_fingerprint"] == second["fleet_fingerprint"]
+    for cid, claim in first["claims"].items():
+        assert claim["fingerprint"] == second["claims"][cid]["fingerprint"]
+
+
+def test_failover_exactly_once_and_accounted(failover_runs):
+    first, _ = failover_runs
+    assert first["duplicate_txs"] == 0
+    assert first["requests"]["unaccounted"] == 0.0
+    moved = first["failover"]["claims"]
+    assert moved, "the killed replica owned no claims — bad fixture"
+    for report in moved.values():
+        assert report["status"] == "migrated"
+        assert report["continuity"] is True
+    # Migrated claims keep serving on the survivors.
+    for cid in moved:
+        assert first["claims"][cid]["owner"] != "r1"
+        assert first["chain"][cid]["predictions"] > 0
+    # The death and every migration boundary hit their fault points.
+    for point in ("replica.kill", "cluster.migrate.pre_drain",
+                  "cluster.migrate.post_ship", "cluster.migrate.pre_adopt"):
+        assert first["fault_points_fired"].get(point, 0) > 0, point
+
+
+def test_cluster_corpus_entry_replays_pinned(tmp_path, failover_runs):
+    from svoc_tpu.cluster.scenario import replay_corpus_entry
+
+    entry = load_corpus_entry()
+    result = replay_corpus_entry(entry, str(tmp_path / "corpus"))
+    assert result["duplicate_txs"] == 0
+    assert result["requests"]["unaccounted"] == 0.0
+    # Same seed + same plan as the fixture runs → the corpus replay is
+    # byte-identical to them (the regression pin).
+    assert result["fleet_fingerprint"] == failover_runs[0]["fleet_fingerprint"]
+
+
+def test_corpus_entry_invisible_to_durable_fuzzer():
+    """The cluster subdirectory must not leak into the durable-plane
+    fuzzer's corpus (its scenario cannot reach cluster points)."""
+    from svoc_tpu.durability.fuzz import load_corpus
+
+    corpus_root = os.path.dirname(CORPUS_DIR)
+    for entry in load_corpus(corpus_root):
+        assert entry.get("format") != "svoc-cluster-corpus-v1"

@@ -13,6 +13,11 @@ from fractions import Fraction
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
+
+# Optional dependency (pyproject [test] extra): without it this module
+# skips at collection instead of erroring out of the tier-1 run.
+pytest.importorskip("hypothesis")
 from hypothesis import HealthCheck, assume, given, settings
 from hypothesis import strategies as st
 

@@ -50,6 +50,10 @@ def test_shape_mismatch_raises():
         first_valid_window(jnp.ones((4, 2)), jnp.ones(5, bool), 2)
 
 
+# Optional dependency (pyproject [test] extra): without it the
+# property-based tail of this module skips at collection instead of
+# erroring the whole file out of the tier-1 run.
+pytest.importorskip("hypothesis")
 from hypothesis import HealthCheck, given, settings  # noqa: E402
 from hypothesis import strategies as st  # noqa: E402
 

@@ -1,0 +1,225 @@
+"""Multi-replica fleet chaos gate: failover as CI (``make cluster-smoke``;
+docs/CLUSTER.md, docs/RESILIENCE.md §failover-runbook).
+
+One seeded 3-replica × 6-claim scenario
+(:func:`svoc_tpu.cluster.scenario.run_cluster_scenario`), run TWICE in
+fresh work directories with an identical schedule:
+
+- one replica is killed mid-run (SIGKILL semantics at a step boundary —
+  the ``replica.kill`` registry point) and failed over two steps later
+  (recover-then-migrate over its durable dirs);
+- one injected forwarding fault (``error`` @ ``cluster.forward.pre_send``)
+  that the per-replica retry/breaker plane must absorb;
+- one stale-epoch probe (typed redirect) and one down-replica probe
+  (typed ``cluster.unavailable`` shed) aimed into the outage window.
+
+Asserted over the results:
+
+- **replay identity** — byte-identical per-claim fingerprints AND the
+  fleet fingerprint across the two runs (the digests fold every
+  forwarding, shed, redirect, migration, and failover decision);
+- **failover served** — the killed replica's claims are owned by
+  survivors at the end, with lineage continuity through every
+  migration and their chain logs still growing;
+- **zero duplicate txs** across the cluster-shared chain logs;
+- **zero unaccounted admitted requests** fleet-wide (at-least-once
+  accounting; recovered durable counts are the authority for the dead
+  replica — the PR 8 convention);
+- **coverage** — all five cluster fault points witnessed in the
+  durable fired log, and the injected error action executed.
+
+Usage::
+
+    python tools/cluster_smoke.py [--seed 0] [--out CLUSTER_SMOKE.json]
+"""
+
+from __future__ import annotations
+
+import os
+
+# Off-TPU by construction (the axon sitecustomize pins the platform —
+# tools/soak.py measurement postmortem).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import argparse  # noqa: E402
+import sys  # noqa: E402
+import tempfile  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from svoc_tpu.durability.faultspace import (  # noqa: E402
+    FaultEvent,
+    read_fired_log,
+)
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
+
+N_REPLICAS = 3
+N_CLAIMS = 6
+TOTAL_STEPS = 10
+ARRIVALS_PER_STEP = 8
+KILL_REPLICA = "r1"
+KILL_AT_STEP = 4
+
+CLUSTER_POINTS = (
+    "cluster.forward.pre_send",
+    "cluster.migrate.pre_drain",
+    "cluster.migrate.post_ship",
+    "cluster.migrate.pre_adopt",
+    "replica.kill",
+)
+
+
+def run_once(seed: int) -> dict:
+    from svoc_tpu.cluster.scenario import run_cluster_scenario
+
+    workdir = tempfile.mkdtemp(prefix="cluster-smoke-")
+    result = run_cluster_scenario(
+        workdir,
+        seed=seed,
+        n_replicas=N_REPLICAS,
+        n_claims=N_CLAIMS,
+        total_steps=TOTAL_STEPS,
+        arrivals_per_step=ARRIVALS_PER_STEP,
+        kill_replica=KILL_REPLICA,
+        kill_at_step=KILL_AT_STEP,
+        events=[
+            FaultEvent(
+                point="cluster.forward.pre_send", nth=7, action="error"
+            )
+        ],
+    )
+    result["workdir"] = workdir
+    result["fired_log"] = read_fired_log(os.path.join(workdir, "fired.jsonl"))
+    return result
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--out", default="CLUSTER_SMOKE.json")
+    args = parser.parse_args()
+
+    checks = []
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+        print(f"[{'PASS' if ok else 'FAIL'}] {name}" + (f" — {detail}" if detail else ""))
+
+    first = run_once(args.seed)
+    second = run_once(args.seed)
+
+    # -- replay identity ----------------------------------------------------
+    per_claim_identical = all(
+        first["claims"][cid]["fingerprint"]
+        == second["claims"][cid]["fingerprint"]
+        for cid in first["claims"]
+    )
+    check(
+        "per-claim fingerprints byte-identical across runs",
+        per_claim_identical,
+        f"{len(first['claims'])} claims",
+    )
+    check(
+        "fleet fingerprint byte-identical across runs",
+        first["fleet_fingerprint"] == second["fleet_fingerprint"],
+        first["fleet_fingerprint"][:16],
+    )
+
+    # -- failover served ----------------------------------------------------
+    check(
+        "replica killed mid-run and failed over",
+        first["kill"] is not None and first["failover"] is not None,
+        f"killed {KILL_REPLICA} @ step {KILL_AT_STEP}",
+    )
+    owners = {cid: v["owner"] for cid, v in first["claims"].items()}
+    check(
+        "no claim still placed on the dead replica",
+        all(owner != KILL_REPLICA for owner in owners.values()),
+        str(owners),
+    )
+    moved = (first["failover"] or {}).get("claims", {})
+    check(
+        "every failed-over claim migrated with lineage continuity",
+        bool(moved)
+        and all(m.get("status") == "migrated" and m.get("continuity") for m in moved.values()),
+        f"{sorted(moved)} -> {[m.get('target') for m in moved.values()]}",
+    )
+    check(
+        "migrated claims serving on the new owners (chain still growing)",
+        all(
+            first["chain"][cid]["predictions"] > 0 for cid in moved
+        ),
+        str({cid: first["chain"][cid]["predictions"] for cid in sorted(moved)}),
+    )
+    check(
+        "placement epoch advanced through the failover",
+        first["epoch"] > N_REPLICAS,
+        f"epoch {first['epoch']}",
+    )
+
+    # -- cluster-wide durability oracles ------------------------------------
+    check(
+        "zero duplicate txs across the shared chain logs",
+        first["duplicate_txs"] == 0 and second["duplicate_txs"] == 0,
+        f"{first['duplicate_txs']} + {second['duplicate_txs']}",
+    )
+    requests = first["requests"]
+    check(
+        "zero unaccounted admitted requests fleet-wide",
+        requests["unaccounted"] == 0 and second["requests"]["unaccounted"] == 0,
+        f"admitted={requests['admitted']:.0f} completed={requests['completed']:.0f} "
+        f"dropped={requests['dropped']:.0f}",
+    )
+    check(
+        "outage window shed typed, counted, journaled",
+        first["cluster_counters"]["cluster_unavailable"] > 0,
+        f"{first['cluster_counters']['cluster_unavailable']:.0f} sheds",
+    )
+    check(
+        "stale-epoch probe answered with a typed redirect",
+        any(p.get("status") == "redirect" for p in first["probes"]),
+    )
+
+    # -- fault-point coverage (durable fired log) ---------------------------
+    fired = set(first["fired_log"]["fired"]) | set(second["fired_log"]["fired"])
+    missing = [p for p in CLUSTER_POINTS if p not in fired]
+    check(
+        "all cluster fault points witnessed in the durable fired log",
+        not missing,
+        f"missing={missing}" if missing else f"{len(CLUSTER_POINTS)} points",
+    )
+    actions = first["fired_log"]["actions"] + second["fired_log"]["actions"]
+    check(
+        "injected forwarding fault executed and absorbed by retry",
+        any(
+            a["point"] == "cluster.forward.pre_send" and a["action"] == "error"
+            for a in actions
+        ),
+    )
+
+    ok = all(c["ok"] for c in checks)
+    artifact = {
+        "artifact": "cluster_smoke",
+        "seed": args.seed,
+        "config": {
+            "n_replicas": N_REPLICAS,
+            "n_claims": N_CLAIMS,
+            "total_steps": TOTAL_STEPS,
+            "arrivals_per_step": ARRIVALS_PER_STEP,
+            "kill": {"replica": KILL_REPLICA, "at_step": KILL_AT_STEP},
+        },
+        "checks": checks,
+        "requests": first["requests"],
+        "cluster_counters": first["cluster_counters"],
+        "claims": first["claims"],
+        "fleet_fingerprint": first["fleet_fingerprint"],
+        "epoch": first["epoch"],
+        "ok": ok,
+    }
+    atomic_write_json(args.out, artifact)
+    print(f"{'PASS' if ok else 'FAIL'}: cluster smoke -> {args.out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,15 @@ committed measurements — not an editorial choice:
   container qualifies; ``"off"`` otherwise with the blocker recorded.
   (Explicit ``SVOC_COST_PLANE`` / constructor pins always override the
   routed default.)
+- ``cluster_replicas`` — the serving-fleet replica count
+  (docs/CLUSTER.md), from the committed ``BENCH_CLUSTER_r11.json``
+  fixed-total-work sweep: the best-QPS replica count iff the sweep ran
+  on TPU-stamped hosts with clean fleet invariants (zero duplicate
+  txs, zero unaccounted requests at every point) and
+  ``scaling_verdict == "scales"`` (≥1.5× aggregate QPS at 1→4
+  replicas); ``"1"`` otherwise — including the honest-null 1-core
+  sweep (every replica thread time-slices the same core), with the
+  blocker recorded as evidence (the BENCH_SHARD_r07 precedent).
 - ``warmup_mode`` / ``compilation_cache`` — the compile plane
   (docs/PARALLELISM.md §compile-plane), from the committed
   ``BENCH_COLDSTART_r09.json`` A/B: ``"prewarm"`` iff the in-process
@@ -325,6 +334,44 @@ def shard_grid_mesh_decision(grid):
     return "none", evidence
 
 
+def cluster_replicas_decision(grid):
+    """``(decision_or_None, evidence)`` for the ``cluster_replicas``
+    routing from the fleet scaling bench (``BENCH_CLUSTER_r11.json``).
+    Routing more than one serving replica needs ALL of: a TPU-stamped
+    sweep, clean fleet invariants (zero duplicate txs, zero unaccounted
+    requests at every point), and the ≥1.5× 1→4 ``"scales"`` verdict;
+    everything else records ``"1"`` with the sweep's own verdict and
+    blocker as evidence — the honest null IS the decision (the 1-core
+    container time-slices every replica onto the same core, the
+    BENCH_SHARD_r07 precedent)."""
+    if grid is None:
+        return None, None
+    clean = bool(grid.get("fleet_invariants_clean"))
+    verdict = grid.get("scaling_verdict")
+    scaling = grid.get("scaling_vs_1_replica") or {}
+    evidence = {
+        "source": grid.get("artifact", "cluster-bench"),
+        "fleet_invariants_clean": clean,
+        "scaling_verdict": verdict,
+        "scaling_vs_1_replica": scaling,
+        "scaling_blocker": grid.get("scaling_blocker"),
+        "tpu_grid": grid_is_tpu(grid),
+    }
+    if grid_is_tpu(grid) and clean and verdict == "scales":
+        best = None
+        for item in grid["items"]:
+            if not isinstance(item, dict) or item.get("rc") != 0:
+                continue
+            detail = item.get("detail", {})
+            qps = item.get("value")
+            if qps and (best is None or qps > best[0]):
+                best = (qps, detail.get("n_replicas"))
+        if best and best[1] and int(best[1]) > 1:
+            evidence["best_replicas_qps"] = best[0]
+            return str(int(best[1])), evidence
+    return "1", evidence
+
+
 def hotpath_commit_decision(grid):
     """``(decision_or_None, evidence)`` for the ``commit_mode`` routing
     from the host-overhead A/B (``bench_hotpath.py``).  Host-side
@@ -514,6 +561,7 @@ def decide(
     hotpath_grid=None,
     coldstart_grid=None,
     obs_grid=None,
+    cluster_grid=None,
 ) -> tuple:
     """``(decisions, evidence)`` from qualifying TPU results (plus the
     grid walkover rules — module docstring)."""
@@ -622,6 +670,13 @@ def decide(
         decisions["cost_plane"] = obs_decision
         evidence["cost_plane"] = obs_evidence
 
+    replicas_decision, replicas_evidence = cluster_replicas_decision(
+        cluster_grid
+    )
+    if replicas_decision is not None:
+        decisions["cluster_replicas"] = replicas_decision
+        evidence["cluster_replicas"] = replicas_evidence
+
     return decisions, evidence
 
 
@@ -663,6 +718,7 @@ def main(argv=None) -> int:
                     "warmup_mode",
                     "compilation_cache",
                     "cost_plane",
+                    "cluster_replicas",
                 )
             }
     except (OSError, ValueError):
@@ -690,6 +746,7 @@ def main(argv=None) -> int:
             os.path.join(REPO, "BENCH_COLDSTART_r09.json")
         ),
         obs_grid=load_obs_grid(os.path.join(REPO, "BENCH_OBS_r10.json")),
+        cluster_grid=load_grid(os.path.join(REPO, "BENCH_CLUSTER_r11.json")),
     )
     if (
         "consensus_impl" in prior_decisions
