@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving bench-shard bench-hotpath bench-coldstart bench-cluster campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke obs-cost-smoke fabric-smoke serving-smoke crash-smoke chaos-fuzz-smoke shard-smoke hotpath-smoke coldstart-smoke cluster-smoke pallas-parity clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving bench-shard bench-hotpath bench-coldstart bench-cluster campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke obs-cost-smoke fabric-smoke serving-smoke crash-smoke chaos-fuzz-smoke shard-smoke hotpath-smoke coldstart-smoke cluster-smoke reconfig-smoke pallas-parity clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -180,6 +180,18 @@ crash-smoke:
 cluster-smoke:
 	$(PY) tools/cluster_smoke.py
 
+# Live-reconfiguration chaos gate (docs/RECONFIG.md): a rolling
+# commit-mode + per-claim-spec re-pin on a seeded 3-replica fleet
+# under traffic, run twice — replay identity across the epoch boundary
+# (fleet + per-claim fingerprints), zero shed (mid-transition traffic
+# DEFERRED and released at commit), zero duplicate txs, lineage
+# continuity for every re-pinned claim — plus a seeded abort at each
+# of the five reconfig.* fault points, each rolling back to a fleet
+# fingerprint byte-identical to never having attempted the plan →
+# RECONFIG_SMOKE.json.
+reconfig-smoke:
+	$(PY) tools/reconfig_smoke.py
+
 # Deterministic fault-space fuzzer gate (docs/RESILIENCE.md
 # §fault-surface): 32 seed-drawn kill/restart schedules over the named
 # fault-point registry — SIGKILL at the Nth firing, torn writes,
@@ -198,7 +210,7 @@ chaos-fuzz-smoke:
 # convergence gates (I/O-plane, then data-plane), then the flight
 # recorder, then the fabric and serving tiers, then crash consistency
 # and the fault-space fuzzer, then the suite.
-verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke obs-cost-smoke fabric-smoke shard-smoke serving-smoke hotpath-smoke coldstart-smoke chaos-fuzz-smoke crash-smoke cluster-smoke test
+verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke obs-cost-smoke fabric-smoke shard-smoke serving-smoke hotpath-smoke coldstart-smoke chaos-fuzz-smoke crash-smoke cluster-smoke reconfig-smoke test
 
 # End-of-round gate: lint + the driver-contract guards FIRST (fast,
 # loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
@@ -219,6 +231,7 @@ presnapshot:
 	$(MAKE) chaos-fuzz-smoke
 	$(MAKE) crash-smoke
 	$(MAKE) cluster-smoke
+	$(MAKE) reconfig-smoke
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_graft_entry.py tests/test_bench.py -q
 	$(MAKE) test

@@ -19,6 +19,7 @@ Differences from the reference, on purpose:
 
 from __future__ import annotations
 
+import json
 import threading
 from typing import Callable, List, Optional
 
@@ -64,9 +65,13 @@ HELP = """Commands:
       docs/OBSERVABILITY.md §cost-attribution)
     - profile [start [seconds]|stop|status] (on-demand jax.profiler
       capture, bounded duration; default: status)
-    - cluster [status | migrate <claim> <replica>] (multi-replica
-      fleet: placement map + epoch, per-replica health/breakers, or
-      one operator migration — docs/CLUSTER.md)
+    - cluster [status | migrate <claim> <replica> | adopt-orphans]
+      (multi-replica fleet: placement map + epoch, per-replica
+      health/breakers, one operator migration, or re-adoption of
+      quarantined migration slices — docs/CLUSTER.md)
+    - reconfig [status | apply <plan.json> | abort] (live
+      reconfiguration plane: transactional drain → re-pin →
+      recover-warm under traffic — docs/RECONFIG.md)
     - drain (graceful teardown: stop admission, flush queues,
       snapshot, postmortem bundle — what SIGTERM does)
     - multimodal [K|auto] (mixture analysis of the last fetch;
@@ -140,6 +145,11 @@ class CommandConsole:
         #: ``/api/state``'s cluster section read it.  None = the
         #: single-replica deployments of PRs 1–17, unchanged.
         self.cluster = None
+        #: Live reconfiguration plane (docs/RECONFIG.md): set by
+        #: ``ReconfigController.attach`` — the ``reconfig`` command and
+        #: ``/api/state``'s reconfig section read it.  None = no
+        #: transactional re-pin path (static fleet config).
+        self.reconfig = None
         self._auto_fetch_thread: Optional[threading.Thread] = None
         self._scraper_stop: Optional[threading.Event] = None
         self._scraper_thread: Optional[threading.Thread] = None
@@ -734,8 +744,24 @@ class CommandConsole:
                         f"{'ok' if report['continuity'] else 'BROKEN'})"
                     )
                     return out
+                if sub == "adopt-orphans":
+                    report = self.cluster.adopt_orphans()
+                    for cid, info in sorted(report["adopted"].items()):
+                        emit(
+                            f"adopted {cid} -> {info['replica']} "
+                            f"(cursor {info['cursor']}, continuity "
+                            f"{'ok' if info['continuity'] else 'BROKEN'})"
+                        )
+                    for cid, reason in sorted(report["remaining"].items()):
+                        emit(f"  still orphaned {cid}: {reason}")
+                    if not report["adopted"] and not report["remaining"]:
+                        emit("no orphaned claims")
+                    return out
                 if sub != "status":
-                    emit("usage: cluster [status | migrate <claim> <replica>]")
+                    emit(
+                        "usage: cluster [status | migrate <claim> "
+                        "<replica> | adopt-orphans]"
+                    )
                     return out
                 snap = self.cluster.snapshot()
                 emit(
@@ -761,6 +787,69 @@ class CommandConsole:
                         f"breaker {rep.get('breaker', '?')}, "
                         f"claims [{', '.join(owned)}], "
                         f"completed {requests.get('completed', 0):.0f}"
+                    )
+            elif cmd == "reconfig":
+                # Live reconfiguration plane (docs/RECONFIG.md):
+                # transactional drain → re-pin → recover-warm.
+                if self.reconfig is None:
+                    emit(
+                        "no reconfiguration plane attached — wire a "
+                        "ReconfigController and attach(console) "
+                        "(docs/RECONFIG.md)"
+                    )
+                    return out
+                sub = args[0] if args else "status"
+                if sub == "apply":
+                    if len(args) != 2:
+                        emit("usage: reconfig apply <plan.json>")
+                        return out
+                    from svoc_tpu.cluster.reconfig import ReconfigPlan
+
+                    with open(args[1]) as f:
+                        plan = ReconfigPlan.from_dict(json.load(f))
+                    report = self.reconfig.apply(plan)
+                    if report["status"] == "committed":
+                        emit(
+                            f"committed epoch {report['epoch']} "
+                            f"(plan {report['plan_fingerprint'][:16]}, "
+                            f"{len(report['replicas'])} replica(s) "
+                            f"re-pinned, {report['deferred_released']} "
+                            "deferred request(s) released)"
+                        )
+                    elif report["status"] == "noop":
+                        emit("plan is a no-op — nothing to change")
+                    else:
+                        emit(
+                            f"ABORTED in {report['phase']} "
+                            f"({report['cause']}) — fleet rolled back "
+                            "to the pre-plan state"
+                        )
+                    return out
+                if sub == "abort":
+                    report = self.reconfig.request_abort()
+                    emit(
+                        f"{report['status']}"
+                        + (
+                            f" (phase {report['phase']})"
+                            if "phase" in report
+                            else f": {report.get('detail', '')}"
+                        )
+                    )
+                    return out
+                if sub != "status":
+                    emit("usage: reconfig [status | apply <plan.json> | abort]")
+                    return out
+                status = self.reconfig.status()
+                emit(
+                    f"reconfig: phase {status['phase']}, "
+                    f"epoch {status['epoch']}, "
+                    f"holding {len(status['holding'])} replica(s), "
+                    f"{status['deferred']} deferred request(s)"
+                )
+                for entry in status["chain"]:
+                    emit(
+                        f"  epoch {entry['epoch']}: plan "
+                        f"{entry['plan'][:16]} over {entry['pre_fleet'][:16]}"
                     )
             elif cmd == "costs":
                 # Shape-keyed dispatch-cost ledger

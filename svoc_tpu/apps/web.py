@@ -438,6 +438,12 @@ class _Handler(BaseHTTPRequestHandler):
             cluster = getattr(self.console, "cluster", None)
             if cluster is not None:
                 payload["cluster"] = cluster.snapshot()
+            # Reconfiguration plane (docs/RECONFIG.md): transition
+            # phase, fleet epoch, holds/deferred depth, and the tail
+            # of the committed epoch chain.
+            reconfig = getattr(self.console, "reconfig", None)
+            if reconfig is not None:
+                payload["reconfig"] = reconfig.status()
             self._send(200, json.dumps(payload).encode(), "application/json")
         elif self.path == "/api/events" or self.path.startswith("/api/events?"):
             self._serve_events()

@@ -5,10 +5,18 @@ serving stacks: :mod:`placement` maps claims to replicas
 deterministically, :mod:`replica` packages one MultiSession/ServingTier
 per durable base dir, :mod:`router` forwards, migrates, and fails over,
 and :mod:`scenario` is the seeded kill/failover workload behind
-``make cluster-smoke``.
+``make cluster-smoke``.  :mod:`reconfig` (PR 19, docs/RECONFIG.md) is
+the live reconfiguration plane — transactional drain → re-pin →
+recover-warm under traffic — and :mod:`reconfig_scenario` its seeded
+workload behind ``make reconfig-smoke``.
 """
 
 from svoc_tpu.cluster.placement import PlacementDirectory, PlacementError
+from svoc_tpu.cluster.reconfig import (
+    ReconfigController,
+    ReconfigError,
+    ReconfigPlan,
+)
 from svoc_tpu.cluster.replica import Replica, ReplicaDeadError
 from svoc_tpu.cluster.router import ClusterRouter, MigrationContinuityError
 
@@ -19,4 +27,7 @@ __all__ = [
     "ReplicaDeadError",
     "ClusterRouter",
     "MigrationContinuityError",
+    "ReconfigController",
+    "ReconfigError",
+    "ReconfigPlan",
 ]

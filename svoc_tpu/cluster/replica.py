@@ -76,6 +76,9 @@ class Replica:
         clock,
         lineage_scope: str = "clu",
         commit_mode: str = "per_tx",
+        consensus_impl: Optional[str] = None,
+        mesh=None,
+        fingerprint_epoch: int = 0,
         step_period_s: float = 0.1,
         queue_capacity: int = 32,
         max_requests_per_step: int = 16,
@@ -96,12 +99,26 @@ class Replica:
         self.clock = clock
         self.lineage_scope = lineage_scope
         self.step_period_s = step_period_s
+        self.commit_mode = commit_mode
+        self.consensus_impl = consensus_impl
+        #: Fingerprint epoch (docs/RECONFIG.md): a re-pinned stack over
+        #: the same durable dirs starts a NEW journal/WAL lineage —
+        #: ``trace-e<N>.jsonl``/``wal-e<N>.jsonl`` — so the old epoch's
+        #: durable history is immutable and the epoch-0 continuity
+        #: record is literally the first event of the new trace.  Epoch
+        #: 0 keeps the legacy names (every pre-reconfig artifact stays
+        #: valid).
+        self.fingerprint_epoch = int(fingerprint_epoch)
         self.alive = True
         os.makedirs(base_dir, exist_ok=True)
         os.makedirs(chain_dir, exist_ok=True)
 
-        self.trace_path = os.path.join(base_dir, "trace.jsonl")
-        self.wal_path = os.path.join(base_dir, "wal.jsonl")
+        suffix = (
+            "" if self.fingerprint_epoch == 0
+            else f"-e{self.fingerprint_epoch}"
+        )
+        self.trace_path = os.path.join(base_dir, f"trace{suffix}.jsonl")
+        self.wal_path = os.path.join(base_dir, f"wal{suffix}.jsonl")
         self.metrics = MetricsRegistry()
         self.journal = EventJournal(registry=self.metrics)
         # The trace is a durability artifact (the failover replays its
@@ -136,6 +153,8 @@ class Replica:
             clock=clock,
             adapter_factory=adapter_factory,
             commit_mode=commit_mode,
+            consensus_impl=consensus_impl,
+            mesh=mesh,
         )
         self.multi.attach_wal(self.wal)
         self.tier = ServingTier(
@@ -336,6 +355,45 @@ class Replica:
         report["cursor"] = lineage_cursor(state.session)
         return report
 
+    def adopt_claim_fresh(
+        self, claim_id: str, spec: ClaimSpec, entry: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        """Adopt a shipped slice under a DIFFERENT :class:`ClaimSpec`
+        (the reconfiguration plane's per-claim spec diff,
+        docs/RECONFIG.md §spec-diff): fleet-shape-dependent state
+        (supervisor scores sized ``n_oracles``, the request window's
+        vector dimension) cannot restore across an N/M change, so the
+        session is built FRESH from the new spec and only the lineage
+        continuity fields carry over — the minted-lineage cursors, the
+        last lineage id, the simulation step, and the PRNG key (the
+        stream continues; a reset key could re-draw a landed cycle's
+        bootstrap noise and mint a chain duplicate).  The shared chain
+        log replays through the adapter factory as usual — dedup is
+        contract state, not session state."""
+        state = self.multi.add_claim(spec)
+        shipped = entry["session"]
+        fresh = session_durable_dict(state.session)
+        for field in (
+            "fetch_claim",
+            "fetch_published",
+            "last_lineage",
+            "simulation_step",
+            "prng_key",
+        ):
+            fresh[field] = shipped.get(field, fresh.get(field))
+        from svoc_tpu.utils.checkpoint import restore_durable_session
+
+        restore_durable_session(
+            fresh, state.session, adapter=state.session.adapter
+        )
+        return {
+            "restored": [claim_id],
+            "unclaimed": [],
+            "fresh": [],
+            "cursor": lineage_cursor(state.session),
+            "carried": True,
+        }
+
     # -- accounting / identity ----------------------------------------------
 
     def request_accounting(self) -> Dict[str, float]:
@@ -368,6 +426,17 @@ class Replica:
         — the per-replica factor of the fleet's per-claim fingerprint."""
         return self.journal.fingerprint(lineage_prefix=lineage_prefix)
 
+    def pinned_config(self) -> Dict[str, Any]:
+        """The replay-relevant knobs this stack was constructed under
+        (SVOC011: resolved once, never re-read) — what a
+        :class:`~svoc_tpu.cluster.reconfig.ReconfigPlan` diffs against."""
+        return {
+            "consensus_impl": self.multi.router.consensus_impl,
+            "mesh": self.multi.router.mesh_spec,
+            "commit_mode": self.commit_mode,
+            "fingerprint_epoch": self.fingerprint_epoch,
+        }
+
     def snapshot(self) -> Dict[str, Any]:
         """The ``/api/state`` per-replica row."""
         return {
@@ -377,6 +446,7 @@ class Replica:
             "steps": self.tier.steps,
             "requests": self.request_accounting(),
             "journal_events": self.journal.last_seq(),
+            "config": self.pinned_config(),
         }
 
 

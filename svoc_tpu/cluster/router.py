@@ -80,6 +80,7 @@ class ClusterRouter:
         replica_factory: Optional[Callable[[str], Replica]] = None,
         lineage_scope: str = "clu",
         unclaimed_path: Optional[str] = None,
+        epochs_path: Optional[str] = None,
     ):
         from svoc_tpu.utils.metrics import registry as default_registry
 
@@ -122,7 +123,30 @@ class ClusterRouter:
         #: Accounting harvested from failed-over replicas: the
         #: recovered durable counters are the authority for the dead
         #: process (PR 8 convention) — fleet totals fold these in.
+        #: Reconfiguration harvests superseded stacks here too, keyed
+        #: ``<rid>@e<epoch>`` (docs/RECONFIG.md §epoch).
         self._retired: Dict[str, Dict[str, Any]] = {}
+        #: Replicas whose traffic is currently DEFERRED at the router
+        #: (a live-reconfig transition holds the owner; requests queue
+        #: here instead of shedding) plus the global FIFO of held
+        #: submissions — released in original order on commit or abort.
+        self._holds: set = set()
+        self._deferred: List[tuple] = []
+        #: The fleet's reconfiguration epoch chain (docs/RECONFIG.md):
+        #: one committed entry per transition — the plan fingerprint and
+        #: the PRE-transition fleet fingerprint — folded into
+        #: :meth:`fleet_fingerprint`, so the transition itself is part
+        #: of replay identity.  Aborted transitions never append.
+        self._epochs_path = epochs_path
+        self._reconfig_epoch = 0
+        self._epoch_chain: List[Dict[str, Any]] = []
+        if epochs_path is not None and os.path.exists(epochs_path):
+            with open(epochs_path) as f:
+                payload = json.load(f)
+            self._epoch_chain = list(payload.get("chain", []))
+            self._reconfig_epoch = int(
+                payload.get("epoch", len(self._epoch_chain))
+            )
 
     # -- membership ----------------------------------------------------------
 
@@ -131,6 +155,25 @@ class ClusterRouter:
         self._replicas[rid] = replica
         self._breakers[rid] = self._breaker_factory(rid)
         self._placement.add_replica(rid)
+
+    def replace_replica(
+        self,
+        replica_id: str,
+        replica: Replica,
+        *,
+        retire_key: Optional[str] = None,
+    ) -> Replica:
+        """Swap a NEW stack in under an existing roster slot — the
+        reconfiguration commit (docs/RECONFIG.md §resume).  The old
+        stack is harvested under ``retire_key`` (its recovered durable
+        counters and journal fingerprints stay authoritative for the
+        superseded epoch); the slot's breaker survives — transport
+        health is a property of the slot, not the stack behind it."""
+        old = self._replicas[replica_id]
+        if retire_key is not None:
+            self._harvest(retire_key, old)
+        self._replicas[replica_id] = replica
+        return old
 
     def replica(self, replica_id: str) -> Replica:
         return self._replicas[replica_id]
@@ -148,6 +191,9 @@ class ClusterRouter:
 
     def claim_ids(self) -> List[str]:
         return sorted(self._claims)
+
+    def claim_spec(self, claim_id: str):
+        return self._claims[claim_id]
 
     def _lineage_prefix(self, claim_id: str) -> str:
         return f"blk{self._lineage_scope}-{claim_id}"
@@ -186,6 +232,26 @@ class ClusterRouter:
                 "owner": owner,
             }
         owner = self._placement.owner(claim_id)
+        if owner in self._holds:
+            # Live-reconfig transition in flight on the owner: DEFER,
+            # never shed (docs/RECONFIG.md §drain).  Deliberately NOT
+            # journaled — an aborted transition must leave every
+            # fingerprint byte-identical to never-attempted, and the
+            # held request replays through this very method on release,
+            # producing exactly the journal the direct path would have.
+            # The counter is the SVOC014 witness (metrics are not
+            # replay-relevant).
+            self._metrics.counter(
+                "reconfig_deferred", labels={"replica": owner}
+            ).add(1)
+            self._deferred.append((claim_id, text))
+            return {
+                "status": "deferred",
+                "claim": claim_id,
+                "replica": owner,
+                "reason": "reconfig",
+                "epoch": current,
+            }
         replica = self._replicas.get(owner)
         if replica is None or not replica.alive:
             return self._shed(claim_id, owner, "replica_down")
@@ -254,6 +320,26 @@ class ClusterRouter:
             **data,
         )
         return {"status": "unavailable", "epoch": self._placement.epoch, **data}
+
+    def hold_replica(self, replica_id: str) -> None:
+        """Start deferring this replica's traffic (transition begin)."""
+        self._holds.add(replica_id)
+
+    def holding(self) -> List[str]:
+        return sorted(self._holds)
+
+    def deferred_count(self) -> int:
+        return len(self._deferred)
+
+    def release_holds(self) -> List[Dict[str, Any]]:
+        """End every hold and replay the deferred submissions in their
+        original arrival order through the normal forwarding path —
+        the single release point for both commit (requests land on the
+        re-pinned stacks) and abort (requests land on the old stacks,
+        producing the exact journal a never-attempted run would)."""
+        self._holds.clear()
+        deferred, self._deferred = self._deferred, []
+        return [self.submit(cid, text) for cid, text in deferred]
 
     def step_all(self) -> Dict[str, Any]:
         """One pull-mode serving cycle on every live replica, roster
@@ -483,17 +569,7 @@ class ClusterRouter:
         # Harvest BEFORE discarding: the recovered durable counters and
         # the recovered journal are the dead process's accounting and
         # replay identity.
-        self._retired[dead_id] = {
-            "requests": recovery.request_accounting(),
-            "journal_fingerprint": recovery.journal.fingerprint(),
-            "journal_events": recovery.journal.last_seq(),
-            "claims": {
-                cid: recovery.claim_journal_fingerprint(
-                    self._lineage_prefix(cid) + "-"
-                )
-                for cid in sorted(self._claims)
-            },
-        }
+        self._harvest(dead_id, recovery)
         del self._replicas[dead_id]
         del self._breakers[dead_id]
         epoch = self._placement.remove_replica(dead_id)
@@ -513,6 +589,215 @@ class ClusterRouter:
             "claims": moved,
             "epoch": epoch,
             "recovery": recovery_report,
+        }
+
+    def _harvest(self, key: str, replica: Replica) -> None:
+        """Fold a stack's durable counters + journal fingerprints into
+        the retired ledger before it stops serving (failover, retire,
+        reconfig epoch supersession — one discipline for all three)."""
+        self._retired[key] = {
+            "requests": replica.request_accounting(),
+            "journal_fingerprint": replica.journal.fingerprint(),
+            "journal_events": replica.journal.last_seq(),
+            "claims": {
+                cid: replica.claim_journal_fingerprint(
+                    self._lineage_prefix(cid) + "-"
+                )
+                for cid in sorted(self._claims)
+            },
+        }
+
+    # -- roster growth / retirement (docs/RECONFIG.md §roster) ---------------
+
+    def grow(self, replica: Replica) -> Dict[str, Any]:
+        """Add a replica to a LIVE fleet with bounded rendezvous
+        rebalance: only claims whose HRW owner becomes the newcomer
+        migrate (adding a replica never changes the relative order of
+        the incumbents' scores); explicitly pinned claims stay put.
+        Each move rides the full drain → ship → adopt migration path
+        with its continuity check."""
+        rid = replica.replica_id
+        if rid in self._replicas:
+            raise ValueError(f"replica {rid!r} already in the roster")
+        old_roster = self._placement.replicas()
+        explicit = self._placement.assignments()
+        new_roster = sorted(old_roster + [rid])
+        moves: List[tuple] = []
+        for cid in sorted(self._claims):
+            if cid in explicit:
+                continue
+            old_owner = max(
+                old_roster, key=lambda r: (_hrw_score(cid, r), r)
+            )
+            new_owner = max(
+                new_roster, key=lambda r: (_hrw_score(cid, r), r)
+            )
+            if new_owner != old_owner:
+                moves.append((cid, old_owner))
+        self._replicas[rid] = replica
+        self._breakers[rid] = self._breaker_factory(rid)
+        epoch = self._placement.add_replica(rid)
+        self._journal.emit(
+            "cluster.grow",
+            replica=rid,
+            phase="start",
+            moves=[cid for cid, _ in moves],
+            epoch=epoch,
+        )
+        moved: Dict[str, Any] = {}
+        for cid, source_id in moves:
+            moved[cid] = self._migrate_from(
+                self._replicas[source_id], cid, rid, reason="growth"
+            )
+        self._metrics.counter(
+            "cluster_grown", labels={"replica": rid}
+        ).add(1)
+        epoch = self._placement.epoch
+        self._journal.emit(
+            "cluster.grow",
+            replica=rid,
+            phase="done",
+            moves=[cid for cid, _ in moves],
+            epoch=epoch,
+        )
+        return {
+            "status": "grown",
+            "replica": rid,
+            "moved": moved,
+            "epoch": epoch,
+        }
+
+    def retire_replica(
+        self, replica_id: str, *, retire_key: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Drain a LIVE replica out of the roster: every owned claim
+        migrates to its rendezvous-best survivor (full continuity
+        checks), the stack's accounting is harvested, and the roster
+        shrinks — the graceful twin of :meth:`fail_over`."""
+        replica = self._replicas.get(replica_id)
+        if replica is None:
+            raise PlacementError(f"unknown replica {replica_id!r}")
+        if not replica.alive:
+            raise ValueError(
+                f"replica {replica_id!r} is dead — fail_over, not retire"
+            )
+        survivors = [
+            rid
+            for rid in sorted(self._replicas)
+            if rid != replica_id and self._replicas[rid].alive
+        ]
+        if not survivors:
+            raise PlacementError("cannot retire the last live replica")
+        owned = sorted(
+            cid
+            for cid in self._claims
+            if self._placement.owner(cid) == replica_id
+        )
+        moved: Dict[str, Any] = {}
+        for cid in owned:
+            target_id = max(
+                survivors, key=lambda rid: (_hrw_score(cid, rid), rid)
+            )
+            moved[cid] = self._migrate_from(
+                replica, cid, target_id, reason="retire"
+            )
+        self._harvest(retire_key or replica_id, replica)
+        del self._replicas[replica_id]
+        del self._breakers[replica_id]
+        epoch = self._placement.remove_replica(replica_id)
+        self._metrics.counter(
+            "cluster_retired", labels={"replica": replica_id}
+        ).add(1)
+        self._journal.emit(
+            "cluster.retire",
+            replica=replica_id,
+            claims=owned,
+            targets={cid: moved[cid].get("target") for cid in owned},
+            epoch=epoch,
+        )
+        return {
+            "status": "retired",
+            "replica": replica_id,
+            "claims": moved,
+            "epoch": epoch,
+        }
+
+    # -- orphan re-adoption (docs/RECONFIG.md §orphans) -----------------------
+
+    def adopt_orphans(self) -> Dict[str, Any]:
+        """Re-adopt quarantined migration slices from ``unclaimed.json``
+        back into the fleet — the way back from the orphan path, so a
+        quarantine is recoverable rather than terminal.  Each slice
+        adopts onto the claim's CURRENT placement owner through the
+        documented :meth:`Replica.adopt_claim` path (shared-chain
+        replay + restore), with the same lineage-continuity check a
+        migration gets; slices that cannot adopt (unknown claim, owner
+        down, claim already live) stay quarantined with a typed
+        reason."""
+        if self._unclaimed_path is None or not os.path.exists(
+            self._unclaimed_path
+        ):
+            return {"adopted": {}, "remaining": {}}
+        with open(self._unclaimed_path) as f:
+            unclaimed: Dict[str, Any] = json.load(f)
+        adopted: Dict[str, Any] = {}
+        remaining: Dict[str, Any] = {}
+        skipped: Dict[str, str] = {}
+        for cid in sorted(unclaimed):
+            entry = unclaimed[cid]
+            if cid not in self._claims:
+                remaining[cid] = entry
+                skipped[cid] = "unknown_claim"
+                continue
+            owner = self._placement.owner(cid)
+            replica = self._replicas.get(owner)
+            if replica is None or not replica.alive:
+                remaining[cid] = entry
+                skipped[cid] = "owner_down"
+                continue
+            if replica.has_claim(cid):
+                # A live owner already serves this claim — adopting the
+                # stale slice would fork its lineage.  Never silent.
+                remaining[cid] = entry
+                skipped[cid] = "claim_live"
+                continue
+            shipped_cursor = int(entry["session"]["fetch_claim"])
+            report = replica.adopt_claim(cid, dict(entry))
+            continuity = (
+                cid in report["restored"]
+                and report["cursor"] == shipped_cursor
+            )
+            if not continuity:
+                raise MigrationContinuityError(
+                    f"orphan {cid!r}: quarantined cursor {shipped_cursor} "
+                    f"!= adopted {report['cursor']}"
+                )
+            epoch = self._placement.assign(cid, owner)
+            self._metrics.counter(
+                "cluster_adopted", labels={"claim": cid}
+            ).add(1)
+            self._journal.emit(
+                "cluster.adopt",
+                lineage=self._lineage_prefix(cid),
+                claim=cid,
+                replica=owner,
+                cursor=report["cursor"],
+                epoch=epoch,
+            )
+            adopted[cid] = {
+                "replica": owner,
+                "cursor": report["cursor"],
+                "continuity": True,
+            }
+        tmp = self._unclaimed_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(remaining, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._unclaimed_path)
+        return {
+            "adopted": adopted,
+            "remaining": {cid: skipped[cid] for cid in sorted(remaining)},
         }
 
     # -- identity / operator plane -------------------------------------------
@@ -535,10 +820,43 @@ class ClusterRouter:
             json.dumps(sorted(parts.items())).encode()
         ).hexdigest()
 
+    @property
+    def reconfig_epoch(self) -> int:
+        return self._reconfig_epoch
+
+    def epoch_chain(self) -> List[Dict[str, Any]]:
+        return [dict(e) for e in self._epoch_chain]
+
+    def record_epoch(self, entry: Dict[str, Any]) -> int:
+        """Append one COMMITTED reconfiguration to the fleet epoch
+        chain (plan fingerprint + pre-transition fleet fingerprint) and
+        persist it atomically.  Called exactly once per committed
+        transition, after the pre_resume fault point — an aborted
+        transition never reaches this, which is what keeps abort
+        invisible to :meth:`fleet_fingerprint`."""
+        self._reconfig_epoch += 1
+        self._epoch_chain.append(
+            {"epoch": self._reconfig_epoch, **dict(entry)}
+        )
+        if self._epochs_path is not None:
+            payload = {
+                "version": 1,
+                "epoch": self._reconfig_epoch,
+                "chain": self._epoch_chain,
+            }
+            tmp = self._epochs_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._epochs_path)
+        return self._reconfig_epoch
+
     def fleet_fingerprint(self) -> str:
         """The whole-fleet replay digest: per-claim fingerprints, the
         cluster journal (every redirect/shed/migrate/failover), the
-        placement content, and the epoch."""
+        placement content, the epoch, and the reconfiguration epoch
+        chain (every committed transition's plan + pre-state)."""
         payload = {
             "claims": {
                 cid: self.claim_fingerprint(cid) for cid in sorted(self._claims)
@@ -549,6 +867,10 @@ class ClusterRouter:
             "retired": {
                 rid: self._retired[rid]["journal_fingerprint"]
                 for rid in sorted(self._retired)
+            },
+            "reconfig": {
+                "epoch": self._reconfig_epoch,
+                "chain": self._epoch_chain,
             },
         }
         return hashlib.sha256(
@@ -587,6 +909,12 @@ class ClusterRouter:
                 for rid in sorted(self._replicas)
             },
             "retired": sorted(self._retired),
+            "reconfig": {
+                "epoch": self._reconfig_epoch,
+                "transitions": len(self._epoch_chain),
+                "holding": self.holding(),
+                "deferred": len(self._deferred),
+            },
         }
 
     def attach(self, console) -> None:

@@ -490,3 +490,70 @@ class PrewarmWorker:
             "universe": len(universe) if universe is not None else None,
             "report": report,
         }
+
+
+def warm_keys(
+    keys,
+    *,
+    budget_s: float = 0.0,
+    clock=None,
+    metrics=None,
+) -> Dict[str, int]:
+    """Budgeted AOT walk over an explicit key list — the standalone twin
+    of :meth:`PrewarmWorker.step` for configurations with NO live router
+    behind them (the reconfiguration plane's PREPARE phase warms the
+    PENDING config's universe, :func:`svoc_tpu.compile.universe
+    .pending_universe`, before any replica drains).
+
+    Only the unsharded XLA keys AOT-compile (``jit_dispatcher.lower()
+    .compile()`` — the same jit objects the post-transition routers will
+    call, so the jit cache they populate is THE cache that makes the
+    first post-resume dispatch warm); sharded and pallas-routed keys
+    are counted ``skipped`` — they compile inside their mesh/pallas
+    dispatch context at first use, exactly like :meth:`PrewarmWorker
+    ._warm_one`'s non-priming path.  Never journals, never dispatches:
+    a prewarmed-then-aborted transition leaves no replay-relevant trace
+    (docs/RECONFIG.md §abort).
+
+    ``budget_s <= 0`` means unbudgeted; otherwise the walk stops at the
+    deadline and the remainder is counted ``deferred`` (never silently
+    dropped — the first real dispatch compiles them).
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from svoc_tpu.consensus.batch import jit_dispatcher
+    from svoc_tpu.robustness.sanitize import SanitizeConfig
+    from svoc_tpu.utils.metrics import registry as _registry
+
+    clock = clock if clock is not None else _time.monotonic
+    metrics = metrics if metrics is not None else _registry
+    deadline = clock() + budget_s if budget_s > 0 else None
+    out = {"compiled": 0, "skipped": 0, "deferred": 0}
+    keys = list(keys)
+    for i, key in enumerate(keys):
+        if deadline is not None and clock() >= deadline:
+            out["deferred"] = len(keys) - i
+            break
+        sharded = key.kind.startswith("sharded_")
+        if sharded or key.impl != "xla":
+            out["skipped"] += 1
+            continue
+        sanitized = key.kind.endswith("sanitized")
+        fn = jit_dispatcher(sanitized, key.donate)
+        sds = jax.ShapeDtypeStruct
+        values = sds((key.bucket, key.n_oracles, key.dimension), jnp.float32)
+        mask = sds((key.bucket,), jnp.bool_)
+        t0 = clock()
+        if sanitized:
+            bounds = SanitizeConfig.for_consensus(key.cfg.constrained)
+            lowered = fn.lower(values, mask, key.cfg, bounds.lo, bounds.hi)
+        else:
+            ok = sds((key.bucket, key.n_oracles), jnp.bool_)
+            lowered = fn.lower(values, ok, mask, key.cfg)
+        lowered.compile()
+        metrics.histogram(PREWARM_HISTOGRAM).observe(max(0.0, clock() - t0))
+        out["compiled"] += 1
+    return out

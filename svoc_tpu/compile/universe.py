@@ -232,6 +232,41 @@ def enumerate_universe(
     return out
 
 
+def pending_universe(
+    specs,
+    *,
+    max_claims_per_batch: int,
+    sanitized_dispatch: bool,
+    donate: bool,
+    impl: str,
+    mesh: Optional[str] = None,
+    mesh_claim_size: int = 1,
+    include_twins: bool = False,
+) -> List[CompileKey]:
+    """The compile universe for a configuration that is NOT live yet —
+    what the reconfiguration plane's PREPARE phase prewarms
+    (docs/RECONFIG.md): the (N, M, cfg) groups come from the plan's
+    effective :class:`~svoc_tpu.fabric.registry.ClaimSpec` set instead
+    of a live registry, and the impl/mesh flags are the PENDING
+    resolution, so the post-transition fleet dispatches warm on its
+    first cycle.  Twins default OFF — a transition prewarms the exact
+    target config, not the whole operator option space."""
+    groups: Dict[Tuple[int, int, ConsensusConfig], int] = {}
+    for spec in specs:
+        key = (spec.n_oracles, spec.dimension, spec.consensus_config())
+        groups[key] = groups.get(key, 0) + 1
+    return enumerate_universe(
+        groups,
+        max_claims_per_batch=max_claims_per_batch,
+        sanitized_dispatch=sanitized_dispatch,
+        donate=donate,
+        impl=impl,
+        mesh=mesh,
+        mesh_claim_size=mesh_claim_size,
+        include_twins=include_twins,
+    )
+
+
 def universe_summary(keys: Iterable[CompileKey]) -> Dict[str, object]:
     """JSON-safe digest of an enumerated universe (bench artifacts,
     the ``/api/state`` compile section): size, per-kind counts, bucket

@@ -71,6 +71,7 @@ from svoc_tpu.resilience.faults import InjectedFault
 SMOKE_FUZZ = "fuzz"    # tools/chaos_fuzz.py — the light durable-plane harness
 SMOKE_CRASH = "crash"  # tools/crash_smoke.py — the full fabric/serving matrix
 SMOKE_CLUSTER = "cluster"  # tools/cluster_smoke.py — the multi-replica fleet
+SMOKE_RECONFIG = "reconfig"  # tools/reconfig_smoke.py — live reconfiguration
 
 ACTIONS = ("kill", "torn", "error")
 STAGES = ("run", "recovery")
@@ -94,7 +95,8 @@ class FaultPointSpec:
         if self.stage not in STAGES:
             raise ValueError(f"{self.name}: invalid stage {self.stage!r}")
         for s in self.smokes:
-            if s not in (SMOKE_FUZZ, SMOKE_CRASH, SMOKE_CLUSTER):
+            if s not in (SMOKE_FUZZ, SMOKE_CRASH, SMOKE_CLUSTER,
+                         SMOKE_RECONFIG):
                 raise ValueError(f"{self.name}: unknown smoke {s!r}")
 
 
@@ -266,6 +268,61 @@ CLUSTER_MIGRATE_PRE_ADOPT = declare(
     actions=("error",),
     smokes=(SMOKE_CLUSTER,),
 )
+# The live-reconfiguration plane (PR 19, docs/RECONFIG.md).  Same
+# circularity note as the cluster points: ``cluster/reconfig.py`` binds
+# :func:`fault_point` at call time, declarations live here.  Every
+# point is an ABORT boundary: an ``error`` action injected at any of
+# them must roll the transition back to a fleet fingerprint
+# byte-identical to never having attempted it (the transaction's
+# all-or-nothing witness, asserted by ``tools/reconfig_smoke.py``).
+RECONFIG_PREPARE = declare(
+    "reconfig.prepare",
+    owner="svoc_tpu/cluster/reconfig.py",
+    invariant="a fault during plan validation / pending-universe "
+    "prewarm aborts before any replica is touched — the fleet "
+    "fingerprint is byte-identical to never-attempted",
+    actions=("error",),
+    smokes=(SMOKE_RECONFIG,),
+)
+RECONFIG_POST_DRAIN = declare(
+    "reconfig.post_drain",
+    owner="svoc_tpu/cluster/reconfig.py",
+    invariant="a fault after a replica's drain (queues empty, new "
+    "arrivals deferred at the router — never shed) releases the hold "
+    "and replays every deferred request in order; no journal record "
+    "of the attempt survives",
+    actions=("error",),
+    smokes=(SMOKE_RECONFIG,),
+)
+RECONFIG_POST_SHIP = declare(
+    "reconfig.post_ship",
+    owner="svoc_tpu/cluster/reconfig.py",
+    invariant="a fault after the claim slices are shipped re-adopts "
+    "every slice onto the SAME source stack with lineage-cursor "
+    "continuity — no half-moved state, no cursor rewind",
+    actions=("error",),
+    smokes=(SMOKE_RECONFIG,),
+)
+RECONFIG_PRE_REPIN = declare(
+    "reconfig.pre_repin",
+    owner="svoc_tpu/cluster/reconfig.py",
+    invariant="a fault before the re-pinned stack is constructed "
+    "rolls back exactly like post_ship — the new fingerprint epoch "
+    "was never minted, its journal files never referenced",
+    actions=("error",),
+    smokes=(SMOKE_RECONFIG,),
+)
+RECONFIG_PRE_RESUME = declare(
+    "reconfig.pre_resume",
+    owner="svoc_tpu/cluster/reconfig.py",
+    invariant="a fault after the new stacks are built but before the "
+    "swap discards them (no epoch record was emitted, no cadence "
+    "installed, no placement mutation) and re-adopts every slice onto "
+    "the old stacks — abort is invisible to every fingerprint",
+    actions=("error",),
+    smokes=(SMOKE_RECONFIG,),
+)
+
 REPLICA_KILL = declare(
     "replica.kill",
     owner="svoc_tpu/cluster/scenario.py",

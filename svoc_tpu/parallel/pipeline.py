@@ -167,9 +167,18 @@ def pipeline_forward_fn(
         check_rep=False,
     )
 
-    def forward(params, ids, mask):
-        stacked, rest = stack_block_params(params, cfg)
-        return mapped(stacked, rest, ids, mask)
+    dispatch = jax.jit(mapped)
 
-    return jax.jit(forward)
+    def forward(params, ids, mask):
+        # The stack happens EAGERLY, outside the jitted program: on a
+        # stage×data mesh (both axes > 1), GSPMD mispartitions an
+        # in-jit concatenate feeding the shard_map manual region and
+        # every logit comes out O(1) wrong — jax 0.4.x, CPU and TPU
+        # lowerings alike.  Keeping the jitted program all-manual
+        # sidesteps the partitioner entirely; the eager stack is a few
+        # small concats per call, amortized by the dispatch underneath.
+        stacked, rest = stack_block_params(params, cfg)
+        return dispatch(stacked, rest, ids, mask)
+
+    return forward
 

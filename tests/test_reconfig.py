@@ -1,0 +1,443 @@
+"""Live reconfiguration plane (ISSUE 19, docs/RECONFIG.md): typed plan
+validation, the transactional drain → re-pin → resume state machine,
+abort invisibility (byte-identical rollback from every fault point),
+fingerprint-epoch continuity, orphan re-adoption, roster growth as a
+one-knob plan, and the reconfig chaos-corpus pinning entry."""
+
+import json
+import os
+
+import pytest
+
+from svoc_tpu.cluster import (
+    ClusterRouter,
+    PlacementDirectory,
+    ReconfigController,
+    ReconfigError,
+    ReconfigPlan,
+    Replica,
+)
+from svoc_tpu.durability import faultspace
+from svoc_tpu.durability.faultspace import FaultEvent
+from svoc_tpu.fabric.registry import ClaimSpec
+from svoc_tpu.resilience.retry import RetryPolicy
+
+CORPUS_DIR = os.path.join(
+    os.path.dirname(__file__), "fixtures", "chaos_corpus", "reconfig"
+)
+
+RECONFIG_POINTS = (
+    "reconfig.prepare",
+    "reconfig.post_drain",
+    "reconfig.post_ship",
+    "reconfig.pre_repin",
+    "reconfig.pre_resume",
+)
+
+
+# ---------------------------------------------------------------------------
+# plan validation (no fleet needed — cheap)
+# ---------------------------------------------------------------------------
+
+
+def test_reconfig_fault_points_declared_for_reconfig_smoke():
+    surface = faultspace.surface()
+    for point in RECONFIG_POINTS:
+        assert point in surface, point
+        assert surface[point].smokes == (faultspace.SMOKE_RECONFIG,), point
+
+
+def test_plan_rejects_bad_knobs():
+    with pytest.raises(Exception):
+        ReconfigPlan(consensus_impl="quantum")
+    with pytest.raises(Exception):
+        ReconfigPlan(commit_mode="eventually")
+    with pytest.raises(ReconfigError):
+        ReconfigPlan(mesh="2by4")
+    with pytest.raises(ReconfigError):
+        ReconfigPlan(add_replicas=("rX",), remove_replicas=("rX",))
+
+
+def test_plan_noop_and_needs_repin():
+    assert ReconfigPlan().is_noop()
+    assert not ReconfigPlan().needs_repin()
+    assert ReconfigPlan(commit_mode="batched").needs_repin()
+    assert ReconfigPlan(mesh="off").needs_repin()
+    growth = ReconfigPlan(add_replicas=("r9",))
+    assert not growth.needs_repin()
+    assert not growth.is_noop()
+
+
+def test_plan_roundtrip_and_fingerprint_stability():
+    plan = ReconfigPlan(
+        commit_mode="batched",
+        claims={"c0": ClaimSpec(claim_id="c0", n_oracles=9, dimension=6)},
+        add_replicas=("r2",),
+    )
+    clone = ReconfigPlan.from_dict(plan.to_dict())
+    assert clone.fingerprint() == plan.fingerprint()
+    assert clone.to_dict() == plan.to_dict()
+    assert plan.fingerprint() != ReconfigPlan().fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# unit fleet (claims live, a few served cycles — module-scoped builders)
+# ---------------------------------------------------------------------------
+
+
+def build_fleet(tmp_path, *, n_replicas=2, claims=("c0", "c1"), seed=0):
+    from svoc_tpu.serving.scenario import VirtualClock
+    from svoc_tpu.utils.events import EventJournal
+    from svoc_tpu.utils.metrics import MetricsRegistry
+
+    metrics = MetricsRegistry()
+    journal = EventJournal(registry=metrics)
+    chain_dir = str(tmp_path / "chain")
+    placement = PlacementDirectory(
+        [], path=str(tmp_path / "placement.json")
+    )
+    master_clock = VirtualClock()
+
+    def builder(
+        rid,
+        *,
+        fingerprint_epoch=0,
+        consensus_impl=None,
+        mesh=None,
+        commit_mode="per_tx",
+    ):
+        return Replica(
+            rid,
+            str(tmp_path / f"replica-{rid}"),
+            chain_dir=chain_dir,
+            seed=seed,
+            clock=VirtualClock(),
+            lineage_scope="clu",
+            commit_mode=commit_mode,
+            consensus_impl=consensus_impl,
+            mesh=mesh,
+            fingerprint_epoch=fingerprint_epoch,
+            max_requests_per_step=64,
+        )
+
+    router = ClusterRouter(
+        placement,
+        journal=journal,
+        metrics=metrics,
+        clock=master_clock,
+        retry=RetryPolicy(max_attempts=2, base_s=0.0, cap_s=0.0, jitter_seed=0),
+        replica_factory=builder,
+        lineage_scope="clu",
+        unclaimed_path=str(tmp_path / "unclaimed.json"),
+        epochs_path=str(tmp_path / "epochs.json"),
+    )
+    controller = ReconfigController(
+        router,
+        builder=builder,
+        journal=journal,
+        metrics=metrics,
+        clock=master_clock,
+        prewarm_budget_s=0.5,
+    )
+    for i in range(n_replicas):
+        router.add_replica(builder(f"r{i}"))
+    for cid in claims:
+        router.add_claim(ClaimSpec(claim_id=cid, n_oracles=7, dimension=6))
+    # A little served history so re-pin carries real cursors.
+    for step in range(2):
+        for cid in claims:
+            router.submit(cid, f"comment {cid} step {step}")
+        router.step_all()
+    return router, placement, controller, metrics
+
+
+def test_commit_repins_under_new_epoch(tmp_path):
+    router, placement, controller, metrics = build_fleet(tmp_path)
+    old_config = router.replica("r0").pinned_config()
+    assert old_config["commit_mode"] == "per_tx"
+    report = controller.apply(ReconfigPlan(commit_mode="batched"))
+    assert report["status"] == "committed"
+    assert report["epoch"] == 1 == router.reconfig_epoch
+    for rid in router.replica_ids():
+        config = router.replica(rid).pinned_config()
+        assert config["commit_mode"] == "batched"
+        assert config["fingerprint_epoch"] == 1
+        # The new journal lineage is on disk under the epoch suffix
+        # and starts with the continuity record.
+        trace = router.replica(rid).trace_path
+        assert trace.endswith("trace-e1.jsonl")
+        with open(trace) as f:
+            first = json.loads(f.readline())
+        assert first["event"] == "reconfig.epoch"
+        assert first["data"]["prev_fingerprint"]
+    # Epoch chain persisted, fingerprint folds it.
+    with open(str(tmp_path / "epochs.json")) as f:
+        persisted = json.load(f)
+    assert persisted["epoch"] == 1
+    assert persisted["chain"][0]["plan"] == report["plan_fingerprint"]
+    # Post-commit serving continues on the re-pinned stacks.
+    assert router.submit("c0", "after repin")["status"] == "admitted"
+    router.step_all()
+    assert metrics.gauge("reconfig_epoch").value == 1
+
+
+def test_noop_plan_mints_no_epoch(tmp_path):
+    router, _, controller, _ = build_fleet(tmp_path, claims=("c0",))
+    before = router.fleet_fingerprint()
+    assert controller.apply(ReconfigPlan()) == {"status": "noop"}
+    assert router.reconfig_epoch == 0
+    assert router.fleet_fingerprint() == before
+
+
+def test_plan_validate_against_fleet(tmp_path):
+    router, _, controller, _ = build_fleet(tmp_path, claims=("c0",))
+    with pytest.raises(ReconfigError):
+        controller.apply(
+            ReconfigPlan(
+                claims={
+                    "nope": ClaimSpec(
+                        claim_id="nope", n_oracles=7, dimension=6
+                    )
+                }
+            )
+        )
+    with pytest.raises(ReconfigError):
+        controller.apply(ReconfigPlan(add_replicas=("r0",)))
+    with pytest.raises(ReconfigError):
+        controller.apply(ReconfigPlan(remove_replicas=("rZ",)))
+    with pytest.raises(ReconfigError):
+        controller.apply(ReconfigPlan(remove_replicas=("r0", "r1")))
+
+
+@pytest.mark.parametrize("point", RECONFIG_POINTS)
+def test_abort_rolls_back_byte_identical(tmp_path, point):
+    router, _, controller, metrics = build_fleet(tmp_path, claims=("c0",))
+    before = router.fleet_fingerprint()
+    faultspace.arm(
+        faultspace.FaultController(
+            [FaultEvent(point=point, nth=1, action="error")]
+        )
+    )
+    try:
+        report = controller.apply(ReconfigPlan(commit_mode="batched"))
+    finally:
+        faultspace.disarm()
+    assert report["status"] == "aborted"
+    assert router.reconfig_epoch == 0
+    assert router.holding() == []
+    assert router.fleet_fingerprint() == before
+    # No epoch-suffixed journal files survive the abort.
+    for rid in router.replica_ids():
+        base = router.replica(rid).base_dir
+        assert not os.path.exists(os.path.join(base, "trace-e1.jsonl"))
+        assert not os.path.exists(os.path.join(base, "wal-e1.jsonl"))
+    assert metrics.family_total("reconfig_aborts") == 1.0
+    # The fleet still serves after the rollback.
+    assert router.submit("c0", "after abort")["status"] == "admitted"
+    router.step_all()
+
+
+def test_operator_abort_request(tmp_path):
+    router, _, controller, _ = build_fleet(tmp_path, claims=("c0",))
+    assert controller.request_abort()["status"] == "idle"
+    before = router.fleet_fingerprint()
+    # Arm the abort flag, then apply: the first gate honors it.
+    controller._abort_requested = True
+    report = controller.apply(ReconfigPlan(commit_mode="batched"))
+    assert report["status"] == "aborted"
+    assert report["cause"] == "_OperatorAbort"
+    assert router.fleet_fingerprint() == before
+
+
+def test_growth_plan_bounded_rebalance(tmp_path):
+    claims = tuple(f"c{i}" for i in range(6))
+    router, placement, controller, _ = build_fleet(
+        tmp_path, n_replicas=2, claims=claims
+    )
+    old_roster = list(placement.replicas())
+    expected_moves = set()
+    probe = PlacementDirectory(old_roster + ["r2"])
+    for cid in claims:
+        if probe.owner(cid) == "r2":
+            expected_moves.add(cid)
+    report = controller.apply(ReconfigPlan(add_replicas=("r2",)))
+    assert report["status"] == "committed"
+    moved = set(report["grown"]["r2"]["moved"])
+    # Rendezvous property: ONLY claims whose HRW owner is the newcomer
+    # move — growth never reshuffles claims between survivors.
+    assert moved == expected_moves
+    for cid in claims:
+        if cid not in moved:
+            assert placement.owner(cid) in old_roster
+    assert router.replica("r2").pinned_config()["fingerprint_epoch"] == 1
+
+
+def test_adopt_orphans_with_continuity(tmp_path):
+    router, placement, controller, metrics = build_fleet(
+        tmp_path, claims=("c0", "c1")
+    )
+    # Quarantine c0 by migrating it to a replica that does not exist.
+    report = router.migrate("c0", "rZ", reason="test")
+    assert report["status"] == "quarantined"
+    adoption = router.adopt_orphans()
+    assert "c0" in adoption["adopted"]
+    assert adoption["adopted"]["c0"]["continuity"] is True
+    assert adoption["remaining"] == {}
+    owner = placement.owner("c0")
+    assert router.replica(owner).has_claim("c0")
+    with open(str(tmp_path / "unclaimed.json")) as f:
+        assert json.load(f) == {}
+    assert metrics.family_total("cluster_adopted") == 1.0
+    # The adopted claim serves again.
+    assert router.submit("c0", "after adoption")["status"] == "admitted"
+    router.step_all()
+
+
+def test_console_reconfig_and_adopt_commands(tmp_path):
+    from svoc_tpu.apps.commands import CommandConsole
+
+    router, _, controller, _ = build_fleet(tmp_path, claims=("c0",))
+    console = CommandConsole.__new__(CommandConsole)
+    console.cluster = None
+    console.reconfig = None
+    console._write = None
+    # query() reads session.adapter before dispatch; the reconfig and
+    # cluster branches never touch the session beyond that.
+    console.session = type("S", (), {"adapter": None})()
+    router.attach(console)
+    controller.attach(console)
+    assert console.reconfig is controller
+
+    out = console.query("reconfig status")
+    assert any("phase idle" in line for line in out)
+    out = console.query("reconfig abort")
+    assert any("idle" in line for line in out)
+    plan_path = str(tmp_path / "plan.json")
+    with open(plan_path, "w") as f:
+        json.dump(ReconfigPlan(commit_mode="batched").to_dict(), f)
+    out = console.query(f"reconfig apply {plan_path}")
+    assert any("committed epoch 1" in line for line in out), out
+    out = console.query("cluster adopt-orphans")
+    assert any("no orphaned claims" in line for line in out), out
+
+
+# ---------------------------------------------------------------------------
+# hypothesis property: ANY aborted plan is invisible (import-gated)
+# ---------------------------------------------------------------------------
+
+
+try:
+    import hypothesis
+    from hypothesis import HealthCheck, given, settings
+    from hypothesis import strategies as st
+
+    HAVE_HYPOTHESIS = True
+except ImportError:  # pragma: no cover - import-gated satellite
+    HAVE_HYPOTHESIS = False
+
+if HAVE_HYPOTHESIS:
+
+    @st.composite
+    def abortable_plans(draw):
+        commit_mode = draw(st.sampled_from([None, "batched"]))
+        respec = draw(st.booleans())
+        grow = draw(st.booleans())
+        claims = (
+            {"c0": ClaimSpec(claim_id="c0", n_oracles=9, dimension=6)}
+            if respec
+            else {}
+        )
+        plan = ReconfigPlan(
+            commit_mode=commit_mode,
+            claims=claims,
+            add_replicas=("rG",) if grow else (),
+        )
+        hypothesis.assume(not plan.is_noop())
+        point = draw(st.sampled_from(RECONFIG_POINTS))
+        return plan, point
+
+    @settings(
+        max_examples=6,
+        deadline=None,
+        suppress_health_check=list(HealthCheck),
+    )
+    @given(abortable_plans())
+    def test_property_abort_is_invisible(tmp_path_factory, plan_and_point):
+        """For ANY non-noop plan prefix, an abort at ANY fault point
+        leaves the fleet fingerprint byte-identical to never having
+        attempted the plan (ISSUE 19's rollback invariant,
+        fleet-shape sampled)."""
+        plan, point = plan_and_point
+        tmp_path = tmp_path_factory.mktemp("prop")
+        router, _, controller, _ = build_fleet(tmp_path, claims=("c0",))
+        before = router.fleet_fingerprint()
+        faultspace.arm(
+            faultspace.FaultController(
+                [FaultEvent(point=point, nth=1, action="error")]
+            )
+        )
+        try:
+            report = controller.apply(plan)
+        finally:
+            faultspace.disarm()
+        assert report["status"] == "aborted", (plan, point)
+        assert router.fleet_fingerprint() == before, (plan, point)
+        assert router.reconfig_epoch == 0
+
+
+# ---------------------------------------------------------------------------
+# seeded scenario (two small committed runs, module-cached)
+# ---------------------------------------------------------------------------
+
+
+def load_corpus_entry():
+    with open(os.path.join(CORPUS_DIR, "rolling-repin-commit.json")) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def committed_runs(tmp_path_factory):
+    from svoc_tpu.cluster.reconfig_scenario import replay_corpus_entry
+
+    entry = load_corpus_entry()
+    runs = []
+    for tag in ("a", "b"):
+        workdir = str(tmp_path_factory.mktemp(f"reconfig-{tag}"))
+        runs.append(replay_corpus_entry(entry, workdir))
+    return runs
+
+
+def test_scenario_replay_identity_through_epoch_boundary(committed_runs):
+    first, second = committed_runs
+    assert first["reconfig"]["status"] == "committed"
+    assert first["fleet_fingerprint"] == second["fleet_fingerprint"]
+    for cid, claim in first["claims"].items():
+        assert claim["fingerprint"] == second["claims"][cid]["fingerprint"]
+    assert first["epoch_chain"] == second["epoch_chain"]
+
+
+def test_scenario_exactly_once_and_continuity(committed_runs):
+    first, _ = committed_runs
+    assert first["duplicate_txs"] == 0
+    assert first["requests"]["unaccounted"] == 0.0
+    assert first["reconfig_epoch"] == 1
+    for rep in first["reconfig"]["replicas"].values():
+        for claim in rep["claims"].values():
+            assert claim["continuity"] is True
+    # Mid-transition traffic was deferred (never shed) and released.
+    deferred = [
+        p
+        for p in first["probes"]
+        if p["response"].get("status") == "deferred"
+    ]
+    assert deferred
+    assert first["cluster_counters"]["cluster_unavailable"] == 0.0
+    assert first["reconfig"]["deferred_released"] == len(deferred)
+
+
+def test_reconfig_corpus_entry_invisible_to_durable_fuzzer():
+    from svoc_tpu.durability.fuzz import load_corpus
+
+    corpus_root = os.path.dirname(CORPUS_DIR)
+    for entry in load_corpus(corpus_root):
+        assert entry.get("format") != "svoc-reconfig-corpus-v1"

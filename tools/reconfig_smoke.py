@@ -1,0 +1,297 @@
+"""Live-reconfiguration chaos gate: transactional re-pin as CI
+(``make reconfig-smoke``; docs/RECONFIG.md, docs/RESILIENCE.md
+§fault-surface).
+
+Three run families over :func:`svoc_tpu.cluster.reconfig_scenario
+.run_reconfig_scenario`, all seeded and byte-reproducible:
+
+1. **Committed transition, twice** — a 3-replica × 6-claim fleet under
+   traffic, with a rolling mesh/commit-mode/spec re-pin applied
+   mid-schedule.  The controller's traffic hook fires a probe at every
+   stage boundary, so the DEFERRED path (held replica's traffic parked
+   at the router, replayed on release) is in the replayed stream.
+   Asserted: replay identity (fleet + per-claim fingerprints byte-
+   identical across the two runs, INCLUDING the epoch transition),
+   epoch chain advanced exactly once, lineage continuity for every
+   re-pinned claim, zero shed (every probe deferred — never
+   ``unavailable``), zero duplicate txs, zero unaccounted requests.
+
+2. **Abort at every fault point** — a smaller fleet, one run per
+   ``reconfig.*`` point with an injected ``error``, each compared
+   against a baseline run with the identical schedule AND the identical
+   (never-firing) event list but no plan.  Asserted: the abort report
+   is typed, the rollback leaves the fleet fingerprint byte-identical
+   to never having attempted the plan, and zero requests were dropped
+   or duplicated.
+
+3. **Coverage** — all five ``reconfig.*`` points witnessed in the
+   durable fired logs across the abort family.
+
+Usage::
+
+    python tools/reconfig_smoke.py [--seed 0] [--out RECONFIG_SMOKE.json]
+"""
+
+from __future__ import annotations
+
+import os
+
+# Off-TPU by construction (the axon sitecustomize pins the platform —
+# tools/soak.py measurement postmortem).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import argparse  # noqa: E402
+import sys  # noqa: E402
+import tempfile  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from svoc_tpu.durability.faultspace import (  # noqa: E402
+    FaultEvent,
+    read_fired_log,
+)
+from svoc_tpu.utils.artifacts import atomic_write_json  # noqa: E402
+
+N_REPLICAS = 3
+N_CLAIMS = 6
+TOTAL_STEPS = 10
+ARRIVALS_PER_STEP = 8
+RECONFIG_AT_STEP = 4
+
+RECONFIG_POINTS = (
+    "reconfig.prepare",
+    "reconfig.post_drain",
+    "reconfig.post_ship",
+    "reconfig.pre_repin",
+    "reconfig.pre_resume",
+)
+
+#: The committed transition: flip the WAL commit mode and re-spec one
+#: claim (wider oracle panel) in one transaction — a knob re-pin AND a
+#: spec-diff carry through the same epoch boundary.
+def _plan(n_oracles: int, dimension: int) -> dict:
+    from svoc_tpu.fabric.registry import ClaimSpec
+    from svoc_tpu.utils.checkpoint import claim_spec_to_dict
+
+    return {
+        "consensus_impl": None,
+        "mesh": None,
+        "commit_mode": "batched",
+        "claims": {
+            "c0": claim_spec_to_dict(
+                ClaimSpec(
+                    claim_id="c0",
+                    n_oracles=n_oracles + 2,
+                    dimension=dimension,
+                )
+            )
+        },
+        "add_replicas": [],
+        "remove_replicas": [],
+    }
+
+
+def run_committed(seed: int) -> dict:
+    from svoc_tpu.cluster.reconfig_scenario import run_reconfig_scenario
+
+    workdir = tempfile.mkdtemp(prefix="reconfig-smoke-")
+    result = run_reconfig_scenario(
+        workdir,
+        seed=seed,
+        n_replicas=N_REPLICAS,
+        n_claims=N_CLAIMS,
+        total_steps=TOTAL_STEPS,
+        arrivals_per_step=ARRIVALS_PER_STEP,
+        reconfig_at_step=RECONFIG_AT_STEP,
+        plan=_plan(7, 6),
+    )
+    result["workdir"] = workdir
+    result["fired_log"] = read_fired_log(os.path.join(workdir, "fired.jsonl"))
+    return result
+
+
+def run_abort_pair(seed: int, point: str) -> tuple:
+    """(baseline, aborted) — identical schedule and event list; only
+    the plan differs, and the abort must erase it."""
+    from svoc_tpu.cluster.reconfig_scenario import run_reconfig_scenario
+
+    events = [FaultEvent(point=point, nth=1, action="error")]
+
+    def run(with_plan: bool) -> dict:
+        workdir = tempfile.mkdtemp(prefix="reconfig-abort-")
+        result = run_reconfig_scenario(
+            workdir,
+            seed=seed,
+            n_replicas=2,
+            n_claims=3,
+            total_steps=6,
+            arrivals_per_step=4,
+            reconfig_at_step=2,
+            plan=_plan(7, 6) if with_plan else None,
+            traffic_probes=False,
+            events=list(events),
+        )
+        result["workdir"] = workdir
+        result["fired_log"] = read_fired_log(
+            os.path.join(workdir, "fired.jsonl")
+        )
+        return result
+
+    return run(with_plan=False), run(with_plan=True)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--out", default="RECONFIG_SMOKE.json")
+    args = parser.parse_args()
+
+    checks = []
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+        print(f"[{'PASS' if ok else 'FAIL'}] {name}" + (f" — {detail}" if detail else ""))
+
+    # -- family 1: committed transition, twice ------------------------------
+    first = run_committed(args.seed)
+    second = run_committed(args.seed)
+
+    check(
+        "transition committed under traffic",
+        (first["reconfig"] or {}).get("status") == "committed",
+        f"epoch {first['reconfig_epoch']}",
+    )
+    check(
+        "fleet fingerprint byte-identical across committed runs",
+        first["fleet_fingerprint"] == second["fleet_fingerprint"],
+        first["fleet_fingerprint"][:16],
+    )
+    check(
+        "per-claim fingerprints byte-identical across committed runs",
+        all(
+            first["claims"][cid]["fingerprint"]
+            == second["claims"][cid]["fingerprint"]
+            for cid in first["claims"]
+        ),
+        f"{len(first['claims'])} claims",
+    )
+    check(
+        "epoch chain advanced exactly once, plan fingerprint recorded",
+        first["reconfig_epoch"] == 1
+        and len(first["epoch_chain"]) == 1
+        and first["epoch_chain"][0]["plan"]
+        == first["reconfig"]["plan_fingerprint"],
+        (first["epoch_chain"][0]["plan"][:16] if first["epoch_chain"] else ""),
+    )
+    repinned = first["reconfig"]["replicas"]
+    check(
+        "lineage continuity for every re-pinned claim",
+        bool(repinned)
+        and all(
+            c["continuity"]
+            for rep in repinned.values()
+            for c in rep["claims"].values()
+        ),
+        f"{sum(len(rep['claims']) for rep in repinned.values())} claims "
+        f"across {len(repinned)} replicas",
+    )
+    check(
+        "spec-diff claim carried (fresh session, lineage fields kept)",
+        any(
+            rep["claims"].get("c0", {}).get("carried")
+            for rep in repinned.values()
+        ),
+    )
+    deferred = [
+        p for p in first["probes"] if p["response"].get("status") == "deferred"
+    ]
+    check(
+        "mid-transition traffic deferred, never shed",
+        len(deferred) > 0
+        and first["cluster_counters"]["cluster_unavailable"] == 0,
+        f"{len(deferred)} deferred, 0 sheds",
+    )
+    check(
+        "every deferred request released at commit",
+        first["reconfig"]["deferred_released"] == len(deferred),
+        f"{first['reconfig']['deferred_released']} released",
+    )
+    check(
+        "zero duplicate txs through the epoch boundary",
+        first["duplicate_txs"] == 0 and second["duplicate_txs"] == 0,
+        f"{first['duplicate_txs']} + {second['duplicate_txs']}",
+    )
+    requests = first["requests"]
+    check(
+        "zero unaccounted admitted requests fleet-wide",
+        requests["unaccounted"] == 0
+        and second["requests"]["unaccounted"] == 0,
+        f"admitted={requests['admitted']:.0f} "
+        f"completed={requests['completed']:.0f} "
+        f"dropped={requests['dropped']:.0f}",
+    )
+    check(
+        "pending-config universe prewarmed in PREPARE",
+        (first["reconfig"]["prewarm"] or {}).get("keys", 0) > 0,
+        str(first["reconfig"]["prewarm"]),
+    )
+
+    # -- family 2: abort at every fault point -------------------------------
+    fired_points = set(first["fired_log"]["fired"])
+    aborts = {}
+    for point in RECONFIG_POINTS:
+        baseline, aborted = run_abort_pair(args.seed, point)
+        aborts[point] = {
+            "status": (aborted["reconfig"] or {}).get("status"),
+            "phase": (aborted["reconfig"] or {}).get("phase"),
+            "identical": aborted["fleet_fingerprint"]
+            == baseline["fleet_fingerprint"],
+            "unaccounted": aborted["requests"]["unaccounted"],
+            "duplicate_txs": aborted["duplicate_txs"],
+        }
+        fired_points |= set(aborted["fired_log"]["fired"])
+        check(
+            f"abort @ {point} rolls back to the never-attempted fingerprint",
+            aborts[point]["status"] == "aborted"
+            and aborts[point]["identical"]
+            and aborts[point]["unaccounted"] == 0
+            and aborts[point]["duplicate_txs"] == 0,
+            f"phase={aborts[point]['phase']}",
+        )
+
+    # -- family 3: coverage --------------------------------------------------
+    missing = [p for p in RECONFIG_POINTS if p not in fired_points]
+    check(
+        "all reconfig fault points witnessed in the durable fired logs",
+        not missing,
+        f"missing={missing}" if missing else f"{len(RECONFIG_POINTS)} points",
+    )
+
+    ok = all(c["ok"] for c in checks)
+    artifact = {
+        "artifact": "reconfig_smoke",
+        "seed": args.seed,
+        "config": {
+            "n_replicas": N_REPLICAS,
+            "n_claims": N_CLAIMS,
+            "total_steps": TOTAL_STEPS,
+            "arrivals_per_step": ARRIVALS_PER_STEP,
+            "reconfig_at_step": RECONFIG_AT_STEP,
+            "plan": _plan(7, 6),
+        },
+        "checks": checks,
+        "reconfig": first["reconfig"],
+        "epoch_chain": first["epoch_chain"],
+        "aborts": aborts,
+        "requests": first["requests"],
+        "cluster_counters": first["cluster_counters"],
+        "fleet_fingerprint": first["fleet_fingerprint"],
+        "ok": ok,
+    }
+    atomic_write_json(args.out, artifact)
+    print(f"{'PASS' if ok else 'FAIL'}: reconfig smoke -> {args.out}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
