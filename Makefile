@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving bench-shard bench-hotpath bench-coldstart bench-cluster campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke obs-cost-smoke fabric-smoke serving-smoke crash-smoke chaos-fuzz-smoke shard-smoke hotpath-smoke coldstart-smoke cluster-smoke reconfig-smoke pallas-parity clean
+.PHONY: run run_with_scraper run_scraper web lint test test_fast test_all verify presnapshot bench bench-serving bench-shard bench-hotpath bench-coldstart bench-cluster campaign native metrics-smoke chaos-smoke robustness-smoke robustness-cert obs-smoke obs-cost-smoke fabric-smoke serving-smoke crash-smoke chaos-fuzz-smoke shard-smoke hotpath-smoke coldstart-smoke cluster-smoke reconfig-smoke fleet-obs-smoke pallas-parity clean
 
 # The stdin console client (reference: `make run` -> python3 main.py).
 run:
@@ -192,6 +192,20 @@ cluster-smoke:
 reconfig-smoke:
 	$(PY) tools/reconfig_smoke.py
 
+# Fleet observability gate (docs/OBSERVABILITY.md §fleet-plane): the
+# seeded kill/failover + migrate scenario four ways (plane on twice,
+# off twice) — byte-identical fleet fingerprints across ALL FOUR (hop
+# records, merged telemetry, SLO alerts and anomaly observations ride
+# the obs channel only), 100% hop-chain join coverage (complete
+# forward chains == the router's cluster_forwarded count), the merged
+# /metrics/fleet exposition equal to the sum of per-source scrapes,
+# fleet totals monotonic across the failover (@retired fold), and a
+# seeded degradation leg whose SUSTAINED anomaly auto-captures a
+# profile and writes a postmortem bundle → FLEET_OBS_SMOKE.json.
+# Seconds on CPU, no transformer builds.
+fleet-obs-smoke:
+	$(PY) tools/fleet_obs_smoke.py
+
 # Deterministic fault-space fuzzer gate (docs/RESILIENCE.md
 # §fault-surface): 32 seed-drawn kill/restart schedules over the named
 # fault-point registry — SIGKILL at the Nth firing, torn writes,
@@ -210,7 +224,7 @@ chaos-fuzz-smoke:
 # convergence gates (I/O-plane, then data-plane), then the flight
 # recorder, then the fabric and serving tiers, then crash consistency
 # and the fault-space fuzzer, then the suite.
-verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke obs-cost-smoke fabric-smoke shard-smoke serving-smoke hotpath-smoke coldstart-smoke chaos-fuzz-smoke crash-smoke cluster-smoke reconfig-smoke test
+verify: lint pallas-parity chaos-smoke robustness-smoke obs-smoke obs-cost-smoke fabric-smoke shard-smoke serving-smoke hotpath-smoke coldstart-smoke chaos-fuzz-smoke crash-smoke cluster-smoke reconfig-smoke fleet-obs-smoke test
 
 # End-of-round gate: lint + the driver-contract guards FIRST (fast,
 # loud — round 4 shipped a red test_graft_entry pinning a stale dryrun
@@ -232,6 +246,7 @@ presnapshot:
 	$(MAKE) crash-smoke
 	$(MAKE) cluster-smoke
 	$(MAKE) reconfig-smoke
+	$(MAKE) fleet-obs-smoke
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PY) -m pytest tests/test_graft_entry.py tests/test_bench.py -q
 	$(MAKE) test

@@ -72,6 +72,9 @@ HELP = """Commands:
     - reconfig [status | apply <plan.json> | abort] (live
       reconfiguration plane: transactional drain → re-pin →
       recover-warm under traffic — docs/RECONFIG.md)
+    - fleet (fleet observability plane: hop-chain count, per-source
+      observation accounting, fleet SLO alerts, recent anomalies,
+      postmortem bundles — docs/OBSERVABILITY.md §fleet-plane)
     - drain (graceful teardown: stop admission, flush queues,
       snapshot, postmortem bundle — what SIGTERM does)
     - multimodal [K|auto] (mixture analysis of the last fetch;
@@ -150,6 +153,11 @@ class CommandConsole:
         #: ``/api/state``'s reconfig section read it.  None = no
         #: transactional re-pin path (static fleet config).
         self.reconfig = None
+        #: Fleet observability plane (docs/OBSERVABILITY.md
+        #: §fleet-plane): set by ``FleetPlane.attach`` — the ``fleet``
+        #: command, ``GET /metrics/fleet``, and ``/api/state``'s
+        #: fleet-obs section read it.  None = no fleet plane wired.
+        self.fleetplane = None
         self._auto_fetch_thread: Optional[threading.Thread] = None
         self._scraper_stop: Optional[threading.Event] = None
         self._scraper_thread: Optional[threading.Thread] = None
@@ -851,6 +859,65 @@ class CommandConsole:
                         f"  epoch {entry['epoch']}: plan "
                         f"{entry['plan'][:16]} over {entry['pre_fleet'][:16]}"
                     )
+            elif cmd == "fleet":
+                # Fleet observability plane (docs/OBSERVABILITY.md
+                # §fleet-plane): merged telemetry + hop chains +
+                # anomaly state.
+                if self.fleetplane is None:
+                    emit(
+                        "no fleet plane attached — wire a FleetPlane "
+                        "and attach(console) (docs/OBSERVABILITY.md "
+                        "§fleet-plane)"
+                    )
+                    return out
+                snap = self.fleetplane.snapshot()
+                if not snap["enabled"]:
+                    emit(
+                        "fleet plane DISABLED (SVOC_FLEET_PLANE / "
+                        "PERF_DECISIONS.json fleet_plane — resolved at "
+                        "construction, SVOC011)"
+                    )
+                    return out
+                emit(
+                    f"fleet plane: step {snap['step']}, "
+                    f"{len(snap['sources'])} source(s) "
+                    f"[{', '.join(snap['sources'])}], "
+                    f"{snap['chains']} hop chain(s)"
+                    + (
+                        f", retired: {', '.join(snap['retired'])}"
+                        if snap["retired"]
+                        else ""
+                    )
+                )
+                for sid, acct in sorted(snap["observations"].items()):
+                    emit(
+                        f"  {sid}: {acct['records']} obs record(s), "
+                        f"last seq {acct['last_seq']}, "
+                        f"dropped {acct['dropped']}"
+                    )
+                alerting = snap["slo"]["alerting"]
+                emit(
+                    "fleet SLOs: "
+                    + (
+                        "ALERTING " + ", ".join(alerting)
+                        if alerting
+                        else "quiet"
+                    )
+                )
+                anomaly = snap.get("anomaly") or {}
+                emit(
+                    f"anomaly: {anomaly.get('series', 0)} series, "
+                    f"{anomaly.get('alerts_total', 0)} alert(s)"
+                )
+                for a in snap["recent_anomalies"]:
+                    emit(
+                        f"  step {a['step']} {a['source']}/{a['family']}: "
+                        f"delta {a['delta']:g} ({a['trigger']}, "
+                        f"z={a['z']:.1f}, streak {a['streak']}"
+                        + (", SUSTAINED)" if a["sustained"] else ")")
+                    )
+                for path in snap["bundles"]:
+                    emit(f"  bundle: {path}")
             elif cmd == "costs":
                 # Shape-keyed dispatch-cost ledger
                 # (docs/OBSERVABILITY.md §cost-attribution).

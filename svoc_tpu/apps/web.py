@@ -444,6 +444,12 @@ class _Handler(BaseHTTPRequestHandler):
             reconfig = getattr(self.console, "reconfig", None)
             if reconfig is not None:
                 payload["reconfig"] = reconfig.status()
+            # Fleet observability plane (docs/OBSERVABILITY.md
+            # §fleet-plane): source roster, hop-chain count, per-source
+            # observation accounting, fleet SLO alerts, anomalies.
+            fleetplane = getattr(self.console, "fleetplane", None)
+            if fleetplane is not None:
+                payload["fleet_obs"] = fleetplane.snapshot()
             self._send(200, json.dumps(payload).encode(), "application/json")
         elif self.path == "/api/events" or self.path.startswith("/api/events?"):
             self._serve_events()
@@ -463,6 +469,25 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send(
                     200, json.dumps(record).encode(), "application/json"
+                )
+        elif self.path == "/metrics/fleet":
+            # Merged fleet exposition (docs/OBSERVABILITY.md
+            # §fleet-plane): counters summed across sources + the
+            # retired ledger, gauges replica-labeled.  404-typed when
+            # no plane is attached or it is disabled — a scraper must
+            # be able to tell "off" from "empty fleet".
+            fleetplane = getattr(self.console, "fleetplane", None)
+            if fleetplane is None or not fleetplane.enabled:
+                self._send(
+                    404,
+                    json.dumps({"error": "fleet plane not enabled"}).encode(),
+                    "application/json",
+                )
+            else:
+                self._send(
+                    200,
+                    fleetplane.render_prometheus_fleet().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
                 )
         elif self.path == "/metrics":
             # Prometheus text exposition of the shared registry.  The

@@ -286,6 +286,18 @@ class ReconfigController:
         self._metrics.counter(
             "reconfig_transitions", labels={"phase": phase}
         ).add(1)
+        self._obs("reconfig.phase", phase=phase)
+
+    def _obs(self, kind: str, **data) -> None:
+        """Phase breadcrumbs on the fleet plane's observation channel
+        (docs/OBSERVABILITY.md §fleet-plane) — obs-only by design:
+        abort invisibility forbids journaling any pre-RESUME phase, but
+        the operator timeline still wants to see the attempt."""
+        plane = getattr(self._router, "fleet_plane", None)
+        if plane is not None and plane.enabled:
+            plane.obslog.record(
+                kind, scope="fleet", epoch=self._router.reconfig_epoch, **data
+            )
 
     def _gate(self, point: str, payload: Dict[str, Any]) -> None:
         if self._abort_requested:
@@ -356,6 +368,9 @@ class ReconfigController:
             self._metrics.counter(
                 "reconfig_aborts", labels={"phase": phase}
             ).add(1)
+            self._obs(
+                "reconfig.aborted", phase=phase, cause=type(err).__name__
+            )
             if isinstance(err, (InjectedFault, _OperatorAbort)):
                 self._last_report = {
                     "status": "aborted",
@@ -675,6 +690,12 @@ class ReconfigController:
         )
         released = router.release_holds()
         self._metrics.gauge("reconfig_epoch").set(epoch)
+        self._obs(
+            "reconfig.committed",
+            plan=plan_fp[:16],
+            replicas=sorted(replicas_report),
+            deferred_released=deferred,
+        )
         self._phase = "idle"
         self._last_report = {
             "status": "committed",

@@ -92,12 +92,17 @@ def run_reconfig_scenario(
     traffic_probes: bool = True,
     prewarm_budget_s: float = 5.0,
     events: Optional[List[FaultEvent]] = None,
+    fleet_plane: Optional[bool] = None,
 ) -> Dict[str, Any]:
     """Run the seeded reconfiguration workload; returns the result dict
     the harness asserts over.  ``consensus_impl``/``mesh``/
     ``commit_mode`` pin the INITIAL fleet; ``plan`` (a
     :class:`ReconfigPlan` or its ``to_dict`` payload) is applied at the
-    ``reconfig_at_step`` step boundary."""
+    ``reconfig_at_step`` step boundary.  ``fleet_plane`` switches the
+    fleet observability plane for the run (obs-channel only — the
+    fleet fingerprint, including abort invisibility, is byte-identical
+    either way)."""
+    from svoc_tpu.obsplane.fleet import FleetPlane
     from svoc_tpu.serving.scenario import VirtualClock
     from svoc_tpu.utils import events as _events
     from svoc_tpu.utils.events import EventJournal
@@ -182,6 +187,17 @@ def run_reconfig_scenario(
         lineage_scope=LINEAGE_SCOPE,
         unclaimed_path=os.path.join(workdir, "unclaimed.json"),
         epochs_path=os.path.join(workdir, "epochs.json"),
+        fleet_plane=FleetPlane(
+            enabled=fleet_plane,
+            clock=master_clock,
+            journal=journal,
+            trace_path=os.path.join(workdir, "fleet-obs.jsonl"),
+            profile_dir=os.path.join(workdir, "profiles"),
+            bundle_dir=workdir,
+            slo_latency_target_s=2.5 * step_period_s,
+            slo_fast_window_s=10 * step_period_s,
+            slo_slow_window_s=50 * step_period_s,
+        ),
     )
     controller = ReconfigController(
         router,
@@ -321,6 +337,7 @@ def run_reconfig_scenario(
         "fleet_fingerprint": router.fleet_fingerprint(),
         "fault_points_fired": fault_controller.counts(),
         "journal_events": journal.last_seq(),
+        "fleet_obs": router.fleet_plane.snapshot(),
     }
 
 

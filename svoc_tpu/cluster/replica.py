@@ -119,6 +119,11 @@ class Replica:
         )
         self.trace_path = os.path.join(base_dir, f"trace{suffix}.jsonl")
         self.wal_path = os.path.join(base_dir, f"wal{suffix}.jsonl")
+        #: Observation-channel sidecar (docs/OBSERVABILITY.md
+        #: §fleet-plane).  Deliberately NOT the fsynced trace file: hop
+        #: records are derived telemetry with no durability contract,
+        #: and the trace writer fsyncs per line.
+        self.obs_path = os.path.join(base_dir, f"obs{suffix}.jsonl")
         self.metrics = MetricsRegistry()
         self.journal = EventJournal(registry=self.metrics)
         # The trace is a durability artifact (the failover replays its

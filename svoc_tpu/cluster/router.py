@@ -52,6 +52,7 @@ from svoc_tpu.cluster.placement import (
 )
 from svoc_tpu.cluster.replica import Replica, ReplicaDeadError, lineage_cursor
 from svoc_tpu.durability import faultspace
+from svoc_tpu.obsplane.fleet import FleetPlane
 from svoc_tpu.resilience.breaker import CircuitBreaker, CircuitOpenError
 from svoc_tpu.resilience.faults import InjectedFault
 from svoc_tpu.resilience.retry import RetryPolicy, call_with_retry
@@ -81,6 +82,7 @@ class ClusterRouter:
         lineage_scope: str = "clu",
         unclaimed_path: Optional[str] = None,
         epochs_path: Optional[str] = None,
+        fleet_plane: Optional[FleetPlane] = None,
     ):
         from svoc_tpu.utils.metrics import registry as default_registry
 
@@ -137,6 +139,18 @@ class ClusterRouter:
         #: the PRE-transition fleet fingerprint — folded into
         #: :meth:`fleet_fingerprint`, so the transition itself is part
         #: of replay identity.  Aborted transitions never append.
+        #: The fleet observability plane (docs/OBSERVABILITY.md
+        #: §fleet-plane) — hop chains, merged telemetry, anomaly
+        #: sampling.  SVOC011: resolved here at construction (a default
+        #: plane resolves its own enabled flag); disabled, every hook
+        #: is one attribute check and the journal byte stream is
+        #: untouched.
+        self._fleet = (
+            fleet_plane
+            if fleet_plane is not None
+            else FleetPlane(clock=self._clock)
+        )
+        self._fleet.register_source("router", registry=self._metrics)
         self._epochs_path = epochs_path
         self._reconfig_epoch = 0
         self._epoch_chain: List[Dict[str, Any]] = []
@@ -155,6 +169,22 @@ class ClusterRouter:
         self._replicas[rid] = replica
         self._breakers[rid] = self._breaker_factory(rid)
         self._placement.add_replica(rid)
+        self._register_obs_source(replica)
+
+    def _register_obs_source(self, replica: Replica) -> None:
+        """Register a replica stack as a fleet-plane telemetry source:
+        its registry joins the merge and its ``obs*.jsonl`` sidecar
+        (non-fsynced — derived telemetry) receives its side of each
+        hop."""
+        self._fleet.register_source(
+            replica.replica_id,
+            registry=replica.metrics,
+            trace_path=getattr(replica, "obs_path", None),
+        )
+
+    @property
+    def fleet_plane(self) -> FleetPlane:
+        return self._fleet
 
     def replace_replica(
         self,
@@ -173,6 +203,7 @@ class ClusterRouter:
         if retire_key is not None:
             self._harvest(retire_key, old)
         self._replicas[replica_id] = replica
+        self._register_obs_source(replica)
         return old
 
     def replica(self, replica_id: str) -> Replica:
@@ -224,6 +255,15 @@ class ClusterRouter:
                 epoch=current,
                 owner=owner,
             )
+            self._fleet.hop_refused(
+                claim_id,
+                lineage=self._lineage_prefix(claim_id),
+                reason="redirect",
+                outcome="redirect",
+                target=owner,
+                presented_epoch=int(epoch),
+                epoch=current,
+            )
             return {
                 "status": "redirect",
                 "claim": claim_id,
@@ -245,6 +285,16 @@ class ClusterRouter:
                 "reconfig_deferred", labels={"replica": owner}
             ).add(1)
             self._deferred.append((claim_id, text))
+            # Obs-channel only, like the counter: the released request
+            # replays through submit and mints its own forward chain.
+            self._fleet.hop_refused(
+                claim_id,
+                lineage=self._lineage_prefix(claim_id),
+                reason="reconfig-defer",
+                outcome="deferred",
+                target=owner,
+                epoch=current,
+            )
             return {
                 "status": "deferred",
                 "claim": claim_id,
@@ -254,14 +304,33 @@ class ClusterRouter:
             }
         replica = self._replicas.get(owner)
         if replica is None or not replica.alive:
+            self._fleet.hop_refused(
+                claim_id,
+                lineage=self._lineage_prefix(claim_id),
+                reason="forward",
+                outcome="unavailable",
+                target=owner,
+                cause="replica_down",
+            )
             return self._shed(claim_id, owner, "replica_down")
         if not replica.has_claim(claim_id):
             # The HTTP 404 contract (unknown claim), kept OUTSIDE the
             # breaker guard — a caller's typo is not replica failure.
             raise KeyError(claim_id)
         breaker = self._breakers[owner]
+        hop = self._fleet.hop_begin(
+            claim_id,
+            lineage=self._lineage_prefix(claim_id),
+            origin="router",
+            target=owner,
+            reason="forward",
+        )
 
         def send() -> Dict[str, Any]:
+            # The send record lands BEFORE the fault point: a request
+            # cut down inside the transport call leaves the unanswered
+            # send as its mid-hop-death evidence.
+            self._fleet.hop_send(hop)
             faultspace.fault_point(
                 faultspace.CLUSTER_FORWARD_PRE_SEND,
                 payload={"claim": claim_id, "replica": owner},
@@ -280,13 +349,24 @@ class ClusterRouter:
                     registry=self._metrics,
                 )
         except CircuitOpenError:
+            self._fleet.hop_end(
+                hop, outcome="unavailable", cause="breaker_open"
+            )
             return self._shed(claim_id, owner, "breaker_open")
         except Exception as err:
             # Retry budget exhausted (injected fault, replica died
             # mid-call): a counted, journaled shed — never silent.
+            self._fleet.hop_end(
+                hop, outcome="unavailable", cause=type(err).__name__
+            )
             return self._shed(
                 claim_id, owner, "forward_error", error=type(err).__name__
             )
+        self._fleet.hop_recv(
+            hop,
+            status=response.get("status"),
+            request=response.get("request_id"),
+        )
         self._metrics.counter(
             "cluster_forwarded", labels={"claim": claim_id, "replica": owner}
         ).add(1)
@@ -345,12 +425,19 @@ class ClusterRouter:
         """One pull-mode serving cycle on every live replica, roster
         order — the cluster twin of ``ServingTier.step``."""
         reports: Dict[str, Any] = {}
+        live: Dict[str, Any] = {"router": self._metrics}
         for rid in sorted(self._replicas):
             replica = self._replicas[rid]
             if not replica.alive:
                 continue
             replica.step()
             reports[rid] = {"steps": replica.tier.steps}
+            live[rid] = replica.metrics
+        # The fleet plane samples on this cadence: SLO evaluation over
+        # one merge, accounting history, anomaly detection over the
+        # LIVE sources only (a dead stack's frozen registry is not a
+        # signal — its last scrape already is).
+        self._fleet.on_step(live)
         return reports
 
     # -- migration -----------------------------------------------------------
@@ -402,12 +489,29 @@ class ClusterRouter:
             deferred=drain_report["deferred"],
             **payload,
         )
+        hop = self._fleet.hop_begin(
+            claim_id,
+            lineage=prefix,
+            origin=source_id,
+            target=target_id,
+            reason="failover" if reason == "failover" else "migrate",
+        )
+        self._fleet.hop_send(
+            hop,
+            cursor=shipped_cursor,
+            cycles=entry["cycles"],
+            deferred=drain_report["deferred"],
+            cause=reason,
+        )
         target = self._replicas.get(target_id)
         if (
             target is None
             or not target.alive
             or target_id not in self._placement.replicas()
         ):
+            self._fleet.hop_end(
+                hop, outcome="quarantined", cause="missing_target"
+            )
             return self._quarantine(
                 source, claim_id, entry, target_id, prefix, "missing_target"
             )
@@ -422,12 +526,18 @@ class ClusterRouter:
         except InjectedFault as err:
             # The slice is detached but not adopted — quarantine it
             # (orphan path), never drop it or leave two live owners.
+            self._fleet.hop_end(
+                hop, outcome="quarantined", cause=type(err).__name__
+            )
             return self._quarantine(
                 source, claim_id, entry, target_id, prefix, type(err).__name__
             )
         continuity = (
             claim_id in adopt_report["restored"]
             and adopt_report["cursor"] == shipped_cursor
+        )
+        self._fleet.hop_recv(
+            hop, cursor=adopt_report["cursor"], continuity=continuity
         )
         epoch = self._placement.assign(claim_id, target_id)
         self._metrics.counter(
@@ -594,11 +704,20 @@ class ClusterRouter:
     def _harvest(self, key: str, replica: Replica) -> None:
         """Fold a stack's durable counters + journal fingerprints into
         the retired ledger before it stops serving (failover, retire,
-        reconfig epoch supersession — one discipline for all three)."""
+        reconfig epoch supersession — one discipline for all three).
+        The counters snapshot also retires the stack's fleet-merge
+        entry under ``replica="<key>@retired"`` so fleet totals never
+        step backward across a failover."""
+        counters = replica.metrics.counters_snapshot()
+        observations = self._fleet.retire_source(
+            key, replica.replica_id, counters
+        )
         self._retired[key] = {
             "requests": replica.request_accounting(),
             "journal_fingerprint": replica.journal.fingerprint(),
             "journal_events": replica.journal.last_seq(),
+            "counters": counters,
+            "observations": observations,
             "claims": {
                 cid: replica.claim_journal_fingerprint(
                     self._lineage_prefix(cid) + "-"
@@ -636,6 +755,7 @@ class ClusterRouter:
                 moves.append((cid, old_owner))
         self._replicas[rid] = replica
         self._breakers[rid] = self._breaker_factory(rid)
+        self._register_obs_source(replica)
         epoch = self._placement.add_replica(rid)
         self._journal.emit(
             "cluster.grow",
@@ -877,10 +997,18 @@ class ClusterRouter:
             json.dumps(payload, sort_keys=True).encode()
         ).hexdigest()
 
-    def fleet_accounting(self) -> Dict[str, float]:
+    def fleet_accounting(self) -> Dict[str, Any]:
         """At-least-once accounting across live AND retired replicas
-        (recovered durable counts are the authority for the dead)."""
-        totals = {"admitted": 0.0, "completed": 0.0, "dropped": 0.0, "cached": 0.0}
+        (recovered durable counts are the authority for the dead),
+        plus the observation-channel ledger: per-source record counts,
+        last obs seq, and writer-error drops — a truncated sidecar
+        must show up here, not as a diff of missing lines."""
+        totals: Dict[str, Any] = {
+            "admitted": 0.0,
+            "completed": 0.0,
+            "dropped": 0.0,
+            "cached": 0.0,
+        }
         for rid in sorted(self._replicas):
             for key, value in self._replicas[rid].request_accounting().items():
                 totals[key] += value
@@ -890,6 +1018,13 @@ class ClusterRouter:
         totals["unaccounted"] = max(
             0.0, totals["admitted"] - totals["completed"] - totals["dropped"]
         )
+        totals["observations"] = {
+            "live": self._fleet.obs_accounting(),
+            "retired": {
+                rid: self._retired[rid].get("observations")
+                for rid in sorted(self._retired)
+            },
+        }
         return totals
 
     def snapshot(self) -> Dict[str, Any]:

@@ -87,6 +87,7 @@ def run_cluster_scenario(
     migrate_at_step: Optional[int] = None,
     events: Optional[List[FaultEvent]] = None,
     stale_epoch_probe: bool = True,
+    fleet_plane: Optional[bool] = None,
 ) -> Dict[str, Any]:
     """Run the seeded fleet workload; returns the result dict the
     harness asserts over.  ``kill_replica``/``kill_at_step`` schedule
@@ -96,7 +97,14 @@ def run_cluster_scenario(
     outage window whose sheds the journal witnesses) runs the
     recover-then-migrate path.  ``migrate_at_step`` exercises one
     operator migration of the first claim to its non-owner.
+    ``fleet_plane`` (tri-state, SVOC011 resolution) switches the fleet
+    observability plane on/off for the run; the result's ``fleet_obs``
+    section carries its snapshot, merged exposition, per-source counter
+    scrapes, sidecar paths, and accounting history — all obs-channel
+    derived, so the fleet fingerprint is byte-identical either way
+    (``make fleet-obs-smoke``).
     """
+    from svoc_tpu.obsplane.fleet import FleetPlane
     from svoc_tpu.serving.scenario import VirtualClock
     from svoc_tpu.utils import events as _events
     from svoc_tpu.utils.events import EventJournal
@@ -121,6 +129,17 @@ def run_cluster_scenario(
 
     placement = PlacementDirectory(
         [], path=os.path.join(workdir, "placement.json")
+    )
+    plane = FleetPlane(
+        enabled=fleet_plane,
+        clock=master_clock,
+        journal=journal,
+        trace_path=os.path.join(workdir, "fleet-obs.jsonl"),
+        profile_dir=os.path.join(workdir, "profiles"),
+        bundle_dir=workdir,
+        slo_latency_target_s=2.5 * step_period_s,
+        slo_fast_window_s=10 * step_period_s,
+        slo_slow_window_s=50 * step_period_s,
     )
 
     def replica_factory(rid: str) -> Replica:
@@ -151,11 +170,16 @@ def run_cluster_scenario(
         replica_factory=replica_factory,
         lineage_scope=LINEAGE_SCOPE,
         unclaimed_path=os.path.join(workdir, "unclaimed.json"),
+        fleet_plane=plane,
     )
+    obs_paths: Dict[str, str] = {
+        "router": os.path.join(workdir, "fleet-obs.jsonl")
+    }
     for rid in replica_ids:
         replica = replica_factory(rid)
         replica.install_cadence(snapshot_every)
         router.add_replica(replica)
+        obs_paths[rid] = replica.obs_path
     for cid in claim_ids:
         router.add_claim(
             ClaimSpec(claim_id=cid, n_oracles=n_oracles, dimension=dimension)
@@ -291,6 +315,27 @@ def run_cluster_scenario(
             ),
             "duplicates": len(dups),
         }
+    if plane.enabled:
+        fleet_obs: Dict[str, Any] = {
+            **plane.snapshot(),
+            "exposition": plane.render_prometheus_fleet(),
+            # The live sources exactly as the merge saw them — the
+            # smoke's merged-equals-sum witness (no-kill legs; kill
+            # legs assert monotonicity over accounting_history instead).
+            "per_source_counters": {
+                "router": metrics.counters_snapshot(),
+                "fleet": plane.registry.counters_snapshot(),
+                **{
+                    rid: router.replica(rid).metrics.counters_snapshot()
+                    for rid in router.replica_ids()
+                    if router.replica(rid).alive
+                },
+            },
+            "obs_paths": obs_paths,
+            "accounting_history": plane.accounting_history(),
+        }
+    else:
+        fleet_obs = {"enabled": False}
     return {
         "seed": seed,
         "steps": total_steps,
@@ -329,6 +374,7 @@ def run_cluster_scenario(
         "fleet_fingerprint": router.fleet_fingerprint(),
         "fault_points_fired": controller.counts(),
         "journal_events": journal.last_seq(),
+        "fleet_obs": fleet_obs,
     }
 
 

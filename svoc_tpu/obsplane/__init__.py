@@ -1,8 +1,28 @@
-"""Cost-attribution plane (docs/OBSERVABILITY.md §cost-attribution):
-per-request latency decomposition, the shape-keyed dispatch-cost
-ledger, and on-demand profiling — the telemetry substrate ROADMAP
-items 1 (fleet placement) and 2 (cost-model scheduling) consume."""
+"""Observability planes (docs/OBSERVABILITY.md): the cost-attribution
+plane (§cost-attribution — per-request latency decomposition, the
+shape-keyed dispatch-cost ledger, on-demand profiling) and the fleet
+plane (§fleet-plane — cross-replica hop-chain tracing, merged fleet
+telemetry + SLOs, seeded anomaly detection) — the telemetry substrate
+ROADMAP items 1 (fleet placement) and 2 (cost-model scheduling)
+consume."""
 
+from svoc_tpu.obsplane.anomaly import (
+    DEFAULT_ANOMALY_FAMILIES,
+    AnomalyConfig,
+    AnomalyDetector,
+)
+from svoc_tpu.obsplane.fleet import (
+    ACCOUNTING_FAMILIES,
+    FleetAggregator,
+    FleetPlane,
+    resolve_fleet_plane_enabled,
+)
+from svoc_tpu.obsplane.hopchain import (
+    HOP_REASONS,
+    HopContext,
+    chain_stats,
+    join_hop_chains,
+)
 from svoc_tpu.obsplane.ledger import (
     CostLedger,
     CostModel,
@@ -25,18 +45,29 @@ from svoc_tpu.obsplane.timeline import (
 )
 
 __all__ = [
+    "ACCOUNTING_FAMILIES",
+    "AnomalyConfig",
+    "AnomalyDetector",
     "CostLedger",
     "CostModel",
     "CostPlane",
+    "DEFAULT_ANOMALY_FAMILIES",
+    "FleetAggregator",
+    "FleetPlane",
+    "HOP_REASONS",
+    "HopContext",
     "MARKS",
     "ObservationLog",
     "ProfileCapture",
     "REQUEST_STAGE_HISTOGRAM",
     "RequestTimeline",
     "STAGE_OF_MARK",
+    "chain_stats",
     "group_key",
+    "join_hop_chains",
     "ledger_key",
     "read_observations",
     "resolve_cost_plane",
     "resolve_cost_plane_enabled",
+    "resolve_fleet_plane_enabled",
 ]

@@ -109,13 +109,28 @@ class ObservationLog:
     rotation/error-latch discipline as the tracer; its seq counter is
     its OWN — observation seqs never interleave with journal seqs."""
 
-    def __init__(self, *, max_records: int = 4096, trace_path: Optional[str] = None):
+    def __init__(
+        self,
+        *,
+        max_records: int = 4096,
+        trace_path: Optional[str] = None,
+        metrics=None,
+        owner: Optional[str] = None,
+    ):
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=max_records)
         self._seq = 0
         self._writer = None
         self._trace_path: Optional[str] = None
         self._write_error_latched = False
+        #: Attribution for the fleet plane: when ``owner`` is set, every
+        #: record the latch silences counts under
+        #: ``obs_lines_dropped{replica=owner}`` on ``metrics`` — a
+        #: truncated sidecar must be visible in the fleet scrape and in
+        #: postmortem bundles, not just as a diff of missing lines.
+        self._metrics = metrics
+        self._owner = owner
+        self._dropped = 0
         if trace_path:
             self.set_trace_file(trace_path)
 
@@ -158,7 +173,37 @@ class ObservationLog:
                 _metrics.counter("trace_write_errors").add(1)
                 with self._lock:
                     self._write_error_latched = True
+                self._count_dropped()
+        elif writer is not None and latched:
+            # Every record the latch silences is a lost export.
+            self._count_dropped()
         return rec
+
+    def _count_dropped(self) -> None:
+        with self._lock:
+            self._dropped += 1
+        if self._owner is not None and self._metrics is not None:
+            self._metrics.counter(
+                "obs_lines_dropped", labels={"replica": self._owner}
+            ).add(1)
+
+    def last_seq(self) -> int:
+        """The newest observation seq — the sidecar-truncation witness
+        postmortem bundles and ``fleet_accounting`` carry: a sidecar
+        whose tail seq lags this was cut short."""
+        with self._lock:
+            return self._seq
+
+    @property
+    def dropped(self) -> int:
+        """Records that never reached the sidecar (write-error latch)."""
+        with self._lock:
+            return self._dropped
+
+    @property
+    def write_error_latched(self) -> bool:
+        with self._lock:
+            return self._write_error_latched
 
     def recent(
         self,

@@ -1,0 +1,351 @@
+"""Fleet observability plane (docs/OBSERVABILITY.md §fleet-plane):
+hop-chain join completeness, aggregator merge math, anomaly
+determinism, replay invisibility, and retired-replica monotonicity
+over the seeded kill/failover + migrate fleet scenario."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest
+
+from svoc_tpu.obsplane.anomaly import AnomalyConfig, AnomalyDetector
+from svoc_tpu.obsplane.fleet import (
+    ACCOUNTING_FAMILIES,
+    FleetAggregator,
+)
+from svoc_tpu.obsplane.hopchain import chain_stats, join_hop_chains
+from svoc_tpu.obsplane.timeline import ObservationLog, read_observations
+from svoc_tpu.utils.metrics import MetricsRegistry
+
+# ---------------------------------------------------------------------------
+# seeded fleet scenario: plane ON and OFF, module-cached (one run each)
+# ---------------------------------------------------------------------------
+
+PLAN = dict(
+    seed=3,
+    n_replicas=3,
+    n_claims=3,
+    total_steps=8,
+    arrivals_per_step=4,
+    kill_replica="r1",
+    kill_at_step=4,
+    migrate_at_step=7,
+)
+
+
+@pytest.fixture(scope="module")
+def fleet_runs(tmp_path_factory):
+    from svoc_tpu.cluster.scenario import run_cluster_scenario
+
+    runs = {}
+    for tag, plane in (("on", True), ("off", False)):
+        workdir = str(tmp_path_factory.mktemp(f"fleet-obs-{tag}"))
+        runs[tag] = run_cluster_scenario(
+            workdir, PLAN["seed"], fleet_plane=plane,
+            **{k: v for k, v in PLAN.items() if k != "seed"},
+        )
+    return runs
+
+
+def hop_records(result):
+    recs = []
+    for path in result["fleet_obs"]["obs_paths"].values():
+        recs.extend(
+            r for r in read_observations(path) if r.get("obs") == "hop"
+        )
+    return recs
+
+
+# ---------------------------------------------------------------------------
+# replay invisibility (the tentpole gate)
+# ---------------------------------------------------------------------------
+
+
+def test_plane_invisible_to_fleet_fingerprint(fleet_runs):
+    on, off = fleet_runs["on"], fleet_runs["off"]
+    assert on["fleet_fingerprint"] == off["fleet_fingerprint"]
+    for cid, claim in on["claims"].items():
+        assert claim["fingerprint"] == off["claims"][cid]["fingerprint"]
+
+
+def test_off_run_carries_no_plane_state(fleet_runs):
+    assert fleet_runs["off"]["fleet_obs"] == {"enabled": False}
+
+
+# ---------------------------------------------------------------------------
+# hop-chain join completeness
+# ---------------------------------------------------------------------------
+
+
+def test_hop_join_gapless(fleet_runs):
+    """Every chain classifies; complete forward chains exactly equal
+    the router's cluster_forwarded counter total — no hop is invisible
+    to the cross-replica join."""
+    chains = join_hop_chains(hop_records(fleet_runs["on"]))
+    assert chains, "scenario produced no hop chains"
+    stats = chain_stats(chains)
+    classified = sum(stats["by_classification"].values())
+    assert classified == stats["chains"]
+    assert set(stats["by_classification"]) <= {
+        "complete", "terminal", "died_mid_hop"
+    }
+
+    forwarded = sum(
+        e["count"]
+        for counters in fleet_runs["on"]["fleet_obs"][
+            "per_source_counters"
+        ].values()
+        for e in counters
+        if e["name"] == "cluster_forwarded"
+    )
+    complete_forwards = sum(
+        1
+        for c in chains.values()
+        if c["reason"] == "forward" and c["classification"] == "complete"
+    )
+    assert complete_forwards == forwarded
+
+
+def test_failover_chain_joins_across_replicas(fleet_runs):
+    """The failover migration hop has BOTH sides (send on the recovery
+    stack, recv on the adopter) — the cross-replica causal edge."""
+    chains = join_hop_chains(hop_records(fleet_runs["on"]))
+    failovers = [c for c in chains.values() if c["reason"] == "failover"]
+    assert failovers
+    for c in failovers:
+        assert c["classification"] == "complete"
+        sides = {r["data"]["side"] for r in c["records"]}
+        assert {"send", "recv"} <= sides
+        assert c["src"] != c["dst"]
+
+
+def test_mid_hop_death_classification():
+    """A send with no matching recv/end is a died-mid-hop chain; an
+    answered retry keeps its dead first attempt visible."""
+    base = {"chain": "h000001", "claim": "c9", "src": "a", "dst": "b",
+            "reason": "forward"}
+    died = [{"obs": "hop", "data": {**base, "side": "send", "hop": 0}}]
+    chains = join_hop_chains(died)
+    assert chains["h000001"]["classification"] == "died_mid_hop"
+    assert chains["h000001"]["outcome"] == "lost"
+    assert chains["h000001"]["dead_attempts"] == [0]
+
+    retried = died + [
+        {"obs": "hop", "data": {**base, "side": "send", "hop": 1}},
+        {"obs": "hop", "data": {**base, "side": "recv", "hop": 1}},
+    ]
+    chains = join_hop_chains(retried)
+    assert chains["h000001"]["classification"] == "complete"
+    assert chains["h000001"]["dead_attempts"] == [0]
+    assert chains["h000001"]["outcome"] == "delivered"
+
+
+# ---------------------------------------------------------------------------
+# aggregator merge math
+# ---------------------------------------------------------------------------
+
+
+def test_merge_counters_sum_and_gauges_label():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("serving_admitted").add(3)
+    b.counter("serving_admitted").add(4)
+    a.counter("serving_shed", labels={"reason": "queue"}).add(2)
+    a.gauge("queue_depth").set(5)
+    b.gauge("queue_depth").set(7)
+
+    merged = FleetAggregator().merge({"r0": a, "r1": b})
+    assert merged.family_total("serving_admitted") == 7.0
+    shed = merged.family_series("serving_shed")
+    assert shed == [({"reason": "queue"}, 2.0)]
+    # Gauges cannot sum — one series per replica.
+    depths = {
+        tuple(sorted(lbl.items())): g.get()
+        for (key, g) in merged.gauges.items()
+        for (name, lbl) in [merged._labels.get(key, (key, {}))]
+        if name == "queue_depth"
+    }
+    assert depths == {
+        (("replica", "r0"),): 5.0,
+        (("replica", "r1"),): 7.0,
+    }
+
+
+def test_merge_histograms_bucket_wise_and_timers():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    grid = (0.1, 1.0)
+    for v in (0.05, 0.5):
+        a.histogram("latency", buckets=grid).observe(v)
+    b.histogram("latency", buckets=grid).observe(5.0)
+    a.timer("step").observe(0.2)
+    b.timer("step").observe(0.4)
+
+    merged = FleetAggregator().merge({"r0": a, "r1": b})
+    h = merged.histogram("latency", buckets=grid)
+    assert h.count == 3
+    assert h.sum == pytest.approx(5.55)
+    assert h._counts == [1, 1, 1]  # one per bucket incl. +Inf overflow
+    t = merged.timer("step")
+    assert t.n == 2
+    assert t.total_s == pytest.approx(0.6)
+    assert t.max_s == pytest.approx(0.4)
+
+
+def test_merge_histogram_grid_mismatch_keeps_replica_series():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.histogram("latency", buckets=(0.1, 1.0)).observe(0.5)
+    b.histogram("latency", buckets=(0.2, 2.0)).observe(0.5)
+
+    merged = FleetAggregator().merge({"r0": a, "r1": b})
+    # First grid wins the unlabeled series; the mismatched source is
+    # preserved under its replica label instead of corrupting bucket
+    # sums (docs/OBSERVABILITY.md §fleet-plane).
+    labeled = [
+        lbl
+        for key in merged.histograms
+        for (name, lbl) in [merged._labels.get(key, (key, {}))]
+        if name == "latency" and lbl
+    ]
+    assert {"replica": "r1"} in labeled
+
+
+def test_retired_fold_under_retired_label():
+    live = MetricsRegistry()
+    live.counter("serving_completed").add(10)
+    agg = FleetAggregator()
+    agg.retire("r1", [
+        {"name": "serving_completed", "labels": {}, "count": 6.0},
+    ])
+    merged = agg.merge({"r0": live})
+    assert merged.family_total("serving_completed") == 16.0
+    series = dict(
+        (tuple(sorted(lbl.items())), n)
+        for lbl, n in merged.family_series("serving_completed")
+    )
+    assert series[(("replica", "r1@retired"),)] == 6.0
+
+
+# ---------------------------------------------------------------------------
+# retired-replica monotonicity through the kill
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_totals_never_step_backward(fleet_runs):
+    history = fleet_runs["on"]["fleet_obs"]["accounting_history"]
+    # The scenario drives at least one step_all per planned step (the
+    # failover window adds a recovery step).
+    assert len(history) >= PLAN["total_steps"]
+    for family in ACCOUNTING_FAMILIES:
+        series = [h.get(family, 0.0) for h in history]
+        for prev, cur in zip(series, series[1:]):
+            assert cur >= prev, (
+                f"{family} stepped backward: {series}"
+            )
+
+
+def test_retired_replica_in_snapshot_and_accounting(fleet_runs):
+    snap = fleet_runs["on"]["fleet_obs"]
+    assert snap["enabled"] is True
+    assert "r1" in snap["retired"]
+    assert "r1" not in snap["sources"]
+    obs = snap["observations"]
+    assert "router" in obs
+    for acct in obs.values():
+        assert acct["records"] >= 0
+        assert acct["last_seq"] >= acct["records"]
+        assert acct["dropped"] == 0
+
+
+# ---------------------------------------------------------------------------
+# anomaly detector determinism
+# ---------------------------------------------------------------------------
+
+SERIES = [0, 0, 1, 0, 1, 9, 18, 28, 29, 30]
+
+
+def run_detector(cfg=None):
+    det = AnomalyDetector(cfg)
+    alerts = []
+    for step, total in enumerate(SERIES):
+        alerts.extend(det.on_step(step, {("r0", "serving_shed"): total}))
+    return det, alerts
+
+
+def test_anomaly_deterministic_and_sustained():
+    _, first = run_detector()
+    _, second = run_detector()
+    assert first == second
+    assert first, "the step series must breach"
+    sustained = [a for a in first if a["sustained"]]
+    assert len(sustained) == 1
+    assert sustained[0]["streak"] == AnomalyConfig().sustain_steps
+    # Streaks keep counting past the sustained edge.
+    assert max(a["streak"] for a in first) > sustained[0]["streak"]
+
+
+def test_anomaly_breaches_not_absorbed():
+    """A breach must not teach the baseline that shedding is normal:
+    the EWMA mean is identical before and after the breach step."""
+    det = AnomalyDetector()
+    for step, total in enumerate(SERIES[:5]):
+        det.on_step(step, {("r0", "serving_shed"): total})
+    state = det._series[("r0", "serving_shed")]
+    mean_before = state.mean
+    alerts = det.on_step(5, {("r0", "serving_shed"): SERIES[5]})
+    assert alerts and alerts[0]["trigger"] == "z"
+    assert state.mean == mean_before
+
+
+def test_anomaly_guardrail_always_armed():
+    cfg = AnomalyConfig(guardrails={"serving_shed": 4.0})
+    det = AnomalyDetector(cfg)
+    det.on_step(0, {("r0", "serving_shed"): 0})
+    alerts = det.on_step(1, {("r0", "serving_shed"): 5})
+    assert alerts and alerts[0]["trigger"] == "guardrail"
+
+
+def test_anomaly_quiet_on_healthy_scenario(fleet_runs):
+    """The small seeded plan degrades gently (deltas under min_delta's
+    reach of the learned baseline) — no SUSTAINED page, so the smoke's
+    dedicated degradation leg is what exercises the trigger chain."""
+    snap = fleet_runs["on"]["fleet_obs"]
+    sustained = [a for a in snap["recent_anomalies"] if a["sustained"]]
+    assert not sustained
+    assert snap["bundles"] == []
+
+
+# ---------------------------------------------------------------------------
+# observation-channel loss accounting
+# ---------------------------------------------------------------------------
+
+
+def test_obs_lines_dropped_latch_and_counter(tmp_path):
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("")
+    metrics = MetricsRegistry()
+    log = ObservationLog(
+        trace_path=str(blocker / "obs.jsonl"),
+        metrics=metrics,
+        owner="r9",
+    )
+    log.record("probe", n=1)
+    assert log.write_error_latched
+    log.record("probe", n=2)
+    assert log.dropped >= 2
+    series = dict(
+        (tuple(sorted(lbl.items())), n)
+        for lbl, n in metrics.family_series("obs_lines_dropped")
+    )
+    assert series[(("replica", "r9"),)] == float(log.dropped)
+    # The ring keeps every record the sidecar lost.
+    assert log.last_seq() == 2
+    assert len(log.recent(10)) == 2
+
+
+def test_fleet_accounting_carries_observations(fleet_runs):
+    acct = fleet_runs["on"]["fleet_obs"]
+    live = acct["observations"]
+    assert set(live) >= {"router", "r0", "r2"}
+    exposition = acct["exposition"]
+    assert "svoc_serving_admitted_total" in exposition
+    assert 'replica="r1@retired"' in exposition

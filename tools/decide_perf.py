@@ -406,7 +406,8 @@ def hotpath_commit_decision(grid):
 
 def load_obs_grid(path):
     """Load the cost-plane overhead A/B artifact
-    (``BENCH_OBS_r10.json``: a flat ``{"checks", "arms",
+    (``BENCH_OBS_r12.json``, falling back to the pre-fleet-arm
+    ``BENCH_OBS_r10.json``: a flat ``{"checks", "arms",
     "p99_overhead", ...}`` record) or None when absent/malformed — the
     same shape-tolerant contract as :func:`load_hotpath_grid`."""
     try:
@@ -745,7 +746,10 @@ def main(argv=None) -> int:
         coldstart_grid=load_coldstart_grid(
             os.path.join(REPO, "BENCH_COLDSTART_r09.json")
         ),
-        obs_grid=load_obs_grid(os.path.join(REPO, "BENCH_OBS_r10.json")),
+        obs_grid=(
+            load_obs_grid(os.path.join(REPO, "BENCH_OBS_r12.json"))
+            or load_obs_grid(os.path.join(REPO, "BENCH_OBS_r10.json"))
+        ),
         cluster_grid=load_grid(os.path.join(REPO, "BENCH_CLUSTER_r11.json")),
     )
     if (

@@ -23,6 +23,16 @@ Many files = many processes: each file is tagged with a source label
 that happened to share a ``lineage_scope`` stay disambiguated per
 file.
 
+``--fleet`` switches to the fleet view (docs/OBSERVABILITY.md
+§fleet-plane): every ``hop``-keyed observation record across the given
+sidecars (the router's ``fleet-obs.jsonl`` + each replica's
+``obs*.jsonl``) joins into cross-replica causal chains via
+:func:`~svoc_tpu.obsplane.hopchain.join_hop_chains` — per-chain
+timelines with send/recv/end sides, classification (``complete`` /
+``terminal`` / ``died_mid_hop``), and a classification/reason summary.
+A chain whose ``send`` has no answer is a mid-hop death: the origin's
+sidecar is the only witness the request ever left.
+
 Everything prints human-readable by default; ``--json`` emits one
 machine-readable document (the smoke gate's round-trip check).
 """
@@ -200,9 +210,107 @@ def reconstruct_ledger(sources, alpha=DEFAULT_ALPHA):
     return ledgers
 
 
+def fleet_view(sources):
+    """Join every ``hop`` observation across the sources into chains
+    + summary stats (the ``--fleet`` document)."""
+    from svoc_tpu.obsplane.hopchain import chain_stats, join_hop_chains
+
+    hops = []
+    other = {}
+    for _tag, records in sources:
+        for rec in records:
+            if rec["_shape"] != "obs":
+                continue
+            if rec.get("obs") == "hop":
+                hops.append(rec)
+            else:
+                kind = rec.get("obs")
+                other[kind] = other.get(kind, 0) + 1
+    chains = join_hop_chains(hops)
+    return {
+        "chains": {
+            cid: {
+                "claim": c["claim"],
+                "lineage": c["lineage"],
+                "reason": c["reason"],
+                "src": c["src"],
+                "dst": c["dst"],
+                "classification": c["classification"],
+                "outcome": c["outcome"],
+                "attempts": c["attempts"],
+                "dead_attempts": c["dead_attempts"],
+                "records": [
+                    {
+                        "side": r["data"].get("side"),
+                        "hop": r["data"].get("hop"),
+                        **{
+                            k: v
+                            for k, v in r["data"].items()
+                            if k
+                            not in (
+                                "side",
+                                "hop",
+                                "chain",
+                                "claim",
+                                "src",
+                                "dst",
+                                "reason",
+                            )
+                        },
+                    }
+                    for r in c["records"]
+                ],
+            }
+            for cid, c in sorted(chains.items())
+        },
+        "stats": chain_stats(chains),
+        "other_observations": other,
+    }
+
+
+def print_fleet(doc) -> None:
+    stats = doc["stats"]
+    print(
+        f"{stats['chains']} hop chain(s), "
+        f"{stats['dead_attempts']} dead attempt(s)"
+    )
+    for cls, n in sorted(stats["by_classification"].items()):
+        print(f"  {cls:<14} {n}")
+    print("by reason:")
+    for reason, n in sorted(stats["by_reason"].items()):
+        print(f"  {reason:<14} {n}")
+    for cid, c in doc["chains"].items():
+        if c["classification"] == "complete" and c["reason"] == "forward":
+            continue  # routine; only the interesting chains narrate
+        line = (
+            f"{cid} {c['reason']} {c['src']}->{c['dst']} "
+            f"claim={c['claim']} [{c['classification']}]"
+        )
+        if c["outcome"]:
+            line += f" outcome={c['outcome']}"
+        if c["dead_attempts"]:
+            line += f" dead_attempts={c['dead_attempts']}"
+        print(line)
+        for r in c["records"]:
+            extras = ", ".join(
+                f"{k}={v}" for k, v in sorted(r.items()) if k not in ("side", "hop")
+            )
+            print(f"    hop {r['hop']} {r['side']}" + (f" ({extras})" if extras else ""))
+    if doc["other_observations"]:
+        print("other observations:")
+        for kind, n in sorted(doc["other_observations"].items()):
+            print(f"  {kind:<20} {n}")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("files", nargs="+", help="trace JSONL file(s)")
+    parser.add_argument(
+        "--fleet",
+        action="store_true",
+        help="join hop chains across the given observation sidecars "
+        "(docs/OBSERVABILITY.md §fleet-plane)",
+    )
     parser.add_argument(
         "--tag",
         action="append",
@@ -234,6 +342,15 @@ def main(argv=None) -> int:
         tags[path] = name
 
     sources = load_sources(args.files, tags)
+    if args.fleet:
+        doc = fleet_view(sources)
+        if args.as_json:
+            print(json.dumps(doc, indent=1, sort_keys=True))
+        else:
+            for tag, records in sources:
+                print(f"source {tag}: {len(records)} records")
+            print_fleet(doc)
+        return 0
     timelines = build_timelines(sources, merge_scopes=args.merge_scopes)
     if args.lineage:
         timelines = {
